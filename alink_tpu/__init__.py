@@ -1,0 +1,16 @@
+"""alink_tpu — a TPU-native distributed ML platform.
+
+A ground-up JAX/XLA re-design of the capabilities of ZhangYuef/Alink
+(Alibaba PAI's Flink-based ML platform): operator DAGs, sklearn-style
+pipelines, a BSP iterative-compute engine with XLA collectives, ~full
+classical-ML algorithm coverage, online learning, and evaluation —
+with Flink task slots replaced by a `jax.sharding.Mesh` of TPU chips.
+"""
+
+__version__ = "0.1.0"
+
+from .common import (Params, ParamInfo, WithParams, AlinkTypes, TableSchema,
+                     DenseVector, SparseVector, VectorUtil, SparseBatch, DenseMatrix,
+                     MTable, MLEnvironment, MLEnvironmentFactory, use_local_env)
+from .engine import (IterativeComQueue, ComContext, ComputeFunction, AllReduce,
+                     AllGather, BroadcastFromWorker0)

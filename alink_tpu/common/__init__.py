@@ -1,0 +1,14 @@
+from .params import Params, ParamInfo, WithParams, RangeValidator, InValidator, MinValidator
+from .types import AlinkTypes, TableSchema
+from .vector import (DenseVector, SparseVector, Vector, VectorUtil, SparseBatch,
+                     DenseMatrix)
+from .mtable import MTable
+from .mlenv import MLEnvironment, MLEnvironmentFactory, use_local_env
+from .lazy import LazyEvaluation, LazyObjectsManager
+
+__all__ = [
+    "Params", "ParamInfo", "WithParams", "RangeValidator", "InValidator", "MinValidator",
+    "AlinkTypes", "TableSchema", "DenseVector", "SparseVector", "Vector", "VectorUtil",
+    "SparseBatch", "DenseMatrix", "MTable", "MLEnvironment", "MLEnvironmentFactory",
+    "use_local_env", "LazyEvaluation", "LazyObjectsManager",
+]

@@ -1,0 +1,68 @@
+"""Lazy evaluation / deferred-callback plumbing.
+
+Re-design of the reference's introspection framework
+(common/lazy/LazyEvaluation.java:17-60 — Rx ReplaySubject callbacks;
+common/lazy/LazyObjectsManager.java:23-75 — session-scoped registry;
+BatchOperator.triggerLazyEvaluation, batch/BatchOperator.java:497-547).
+
+Here operators compute eagerly (XLA jit replaces the deferred Flink job),
+but the *callback* contract is kept: ``lazy_print``/``lazy_collect`` register
+consumers that fire when ``execute()`` runs (or immediately if a value was
+already materialized by an earlier execute).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+
+class LazyEvaluation:
+    """Holds a future value plus callbacks; replays value to late subscribers."""
+
+    def __init__(self):
+        self._callbacks: List[Callable[[Any], None]] = []
+        self._has_value = False
+        self._value = None
+        self._fired = False
+
+    def add_callback(self, cb: Callable[[Any], None]):
+        self._callbacks.append(cb)
+        if self._has_value and self._fired:
+            cb(self._value)
+
+    def add_value(self, value):
+        self._has_value = True
+        self._value = value
+
+    def fire(self):
+        if not self._has_value:
+            return
+        self._fired = True
+        for cb in self._callbacks:
+            cb(self._value)
+        self._callbacks = []
+
+    @property
+    def value(self):
+        if not self._has_value:
+            raise RuntimeError("lazy value not materialized; call execute() first")
+        return self._value
+
+
+class LazyObjectsManager:
+    """Per-session registry of pending LazyEvaluations keyed by (op, tag)."""
+
+    def __init__(self):
+        self._lazy: Dict[Any, LazyEvaluation] = {}
+
+    def gen_lazy(self, key) -> LazyEvaluation:
+        if key not in self._lazy:
+            self._lazy[key] = LazyEvaluation()
+        return self._lazy[key]
+
+    def fire_all(self):
+        for lazy in list(self._lazy.values()):
+            lazy.fire()
+
+    def clear(self):
+        self._lazy.clear()

@@ -1,0 +1,138 @@
+"""Session / environment layer.
+
+Re-design of ``MLEnvironment`` / ``MLEnvironmentFactory``
+(common/MLEnvironment.java:38-44,115-138; common/MLEnvironmentFactory.java:42-90).
+
+The reference session holds Flink batch+stream execution environments sized
+to the local cores. The TPU-native session instead holds a
+``jax.sharding.Mesh``: the data axis ``'d'`` replaces Flink task slots
+(BatchOperator partitions map 1:1 to chips — BASELINE.json north star), and
+an optional model axis ``'m'`` carries feature-sharded state (FTRL-style
+tensor parallelism, SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .lazy import LazyObjectsManager
+
+
+class MLEnvironment:
+    """One session: device mesh + lazy-objects manager + RNG seed stream."""
+
+    def __init__(self, parallelism: Optional[int] = None, model_parallelism: int = 1,
+                 devices=None):
+        import jax
+
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+        if parallelism is None:
+            parallelism = max(1, n // model_parallelism)
+        total = parallelism * model_parallelism
+        if total > n:
+            raise ValueError(
+                f"requested {parallelism}x{model_parallelism} devices but only {n} available")
+        self._devices = devices[:total]
+        self.parallelism = parallelism
+        self.model_parallelism = model_parallelism
+        self._mesh = None
+        self.lazy_objects_manager = LazyObjectsManager()
+        self._seed_counter = 0
+
+    @property
+    def mesh(self):
+        from jax.sharding import Mesh
+        if self._mesh is None:
+            arr = np.asarray(self._devices).reshape(self.parallelism, self.model_parallelism)
+            self._mesh = Mesh(arr, ("d", "m"))
+        return self._mesh
+
+    @property
+    def num_workers(self) -> int:
+        """Flink parallelism analogue: number of data-axis shards."""
+        return self.parallelism
+
+    def next_seed(self) -> int:
+        self._seed_counter += 1
+        return self._seed_counter
+
+    def data_sharding(self, *extra_axes):
+        """NamedSharding that shards dim 0 along 'd' and replicates the rest."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P("d", *extra_axes))
+
+    def replicated_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P())
+
+
+class MLEnvironmentFactory:
+    """id -> MLEnvironment registry (reference MLEnvironmentFactory.java:42-90)."""
+
+    DEFAULT_ML_ENVIRONMENT_ID = 0
+    _lock = threading.Lock()
+    _map: Dict[int, MLEnvironment] = {}
+    _next_id = 1
+
+    @classmethod
+    def get(cls, session_id: int) -> MLEnvironment:
+        with cls._lock:
+            if session_id not in cls._map:
+                if session_id == cls.DEFAULT_ML_ENVIRONMENT_ID:
+                    cls._map[session_id] = MLEnvironment()
+                else:
+                    raise KeyError(
+                        f"Cannot find MLEnvironment for id {session_id}; "
+                        "call get_new_ml_environment_id()/set_default first.")
+            return cls._map[session_id]
+
+    @classmethod
+    def get_default(cls) -> MLEnvironment:
+        return cls.get(cls.DEFAULT_ML_ENVIRONMENT_ID)
+
+    @classmethod
+    def set_default(cls, env: MLEnvironment):
+        with cls._lock:
+            cls._map[cls.DEFAULT_ML_ENVIRONMENT_ID] = env
+
+    @classmethod
+    def get_new_ml_environment_id(cls) -> int:
+        with cls._lock:
+            sid = cls._next_id
+            cls._next_id += 1
+            cls._map[sid] = MLEnvironment()
+            return sid
+
+    @classmethod
+    def register(cls, env: MLEnvironment) -> int:
+        with cls._lock:
+            sid = cls._next_id
+            cls._next_id += 1
+            cls._map[sid] = env
+            return sid
+
+    @classmethod
+    def remove(cls, session_id: int) -> Optional[MLEnvironment]:
+        with cls._lock:
+            if session_id == cls.DEFAULT_ML_ENVIRONMENT_ID:
+                return cls._map.get(session_id)
+            return cls._map.pop(session_id, None)
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._map.clear()
+            cls._next_id = 1
+
+
+def use_local_env(parallelism: Optional[int] = None, model_parallelism: int = 1) -> MLEnvironment:
+    """PyAlink-style entry (reference README.md:49-58 ``useLocalEnv``)."""
+    env = MLEnvironment(parallelism=parallelism, model_parallelism=model_parallelism)
+    MLEnvironmentFactory.set_default(env)
+    return env

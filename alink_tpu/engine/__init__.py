@@ -1,0 +1,11 @@
+from .context import ComContext
+from .comqueue import IterativeComQueue, ComputeFunction, ComQueueResult
+from .communication import (AllReduce, AllGather, BroadcastFromWorker0,
+                            CommunicateFunction, distributed_info_start,
+                            distributed_info_count)
+
+__all__ = [
+    "ComContext", "IterativeComQueue", "ComputeFunction", "ComQueueResult",
+    "AllReduce", "AllGather", "BroadcastFromWorker0", "CommunicateFunction",
+    "distributed_info_start", "distributed_info_count",
+]

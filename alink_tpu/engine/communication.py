@@ -1,0 +1,121 @@
+"""Communicate stages — XLA collectives over the device mesh.
+
+The reference implements MPI-style primitives by hand over Flink shuffles:
+  - AllReduce: 3-phase scatter(4096-chunk)/reduce/broadcast over two
+    ``partitionCustom`` shuffles (communication/AllReduce.java:85-360).
+  - broadcast: ``withBroadcastSet`` replication (BaseComQueue.java:337-369).
+Here each primitive is ONE XLA collective over the ICI mesh (SURVEY §2.4):
+psum / pmax / pmin / all_gather / ppermute. Chunking, routing and reassembly
+belong to the compiler.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .context import ComContext
+
+
+class CommunicateFunction:
+    """Marker base (reference comqueue/CommunicateFunction.java)."""
+
+    def calc(self, context: ComContext):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class AllReduce(CommunicateFunction):
+    """All-reduce named carry buffers across workers.
+
+    reference: communication/AllReduce.java:85-120 (SUM/MAX/MIN ops :125-159).
+    ``lax.psum`` rides the ICI; the reference's TRANSFER_BUFFER_SIZE=4096
+    chunking machinery has no analogue here.
+    """
+
+    OPS = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin}
+
+    def __init__(self, *buffer_names: str, op: str = "sum",
+                 mean: bool = False):
+        if not buffer_names:
+            raise ValueError("AllReduce needs at least one buffer name")
+        self.buffer_names = buffer_names
+        if op.lower() not in self.OPS:
+            raise ValueError(f"unsupported allreduce op {op}; use sum/max/min")
+        self.op = op.lower()
+        self.mean = mean
+
+    def calc(self, context: ComContext):
+        fn = self.OPS[self.op]
+        for name in self.buffer_names:
+            v = context.get_obj(name)
+            out = jax.tree_util.tree_map(lambda x: fn(x, ComContext.AXIS), v)
+            if self.mean:
+                out = jax.tree_util.tree_map(lambda x: x / context.num_task, out)
+            context.put_obj(name, out)
+
+
+class AllGather(CommunicateFunction):
+    """Gather per-worker arrays into a replicated stacked array.
+
+    The ALS "factor all-gather" primitive (SURVEY §2.3 block parallelism);
+    result shape: (num_workers, *shard_shape), stored under
+    ``<name><suffix>``.
+    """
+
+    def __init__(self, *buffer_names: str, suffix: str = "_gathered", axis: int = 0,
+                 tiled: bool = False):
+        self.buffer_names = buffer_names
+        self.suffix = suffix
+        self.axis = axis
+        self.tiled = tiled
+
+    def calc(self, context: ComContext):
+        for name in self.buffer_names:
+            v = context.get_obj(name)
+            out = jax.tree_util.tree_map(
+                lambda x: jax.lax.all_gather(x, ComContext.AXIS, axis=self.axis,
+                                             tiled=self.tiled), v)
+            context.put_obj(name + self.suffix, out)
+
+
+class BroadcastFromWorker0(CommunicateFunction):
+    """Replicate worker 0's value of a buffer to all workers.
+
+    reference: the node-0 criterion rebroadcast pattern (BaseComQueue.java:242-304).
+    """
+
+    def __init__(self, *buffer_names: str):
+        self.buffer_names = buffer_names
+
+    def calc(self, context: ComContext):
+        tid = context.task_id
+        for name in self.buffer_names:
+            v = context.get_obj(name)
+
+            def bcast(x):
+                x = jnp.where(tid == 0, x, jnp.zeros_like(x))
+                return jax.lax.psum(x, ComContext.AXIS)
+
+            context.put_obj(name, jax.tree_util.tree_map(bcast, v))
+
+
+def distributed_info_start(total, task_id, num_tasks):
+    """Start offset of ``task_id``'s slice of ``total`` items.
+
+    reference: DefaultDistributedInfo.startPos (io/directreader/) — first
+    ``total % n`` workers get one extra item. Traceable arithmetic.
+    """
+    total = jnp.asarray(total)
+    base = total // num_tasks
+    rem = total % num_tasks
+    return task_id * base + jnp.minimum(task_id, rem)
+
+
+def distributed_info_count(total, task_id, num_tasks):
+    """Length of ``task_id``'s slice (DefaultDistributedInfo.localRowCnt)."""
+    total = jnp.asarray(total)
+    base = total // num_tasks
+    rem = total % num_tasks
+    return base + (task_id < rem).astype(total.dtype)

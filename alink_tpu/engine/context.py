@@ -1,0 +1,84 @@
+"""ComContext — the per-worker state handle inside a superstep.
+
+Re-design of the reference ``ComContext`` (common/comqueue/ComContext.java:52-65):
+there, ``getObj/putObj`` hit a static per-TaskManager heap map keyed by
+(handle, taskId). Here the backing store is an explicit functional **carry
+pytree** traced through ``lax.while_loop`` (SURVEY §7 "hard parts": every
+putObj key becomes a carry entry), plus a read-only dict of device-resident
+partitioned/broadcast data (the ``SessionSharedObjs`` cache analogue,
+comqueue/SessionSharedObjs.java:157-178).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+class ComContext:
+    AXIS = "d"
+
+    def __init__(self, carry: Dict[str, Any], static: Dict[str, Any],
+                 num_workers: int, init_pass: bool):
+        self._carry = dict(carry)
+        self._static = static
+        self._num_workers = num_workers
+        self._init_pass = init_pass
+
+    # -- identity --------------------------------------------------------
+    @property
+    def task_id(self):
+        """Worker index along the data mesh axis (Flink getTaskId analogue)."""
+        return jax.lax.axis_index(self.AXIS)
+
+    @property
+    def num_task(self) -> int:
+        return self._num_workers
+
+    @property
+    def step_no(self):
+        """1-based superstep number (reference ComContext.getStepNo)."""
+        return self._carry["__step"]
+
+    @property
+    def is_init_step(self) -> bool:
+        """True only during the (un-traced-step) first superstep pass.
+
+        Replaces the reference's ``if (context.getStepNo() == 1)`` allocation
+        idiom: allocation must happen where the carry structure is being
+        built, i.e. the init pass.
+        """
+        return self._init_pass
+
+    # -- state -----------------------------------------------------------
+    def get_obj(self, name: str):
+        if name in self._carry:
+            return self._carry[name]
+        if name in self._static:
+            return self._static[name]
+        raise KeyError(f"ComContext: no object '{name}' "
+                       f"(carry keys: {sorted(self._carry)}, "
+                       f"static keys: {sorted(self._static)})")
+
+    def put_obj(self, name: str, value):
+        if name in self._static:
+            raise ValueError(f"'{name}' is immutable partitioned/broadcast data")
+        self._carry[name] = value
+
+    def contains_obj(self, name: str) -> bool:
+        return name in self._carry or name in self._static
+
+    def remove_obj(self, name: str):
+        self._carry.pop(name, None)
+
+    # -- randomness ------------------------------------------------------
+    def rng_key(self):
+        """Per-worker, per-step PRNG key (mini-batch SGD sampling etc.)."""
+        key = self._carry["__key"]
+        return jax.random.fold_in(jax.random.fold_in(key, self.step_no), self.task_id)
+
+    @property
+    def carry(self) -> Dict[str, Any]:
+        return self._carry

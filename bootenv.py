@@ -1,0 +1,22 @@
+"""Early pytest plugin (loaded via ``addopts = -p bootenv`` in pytest.ini).
+
+Re-execs the test process with a CPU 8-device JAX environment BEFORE pytest
+installs fd capture (so child output reaches the terminal) and before any
+jax backend is touched. Needed because the container's sitecustomize
+registers the TPU backend in every python process and XLA flags latch at
+backend init. See tests/conftest.py for the rationale of the 8-device mesh.
+"""
+
+import os
+import sys
+
+_MARK = "ALINK_TPU_TEST_ENV"
+
+if os.environ.get(_MARK) != "1":
+    env = dict(os.environ)
+    env[_MARK] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("ALINK_TPU_EXTRA_XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8").strip()
+    env["PALLAS_AXON_POOL_IPS"] = ""  # disable axon sitecustomize TPU hook
+    os.execvpe(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)

@@ -1,0 +1,32 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's "distributed without a cluster" strategy (SURVEY §4):
+Alink tests run on a Flink local mini-cluster whose parallel subtasks are
+threads in one JVM; we run on 8 virtual CPU devices in one process
+(``--xla_force_host_platform_device_count=8``), so collectives, supersteps
+and sharding get real multi-worker semantics.
+
+The container's sitecustomize registers the TPU backend before any test code
+runs, and XLA flags are latched at backend init — so the process is re-exec'd
+with a scrubbed CPU environment by the early plugin ``bootenv.py`` (repo
+root, loaded via pytest.ini ``addopts = -p bootenv`` before fd capture
+starts).
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _default_env():
+    import jax
+    assert len(jax.devices()) == 8, f"expected 8 CPU devices, got {jax.devices()}"
+    from alink_tpu.common.mlenv import MLEnvironmentFactory, use_local_env
+    use_local_env(parallelism=8)
+    yield
+    MLEnvironmentFactory.reset()
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(2026)

@@ -1,0 +1,127 @@
+"""Common-layer tests: params, vectors, MTable, schema."""
+
+import json
+
+import numpy as np
+import pytest
+
+from alink_tpu.common import (Params, ParamInfo, WithParams, RangeValidator,
+                              DenseVector, SparseVector, VectorUtil, SparseBatch,
+                              MTable, TableSchema, AlinkTypes, DenseMatrix)
+
+
+class HasMaxIter:
+    MAX_ITER = ParamInfo("max_iter", int, "max iterations", default=100,
+                         validator=RangeValidator(1, None))
+
+
+class HasLearningRate:
+    LEARNING_RATE = ParamInfo("learningRate", float, default=0.1)
+
+
+class DemoOp(WithParams, HasMaxIter, HasLearningRate):
+    pass
+
+
+def test_params_fluent_and_defaults():
+    op = DemoOp()
+    assert op.get_max_iter() == 100
+    op.set_max_iter(7).set_learning_rate(0.5)
+    assert op.get_max_iter() == 7
+    assert op.get_learning_rate() == 0.5
+
+
+def test_params_kwargs_and_aliases():
+    op = DemoOp(maxIter=3, learning_rate=0.2)
+    assert op.get_max_iter() == 3
+    assert op.get_learning_rate() == 0.2
+    with pytest.raises(TypeError):
+        DemoOp(nope=1)
+
+
+def test_params_validator():
+    with pytest.raises(ValueError):
+        DemoOp().set_max_iter(0)
+
+
+def test_params_json_roundtrip():
+    p = Params({"a": 1, "b": [1, 2], "c": "x"})
+    q = Params.from_json(p.to_json())
+    assert q == p
+    assert json.loads(p.to_json())["a"] == 1
+
+
+def test_dense_vector():
+    v = DenseVector([1.0, 2.0, 3.0])
+    assert v.size() == 3
+    assert v.dot(DenseVector([1, 1, 1])) == 6.0
+    assert v.norm_l1() == 6.0
+    assert v.prefix(0.5).get(0) == 0.5
+    assert VectorUtil.parse(VectorUtil.to_string(v)) == v
+
+
+def test_sparse_vector():
+    s = SparseVector(5, [3, 1], [30.0, 10.0])
+    assert s.get(1) == 10.0 and s.get(0) == 0.0
+    assert list(s.indices) == [1, 3]  # sorted
+    d = s.to_dense()
+    assert d.get(3) == 30.0
+    assert s.dot(DenseVector([1, 1, 1, 1, 1])) == 40.0
+    assert s.dot(SparseVector(5, [1, 2], [2.0, 9.0])) == 20.0
+    # "$size$i:v" format (reference VectorUtil)
+    assert VectorUtil.to_string(s) == "$5$1:10.0 3:30.0"
+    assert VectorUtil.parse("$5$1:10.0 3:30.0") == s
+    assert VectorUtil.parse("1:10.0 3:30.0").n == -1
+
+
+def test_sparse_batch_padded_coo():
+    vecs = [SparseVector(6, [0, 4], [1.0, 2.0]), SparseVector(6, [5], [3.0]),
+            DenseVector([1, 1, 1, 0, 0, 0])]
+    b = SparseBatch.from_vectors(vecs)
+    assert b.n_cols == 6 and b.n_rows == 3 and b.max_nnz == 6
+    dense = b.to_dense()
+    assert dense[0, 4] == 2.0 and dense[1, 5] == 3.0 and dense[2, :3].sum() == 3.0
+    # padded slots contribute 0 to dot products
+    w = np.arange(6.0)
+    assert np.allclose((b.values * w[b.indices]).sum(-1), dense @ w)
+    b2 = b.pad_rows(8)
+    assert b2.n_rows == 8 and b2.to_dense()[3:].sum() == 0
+
+
+def test_mtable_basics():
+    t = MTable({"f0": [1.0, 2.0, 3.0], "label": ["a", "b", "a"]})
+    assert t.num_rows == 3
+    assert t.col_types == ["DOUBLE", "STRING"]
+    assert list(t.select("f0").col("f0")) == [1.0, 2.0, 3.0]
+    assert t.filter_mask(t["f0"] > 1.5).num_rows == 2
+    assert t.order_by("f0", ascending=False).row(0)[0] == 3.0
+    t2 = t.add_column("g", [9, 9, 9])
+    assert t2.schema.type_of("g") == "LONG"
+    assert t.concat_rows(t).num_rows == 6
+    groups = t.group_indices(["label"])
+    assert sorted(len(v) for v in groups.values()) == [1, 2]
+
+
+def test_mtable_rows_and_schema_parse():
+    schema = TableSchema.parse("x DOUBLE, name STRING")
+    t = MTable([(1.0, "a"), (2.0, "b")], schema)
+    assert t.row(1) == (2.0, "b")
+    assert schema.to_spec() == "x DOUBLE, name STRING"
+    rt = MTable.from_json_rows(t.to_json_rows())
+    assert rt.to_rows() == t.to_rows()
+
+
+def test_mtable_vector_column():
+    vecs = [DenseVector([1, 2]), DenseVector([3, 4])]
+    t = MTable({"vec": vecs, "y": [0.0, 1.0]})
+    assert t.schema.type_of("vec") == AlinkTypes.DENSE_VECTOR
+    rt = MTable.from_json_rows(t.to_json_rows())
+    assert rt.col("vec")[1] == vecs[1]
+
+
+def test_dense_matrix():
+    m = DenseMatrix(data=[[2.0, 0.0], [0.0, 4.0]])
+    v = m.multiplies(DenseVector([1.0, 1.0]))
+    assert list(v.data) == [2.0, 4.0]
+    sol = m.solve(DenseVector([2.0, 8.0]))
+    assert np.allclose(sol.data, [1.0, 2.0])
