@@ -113,6 +113,8 @@ class MTable:
 
     def take_rows(self, idx) -> "MTable":
         idx = np.asarray(idx)
+        if idx.dtype != bool:
+            idx = idx.astype(np.intp)
         return MTable({n: c[idx] for n, c in self._cols.items()}, self.schema)
 
     def first_n(self, n: int) -> "MTable":
@@ -221,6 +223,8 @@ class MTable:
             for v, t in zip(r, schema.types):
                 if v is not None and AlinkTypes.is_vector(t):
                     v = VectorUtil.parse(v)
+                elif v is not None and t == AlinkTypes.M_TABLE:
+                    v = MTable.from_json_rows(v)
                 out.append(v)
             rows.append(tuple(out))
         return MTable(rows, schema)

@@ -236,10 +236,17 @@ class _WithParamsMeta(type):
         for pname, info in infos.items():
             setter = f"set_{pname}"
             getter = f"get_{pname}"
-            if setter not in ns and not hasattr(cls, setter):
-                setattr(cls, setter, mcls._make_setter(info))
-            if getter not in ns and not hasattr(cls, getter):
-                setattr(cls, getter, mcls._make_getter(info))
+            # regenerate inherited accessors so a subclass overriding a
+            # ParamInfo (the Has*DefaultAsN pattern) binds its own info;
+            # hand-written methods (no _param_info tag) always win.
+            for attr, make in ((setter, mcls._make_setter), (getter, mcls._make_getter)):
+                if attr in ns:
+                    continue
+                existing = getattr(cls, attr, None)
+                existing_info = getattr(existing, "_param_info", None)
+                if existing is None or (existing_info is not None
+                                        and existing_info is not info):
+                    setattr(cls, attr, make(info))
         return cls
 
     @staticmethod
@@ -249,6 +256,7 @@ class _WithParamsMeta(type):
             return self
         _set.__name__ = f"set_{info.name}"
         _set.__doc__ = info.description
+        _set._param_info = info
         return _set
 
     @staticmethod
@@ -257,6 +265,7 @@ class _WithParamsMeta(type):
             return self.params.get(info)
         _get.__name__ = f"get_{info.name}"
         _get.__doc__ = info.description
+        _get._param_info = info
         return _get
 
 

@@ -60,6 +60,9 @@ class AlinkTypes:
             return cls.SPARSE_VECTOR
         if isinstance(v, np.ndarray) and v.ndim == 1:
             return cls.DENSE_VECTOR
+        from .mtable import MTable
+        if isinstance(v, MTable):
+            return cls.M_TABLE
         return cls.ANY
 
     @classmethod

@@ -302,14 +302,17 @@ class SparseBatch:
         idx = np.zeros((n, max_nnz), dtype=np.int32)
         val = np.zeros((n, max_nnz), dtype=dtype)
         for i, r in enumerate(rows):
+            nnz = r.size() if isinstance(r, DenseVector) else r.number_of_values()
+            if nnz > max_nnz:
+                raise ValueError(
+                    f"row {i} has {nnz} nonzeros > max_nnz={max_nnz}; "
+                    "raise max_nnz (truncation would corrupt the batch)")
             if isinstance(r, DenseVector):
-                nnz = min(r.size(), max_nnz)
                 idx[i, :nnz] = np.arange(nnz)
-                val[i, :nnz] = r.data[:nnz]
+                val[i, :nnz] = r.data
             else:
-                nnz = min(r.number_of_values(), max_nnz)
-                idx[i, :nnz] = r.indices[:nnz]
-                val[i, :nnz] = r.values[:nnz]
+                idx[i, :nnz] = r.indices
+                val[i, :nnz] = r.values
         return SparseBatch(idx, val, n_cols)
 
     def to_dense(self, dtype=np.float32) -> np.ndarray:

@@ -44,6 +44,8 @@ class AllReduce(CommunicateFunction):
         if op.lower() not in self.OPS:
             raise ValueError(f"unsupported allreduce op {op}; use sum/max/min")
         self.op = op.lower()
+        if mean and self.op != "sum":
+            raise ValueError("mean=True only makes sense with op='sum'")
         self.mean = mean
 
     def calc(self, context: ComContext):

@@ -78,10 +78,19 @@ class ComQueueResult:
         import jax
         return jax.tree_util.tree_map(lambda x: np.asarray(x)[0], self._stacked[name])
 
-    def concat(self, name: str):
-        """Concatenate per-worker shards along their axis 0 (departitioning)."""
+    def concat(self, name: str, total: Optional[int] = None):
+        """Concatenate per-worker shards along axis 0 (departitioning).
+
+        Zero-padding added by ``init_with_partitioned_data`` sits at the end
+        of the global order, so per-row outputs aligned with a partitioned
+        input can be trimmed with ``total`` (defaults to the input total when
+        unambiguous).
+        """
         v = self.shards(name)
-        return np.concatenate(list(v), axis=0)
+        out = np.concatenate(list(v), axis=0)
+        if total is None and len(set(self.totals.values())) == 1:
+            total = next(iter(self.totals.values()), None)
+        return out if total is None else out[:total]
 
     @property
     def step_count(self) -> int:
