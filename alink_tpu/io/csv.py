@@ -1,0 +1,118 @@
+"""CSV read/write utilities.
+
+Re-design of common/io/csv/ (CsvUtil, CsvParser, CsvFormatter): schema-aware
+CSV <-> MTable with the reference's "col TYPE, col TYPE" schema strings.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import os
+from typing import List, Optional, Sequence
+from urllib.request import urlopen
+
+import numpy as np
+
+from ..common.mtable import MTable
+from ..common.types import AlinkTypes, TableSchema
+from ..common.vector import VectorUtil
+
+
+def _parse_cell(s: str, type_: str):
+    if s is None or s == "":
+        return None
+    t = type_.upper()
+    if t in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
+        return float(s)
+    if t in (AlinkTypes.LONG, AlinkTypes.INT):
+        return int(float(s))
+    if t == AlinkTypes.BOOLEAN:
+        return s.strip().lower() in ("true", "1", "t")
+    if AlinkTypes.is_vector(t):
+        return VectorUtil.parse(s)
+    return s
+
+
+def read_csv(path: str, schema: TableSchema, field_delimiter: str = ",",
+             quote_char: str = '"', skip_blank: bool = True,
+             ignore_first_line: bool = False) -> MTable:
+    if path.startswith(("http://", "https://")):
+        raw = urlopen(path).read().decode("utf-8")  # pragma: no cover - no egress in CI
+        f = io.StringIO(raw)
+    else:
+        f = open(path, "r", encoding="utf-8")
+    try:
+        reader = csv.reader(f, delimiter=field_delimiter, quotechar=quote_char)
+        rows = []
+        for i, rec in enumerate(reader):
+            if ignore_first_line and i == 0:
+                continue
+            if skip_blank and not rec:
+                continue
+            vals = [_parse_cell(rec[j] if j < len(rec) else None, t)
+                    for j, t in enumerate(schema.types)]
+            rows.append(tuple(vals))
+    finally:
+        f.close()
+    return MTable(rows, schema)
+
+
+def write_csv(table: MTable, path: str, field_delimiter: str = ",",
+              quote_char: str = '"', with_header: bool = False):
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", newline="", encoding="utf-8") as f:
+        writer = csv.writer(f, delimiter=field_delimiter, quotechar=quote_char)
+        if with_header:
+            writer.writerow(table.col_names)
+        for row in table.rows():
+            out = []
+            for v, t in zip(row, table.schema.types):
+                if v is None:
+                    out.append("")
+                elif AlinkTypes.is_vector(t):
+                    out.append(VectorUtil.to_string(VectorUtil.parse(v)))
+                else:
+                    out.append(v)
+            writer.writerow(out)
+
+
+def read_libsvm(path: str, start_index: int = 1) -> MTable:
+    """LibSVM format -> (label DOUBLE, features SPARSE_VECTOR)
+    (reference common/io/LibSvmSourceBatchOp)."""
+    labels: List[float] = []
+    vecs = []
+    max_idx = 0
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            parts = line.strip().split()
+            if not parts:
+                continue
+            labels.append(float(parts[0]))
+            idx, val = [], []
+            for p in parts[1:]:
+                k, v = p.split(":")
+                idx.append(int(k) - start_index)
+                val.append(float(v))
+            if idx:
+                max_idx = max(max_idx, max(idx) + 1)
+            vecs.append((idx, val))
+    from ..common.vector import SparseVector
+    col = [SparseVector(max_idx, i, v) for i, v in vecs]
+    return MTable({"label": np.asarray(labels), "features": col},
+                  TableSchema(["label", "features"],
+                              [AlinkTypes.DOUBLE, AlinkTypes.SPARSE_VECTOR]))
+
+
+def write_libsvm(table: MTable, path: str, label_col: str, vector_col: str,
+                 start_index: int = 1):
+    with open(path, "w", encoding="utf-8") as f:
+        for lbl, vec in zip(table.col(label_col), table.col(vector_col)):
+            v = VectorUtil.parse(vec)
+            from ..common.vector import DenseVector
+            if isinstance(v, DenseVector):
+                pairs = [(i, x) for i, x in enumerate(v.data) if x != 0]
+            else:
+                pairs = list(zip(v.indices, v.values))
+            body = " ".join(f"{int(i) + start_index}:{x}" for i, x in pairs)
+            f.write(f"{lbl} {body}\n")

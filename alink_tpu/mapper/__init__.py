@@ -1,0 +1,3 @@
+from .base import Mapper, ModelMapper, OutputColsHelper
+
+__all__ = ["Mapper", "ModelMapper", "OutputColsHelper"]

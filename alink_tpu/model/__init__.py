@@ -1,0 +1,4 @@
+from .converters import (ModelDataConverter, SimpleModelDataConverter,
+                         LabeledModelDataConverter)
+
+__all__ = ["ModelDataConverter", "SimpleModelDataConverter", "LabeledModelDataConverter"]

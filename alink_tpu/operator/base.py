@@ -1,0 +1,253 @@
+"""Operator layer base classes.
+
+Re-design of the reference operator API
+(operator/AlgoOperator.java:24, batch/BatchOperator.java:93-124 ``link/linkFrom``,
+:251-292 ``execute/collect``, :497-547 lazy evaluation, stream/StreamOperator.java).
+
+Execution model: the reference builds a deferred Flink plan and materializes
+it at ``execute()``. Here operators compute **eagerly** when linked — device
+work is already batched through jit/shard_map so deferral buys nothing — but
+the lazy-callback contract (``lazy_print``/``lazy_collect`` firing at
+``execute()``) is preserved.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..common.mlenv import MLEnvironment, MLEnvironmentFactory
+from ..common.mtable import MTable
+from ..common.params import Params, WithParams
+from ..common.types import TableSchema
+from ..params.shared import HasMLEnvironmentId
+
+
+class AlgoOperator(WithParams, HasMLEnvironmentId):
+    """Base of all operators (reference operator/AlgoOperator.java)."""
+
+    def __init__(self, params: Optional[Params] = None, **kwargs):
+        super().__init__(params, **kwargs)
+        self._output: Optional[MTable] = None
+        self._side_outputs: List[MTable] = []
+
+    # -- outputs ---------------------------------------------------------
+    def get_output_table(self) -> MTable:
+        if self._output is None:
+            raise RuntimeError(
+                f"{type(self).__name__} has no output; link it to inputs first")
+        return self._output
+
+    def set_output_table(self, table: MTable):
+        self._output = table
+        return self
+
+    def get_side_output(self, index: int) -> "BatchOperator":
+        if index >= len(self._side_outputs):
+            raise IndexError(f"side output {index} of {len(self._side_outputs)}")
+        return TableSourceBatchOp(self._side_outputs[index])
+
+    def get_side_output_count(self) -> int:
+        return len(self._side_outputs)
+
+    def get_col_names(self) -> List[str]:
+        return self.get_output_table().col_names
+
+    def get_schema(self) -> TableSchema:
+        return self.get_output_table().schema
+
+    def get_ml_env(self) -> MLEnvironment:
+        return MLEnvironmentFactory.get(self.get_ml_environment_id())
+
+    # -- misc ------------------------------------------------------------
+    def __repr__(self):
+        tail = f" -> {self._output!r}" if self._output is not None else " (unlinked)"
+        return f"{type(self).__name__}{tail}"
+
+
+class BatchOperator(AlgoOperator):
+    """Batch operator with link semantics (reference batch/BatchOperator.java)."""
+
+    def link(self, next_op: "BatchOperator") -> "BatchOperator":
+        return next_op.link_from(self)
+
+    def link_from(self, *inputs: "BatchOperator") -> "BatchOperator":
+        raise NotImplementedError(f"{type(self).__name__}.link_from")
+
+    # -- materialization -------------------------------------------------
+    def collect(self) -> List[tuple]:
+        return self.get_output_table().to_rows()
+
+    def collect_mtable(self) -> MTable:
+        return self.get_output_table()
+
+    def first_n(self, n: int) -> "BatchOperator":
+        return TableSourceBatchOp(self.get_output_table().first_n(n))
+
+    def print(self, n: int = -1, title: Optional[str] = None):
+        t = self.get_output_table()
+        if title:
+            print(title)
+        print(t.to_display_string(max_rows=n if n > 0 else 20))
+        return self
+
+    def execute(self):
+        """Fire all pending lazy callbacks (reference triggerLazyEvaluation)."""
+        self.get_ml_env().lazy_objects_manager.fire_all()
+
+    # -- lazy hooks ------------------------------------------------------
+    def _lazy(self, tag: str, value, cb: Callable[[Any], None]):
+        lazy = self.get_ml_env().lazy_objects_manager.gen_lazy((id(self), tag, cb))
+        lazy.add_value(value)
+        lazy.add_callback(cb)
+        return self
+
+    def lazy_print(self, n: int = -1, title: Optional[str] = None) -> "BatchOperator":
+        def show(t: MTable):
+            if title:
+                print(title)
+            print(t.to_display_string(max_rows=n if n > 0 else 20))
+        return self._lazy("print", self.get_output_table(), show)
+
+    def lazy_collect(self, callback: Callable[[List[tuple]], None]) -> "BatchOperator":
+        return self._lazy("collect", self.get_output_table().to_rows(), callback)
+
+    def lazy_collect_mtable(self, callback) -> "BatchOperator":
+        return self._lazy("collect_mtable", self.get_output_table(), callback)
+
+    def lazy_print_statistics(self, title: Optional[str] = None) -> "BatchOperator":
+        def show(t: MTable):
+            from ..operator.common.statistics.summarizer import summarize_table
+            if title:
+                print(title)
+            print(summarize_table(t).to_display_string())
+        return self._lazy("stats", self.get_output_table(), show)
+
+    def collect_statistics(self):
+        """reference BatchOperator.collectStatistics (batch/BatchOperator.java:576-603)."""
+        from ..operator.common.statistics.summarizer import summarize_table
+        return summarize_table(self.get_output_table())
+
+    # -- SQL-ish conveniences (delegate to MTable; full ops in batch/sql) --
+    def select(self, fields) -> "BatchOperator":
+        from .batch.sql import SelectBatchOp
+        return SelectBatchOp(clause=fields if isinstance(fields, str)
+                             else ",".join(fields)).link_from(self)
+
+    def alias(self, fields) -> "BatchOperator":
+        from .batch.sql import AsBatchOp
+        return AsBatchOp(clause=fields if isinstance(fields, str)
+                         else ",".join(fields)).link_from(self)
+
+    def where(self, predicate: str) -> "BatchOperator":
+        from .batch.sql import WhereBatchOp
+        return WhereBatchOp(clause=predicate).link_from(self)
+
+    filter = where
+
+    def distinct(self) -> "BatchOperator":
+        from .batch.sql import DistinctBatchOp
+        return DistinctBatchOp().link_from(self)
+
+    def order_by(self, field: str, limit: Optional[int] = None,
+                 ascending: bool = True) -> "BatchOperator":
+        from .batch.sql import OrderByBatchOp
+        op = OrderByBatchOp(clause=field, ascending=ascending)
+        if limit is not None:
+            op.set_limit(limit)
+        return op.link_from(self)
+
+    def group_by(self, by: str, select_clause: str) -> "BatchOperator":
+        from .batch.sql import GroupByBatchOp
+        return GroupByBatchOp(group_by_predicate=by,
+                              select_clause=select_clause).link_from(self)
+
+    def union_all(self, other: "BatchOperator") -> "BatchOperator":
+        from .batch.sql import UnionAllBatchOp
+        return UnionAllBatchOp().link_from(self, other)
+
+    def sample(self, ratio: float, with_replacement: bool = False) -> "BatchOperator":
+        from .batch.dataproc import SampleBatchOp
+        return SampleBatchOp(ratio=ratio,
+                             with_replacement=with_replacement).link_from(self)
+
+    def split(self, fraction: float, seed: int = 0):
+        from .batch.dataproc import SplitBatchOp
+        op = SplitBatchOp(fraction=fraction, seed=seed).link_from(self)
+        return op, op.get_side_output(0)
+
+    @staticmethod
+    def from_table(table: MTable) -> "TableSourceBatchOp":
+        return TableSourceBatchOp(table)
+
+
+class TableSourceBatchOp(BatchOperator):
+    """Wrap an in-memory MTable as a source (reference TableSourceBatchOp)."""
+
+    def __init__(self, table: MTable, params: Optional[Params] = None, **kwargs):
+        super().__init__(params, **kwargs)
+        self._output = table
+
+    def link_from(self, *inputs):
+        raise RuntimeError("TableSourceBatchOp is a source; it takes no inputs")
+
+
+class StreamOperator(AlgoOperator):
+    """Stream operator base (reference stream/StreamOperator.java).
+
+    A stream is a host-side iterator of MTable micro-batches (the Flink
+    DataStream replacement, SURVEY §7 step 9). Linking composes per-batch
+    transforms lazily; ``StreamOperator.execute()`` drains the whole DAG.
+    """
+
+    def __init__(self, params: Optional[Params] = None, **kwargs):
+        super().__init__(params, **kwargs)
+        self._stream_fn: Optional[Callable[[], Any]] = None  # () -> iterator of MTable
+        self._schema: Optional[TableSchema] = None
+        self._sinks: List[Callable[[MTable], None]] = []
+
+    def link(self, next_op: "StreamOperator") -> "StreamOperator":
+        return next_op.link_from(self)
+
+    def link_from(self, *inputs: "StreamOperator") -> "StreamOperator":
+        raise NotImplementedError(f"{type(self).__name__}.link_from")
+
+    def get_schema(self) -> TableSchema:
+        if self._schema is None:
+            raise RuntimeError(f"{type(self).__name__} schema unknown; link first")
+        return self._schema
+
+    def get_col_names(self) -> List[str]:
+        return list(self.get_schema().names)
+
+    def micro_batches(self):
+        if self._stream_fn is None:
+            raise RuntimeError(f"{type(self).__name__} has no stream; link it first")
+        return self._stream_fn()
+
+    def print(self) -> "StreamOperator":
+        self._sinks.append(lambda mt: print(mt.to_display_string()))
+        return self
+
+    def sample(self, ratio: float) -> "StreamOperator":
+        from .stream.dataproc import SampleStreamOp
+        return SampleStreamOp(ratio=ratio).link_from(self)
+
+    # registry of every stream termination in the session
+    _session_streams: List["StreamOperator"] = []
+
+    def _register(self):
+        StreamOperator._session_streams.append(self)
+        return self
+
+    @staticmethod
+    def execute():
+        """Drain all registered stream DAGs to completion (reference
+        StreamOperator.execute launching the stream job)."""
+        streams = StreamOperator._session_streams
+        StreamOperator._session_streams = []
+        for s in streams:
+            for mt in s.micro_batches():
+                for sink in s._sinks:
+                    sink(mt)

@@ -1,0 +1,4 @@
+from .linear import (LogisticRegressionTrainBatchOp, LogisticRegressionPredictBatchOp,
+                     LinearSvmTrainBatchOp, LinearSvmPredictBatchOp,
+                     SoftmaxTrainBatchOp, SoftmaxPredictBatchOp,
+                     PerceptronTrainBatchOp, PerceptronPredictBatchOp)

@@ -1,0 +1,86 @@
+"""Linear classifier batch operators.
+
+Re-design of operator/batch/classification/ LogisticRegressionTrainBatchOp,
+LinearSvmTrainBatchOp, SoftmaxTrainBatchOp (+ their predict ops), all thin
+shells over the shared linear training core (common/linear/).
+"""
+
+from __future__ import annotations
+
+from ....params.shared import (HasEpsilonDefaultAs000001, HasFeatureCols,
+                               HasL1, HasL2, HasLabelCol, HasLearningRate,
+                               HasMaxIterDefaultAs100, HasMiniBatchFraction,
+                               HasOptimMethod, HasPositiveLabelValueString,
+                               HasPredictionCol, HasPredictionDetailCol,
+                               HasReservedCols, HasStandardization,
+                               HasVectorCol, HasWeightCol, HasWithIntercept)
+from ...base import BatchOperator
+from ...common.linear.base import LinearModelType, train_linear_model
+from ...common.linear.mapper import LinearModelMapper
+from ..utils.model_map import ModelMapBatchOp
+
+
+class _LinearTrainParams(HasLabelCol, HasFeatureCols, HasVectorCol, HasWeightCol,
+                         HasOptimMethod, HasMaxIterDefaultAs100,
+                         HasEpsilonDefaultAs000001, HasL1, HasL2,
+                         HasWithIntercept, HasStandardization, HasLearningRate,
+                         HasMiniBatchFraction):
+    pass
+
+
+class BaseLinearTrainBatchOp(BatchOperator, _LinearTrainParams):
+    MODEL_TYPE = LinearModelType.LR
+
+    def link_from(self, in_op: BatchOperator) -> "BaseLinearTrainBatchOp":
+        model, info = train_linear_model(in_op.get_output_table(), self, self.MODEL_TYPE)
+        self._output = model
+        self._side_outputs = [info]
+        return self
+
+    def get_train_info(self):
+        return self._side_outputs[0]
+
+
+class _LinearPredictParams(HasPredictionCol, HasPredictionDetailCol, HasReservedCols,
+                           HasVectorCol):
+    pass
+
+
+class LinearModelPredictBatchOp(ModelMapBatchOp, _LinearPredictParams):
+    MAPPER_CLS = LinearModelMapper
+
+
+class LogisticRegressionTrainBatchOp(BaseLinearTrainBatchOp, HasPositiveLabelValueString):
+    """reference: batch/classification/LogisticRegressionTrainBatchOp.java"""
+    MODEL_TYPE = LinearModelType.LR
+
+
+class LogisticRegressionPredictBatchOp(LinearModelPredictBatchOp):
+    pass
+
+
+class LinearSvmTrainBatchOp(BaseLinearTrainBatchOp, HasPositiveLabelValueString):
+    """reference: batch/classification/LinearSvmTrainBatchOp.java (hinge loss)"""
+    MODEL_TYPE = LinearModelType.SVM
+
+
+class LinearSvmPredictBatchOp(LinearModelPredictBatchOp):
+    pass
+
+
+class SoftmaxTrainBatchOp(BaseLinearTrainBatchOp):
+    """reference: batch/classification/SoftmaxTrainBatchOp.java (multinomial LR)"""
+    MODEL_TYPE = LinearModelType.Softmax
+
+
+class SoftmaxPredictBatchOp(LinearModelPredictBatchOp):
+    pass
+
+
+class PerceptronTrainBatchOp(BaseLinearTrainBatchOp):
+    """perceptron loss on the same optimizer stack (reference unarylossfunc/PerceptronLossFunc)"""
+    MODEL_TYPE = LinearModelType.Perceptron
+
+
+class PerceptronPredictBatchOp(LinearModelPredictBatchOp):
+    pass

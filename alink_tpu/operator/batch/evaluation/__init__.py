@@ -1,0 +1,2 @@
+from .eval_ops import (EvalBinaryClassBatchOp, EvalMultiClassBatchOp,
+                       EvalRegressionBatchOp, EvalClusterBatchOp)

@@ -1,0 +1,4 @@
+from .linear import (LinearRegTrainBatchOp, LinearRegPredictBatchOp,
+                     RidgeRegTrainBatchOp, RidgeRegPredictBatchOp,
+                     LassoRegTrainBatchOp, LassoRegPredictBatchOp,
+                     LinearSvrTrainBatchOp, LinearSvrPredictBatchOp)

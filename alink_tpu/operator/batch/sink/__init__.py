@@ -1,0 +1,3 @@
+from .sinks import CsvSinkBatchOp, LibSvmSinkBatchOp, MemSinkBatchOp
+
+__all__ = ["CsvSinkBatchOp", "LibSvmSinkBatchOp", "MemSinkBatchOp"]

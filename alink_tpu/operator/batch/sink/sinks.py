@@ -1,0 +1,54 @@
+"""Batch sink operators.
+
+Re-design of operator/batch/sink/ (CsvSinkBatchOp, TextSinkBatchOp,
+MemSinkBatchOp — the collect backbone, BatchOperator.java:455-494).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ....common.mtable import MTable
+from ....common.params import ParamInfo, Params
+from ....io.csv import write_csv, write_libsvm
+from ...base import BatchOperator
+
+
+class CsvSinkBatchOp(BatchOperator):
+    FILE_PATH = ParamInfo("file_path", str, optional=False)
+    FIELD_DELIMITER = ParamInfo("field_delimiter", str, default=",")
+    WITH_HEADER = ParamInfo("with_header", bool, default=False)
+
+    def link_from(self, in_op: BatchOperator) -> "CsvSinkBatchOp":
+        t = in_op.get_output_table()
+        write_csv(t, self.get_file_path(),
+                  field_delimiter=self.get_field_delimiter(),
+                  with_header=self.get_with_header())
+        self._output = t
+        return self
+
+
+class LibSvmSinkBatchOp(BatchOperator):
+    FILE_PATH = ParamInfo("file_path", str, optional=False)
+    LABEL_COL = ParamInfo("label_col", str, optional=False)
+    VECTOR_COL = ParamInfo("vector_col", str, optional=False)
+
+    def link_from(self, in_op: BatchOperator) -> "LibSvmSinkBatchOp":
+        t = in_op.get_output_table()
+        write_libsvm(t, self.get_file_path(), self.get_label_col(),
+                     self.get_vector_col())
+        self._output = t
+        return self
+
+
+class MemSinkBatchOp(BatchOperator):
+    """Collect rows into host memory (reference MemSinkBatchOp / CollectHelper)."""
+
+    def __init__(self, params: Optional[Params] = None, **kwargs):
+        super().__init__(params, **kwargs)
+        self.rows: List[tuple] = []
+
+    def link_from(self, in_op: BatchOperator) -> "MemSinkBatchOp":
+        self._output = in_op.get_output_table()
+        self.rows = self._output.to_rows()
+        return self

@@ -1,0 +1,7 @@
+from .sources import (MemSourceBatchOp, CsvSourceBatchOp, LibSvmSourceBatchOp,
+                      TextSourceBatchOp, NumSeqSourceBatchOp, RandomTableSourceBatchOp)
+from ...base import TableSourceBatchOp
+
+__all__ = ["MemSourceBatchOp", "CsvSourceBatchOp", "LibSvmSourceBatchOp",
+           "TextSourceBatchOp", "NumSeqSourceBatchOp", "RandomTableSourceBatchOp",
+           "TableSourceBatchOp"]

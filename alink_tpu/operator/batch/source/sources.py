@@ -1,0 +1,117 @@
+"""Batch source operators.
+
+Re-design of operator/batch/source/ (MemSourceBatchOp — the test backbone,
+CsvSourceBatchOp with http support, LibSvmSourceBatchOp, TextSourceBatchOp,
+NumSeqSourceBatchOp, TableSourceBatchOp) over the host columnar engine.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ....common.mtable import MTable
+from ....common.params import ParamInfo, Params
+from ....common.types import AlinkTypes, TableSchema
+from ....io.csv import read_csv, read_libsvm
+from ...base import BatchOperator, TableSourceBatchOp
+
+
+class MemSourceBatchOp(BatchOperator):
+    """In-memory rows source (reference MemSourceBatchOp)."""
+
+    def __init__(self, rows, schema=None, params: Optional[Params] = None, **kwargs):
+        super().__init__(params, **kwargs)
+        if isinstance(rows, MTable):
+            self._output = rows if schema is None else MTable(rows.to_rows(), schema)
+        else:
+            if isinstance(schema, str):
+                schema = TableSchema.parse(schema)
+            self._output = MTable(rows, schema)
+
+    def link_from(self, *inputs):
+        raise RuntimeError("MemSourceBatchOp is a source")
+
+
+class _FileSourceBase(BatchOperator):
+    """File sources load lazily so fluent ``set_file_path(...)`` works too."""
+
+    def _load(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def get_output_table(self) -> MTable:
+        if self._output is None:
+            self._load()
+        return super().get_output_table()
+
+    def link_from(self, *inputs):
+        raise RuntimeError(f"{type(self).__name__} is a source; it takes no inputs")
+
+
+class CsvSourceBatchOp(_FileSourceBase):
+    """reference: batch/source/CsvSourceBatchOp (common/io/csv/CsvUtil)."""
+
+    FILE_PATH = ParamInfo("file_path", str, "csv path or http url", optional=False)
+    SCHEMA_STR = ParamInfo("schema_str", str, "'col TYPE, col TYPE'", optional=False)
+    FIELD_DELIMITER = ParamInfo("field_delimiter", str, default=",")
+    QUOTE_CHAR = ParamInfo("quote_char", str, default='"')
+    IGNORE_FIRST_LINE = ParamInfo("ignore_first_line", bool, default=False)
+
+    def _load(self):
+        self._output = read_csv(
+            self.get_file_path(), TableSchema.parse(self.get_schema_str()),
+            field_delimiter=self.get_field_delimiter(),
+            quote_char=self.get_quote_char(),
+            ignore_first_line=self.get_ignore_first_line())
+
+
+class LibSvmSourceBatchOp(_FileSourceBase):
+    """reference: batch/source/LibSvmSourceBatchOp."""
+
+    FILE_PATH = ParamInfo("file_path", str, optional=False)
+    START_INDEX = ParamInfo("start_index", int, default=1)
+
+    def _load(self):
+        self._output = read_libsvm(self.get_file_path(), self.get_start_index())
+
+
+class TextSourceBatchOp(_FileSourceBase):
+    """One STRING column named 'text' per line (reference TextSourceBatchOp)."""
+
+    FILE_PATH = ParamInfo("file_path", str, optional=False)
+    TEXT_COL = ParamInfo("text_col", str, default="text")
+
+    def _load(self):
+        with open(self.get_file_path(), "r", encoding="utf-8") as f:
+            lines = [l.rstrip("\n") for l in f]
+        self._output = MTable({self.get_text_col(): lines},
+                              TableSchema([self.get_text_col()], [AlinkTypes.STRING]))
+
+
+class NumSeqSourceBatchOp(BatchOperator):
+    """Integer sequence [from, to] (reference NumSeqSourceBatchOp)."""
+
+    def __init__(self, from_: int = 0, to: int = 0, col_name: str = "num",
+                 params: Optional[Params] = None, **kwargs):
+        super().__init__(params, **kwargs)
+        seq = np.arange(from_, to + 1, dtype=np.int64)
+        self._output = MTable({col_name: seq}, TableSchema([col_name], [AlinkTypes.LONG]))
+
+    def link_from(self, *inputs):
+        raise RuntimeError("NumSeqSourceBatchOp is a source")
+
+
+class RandomTableSourceBatchOp(BatchOperator):
+    """Random numeric table (reference RandomTableSourceBatchOp)."""
+
+    def __init__(self, num_rows: int, num_cols: int, seed: int = 0,
+                 output_col_prefix: str = "col", params=None, **kwargs):
+        super().__init__(params, **kwargs)
+        rng = np.random.RandomState(seed)
+        cols = {f"{output_col_prefix}{i}": rng.rand(num_rows)
+                for i in range(num_cols)}
+        self._output = MTable(cols)
+
+    def link_from(self, *inputs):
+        raise RuntimeError("RandomTableSourceBatchOp is a source")

@@ -1,0 +1,1 @@
+from .model_map import ModelMapBatchOp

@@ -1,0 +1,286 @@
+"""Linear-model training core.
+
+Re-design of ``BaseLinearModelTrainBatchOp``
+(common/linear/BaseLinearModelTrainBatchOp.java:68-104 linkFrom flow:
+label encode -> Tuple3(weight,label,vec) transform -> stats/standardization
+(:111-180) -> ``optimize()`` dispatch (:229-265) -> model rows via
+LinearModelDataConverter :91-102) plus the model value object
+(common/linear/LinearModelData.java).
+
+Differences by design (TPU-first, not a port):
+  * features cross to the device once as dense blocks / padded-COO batches;
+  * standardization statistics come from one weighted-moment pass
+    (psum-able) instead of the VectorSummarizer dataflow;
+  * the intercept is excluded from L1/L2 regularization;
+  * sparse input is scaled but not centered (keeps sparsity), like the
+    reference.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ....common.mlenv import MLEnvironmentFactory
+from ....common.mtable import MTable
+from ....common.params import Params
+from ....common.types import AlinkTypes, TableSchema
+from ....model.converters import (LabeledModelDataConverter, decode_array,
+                                  encode_array)
+from ..dataproc.feature_extract import add_intercept, extract_design
+from ..optim.objfunc import (HingeLossFunc, HuberLossFunc, LogLossFunc,
+                             PerceptronLossFunc, SmoothHingeLossFunc,
+                             SoftmaxObjFunc, SquareLossFunc, SvrLossFunc,
+                             UnaryLossObjFunc)
+from ..optim.optimizers import OptimParams, optimize
+
+
+class LinearModelType:
+    LR = "LR"
+    SVM = "SVM"
+    LinearReg = "LinearReg"
+    SVR = "SVR"
+    Perceptron = "Perceptron"
+    Softmax = "Softmax"
+    AFT = "AFT"
+
+    LOSSES = {
+        "LR": LogLossFunc, "SVM": HingeLossFunc, "LinearReg": SquareLossFunc,
+        "SVR": SvrLossFunc, "Perceptron": PerceptronLossFunc,
+    }
+    IS_REGRESSION = {"LinearReg", "SVR"}
+
+
+@dataclass
+class LinearModelData:
+    model_name: str
+    linear_model_type: str
+    has_intercept: bool
+    vector_col: Optional[str]
+    feature_names: Optional[List[str]]
+    vector_size: int
+    coef: np.ndarray                       # (dim,) or flattened (k-1, dim) for Softmax
+    label_values: List[Any] = field(default_factory=list)
+    label_type: str = AlinkTypes.STRING
+    loss_curve: Optional[np.ndarray] = None
+
+
+class LinearModelDataConverter(LabeledModelDataConverter):
+    """Model rows (reference common/linear/LinearModelDataConverter.java)."""
+
+    def __init__(self, label_type: str = AlinkTypes.STRING):
+        super().__init__(label_type)
+
+    def serialize_model(self, m: LinearModelData):
+        meta = Params({
+            "model_name": m.model_name, "linear_model_type": m.linear_model_type,
+            "has_intercept": m.has_intercept, "vector_col": m.vector_col,
+            "feature_names": m.feature_names, "vector_size": m.vector_size,
+            "label_type": m.label_type,
+        })
+        return meta, [encode_array(m.coef)], list(m.label_values)
+
+    def deserialize_model(self, meta: Params, data: List[str], labels: List[Any]):
+        get = lambda k, d=None: meta._m.get(k, d)  # noqa: E731
+        return LinearModelData(
+            model_name=get("model_name", ""),
+            linear_model_type=get("linear_model_type", "LR"),
+            has_intercept=bool(get("has_intercept", True)),
+            vector_col=get("vector_col"),
+            feature_names=get("feature_names"),
+            vector_size=int(get("vector_size", 0)),
+            coef=decode_array(data[0]),
+            label_values=labels,
+            label_type=get("label_type", AlinkTypes.STRING),
+        )
+
+
+def encode_labels(raw_labels: np.ndarray, positive_value=None) -> Tuple[List[Any], np.ndarray]:
+    """Distinct labels + per-row {-1,+1} targets (binary).
+
+    reference: getLabelInfo/getLabelValues (BaseLinearModelTrainBatchOp.java).
+    Ordering: positive label first; default positive = largest distinct
+    (so numeric {0,1} gets positive=1).
+    """
+    distinct = sorted(set(_canon(v) for v in raw_labels), key=_sort_key, reverse=True)
+    if len(distinct) != 2:
+        raise ValueError(f"binary trainer needs exactly 2 label values, got {distinct}")
+    if positive_value is not None:
+        pv = _canon(positive_value)
+        match = [l for l in distinct if str(l) == str(pv)]
+        if not match:
+            raise ValueError(f"positive label {positive_value!r} not in {distinct}")
+        distinct = [match[0]] + [l for l in distinct if l is not match[0]]
+    y = np.where([_canon(v) == distinct[0] for v in raw_labels], 1.0, -1.0)
+    return distinct, y
+
+
+def index_labels(raw_labels: np.ndarray) -> Tuple[List[Any], np.ndarray]:
+    """Distinct labels + integer class ids (multiclass, reference Softmax)."""
+    distinct = sorted(set(_canon(v) for v in raw_labels), key=_sort_key)
+    lookup = {l: i for i, l in enumerate(distinct)}
+    y = np.asarray([lookup[_canon(v)] for v in raw_labels], np.float64)
+    return distinct, y
+
+
+def _canon(v):
+    if isinstance(v, (np.generic,)):
+        return v.item()
+    return v
+
+
+def _sort_key(v):
+    return (0, float(v)) if isinstance(v, (int, float, bool)) else (1, str(v))
+
+
+def train_linear_model(data: MTable, op, model_type: str) -> Tuple[MTable, MTable]:
+    """Full train flow; ``op`` supplies params. Returns (model_table, train_info)."""
+    env = MLEnvironmentFactory.get(op.get_ml_environment_id())
+    feature_cols = op.params._m.get("feature_cols")
+    vector_col = op.params._m.get("vector_col")
+    label_col = op.params._m.get("label_col")
+    weight_col = op.params._m.get("weight_col")
+    with_intercept = op.params._m.get("with_intercept", True)
+    standardize = op.params._m.get("standardization", True)
+    l1 = float(op.params._m.get("l1", 0.0) or 0.0)
+    l2 = float(op.params._m.get("l2", 0.0) or 0.0)
+    dtype = np.float64 if _x64_enabled() else np.float32
+
+    if not vector_col:
+        from ..dataproc.feature_extract import resolve_feature_cols
+        feature_cols = resolve_feature_cols(data, feature_cols, label_col,
+                                            exclude=[weight_col] if weight_col else [])
+    design = extract_design(data, feature_cols, vector_col, dtype)
+    n = data.num_rows
+    w = (np.asarray(data.col(weight_col), dtype) if weight_col
+         else np.ones(n, dtype))
+
+    # -- label encoding --------------------------------------------------
+    softmax = model_type == LinearModelType.Softmax
+    regression = model_type in LinearModelType.IS_REGRESSION
+    raw = data.col(label_col)
+    label_type = data.schema.type_of(label_col)
+    if regression:
+        labels, y = [], np.asarray(raw, dtype)
+    elif softmax:
+        labels, y = index_labels(raw)
+    else:
+        labels, y = encode_labels(raw, op.params._m.get("positive_label_value_string"))
+
+    # -- standardization (reference :111-180) ----------------------------
+    mean, std = _weighted_moments(design, w)
+    if design["kind"] == "sparse":
+        mean = np.zeros_like(mean)  # sparse path scales only; no centering
+    if standardize:
+        design = _apply_standardization(design, mean, std)
+    if with_intercept:
+        design = add_intercept(design, dtype)
+    dim = design["dim"]
+
+    # -- optimize ---------------------------------------------------------
+    method = _default_method(op, l1)
+    lr = op.params._m.get("learning_rate")
+    if lr is None:
+        # line-search base for (quasi-)Newton methods; step size for SGD
+        lr = 0.1 if method.upper() == "SGD" else 1.0
+    optim = OptimParams(
+        method=method,
+        max_iter=int(op.params._m.get("max_iter", 100)),
+        epsilon=float(op.params._m.get("epsilon", 1e-6)),
+        learning_rate=float(lr),
+        mini_batch_fraction=float(op.params._m.get("mini_batch_fraction", 0.1)),
+    )
+    reg_free = 1 if with_intercept else 0
+    if softmax:
+        k = len(labels)
+        obj = SoftmaxObjFunc(k, dim, l1=l1, l2=l2, reg_free_cols=reg_free)
+    else:
+        loss_cls = LinearModelType.LOSSES[model_type]
+        loss_kwargs = {}
+        if model_type == LinearModelType.SVR:
+            loss_kwargs["epsilon"] = float(op.params._m.get("tau", 0.1))
+        obj = UnaryLossObjFunc(loss_cls(**loss_kwargs), dim, l1=l1, l2=l2,
+                               reg_free_head=reg_free)
+
+    train = {k2: v for k2, v in design.items() if k2 in ("X", "idx", "val")}
+    train["y"] = y.astype(dtype)
+    train["w"] = w
+    coef, loss_curve, steps = optimize(obj, train, optim, env)
+
+    # -- de-standardize back to the original feature scale ----------------
+    if standardize:
+        coef = _destandardize_coef(coef, mean, std, with_intercept,
+                                   softmax, len(labels))
+
+    model = LinearModelData(
+        model_name=f"{model_type} model", linear_model_type=model_type,
+        has_intercept=bool(with_intercept), vector_col=vector_col,
+        feature_names=feature_cols if not vector_col else None,
+        vector_size=int(design["dim"] - (1 if with_intercept else 0)),
+        coef=np.asarray(coef, np.float64), label_values=labels,
+        label_type=label_type, loss_curve=loss_curve)
+    model_table = LinearModelDataConverter(label_type).save_model(model)
+    info = MTable({"iter": np.arange(1, len(loss_curve) + 1),
+                   "loss": np.asarray(loss_curve, np.float64)})
+    return model_table, info
+
+
+def _x64_enabled() -> bool:
+    import jax
+    return bool(jax.config.jax_enable_x64)
+
+
+def _default_method(op, l1: float) -> str:
+    m = op.params._m.get("optim_method")
+    if m:
+        return str(m)
+    return "OWLQN" if l1 > 0 else "LBFGS"
+
+
+def _weighted_moments(design: Dict, w: np.ndarray):
+    W = max(float(w.sum()), 1e-12)
+    if design["kind"] == "dense":
+        X = design["X"]
+        mean = (X * w[:, None]).sum(0) / W
+        var = ((X - mean) ** 2 * w[:, None]).sum(0) / W
+    else:
+        dim = design["dim"]
+        idx, val = design["idx"], design["val"]
+        mean = np.zeros(dim, val.dtype)
+        sq = np.zeros(dim, val.dtype)
+        np.add.at(mean, idx.reshape(-1), (val * w[:, None]).reshape(-1))
+        np.add.at(sq, idx.reshape(-1), (val ** 2 * w[:, None]).reshape(-1))
+        mean /= W
+        var = sq / W - mean ** 2  # zeros count toward the moments
+    std = np.sqrt(np.maximum(var, 0.0))
+    std = np.where(std < 1e-12, 1.0, std)
+    return mean, std
+
+
+def _apply_standardization(design: Dict, mean, std):
+    if design["kind"] == "dense":
+        # center + scale (reference standardizes dense input)
+        return {"kind": "dense", "X": (design["X"] - mean) / std, "dim": design["dim"]}
+    # sparse: scale only, centering would densify
+    val = design["val"] / std[design["idx"]]
+    return {"kind": "sparse", "idx": design["idx"], "val": val, "dim": design["dim"]}
+
+
+def _destandardize_coef(coef, mean, std, with_intercept, softmax, k):
+    if softmax:
+        W = coef.reshape(k - 1, -1)
+        if with_intercept:
+            b, Wf = W[:, 0], W[:, 1:]
+            Wo = Wf / std
+            bo = b - (Wf * (mean / std)).sum(1)
+            return np.concatenate([bo[:, None], Wo], 1).reshape(-1)
+        return (W / std).reshape(-1)
+    if with_intercept:
+        b, wf = coef[0], coef[1:]
+        wo = wf / std
+        bo = b - float((wf * (mean / std)).sum())
+        return np.concatenate([[bo], wo])
+    return coef / std

@@ -1,0 +1,134 @@
+"""LinearModelMapper — batched model serving.
+
+Re-design of common/linear/LinearModelMapper.java (per-row dot product,
+reference call stack §3.4) as a batched kernel: the whole input table is
+encoded once and scored with one matmul.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+from ....common.mtable import MTable
+from ....common.types import AlinkTypes, TableSchema
+from ....mapper.base import ModelMapper, OutputColsHelper
+from ..dataproc.feature_extract import extract_design
+from .base import LinearModelData, LinearModelDataConverter, LinearModelType
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-np.clip(x, -500, 500)))
+
+
+class LinearModelMapper(ModelMapper):
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model: Optional[LinearModelData] = None
+
+    def load_model(self, model_table: MTable):
+        label_type = model_table.schema.types[2] if len(model_table.schema) > 2 \
+            else AlinkTypes.STRING
+        self.model = LinearModelDataConverter(label_type).load_model(model_table)
+
+    # ------------------------------------------------------------------
+    def _scores(self, data: MTable) -> np.ndarray:
+        m = self.model
+        design = extract_design(data, m.feature_names, m.vector_col,
+                                np.float64, vector_size=m.vector_size)
+        coef = m.coef
+        if m.linear_model_type == LinearModelType.Softmax:
+            k = len(m.label_values)
+            W = coef.reshape(k - 1, -1)
+            if m.has_intercept:
+                b, Wf = W[:, 0], W[:, 1:]
+            else:
+                b, Wf = np.zeros(k - 1), W
+            Z = _matmul(design, Wf.T, m.vector_size) + b
+            return np.concatenate([Z, np.zeros((Z.shape[0], 1))], 1)
+        if m.has_intercept:
+            b, wf = coef[0], coef[1:]
+        else:
+            b, wf = 0.0, coef
+        return _matmul(design, wf, m.vector_size) + b
+
+    def predict_scores(self, data: MTable) -> np.ndarray:
+        return self._scores(data)
+
+    def get_output_schema(self) -> TableSchema:
+        m = self.model
+        pred_col = self.params._m.get("prediction_col", "pred")
+        detail_col = self.params._m.get("prediction_detail_col")
+        reserved = self.params._m.get("reserved_cols")
+        regression = m.linear_model_type in LinearModelType.IS_REGRESSION if m else False
+        out_type = AlinkTypes.DOUBLE if regression else (m.label_type if m else "STRING")
+        cols, types = [pred_col], [out_type]
+        if detail_col:
+            cols.append(detail_col)
+            types.append(AlinkTypes.STRING)
+        return OutputColsHelper(self.data_schema, cols, types, reserved).get_output_schema()
+
+    def map_table(self, data: MTable) -> MTable:
+        m = self.model
+        if m is None:
+            raise RuntimeError("load_model must be called before map_table")
+        pred_col = self.params._m.get("prediction_col", "pred")
+        detail_col = self.params._m.get("prediction_detail_col")
+        reserved = self.params._m.get("reserved_cols")
+        scores = self._scores(data)
+        out_cols, out_types = [], []
+        details = None
+        if m.linear_model_type in LinearModelType.IS_REGRESSION:
+            preds = scores
+            out_types = [AlinkTypes.DOUBLE]
+        elif m.linear_model_type == LinearModelType.Softmax:
+            e = np.exp(scores - scores.max(1, keepdims=True))
+            probs = e / e.sum(1, keepdims=True)
+            pick = probs.argmax(1)
+            preds = _label_array([m.label_values[i] for i in pick])
+            if detail_col:
+                details = [json.dumps({str(l): float(p)
+                                       for l, p in zip(m.label_values, row)})
+                           for row in probs]
+            out_types = [m.label_type]
+        else:
+            preds = _label_array([m.label_values[0] if s > 0 else m.label_values[1]
+                                  for s in scores])
+            if detail_col:
+                p_pos = _sigmoid(scores)
+                details = [json.dumps({str(m.label_values[0]): float(p),
+                                       str(m.label_values[1]): float(1 - p)})
+                           for p in p_pos]
+            out_types = [m.label_type]
+        cols = [pred_col]
+        values = [preds]
+        if detail_col:
+            cols.append(detail_col)
+            out_types.append(AlinkTypes.STRING)
+            values.append(np.asarray(details, object) if details is not None
+                          else np.asarray([None] * len(preds), object))
+        helper = OutputColsHelper(data.schema, cols, out_types, reserved)
+        return helper.build_output(data, values)
+
+
+def _matmul(design, w, dim):
+    if design["kind"] == "dense":
+        return design["X"] @ w
+    idx, val = design["idx"], design["val"]
+    if w.ndim == 1:
+        return (val * w[idx]).sum(-1)
+    # (n, nnz, k)
+    return (val[..., None] * w[idx]).sum(1)
+
+
+def _label_array(values: List) -> np.ndarray:
+    first = values[0] if values else ""
+    if isinstance(first, (int, np.integer)):
+        return np.asarray(values, np.int64)
+    if isinstance(first, (float, np.floating)):
+        return np.asarray(values, np.float64)
+    out = np.empty(len(values), object)
+    out[:] = values
+    return out

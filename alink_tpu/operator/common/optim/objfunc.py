@@ -1,0 +1,329 @@
+"""Objective functions for linear-model training.
+
+Re-design of the reference optimization objectives
+(common/optim/objfunc/OptimObjFunc.java:60-80 ``calcGradient/updateGradient``;
+common/linear/UnaryLossObjFunc.java; the 11 per-loss classes under
+common/linear/unarylossfunc/ — LogLoss, Hinge, SmoothHinge, Square, Huber,
+Exponential, Perceptron, Svr, ZeroOne).
+
+TPU-first shape: objectives are pure jax functions over a **shard** of
+training data held as device arrays — dense ``{"X"}`` or padded-COO sparse
+``{"idx","val"}`` plus ``{"y","w"}`` — returning unnormalized sums
+(grad, loss, weight). Cross-worker normalization happens after an
+``AllReduce``, mirroring the reference's gradAllReduce/lossAllReduce stages.
+Per-sample Java loops become one fused matmul/gather per shard (MXU).
+Sample weights double as the padding mask (padded rows have w == 0).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# unary losses: loss(eta, y) and d loss / d eta, with y in {-1, +1} for
+# classification losses and real y for regression losses.
+# ---------------------------------------------------------------------------
+
+class UnaryLossFunc:
+    name = "base"
+
+    def loss(self, eta, y):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def derivative(self, eta, y):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def second_derivative(self, eta, y):
+        raise NotImplementedError(f"{self.name} has no curvature (Newton unsupported)")
+
+
+class LogLossFunc(UnaryLossFunc):
+    """logistic loss (reference unarylossfunc/LogLossFunc.java)."""
+    name = "log"
+
+    def loss(self, eta, y):
+        # log(1 + exp(-y*eta)), stable
+        m = -y * eta
+        return jnp.logaddexp(0.0, m)
+
+    def derivative(self, eta, y):
+        return -y * jax.nn.sigmoid(-y * eta)
+
+    def second_derivative(self, eta, y):
+        p = jax.nn.sigmoid(y * eta)
+        return p * (1.0 - p)
+
+
+class HingeLossFunc(UnaryLossFunc):
+    name = "hinge"
+
+    def loss(self, eta, y):
+        return jnp.maximum(0.0, 1.0 - y * eta)
+
+    def derivative(self, eta, y):
+        return jnp.where(y * eta < 1.0, -y, 0.0)
+
+
+class SmoothHingeLossFunc(UnaryLossFunc):
+    """quadratically-smoothed hinge (reference SmoothHingeLossFunc.java)."""
+    name = "smooth_hinge"
+
+    def __init__(self, gamma: float = 1.0):
+        self.gamma = gamma
+
+    def loss(self, eta, y):
+        z = y * eta
+        g = self.gamma
+        return jnp.where(z >= 1.0, 0.0,
+                         jnp.where(z <= 1.0 - g, 1.0 - z - g / 2,
+                                   (1.0 - z) ** 2 / (2 * g)))
+
+    def derivative(self, eta, y):
+        z = y * eta
+        g = self.gamma
+        return jnp.where(z >= 1.0, 0.0,
+                         jnp.where(z <= 1.0 - g, -y, -y * (1.0 - z) / g))
+
+
+class SquareLossFunc(UnaryLossFunc):
+    name = "square"
+
+    def loss(self, eta, y):
+        return 0.5 * (eta - y) ** 2
+
+    def derivative(self, eta, y):
+        return eta - y
+
+    def second_derivative(self, eta, y):
+        return jnp.ones_like(eta)
+
+
+class SvrLossFunc(UnaryLossFunc):
+    """epsilon-insensitive (reference SvrLossFunc.java)."""
+    name = "svr"
+
+    def __init__(self, epsilon: float = 0.1):
+        self.epsilon = epsilon
+
+    def loss(self, eta, y):
+        return jnp.maximum(0.0, jnp.abs(y - eta) - self.epsilon)
+
+    def derivative(self, eta, y):
+        r = eta - y
+        return jnp.where(jnp.abs(r) <= self.epsilon, 0.0, jnp.sign(r))
+
+
+class HuberLossFunc(UnaryLossFunc):
+    name = "huber"
+
+    def __init__(self, delta: float = 1.0):
+        self.delta = delta
+
+    def loss(self, eta, y):
+        r = jnp.abs(eta - y)
+        d = self.delta
+        return jnp.where(r <= d, 0.5 * r ** 2, d * (r - 0.5 * d))
+
+    def derivative(self, eta, y):
+        r = eta - y
+        d = self.delta
+        return jnp.clip(r, -d, d)
+
+
+class ExponentialLossFunc(UnaryLossFunc):
+    name = "exponential"
+
+    def loss(self, eta, y):
+        return jnp.exp(-y * eta)
+
+    def derivative(self, eta, y):
+        return -y * jnp.exp(-y * eta)
+
+
+class PerceptronLossFunc(UnaryLossFunc):
+    name = "perceptron"
+
+    def loss(self, eta, y):
+        return jnp.maximum(0.0, -y * eta)
+
+    def derivative(self, eta, y):
+        return jnp.where(y * eta < 0.0, -y, 0.0)
+
+
+class ZeroOneLossFunc(UnaryLossFunc):
+    name = "zero_one"
+
+    def loss(self, eta, y):
+        return (jnp.sign(eta) != y).astype(eta.dtype)
+
+    def derivative(self, eta, y):
+        return jnp.zeros_like(eta)
+
+
+LOSS_REGISTRY = {
+    "log": LogLossFunc, "hinge": HingeLossFunc, "smooth_hinge": SmoothHingeLossFunc,
+    "square": SquareLossFunc, "svr": SvrLossFunc, "huber": HuberLossFunc,
+    "exponential": ExponentialLossFunc, "perceptron": PerceptronLossFunc,
+    "zero_one": ZeroOneLossFunc,
+}
+
+
+# ---------------------------------------------------------------------------
+# design-matrix ops over a data shard
+# ---------------------------------------------------------------------------
+
+def matvec(data: Dict, coef):
+    """margins = X @ coef for dense or padded-COO shard."""
+    if "X" in data:
+        return data["X"] @ coef
+    return (data["val"] * coef[data["idx"]]).sum(-1)
+
+
+def rmatvec(data: Dict, c, dim: int):
+    """X^T @ c — gradient accumulation (one-hot scatter-add for sparse)."""
+    if "X" in data:
+        return data["X"].T @ c
+    contrib = data["val"] * c[:, None]
+    return jnp.zeros(dim, contrib.dtype).at[data["idx"].reshape(-1)].add(
+        contrib.reshape(-1))
+
+
+class OptimObjFunc:
+    """Base objective: per-shard grad/loss/hessian + global regularization."""
+
+    def __init__(self, dim: int, l1: float = 0.0, l2: float = 0.0,
+                 reg_free_head: int = 0):
+        self.dim = int(dim)
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+        # first `reg_free_head` coefficients (the intercept) are unregularized
+        self.reg_free_head = int(reg_free_head)
+
+    def _reg_mask(self, coef):
+        if self.reg_free_head == 0:
+            return jnp.ones_like(coef)
+        return jnp.concatenate([jnp.zeros(self.reg_free_head, coef.dtype),
+                                jnp.ones(self.dim - self.reg_free_head, coef.dtype)])
+
+    def regular_loss(self, coef):
+        m = self._reg_mask(coef)
+        return (0.5 * self.l2 * ((coef * m) ** 2).sum()
+                + self.l1 * jnp.abs(coef * m).sum())
+
+    def l2_grad(self, coef):
+        return self.l2 * coef * self._reg_mask(coef)
+
+    # interface ----------------------------------------------------------
+    def calc_grad_shard(self, data, coef):
+        """-> (grad_sum, loss_sum, weight_sum) — unnormalized shard sums."""
+        raise NotImplementedError
+
+    def line_losses_shard(self, data, coef, direction, steps):
+        """losses at coef - steps[j]*direction -> (num_steps,) shard sums."""
+        raise NotImplementedError
+
+    def hessian_shard(self, data, coef):
+        raise NotImplementedError
+
+
+class UnaryLossObjFunc(OptimObjFunc):
+    """sum_i w_i * loss(x_i . coef, y_i) (reference common/linear/UnaryLossObjFunc.java)."""
+
+    def __init__(self, unary_loss: UnaryLossFunc, dim: int, l1=0.0, l2=0.0,
+                 reg_free_head: int = 0):
+        super().__init__(dim, l1, l2, reg_free_head)
+        self.unary_loss = unary_loss
+
+    def calc_grad_shard(self, data, coef):
+        eta = matvec(data, coef)
+        y, w = data["y"], data["w"]
+        loss = (w * self.unary_loss.loss(eta, y)).sum()
+        c = w * self.unary_loss.derivative(eta, y)
+        grad = rmatvec(data, c, self.dim)
+        return grad, loss, w.sum()
+
+    def line_losses_shard(self, data, coef, direction, steps):
+        eta0 = matvec(data, coef)
+        etad = matvec(data, direction)
+        y, w = data["y"], data["w"]
+
+        def one(s):
+            return (w * self.unary_loss.loss(eta0 - s * etad, y)).sum()
+
+        return jax.vmap(one)(steps)
+
+    def hessian_shard(self, data, coef):
+        if "X" not in data:
+            raise NotImplementedError("Newton requires dense features")
+        eta = matvec(data, coef)
+        y, w = data["y"], data["w"]
+        h = w * self.unary_loss.second_derivative(eta, y)
+        H = (data["X"] * h[:, None]).T @ data["X"]
+        grad, loss, wsum = self.calc_grad_shard(data, coef)
+        return H, grad, loss, wsum
+
+
+class SoftmaxObjFunc(OptimObjFunc):
+    """Multinomial logistic objective (reference common/linear/SoftmaxObjFunc.java).
+
+    coef is the flattened (k-1, d) matrix — class k-1 is the pivot with zero
+    logits, matching the reference's k-1 parameterization. ``data["y"]``
+    holds integer class indices.
+    """
+
+    def __init__(self, k: int, d: int, l1=0.0, l2=0.0, reg_free_cols: int = 0):
+        super().__init__((k - 1) * d, l1, l2, reg_free_head=0)
+        self.k = int(k)
+        self.d = int(d)
+        self.reg_free_cols = reg_free_cols  # leading feature columns w/o reg (intercept)
+
+    def _reg_mask(self, coef):
+        m = jnp.ones((self.k - 1, self.d), coef.dtype)
+        if self.reg_free_cols:
+            m = m.at[:, :self.reg_free_cols].set(0.0)
+        return m.reshape(-1)
+
+    def _logits(self, data, W):
+        if "X" in data:
+            z = data["X"] @ W.T  # (n, k-1)
+        else:
+            gathered = W.T[data["idx"]]           # (n, nnz, k-1)
+            z = (gathered * data["val"][..., None]).sum(1)
+        return jnp.concatenate([z, jnp.zeros((z.shape[0], 1), z.dtype)], axis=1)
+
+    def calc_grad_shard(self, data, coef):
+        W = coef.reshape(self.k - 1, self.d)
+        y, w = data["y"].astype(jnp.int32), data["w"]
+        logits = self._logits(data, W)
+        lse = jax.nn.logsumexp(logits, axis=1)
+        loss = (w * (lse - jnp.take_along_axis(logits, y[:, None], 1)[:, 0])).sum()
+        p = jax.nn.softmax(logits, axis=1)
+        delta = (p - jax.nn.one_hot(y, self.k, dtype=p.dtype)) * w[:, None]  # (n,k)
+        delta = delta[:, :self.k - 1]  # drop pivot class
+        if "X" in data:
+            grad = (delta.T @ data["X"]).reshape(-1)
+        else:
+            contrib = delta[:, None, :] * data["val"][:, :, None]  # (n, nnz, k-1)
+            flat_idx = data["idx"].reshape(-1)
+            g = jnp.zeros((self.d, self.k - 1), contrib.dtype)
+            g = g.at[flat_idx].add(contrib.reshape(-1, self.k - 1))
+            grad = g.T.reshape(-1)
+        return grad, loss, w.sum()
+
+    def line_losses_shard(self, data, coef, direction, steps):
+        W = coef.reshape(self.k - 1, self.d)
+        D = direction.reshape(self.k - 1, self.d)
+        y, w = data["y"].astype(jnp.int32), data["w"]
+        z0 = self._logits(data, W)
+        zd = self._logits(data, D)
+
+        def one(s):
+            z = z0 - s * zd
+            lse = jax.nn.logsumexp(z, axis=1)
+            return (w * (lse - jnp.take_along_axis(z, y[:, None], 1)[:, 0])).sum()
+
+        return jax.vmap(one)(steps)

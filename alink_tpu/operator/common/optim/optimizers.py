@@ -1,0 +1,360 @@
+"""Distributed optimizers on the BSP engine.
+
+Re-design of the reference optimizer stack (common/optim/: Lbfgs.java:82-176,
+Sgd.java:82-140, Gd.java, Owlqn.java, Newton.java, subfunc/CalcGradient.java:27-54,
+subfunc/UpdateModel.java, PreallocateLossCurve) — each optimizer is an
+IterativeComQueue program:
+
+  CalcGradient      -> per-shard fused matmul/gather kernel
+  AllReduce(grad)   -> lax.psum
+  CalDirection      -> L-BFGS two-loop on a fixed-size ring buffer
+                       (the mutable sK/yK heap state of Lbfgs.java:130-174
+                       becomes masked carry arrays)
+  CalcLosses        -> vectorized parallel line search (losses at a fixed
+                       ladder of step sizes in one vmap — the reference's
+                       numSearchStep loop, UpdateModel.java)
+  AllReduce(losses) -> lax.psum
+  UpdateModel       -> argmin step, coef update, loss-curve write
+
+The whole loop is one compiled XLA program; convergence is a carry bit
+checked by the engine's while_loop (variable trip count with a preallocated
+loss curve, per SURVEY §7 hard-parts).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ....common.mlenv import MLEnvironment
+from ....engine import AllReduce, IterativeComQueue
+from .objfunc import OptimObjFunc
+
+_TINY = 1e-12
+_NUM_SEARCH_STEP = 10  # line-search ladder size (reference numSearchStep=4, widened)
+_HISTORY = 10          # L-BFGS memory (reference m=10, Lbfgs.java)
+
+
+@dataclass
+class OptimParams:
+    method: str = "LBFGS"
+    max_iter: int = 100
+    epsilon: float = 1e-6
+    learning_rate: float = 1.0
+    mini_batch_fraction: float = 0.1
+    seed: int = 0
+
+
+def optimize(obj: OptimObjFunc, data: Dict[str, np.ndarray], params: OptimParams,
+             env: Optional[MLEnvironment] = None,
+             warm_start: Optional[np.ndarray] = None) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Run the selected optimizer; returns (coef, loss_curve, num_steps).
+
+    ``data``: host arrays — dense {"X", "y", "w"} or sparse
+    {"idx", "val", "y", "w"}; rows are padded/sharded by the engine
+    (w==0 marks padding).
+    """
+    method = (params.method or "LBFGS").upper()
+    if method == "LBFGS":
+        return _quasi_newton(obj, data, params, env, warm_start, owlqn=False)
+    if method == "OWLQN":
+        return _quasi_newton(obj, data, params, env, warm_start, owlqn=True)
+    if method == "GD":
+        return _quasi_newton(obj, data, params, env, warm_start, owlqn=False, history=0)
+    if method == "SGD":
+        return _sgd(obj, data, params, env, warm_start)
+    if method == "NEWTON":
+        return _newton(obj, data, params, env, warm_start)
+    raise ValueError(f"unknown optim method {params.method}")
+
+
+# ---------------------------------------------------------------------------
+# L-BFGS / OWLQN / GD (shared skeleton; GD is history=0)
+# ---------------------------------------------------------------------------
+
+def _two_loop(g, sk, yk, pos, nvalid, m):
+    """L-BFGS two-loop recursion with ring buffer + validity masks
+    (reference Lbfgs.java:109-176 ``CalDirection``)."""
+    if m == 0:
+        return g
+    dt = g.dtype
+    q = g
+    alphas = []
+    for t in range(m):
+        j = (pos - 1 - t) % m
+        s, yv = sk[j], yk[j]
+        sy = jnp.dot(s, yv)
+        valid = (t < nvalid) & (sy > _TINY)
+        rho = 1.0 / jnp.where(valid, sy, 1.0)
+        a = jnp.where(valid, rho * jnp.dot(s, q), 0.0)
+        q = q - a * yv
+        alphas.append((a, valid, j))
+    jlast = (pos - 1) % m
+    sy_l = jnp.dot(sk[jlast], yk[jlast])
+    yy_l = jnp.dot(yk[jlast], yk[jlast])
+    ok = (nvalid > 0) & (sy_l > _TINY) & (yy_l > _TINY)
+    gamma = jnp.where(ok, sy_l / jnp.where(yy_l > _TINY, yy_l, 1.0), jnp.asarray(1.0, dt))
+    r = gamma * q
+    for a, valid, j in reversed(alphas):
+        s, yv = sk[j], yk[j]
+        sy = jnp.dot(s, yv)
+        rho = 1.0 / jnp.where(sy > _TINY, sy, 1.0)
+        b = rho * jnp.dot(yv, r)
+        r = r + jnp.where(valid, (a - b) * s, 0.0)
+    return r
+
+
+def _pseudo_grad(g_plain, coef, l1, reg_mask):
+    """OWLQN pseudo-gradient (reference Owlqn.java)."""
+    l1m = l1 * reg_mask
+    at_zero = jnp.where(g_plain + l1m < 0, g_plain + l1m,
+                        jnp.where(g_plain - l1m > 0, g_plain - l1m, 0.0))
+    return jnp.where(coef != 0, g_plain + l1m * jnp.sign(coef), at_zero)
+
+
+def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
+                  history: int = _HISTORY):
+    dim = obj.dim
+    dtype = np.asarray(data["y"]).dtype
+    if dtype not in (np.float32, np.float64):
+        dtype = np.float32
+    m = history
+    max_iter = params.max_iter
+    eps = params.epsilon
+    w0 = np.zeros(dim, dtype) if warm_start is None else np.asarray(warm_start, dtype)
+    reg_mask_np = None  # built lazily on device
+
+    steps_ladder = params.learning_rate * np.power(
+        2.0, 1 - np.arange(_NUM_SEARCH_STEP, dtype=np.float64))
+    steps_ladder = np.concatenate([[0.0], steps_ladder]).astype(dtype)
+
+    def calc_grad(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("coef", ctx.get_obj("coef0"))
+            ctx.put_obj("coef_prev", ctx.get_obj("coef0"))
+            ctx.put_obj("grad_prev", jnp.zeros(dim, dtype))
+            if m > 0:
+                ctx.put_obj("sk", jnp.zeros((m, dim), dtype))
+                ctx.put_obj("yk", jnp.zeros((m, dim), dtype))
+            ctx.put_obj("pos", jnp.asarray(0, jnp.int32))
+            ctx.put_obj("nvalid", jnp.asarray(0, jnp.int32))
+            ctx.put_obj("step_scale", jnp.asarray(1.0, dtype))
+            ctx.put_obj("loss_curve", jnp.full((max_iter,), jnp.nan, dtype))
+            ctx.put_obj("conv", jnp.asarray(False))
+        shard = _shard_views(ctx)
+        g, loss, wsum = obj.calc_grad_shard(shard, ctx.get_obj("coef"))
+        ctx.put_obj("glw", jnp.concatenate([g, jnp.stack([loss, wsum])]))
+
+    def direction_and_losses(ctx):
+        glw = ctx.get_obj("glw")
+        coef = ctx.get_obj("coef")
+        W = jnp.maximum(glw[dim + 1], _TINY)
+        g_plain = glw[:dim] / W + obj.l2_grad(coef)
+        loss_total = glw[dim] / W + obj.regular_loss(coef)
+        step = ctx.step_no
+        ctx.put_obj("loss_curve", jax.lax.dynamic_update_index_in_dim(
+            ctx.get_obj("loss_curve"), loss_total.astype(dtype), step - 1, 0))
+
+        if owlqn:
+            g_dir = _pseudo_grad(g_plain, coef, obj.l1, obj._reg_mask(coef))
+        else:
+            g_dir = g_plain
+        gnorm = jnp.linalg.norm(g_dir) / jnp.maximum(1.0, jnp.linalg.norm(coef))
+        ctx.put_obj("conv", gnorm < eps)
+
+        if m > 0:
+            # push pair (coef - coef_prev, g - g_prev); masked out on step 1
+            push = step > 1
+            snew = coef - ctx.get_obj("coef_prev")
+            ynew = g_plain - ctx.get_obj("grad_prev")
+            pos = ctx.get_obj("pos")
+            sk = ctx.get_obj("sk")
+            yk = ctx.get_obj("yk")
+            sk = jnp.where(push, sk.at[pos].set(snew), sk)
+            yk = jnp.where(push, yk.at[pos].set(ynew), yk)
+            pos = jnp.where(push, (pos + 1) % m, pos)
+            nvalid = jnp.where(push, jnp.minimum(ctx.get_obj("nvalid") + 1, m),
+                               ctx.get_obj("nvalid"))
+            ctx.put_obj("sk", sk)
+            ctx.put_obj("yk", yk)
+            ctx.put_obj("pos", pos)
+            ctx.put_obj("nvalid", nvalid)
+            d = _two_loop(g_dir, sk, yk, pos, nvalid, m)
+        else:
+            d = g_dir
+        if owlqn:
+            d = jnp.where(d * g_dir > 0, d, 0.0)
+        ctx.put_obj("dir", d)
+        ctx.put_obj("grad_prev", g_plain)
+        ctx.put_obj("pg", g_dir)
+
+        steps = jnp.asarray(steps_ladder) * ctx.get_obj("step_scale")
+        shard = _shard_views(ctx)
+        ctx.put_obj("line_losses", obj.line_losses_shard(shard, coef, d, steps))
+        ctx.put_obj("steps", steps)
+
+    def update_model(ctx):
+        coef = ctx.get_obj("coef")
+        d = ctx.get_obj("dir")
+        steps = ctx.get_obj("steps")
+        glw = ctx.get_obj("glw")
+        W = jnp.maximum(glw[dim + 1], _TINY)
+        reg = jax.vmap(lambda s: obj.regular_loss(coef - s * d))(steps)
+        total = ctx.get_obj("line_losses") / W + reg
+        best = jnp.argmin(total)
+        s_best = steps[best]
+        new_coef = coef - s_best * d
+        if owlqn:
+            pg = ctx.get_obj("pg")
+            orthant = jnp.where(coef != 0, jnp.sign(coef), -jnp.sign(pg))
+            new_coef = jnp.where(new_coef * orthant < 0, 0.0, new_coef)
+        ctx.put_obj("coef_prev", coef)
+        ctx.put_obj("coef", new_coef)
+        # adapt the ladder like the reference's step grow/shrink heuristic
+        scale = ctx.get_obj("step_scale")
+        scale = jnp.where(best == 0, scale * 0.25,
+                          jnp.where(best == 1, scale * 2.0,
+                                    jnp.where(best == _NUM_SEARCH_STEP, scale * 0.5, scale)))
+        ctx.put_obj("step_scale", jnp.clip(scale, 1e-10, 1e6))
+
+    queue = (IterativeComQueue(env=env, max_iter=max_iter, seed=params.seed)
+             .init_with_broadcast_data("coef0", w0)
+             .add(calc_grad)
+             .add(AllReduce("glw"))
+             .add(direction_and_losses)
+             .add(AllReduce("line_losses"))
+             .add(update_model)
+             .set_compare_criterion(lambda ctx: ctx.get_obj("conv")))
+    for k, v in data.items():
+        queue.init_with_partitioned_data(k, v)
+    res = queue.exec()
+    return res.get("coef"), _trim_curve(res.get("loss_curve")), res.step_count
+
+
+# ---------------------------------------------------------------------------
+# mini-batch SGD (reference Sgd.java CalcSubGradient :101-140)
+# ---------------------------------------------------------------------------
+
+def _sgd(obj, data, params, env, warm_start):
+    dim = obj.dim
+    dtype = np.asarray(data["y"]).dtype
+    if dtype not in (np.float32, np.float64):
+        dtype = np.float32
+    max_iter = params.max_iter
+    frac = params.mini_batch_fraction
+    w0 = np.zeros(dim, dtype) if warm_start is None else np.asarray(warm_start, dtype)
+
+    def calc_grad(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("coef", ctx.get_obj("coef0"))
+            ctx.put_obj("loss_curve", jnp.full((max_iter,), jnp.nan, dtype))
+            ctx.put_obj("conv", jnp.asarray(False))
+        shard = _shard_views(ctx)
+        # per-worker random sub-sample each superstep, on-device RNG
+        mask = jax.random.bernoulli(ctx.rng_key(), frac, shard["y"].shape)
+        sub = dict(shard)
+        sub["w"] = shard["w"] * mask.astype(shard["w"].dtype)
+        g, loss, wsum = obj.calc_grad_shard(sub, ctx.get_obj("coef"))
+        ctx.put_obj("glw", jnp.concatenate([g, jnp.stack([loss, wsum])]))
+
+    def update(ctx):
+        glw = ctx.get_obj("glw")
+        coef = ctx.get_obj("coef")
+        W = jnp.maximum(glw[dim + 1], _TINY)
+        g = glw[:dim] / W + obj.l2_grad(coef)
+        step = ctx.step_no
+        lr = params.learning_rate / jnp.sqrt(step.astype(dtype))
+        new_coef = coef - lr * g
+        if obj.l1 > 0:  # proximal soft-threshold for L1
+            thr = obj.l1 * lr * obj._reg_mask(coef)
+            new_coef = jnp.sign(new_coef) * jnp.maximum(jnp.abs(new_coef) - thr, 0.0)
+        ctx.put_obj("coef", new_coef)
+        loss_total = glw[dim] / W + obj.regular_loss(coef)
+        ctx.put_obj("loss_curve", jax.lax.dynamic_update_index_in_dim(
+            ctx.get_obj("loss_curve"), loss_total.astype(dtype), step - 1, 0))
+        ctx.put_obj("conv", jnp.linalg.norm(lr * g) <
+                    params.epsilon * jnp.maximum(1.0, jnp.linalg.norm(coef)))
+
+    queue = (IterativeComQueue(env=env, max_iter=max_iter, seed=params.seed)
+             .init_with_broadcast_data("coef0", w0)
+             .add(calc_grad)
+             .add(AllReduce("glw"))
+             .add(update)
+             .set_compare_criterion(lambda ctx: ctx.get_obj("conv")))
+    for k, v in data.items():
+        queue.init_with_partitioned_data(k, v)
+    res = queue.exec()
+    return res.get("coef"), _trim_curve(res.get("loss_curve")), res.step_count
+
+
+# ---------------------------------------------------------------------------
+# Newton (reference Newton.java — dense Hessian + solve)
+# ---------------------------------------------------------------------------
+
+def _newton(obj, data, params, env, warm_start):
+    dim = obj.dim
+    dtype = np.asarray(data["y"]).dtype
+    if dtype not in (np.float32, np.float64):
+        dtype = np.float32
+    max_iter = params.max_iter
+    w0 = np.zeros(dim, dtype) if warm_start is None else np.asarray(warm_start, dtype)
+
+    def calc(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("coef", ctx.get_obj("coef0"))
+            ctx.put_obj("loss_curve", jnp.full((max_iter,), jnp.nan, dtype))
+            ctx.put_obj("conv", jnp.asarray(False))
+        shard = _shard_views(ctx)
+        H, g, loss, wsum = obj.hessian_shard(shard, ctx.get_obj("coef"))
+        ctx.put_obj("H", H)
+        ctx.put_obj("glw", jnp.concatenate([g, jnp.stack([loss, wsum])]))
+
+    def update(ctx):
+        glw = ctx.get_obj("glw")
+        coef = ctx.get_obj("coef")
+        W = jnp.maximum(glw[dim + 1], _TINY)
+        g = glw[:dim] / W + obj.l2_grad(coef)
+        H = ctx.get_obj("H") / W
+        reg_diag = obj.l2 * obj._reg_mask(coef) + 1e-8
+        H = H + jnp.diag(reg_diag.astype(H.dtype))
+        d = jnp.linalg.solve(H, g)
+        ctx.put_obj("coef", coef - d)
+        step = ctx.step_no
+        loss_total = glw[dim] / W + obj.regular_loss(coef)
+        ctx.put_obj("loss_curve", jax.lax.dynamic_update_index_in_dim(
+            ctx.get_obj("loss_curve"), loss_total.astype(dtype), step - 1, 0))
+        ctx.put_obj("conv", jnp.linalg.norm(d) <
+                    params.epsilon * jnp.maximum(1.0, jnp.linalg.norm(coef)))
+
+    queue = (IterativeComQueue(env=env, max_iter=max_iter, seed=params.seed)
+             .init_with_broadcast_data("coef0", w0)
+             .add(calc)
+             .add(AllReduce("H"))
+             .add(AllReduce("glw"))
+             .add(update)
+             .set_compare_criterion(lambda ctx: ctx.get_obj("conv")))
+    for k, v in data.items():
+        queue.init_with_partitioned_data(k, v)
+    res = queue.exec()
+    return res.get("coef"), _trim_curve(res.get("loss_curve")), res.step_count
+
+
+# ---------------------------------------------------------------------------
+
+def _shard_views(ctx):
+    """Collect the partitioned training arrays visible to this worker."""
+    shard = {}
+    for k in ("X", "idx", "val", "y", "w"):
+        if ctx.contains_obj(k):
+            shard[k] = ctx.get_obj(k)
+    return shard
+
+
+def _trim_curve(curve: np.ndarray) -> np.ndarray:
+    curve = np.asarray(curve)
+    valid = ~np.isnan(curve)
+    return curve[:int(valid.sum())]

@@ -1,0 +1,3 @@
+from .base import (Pipeline, PipelineModel, PipelineStage, Estimator, Transformer,
+                   Model, MapModel, Trainer, LocalPredictor)
+from . import classification, regression

@@ -1,0 +1,55 @@
+"""Pipeline wrappers — classification.
+
+Re-design of pipeline/classification/ (LogisticRegression, LinearSvm,
+Softmax + *Model classes): declarative shells over the batch ops
+(reference pipeline/Trainer.java reflection pattern). Each estimator
+carries both train and predict params so the fitted model transforms
+directly.
+"""
+
+from ..operator.batch.classification.linear import (
+    _LinearPredictParams, _LinearTrainParams, LinearSvmTrainBatchOp,
+    LogisticRegressionTrainBatchOp, PerceptronTrainBatchOp, SoftmaxTrainBatchOp)
+from ..operator.common.linear.mapper import LinearModelMapper
+from ..params.shared import HasPositiveLabelValueString
+from .base import MapModel, Trainer
+
+
+class _LinearParams(_LinearTrainParams, _LinearPredictParams):
+    pass
+
+
+class LogisticRegressionModel(MapModel, _LinearPredictParams):
+    MAPPER_CLS = LinearModelMapper
+
+
+class LogisticRegression(Trainer, _LinearParams, HasPositiveLabelValueString):
+    TRAIN_OP_CLS = LogisticRegressionTrainBatchOp
+    MODEL_CLS = LogisticRegressionModel
+
+
+class LinearSvmModel(MapModel, _LinearPredictParams):
+    MAPPER_CLS = LinearModelMapper
+
+
+class LinearSvm(Trainer, _LinearParams, HasPositiveLabelValueString):
+    TRAIN_OP_CLS = LinearSvmTrainBatchOp
+    MODEL_CLS = LinearSvmModel
+
+
+class SoftmaxModel(MapModel, _LinearPredictParams):
+    MAPPER_CLS = LinearModelMapper
+
+
+class Softmax(Trainer, _LinearParams):
+    TRAIN_OP_CLS = SoftmaxTrainBatchOp
+    MODEL_CLS = SoftmaxModel
+
+
+class PerceptronModel(MapModel, _LinearPredictParams):
+    MAPPER_CLS = LinearModelMapper
+
+
+class Perceptron(Trainer, _LinearParams):
+    TRAIN_OP_CLS = PerceptronTrainBatchOp
+    MODEL_CLS = PerceptronModel

@@ -19,4 +19,5 @@ if os.environ.get(_MARK) != "1":
     env["XLA_FLAGS"] = (env.get("ALINK_TPU_EXTRA_XLA_FLAGS", "") +
                         " --xla_force_host_platform_device_count=8").strip()
     env["PALLAS_AXON_POOL_IPS"] = ""  # disable axon sitecustomize TPU hook
+    env["JAX_ENABLE_X64"] = "1"  # float64 parity on the CPU test mesh
     os.execvpe(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
