@@ -1,0 +1,1 @@
+from .kmeans_ops import KMeansTrainBatchOp, KMeansPredictBatchOp

@@ -128,7 +128,9 @@ class NumericalTypeCastBatchOp(BatchOperator, HasSelectedCols):
         t = in_op.get_output_table()
         target = self.get_target_type().upper()
         dt = AlinkTypes.to_numpy_dtype(target)
-        for c in (self.get_selected_cols() or t.col_names):
+        default = [n for n, tp in zip(t.schema.names, t.schema.types)
+                   if AlinkTypes.is_numeric(tp)]
+        for c in (self.get_selected_cols() or default):
             t = t.add_column(c, np.asarray(t.col(c), dtype=dt), target)
         self._output = t
         return self

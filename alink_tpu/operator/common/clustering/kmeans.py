@@ -1,0 +1,118 @@
+"""KMeans internals — TPU-native.
+
+Re-design of common/clustering/kmeans/ (call stack SURVEY §3.3):
+  KMeansPreallocateCentroid  -> init centroids (host k-means++ / random)
+  KMeansAssignCluster        -> distances as ONE matmul on the MXU
+                                (||x||^2 - 2 x.c + ||c||^2), argmin, and the
+                                k x (d+1) sum/weight buffer built with a
+                                one-hot scatter-add matmul (replaces
+                                KMeansUtil.updateSumMatrix's per-point loop,
+                                KMeansAssignCluster.java:60-64)
+  AllReduce(centroidAllReduce) -> lax.psum
+  KMeansUpdateCentroids      -> sums / weights (KMeansUpdateCentroids.java:53-71)
+  KMeansIterTermination      -> centroid movement < tol carry bit
+Supports EUCLIDEAN and COSINE distances (reference FastDistance pre-norms).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ....common.mlenv import MLEnvironment
+from ....engine import AllReduce, IterativeComQueue
+
+
+def kmeans_plus_plus_init(X: np.ndarray, k: int, seed: int,
+                          sample_cap: int = 4096) -> np.ndarray:
+    """k-means++ seeding on a bounded host sample (reference KMeansInitCentroids
+    K-MEANS|| has the same role: good seeds without a full device pass)."""
+    rng = np.random.RandomState(seed)
+    n = X.shape[0]
+    if n > sample_cap:
+        X = X[rng.choice(n, sample_cap, replace=False)]
+        n = sample_cap
+    cents = [X[rng.randint(n)]]
+    d2 = ((X - cents[0]) ** 2).sum(1)
+    for _ in range(1, k):
+        tot = d2.sum()
+        if tot <= 0:  # fewer distinct points than k: fall back to uniform
+            cents.append(X[rng.randint(n)])
+            continue
+        cents.append(X[rng.choice(n, p=d2 / tot)])
+        d2 = np.minimum(d2, ((X - cents[-1]) ** 2).sum(1))
+    return np.stack(cents)
+
+
+def random_init(X: np.ndarray, k: int, seed: int) -> np.ndarray:
+    rng = np.random.RandomState(seed)
+    return X[rng.choice(X.shape[0], k, replace=X.shape[0] < k)]
+
+
+def _distances(X, C, distance_type: str):
+    """(n, k) distance matrix as one MXU matmul."""
+    if distance_type == "COSINE":
+        Xn = X / jnp.maximum(jnp.linalg.norm(X, axis=1, keepdims=True), 1e-12)
+        Cn = C / jnp.maximum(jnp.linalg.norm(C, axis=1, keepdims=True), 1e-12)
+        return 1.0 - Xn @ Cn.T
+    x2 = (X ** 2).sum(1, keepdims=True)
+    c2 = (C ** 2).sum(1)
+    return x2 - 2.0 * (X @ C.T) + c2
+
+
+def assign_clusters(X, C, distance_type: str = "EUCLIDEAN"):
+    """Nearest centroid ids + distances for a block."""
+    D = _distances(X, C, distance_type)
+    ids = jnp.argmin(D, axis=1)
+    return ids, jnp.take_along_axis(D, ids[:, None], 1)[:, 0]
+
+
+def kmeans_train(X: np.ndarray, k: int, max_iter: int = 50, tol: float = 1e-4,
+                 distance_type: str = "EUCLIDEAN", init: str = "K_MEANS_PARALLEL",
+                 seed: int = 0, env: Optional[MLEnvironment] = None,
+                 sample_weight: Optional[np.ndarray] = None
+                 ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Returns (centroids (k,d), cluster_weights (k,), num_steps)."""
+    X = np.asarray(X)
+    n, d = X.shape
+    w = np.ones(n, X.dtype) if sample_weight is None else np.asarray(sample_weight, X.dtype)
+    init_c = (kmeans_plus_plus_init(X, k, seed) if init.upper() != "RANDOM"
+              else random_init(X, k, seed)).astype(X.dtype)
+    data = np.concatenate([X, w[:, None]], axis=1)
+    dt = X.dtype
+
+    def assign(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("centroids", ctx.get_obj("init_centroids"))
+            ctx.put_obj("movement", jnp.asarray(jnp.inf, dt))
+        block = ctx.get_obj("data")
+        Xb, wb = block[:, :d], block[:, d]
+        C = ctx.get_obj("centroids")
+        ids, _ = assign_clusters(Xb, C, distance_type)
+        onehot = jax.nn.one_hot(ids, k, dtype=dt) * wb[:, None]   # (n, k), weighted
+        sums = onehot.T @ Xb                                      # (k, d) on MXU
+        cnts = onehot.sum(0)                                      # (k,)
+        ctx.put_obj("buf", jnp.concatenate([sums, cnts[:, None]], 1))
+
+    def update(ctx):
+        buf = ctx.get_obj("buf")
+        C = ctx.get_obj("centroids")
+        sums, cnts = buf[:, :d], buf[:, d]
+        newC = jnp.where(cnts[:, None] > 0, sums / jnp.maximum(cnts[:, None], 1e-12), C)
+        ctx.put_obj("movement", jnp.sqrt(((newC - C) ** 2).sum(1)).max())
+        ctx.put_obj("centroids", newC)
+        ctx.put_obj("cluster_weights", cnts)
+
+    result = (IterativeComQueue(env=env, max_iter=max_iter, seed=seed)
+              .init_with_partitioned_data("data", data)
+              .init_with_broadcast_data("init_centroids", init_c)
+              .add(assign)
+              .add(AllReduce("buf"))
+              .add(update)
+              .set_compare_criterion(lambda ctx: ctx.get_obj("movement") < tol)
+              .exec())
+    return (result.get("centroids"), result.get("cluster_weights"),
+            result.step_count)

@@ -74,8 +74,6 @@ def binary_metrics(labels: np.ndarray, p_pos: np.ndarray, pos_value,
     order = np.argsort(p, kind="mergesort")
     ranks = np.empty(len(p), np.float64)
     sp = p[order]
-    i = 0
-    r = np.arange(1, len(p) + 1, dtype=np.float64)
     # average ranks for ties
     uniq, inv, counts = np.unique(sp, return_inverse=True, return_counts=True)
     csum = np.cumsum(counts)
@@ -191,14 +189,15 @@ def cluster_metrics(X: np.ndarray, assignment: np.ndarray,
                     labels: Optional[Sequence] = None) -> ClusterMetrics:
     """reference ClusterMetricsSummary: CH / DB / silhouette (+purity/NMI/ARI
     when true labels supplied)."""
-    X = np.asarray(X, np.float64)
     a = np.asarray(assignment)
     clusters = sorted(set(a.tolist()))
     k = len(clusters)
     n = len(a)
     out: Dict = {"K": k, "Count": n,
                  "ClusterArray": [int((a == c).sum()) for c in clusters]}
-    if k >= 1 and n > k:
+    if X is not None:
+        X = np.asarray(X, np.float64)
+    if X is not None and k >= 1 and n > k:
         cents = np.stack([X[a == c].mean(0) for c in clusters])
         gmean = X.mean(0)
         sizes = np.asarray([(a == c).sum() for c in clusters], np.float64)

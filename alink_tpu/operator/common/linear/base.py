@@ -192,6 +192,7 @@ def train_linear_model(data: MTable, op, model_type: str) -> Tuple[MTable, MTabl
         epsilon=float(op.params._m.get("epsilon", 1e-6)),
         learning_rate=float(lr),
         mini_batch_fraction=float(op.params._m.get("mini_batch_fraction", 0.1)),
+        seed=int(op.params._m.get("seed", 0) or 0),
     )
     reg_free = 1 if with_intercept else 0
     if softmax:

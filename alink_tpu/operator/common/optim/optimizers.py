@@ -264,7 +264,9 @@ def _sgd(obj, data, params, env, warm_start):
     def update(ctx):
         glw = ctx.get_obj("glw")
         coef = ctx.get_obj("coef")
-        W = jnp.maximum(glw[dim + 1], _TINY)
+        wsum = glw[dim + 1]
+        nonempty = wsum > 0
+        W = jnp.maximum(wsum, _TINY)
         g = glw[:dim] / W + obj.l2_grad(coef)
         step = ctx.step_no
         lr = params.learning_rate / jnp.sqrt(step.astype(dtype))
@@ -272,12 +274,13 @@ def _sgd(obj, data, params, env, warm_start):
         if obj.l1 > 0:  # proximal soft-threshold for L1
             thr = obj.l1 * lr * obj._reg_mask(coef)
             new_coef = jnp.sign(new_coef) * jnp.maximum(jnp.abs(new_coef) - thr, 0.0)
+        new_coef = jnp.where(nonempty, new_coef, coef)  # skip empty minibatches
         ctx.put_obj("coef", new_coef)
         loss_total = glw[dim] / W + obj.regular_loss(coef)
         ctx.put_obj("loss_curve", jax.lax.dynamic_update_index_in_dim(
             ctx.get_obj("loss_curve"), loss_total.astype(dtype), step - 1, 0))
-        ctx.put_obj("conv", jnp.linalg.norm(lr * g) <
-                    params.epsilon * jnp.maximum(1.0, jnp.linalg.norm(coef)))
+        ctx.put_obj("conv", nonempty & (jnp.linalg.norm(lr * g) <
+                    params.epsilon * jnp.maximum(1.0, jnp.linalg.norm(coef))))
 
     queue = (IterativeComQueue(env=env, max_iter=max_iter, seed=params.seed)
              .init_with_broadcast_data("coef0", w0)

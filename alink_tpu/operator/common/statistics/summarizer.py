@@ -171,7 +171,6 @@ def summarize_vector_col(table: MTable, vector_col: str) -> VectorSummary:
     mn = np.full(dim, np.inf)
     mx = np.full(dim, -np.inf)
     nnz = np.zeros(dim)
-    touched = np.zeros(dim, dtype=np.int64)
     for v in vecs:
         if isinstance(v, DenseVector):
             d = np.zeros(dim)
@@ -181,7 +180,6 @@ def summarize_vector_col(table: MTable, vector_col: str) -> VectorSummary:
             mn = np.minimum(mn, d)
             mx = np.maximum(mx, d)
             nnz += d != 0
-            touched += 1
         else:
             idx, val = v.indices, v.values
             np.add.at(s, idx, val)

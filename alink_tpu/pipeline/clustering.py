@@ -1,0 +1,19 @@
+"""Pipeline wrappers — clustering (reference pipeline/clustering/)."""
+
+from ..operator.batch.clustering.kmeans_ops import (KMeansModelMapper,
+                                                    KMeansPredictBatchOp,
+                                                    KMeansTrainBatchOp,
+                                                    _KMeansParams)
+from ..params.shared import HasPredictionCol, HasReservedCols
+from .base import MapModel, Trainer
+
+
+class KMeansModel(MapModel, HasPredictionCol, HasReservedCols):
+    MAPPER_CLS = KMeansModelMapper
+    PREDICTION_DISTANCE_COL = KMeansPredictBatchOp.PREDICTION_DISTANCE_COL
+
+
+class KMeans(Trainer, _KMeansParams, HasPredictionCol, HasReservedCols):
+    TRAIN_OP_CLS = KMeansTrainBatchOp
+    MODEL_CLS = KMeansModel
+    PREDICTION_DISTANCE_COL = KMeansPredictBatchOp.PREDICTION_DISTANCE_COL

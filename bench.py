@@ -1,0 +1,96 @@
+"""Benchmark: LogisticRegression training throughput (north-star workload).
+
+Measures samples/sec/chip training a Criteo-style sparse CTR LogisticRegression
+with the distributed L-BFGS BSP program (BASELINE.md: "FTRL/LogReg on
+Criteo" is the headline config; the reference publishes no numbers, so
+``vs_baseline`` compares against a numpy/BLAS implementation of the same
+superstep on the host CPU — the stand-in for one Flink task-slot worker).
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_data(n_rows: int, dim: int, nnz: int, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    idx = rng.randint(0, dim, size=(n_rows, nnz)).astype(np.int32)
+    val = np.ones((n_rows, nnz), np.float32)
+    w_true = (rng.randn(dim) * (rng.rand(dim) < 0.05)).astype(np.float32)
+    margin = (w_true[idx] * val).sum(-1)
+    y = np.where(rng.rand(n_rows) < 1.0 / (1.0 + np.exp(-margin)), 1.0, -1.0
+                 ).astype(np.float32)
+    return idx, val, y
+
+
+def tpu_run(idx, val, y, iters: int) -> float:
+    """Wall-seconds for `iters` L-BFGS supersteps (compile excluded by delta)."""
+    from alink_tpu.common.mlenv import MLEnvironment, MLEnvironmentFactory
+    from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                         UnaryLossObjFunc)
+    from alink_tpu.operator.common.optim.optimizers import OptimParams, optimize
+
+    env = MLEnvironment()
+    MLEnvironmentFactory.set_default(env)
+    dim = int(idx.max()) + 1
+    data = {"idx": idx, "val": val, "y": y, "w": np.ones(len(y), np.float32)}
+
+    def run(n_iter):
+        obj = UnaryLossObjFunc(LogLossFunc(), dim, l2=1e-4)
+        t0 = time.perf_counter()
+        optimize(obj, data, OptimParams(method="LBFGS", max_iter=n_iter,
+                                        epsilon=0.0), env)
+        return time.perf_counter() - t0
+
+    t1 = run(1)          # compile + 1 iter
+    t_full = run(1 + iters)  # compile + 1 + iters
+    return max(t_full - t1, 1e-9), env.num_workers
+
+
+def cpu_baseline(idx, val, y, iters: int) -> float:
+    """Same superstep in numpy (gather, scatter-add grad, 11-point line search)."""
+    dim = int(idx.max()) + 1
+    coef = np.zeros(dim, np.float32)
+    d = np.zeros(dim, np.float32)
+    w = np.ones(len(y), np.float32)
+    steps = np.concatenate([[0.0], 2.0 ** (1 - np.arange(10))]).astype(np.float32)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        eta = (val * coef[idx]).sum(-1)
+        c = w * (-y / (1.0 + np.exp(y * eta)))
+        g = np.zeros(dim, np.float32)
+        np.add.at(g, idx.reshape(-1), (val * c[:, None]).reshape(-1))
+        d = g
+        eta_d = (val * d[idx]).sum(-1)
+        losses = []
+        for s in steps:
+            m = y * (eta - s * eta_d)
+            losses.append((w * np.logaddexp(0.0, -m)).sum())
+        coef = coef - steps[int(np.argmin(losses))] * d
+    return time.perf_counter() - t0
+
+
+def main():
+    n_rows, dim, nnz, iters = 200_000, 1 << 16, 32, 30
+    idx, val, y = make_data(n_rows, dim, nnz)
+    tpu_t, n_chips = tpu_run(idx, val, y, iters)
+    tpu_sps = n_rows * iters / tpu_t / max(n_chips, 1)
+
+    base_iters = 3
+    cpu_t = cpu_baseline(idx, val, y, base_iters)
+    cpu_sps = n_rows * base_iters / cpu_t
+
+    print(json.dumps({
+        "metric": "logreg_criteo_lbfgs_samples_per_sec_per_chip",
+        "value": round(tpu_sps, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(tpu_sps / cpu_sps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()
