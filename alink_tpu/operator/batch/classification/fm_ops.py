@@ -1,0 +1,177 @@
+"""FM classifier/regressor batch operators.
+
+Re-design of batch/classification/FmClassifierTrainBatchOp and
+batch/regression/FmRegressorTrainBatchOp (+ predict ops) over common/fm.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+from ....common.mtable import MTable
+from ....common.params import ParamInfo, Params, RangeValidator
+from ....common.types import AlinkTypes, TableSchema
+from ....mapper.base import ModelMapper, OutputColsHelper
+from ....model.converters import (SimpleModelDataConverter, decode_array,
+                                  encode_array)
+from ....params.shared import (HasFeatureCols, HasLabelCol, HasPredictionCol,
+                               HasPredictionDetailCol, HasReservedCols, HasSeed,
+                               HasVectorCol, HasWeightCol)
+from ...base import BatchOperator
+from ...common.dataproc.feature_extract import extract_design, resolve_feature_cols
+from ...common.fm.fm import FmTrainParams, fm_predict_margin, fm_train
+from ...common.linear.base import encode_labels
+from ..utils.model_map import ModelMapBatchOp
+
+
+class FmModelData:
+    def __init__(self, w0, w, V, is_regression, vector_col, feature_cols,
+                 label_values, label_type=AlinkTypes.STRING):
+        self.w0, self.w, self.V = w0, w, V
+        self.is_regression = is_regression
+        self.vector_col = vector_col
+        self.feature_cols = feature_cols
+        self.label_values = label_values
+        self.label_type = label_type
+
+
+class FmModelDataConverter(SimpleModelDataConverter):
+    """reference: common/fm/FmModelDataConverter.java"""
+
+    def serialize_model(self, m: FmModelData):
+        meta = Params({"is_regression": m.is_regression, "vector_col": m.vector_col,
+                       "feature_cols": m.feature_cols,
+                       "label_values": [str(v) for v in (m.label_values or [])],
+                       "label_type": m.label_type,
+                       "raw_labels": json.dumps(m.label_values, default=str)})
+        return meta, [encode_array(np.asarray([m.w0])), encode_array(m.w),
+                      encode_array(m.V)]
+
+    def deserialize_model(self, meta, data):
+        labels = meta._m.get("label_values") or []
+        lt = meta._m.get("label_type", AlinkTypes.STRING)
+        if lt in (AlinkTypes.LONG, AlinkTypes.INT):
+            labels = [int(float(v)) for v in labels]
+        elif lt in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
+            labels = [float(v) for v in labels]
+        return FmModelData(
+            float(decode_array(data[0])[0]), decode_array(data[1]),
+            decode_array(data[2]), bool(meta._m.get("is_regression")),
+            meta._m.get("vector_col"), meta._m.get("feature_cols"), labels, lt)
+
+
+class _FmTrainParamsMixin(HasLabelCol, HasFeatureCols, HasVectorCol, HasWeightCol,
+                          HasSeed):
+    NUM_FACTOR = ParamInfo("num_factor", int, "latent factors", default=10,
+                           validator=RangeValidator(1, None))
+    NUM_EPOCHS = ParamInfo("num_epochs", int, default=10,
+                           validator=RangeValidator(1, None))
+    LEARN_RATE = ParamInfo("learn_rate", float, default=0.05)
+    INIT_STDEV = ParamInfo("init_stdev", float, default=0.05)
+    LAMBDA_0 = ParamInfo("lambda_0", float, default=0.0)
+    LAMBDA_1 = ParamInfo("lambda_1", float, default=0.0)
+    LAMBDA_2 = ParamInfo("lambda_2", float, default=0.0)
+    WITH_INTERCEPT = ParamInfo("with_intercept", bool, default=True)
+    WITH_LINEAR_ITEM = ParamInfo("with_linear_item", bool, default=True)
+
+
+class BaseFmTrainBatchOp(BatchOperator, _FmTrainParamsMixin):
+    IS_REGRESSION = False
+
+    def link_from(self, in_op: BatchOperator):
+        import jax
+        t = in_op.get_output_table()
+        dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+        vector_col = self.params._m.get("vector_col")
+        feature_cols = self.params._m.get("feature_cols")
+        label_col = self.get_label_col()
+        weight_col = self.params._m.get("weight_col")
+        if not vector_col:
+            feature_cols = resolve_feature_cols(
+                t, feature_cols, label_col,
+                exclude=[weight_col] if weight_col else [])
+        design = extract_design(t, feature_cols, vector_col, dtype)
+        raw = t.col(label_col)
+        label_type = t.schema.type_of(label_col)
+        if self.IS_REGRESSION:
+            labels, y = [], np.asarray(raw, dtype)
+        else:
+            labels, y = encode_labels(
+                raw, self.params._m.get("positive_label_value_string"))
+        w = (np.asarray(t.col(weight_col), dtype) if weight_col
+             else np.ones(t.num_rows, dtype))
+        data = {k: v for k, v in design.items() if k in ("X", "idx", "val")}
+        data["y"] = y.astype(dtype)
+        data["w"] = w
+        p = FmTrainParams(
+            num_factors=self.get_num_factor(), learn_rate=self.get_learn_rate(),
+            init_stdev=self.get_init_stdev(), num_epochs=self.get_num_epochs(),
+            lambda_0=self.get_lambda_0(), lambda_1=self.get_lambda_1(),
+            lambda_2=self.get_lambda_2(), with_intercept=self.get_with_intercept(),
+            with_linear_item=self.get_with_linear_item(),
+            is_regression=self.IS_REGRESSION, seed=self.get_seed())
+        w0, wv, V, curve, steps = fm_train(data, design["dim"], p)
+        model = FmModelData(w0, wv, V, self.IS_REGRESSION, vector_col,
+                            feature_cols, labels, label_type)
+        self._output = FmModelDataConverter().save_model(model)
+        self._side_outputs = [MTable({"epoch": np.arange(1, len(curve) + 1),
+                                      "loss": curve.astype(np.float64)})]
+        return self
+
+
+class FmClassifierTrainBatchOp(BaseFmTrainBatchOp):
+    IS_REGRESSION = False
+
+
+class FmRegressorTrainBatchOp(BaseFmTrainBatchOp):
+    IS_REGRESSION = True
+
+
+class FmModelMapper(ModelMapper):
+    """reference: common/fm/FmModelMapper.java"""
+
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model: Optional[FmModelData] = None
+
+    def load_model(self, model_table: MTable):
+        self.model = FmModelDataConverter().load_model(model_table)
+
+    def map_table(self, data: MTable) -> MTable:
+        m = self.model
+        design = extract_design(data, m.feature_cols, m.vector_col, np.float64,
+                                vector_size=m.w.shape[0])
+        margin = fm_predict_margin(m.w0, m.w, m.V, design)
+        pred_col = self.params._m.get("prediction_col", "pred")
+        detail_col = self.params._m.get("prediction_detail_col")
+        reserved = self.params._m.get("reserved_cols")
+        if m.is_regression:
+            cols, types, vals = [pred_col], [AlinkTypes.DOUBLE], [margin]
+        else:
+            p_pos = 1.0 / (1.0 + np.exp(-np.clip(margin, -500, 500)))
+            preds = np.empty(len(margin), object)
+            preds[:] = [m.label_values[0] if s > 0 else m.label_values[1]
+                        for s in margin]
+            cols, types, vals = [pred_col], [m.label_type], [preds]
+            if detail_col:
+                details = np.asarray(
+                    [json.dumps({str(m.label_values[0]): float(p),
+                                 str(m.label_values[1]): float(1 - p)})
+                     for p in p_pos], object)
+                cols.append(detail_col)
+                types.append(AlinkTypes.STRING)
+                vals.append(details)
+        helper = OutputColsHelper(data.schema, cols, types, reserved)
+        return helper.build_output(data, vals)
+
+
+class FmPredictBatchOp(ModelMapBatchOp, HasPredictionCol, HasPredictionDetailCol,
+                       HasReservedCols):
+    MAPPER_CLS = FmModelMapper
+
+
+FmClassifierPredictBatchOp = FmPredictBatchOp
+FmRegressorPredictBatchOp = FmPredictBatchOp

@@ -1,0 +1,286 @@
+"""Naive Bayes operators.
+
+Re-design of common/classification/NaiveBayesText* (multinomial/bernoulli
+over vector features) and the mixed categorical/gaussian NaiveBayes
+(batch/classification/NaiveBayesTrainBatchOp). Fitting is one pass of
+label-grouped sufficient statistics (psum-able count vectors).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ....common.mtable import MTable
+from ....common.params import InValidator, ParamInfo, Params
+from ....common.types import AlinkTypes, TableSchema
+from ....common.vector import SparseBatch
+from ....mapper.base import ModelMapper, OutputColsHelper
+from ....model.converters import (SimpleModelDataConverter, decode_array,
+                                  encode_array)
+from ....params.shared import (HasFeatureCols, HasLabelCol, HasPredictionCol,
+                               HasPredictionDetailCol, HasReservedCols,
+                               HasVectorCol, HasWeightCol)
+from ...base import BatchOperator
+from ...common.dataproc.feature_extract import extract_design
+from ..utils.model_map import ModelMapBatchOp
+
+
+class NaiveBayesTextModelConverter(SimpleModelDataConverter):
+    def serialize_model(self, model):
+        meta = Params({"model_type": model["model_type"],
+                       "vector_col": model["vector_col"],
+                       "label_type": model["label_type"],
+                       "labels": [str(l) for l in model["labels"]]})
+        return meta, [encode_array(model["log_prior"]),
+                      encode_array(model["log_prob"])]
+
+    def deserialize_model(self, meta, data):
+        labels = meta._m.get("labels", [])
+        lt = meta._m.get("label_type", AlinkTypes.STRING)
+        if lt in (AlinkTypes.LONG, AlinkTypes.INT):
+            labels = [int(float(v)) for v in labels]
+        elif lt in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
+            labels = [float(v) for v in labels]
+        return {"model_type": meta._m.get("model_type", "Multinomial"),
+                "vector_col": meta._m.get("vector_col"),
+                "label_type": lt, "labels": labels,
+                "log_prior": decode_array(data[0]),
+                "log_prob": decode_array(data[1])}
+
+
+class NaiveBayesTextTrainBatchOp(BatchOperator, HasLabelCol, HasVectorCol,
+                                 HasWeightCol):
+    """reference: batch/classification/NaiveBayesTextTrainBatchOp."""
+    MODEL_TYPE = ParamInfo("model_type", str, default="Multinomial",
+                           validator=InValidator(["Multinomial", "Bernoulli"]))
+    SMOOTHING = ParamInfo("smoothing", float, default=1.0)
+
+    def link_from(self, in_op: BatchOperator) -> "NaiveBayesTextTrainBatchOp":
+        t = in_op.get_output_table()
+        vec_col = self.params._m.get("vector_col")
+        design = extract_design(t, None, vec_col, np.float64)
+        X = design["X"] if design["kind"] == "dense" else \
+            SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)
+        label_col = self.get_label_col()
+        raw = t.col(label_col)
+        labels = sorted({str(v) for v in raw})
+        label_type = t.schema.type_of(label_col)
+        y = np.asarray([labels.index(str(v)) for v in raw])
+        w = (np.asarray(t.col(self.params._m["weight_col"]), np.float64)
+             if self.params._m.get("weight_col") else np.ones(len(y)))
+        k, d = len(labels), X.shape[1]
+        sm = self.get_smoothing()
+        if self.get_model_type() == "Bernoulli":
+            X = (X != 0).astype(np.float64)
+        counts = np.zeros((k, d))
+        prior = np.zeros(k)
+        for c in range(k):
+            mask = (y == c)
+            counts[c] = (X[mask] * w[mask, None]).sum(0)
+            prior[c] = w[mask].sum()
+        if self.get_model_type() == "Bernoulli":
+            log_prob = np.log((counts + sm) / (prior[:, None] + 2 * sm))
+        else:
+            log_prob = np.log((counts + sm) /
+                              (counts.sum(1, keepdims=True) + sm * d))
+        log_prior = np.log(prior / prior.sum())
+        typed_labels = [_typed(l, label_type) for l in labels]
+        self._output = NaiveBayesTextModelConverter().save_model({
+            "model_type": self.get_model_type(), "vector_col": vec_col,
+            "label_type": label_type, "labels": typed_labels,
+            "log_prior": log_prior, "log_prob": log_prob})
+        return self
+
+
+def _typed(v: str, label_type: str):
+    if label_type in (AlinkTypes.LONG, AlinkTypes.INT):
+        return int(float(v))
+    if label_type in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
+        return float(v)
+    return v
+
+
+class NaiveBayesTextModelMapper(ModelMapper):
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model = None
+
+    def load_model(self, model_table: MTable):
+        self.model = NaiveBayesTextModelConverter().load_model(model_table)
+
+    def map_table(self, data: MTable) -> MTable:
+        m = self.model
+        d = m["log_prob"].shape[1]
+        design = extract_design(data, None, m["vector_col"], np.float64,
+                                vector_size=d)
+        X = design["X"] if design["kind"] == "dense" else \
+            SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)
+        if X.shape[1] < d:
+            X = np.concatenate([X, np.zeros((X.shape[0], d - X.shape[1]))], 1)
+        if m["model_type"] == "Bernoulli":
+            Xb = (X != 0).astype(np.float64)
+            lp = m["log_prob"]
+            lq = np.log1p(-np.exp(np.minimum(lp, -1e-12)))
+            scores = Xb @ lp.T + (1 - Xb) @ lq.T + m["log_prior"]
+        else:
+            scores = X @ m["log_prob"].T + m["log_prior"]
+        pick = scores.argmax(1)
+        probs = np.exp(scores - scores.max(1, keepdims=True))
+        probs /= probs.sum(1, keepdims=True)
+        pred_col = self.params._m.get("prediction_col", "pred")
+        detail_col = self.params._m.get("prediction_detail_col")
+        preds = np.empty(len(pick), object)
+        preds[:] = [m["labels"][i] for i in pick]
+        cols, types, vals = [pred_col], [m["label_type"]], [preds]
+        if detail_col:
+            details = np.asarray(
+                [json.dumps({str(l): float(p) for l, p in zip(m["labels"], row)})
+                 for row in probs], object)
+            cols.append(detail_col)
+            types.append(AlinkTypes.STRING)
+            vals.append(details)
+        helper = OutputColsHelper(data.schema, cols, types,
+                                  self.params._m.get("reserved_cols"))
+        return helper.build_output(data, vals)
+
+
+class NaiveBayesTextPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
+                                   HasPredictionDetailCol, HasReservedCols):
+    MAPPER_CLS = NaiveBayesTextModelMapper
+
+
+# ---------------------------------------------------------------------------
+# Mixed categorical/gaussian NaiveBayes over table columns
+# ---------------------------------------------------------------------------
+
+class NaiveBayesModelConverter(SimpleModelDataConverter):
+    def serialize_model(self, model):
+        meta = Params({"feature_cols": model["feature_cols"],
+                       "is_cat": model["is_cat"],
+                       "label_type": model["label_type"],
+                       "labels": [str(l) for l in model["labels"]]})
+        return meta, [json.dumps(model["stats"]), encode_array(model["log_prior"])]
+
+    def deserialize_model(self, meta, data):
+        labels = meta._m.get("labels", [])
+        lt = meta._m.get("label_type", AlinkTypes.STRING)
+        if lt in (AlinkTypes.LONG, AlinkTypes.INT):
+            labels = [int(float(v)) for v in labels]
+        elif lt in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
+            labels = [float(v) for v in labels]
+        return {"feature_cols": meta._m["feature_cols"],
+                "is_cat": meta._m["is_cat"], "labels": labels, "label_type": lt,
+                "stats": json.loads(data[0]), "log_prior": decode_array(data[1])}
+
+
+class NaiveBayesTrainBatchOp(BatchOperator, HasLabelCol, HasFeatureCols,
+                             HasWeightCol):
+    """reference: batch/classification/NaiveBayesTrainBatchOp (categorical
+    columns -> smoothed frequency tables, numeric -> gaussians)."""
+    SMOOTHING = ParamInfo("smoothing", float, default=1.0)
+
+    def link_from(self, in_op: BatchOperator) -> "NaiveBayesTrainBatchOp":
+        t = in_op.get_output_table()
+        label_col = self.get_label_col()
+        cols = self.params._m.get("feature_cols") or \
+            [c for c in t.col_names if c != label_col]
+        raw = t.col(label_col)
+        labels = sorted({str(v) for v in raw})
+        y = np.asarray([labels.index(str(v)) for v in raw])
+        w = (np.asarray(t.col(self.params._m["weight_col"]), np.float64)
+             if self.params._m.get("weight_col") else np.ones(len(y)))
+        sm = self.get_smoothing()
+        is_cat = [not AlinkTypes.is_numeric(t.schema.type_of(c)) for c in cols]
+        stats = []
+        prior = np.asarray([w[y == c].sum() for c in range(len(labels))], np.float64)
+        for c, cat in zip(cols, is_cat):
+            col = t.col(c)
+            if cat:
+                values = sorted({str(v) for v in col})
+                table = {}
+                for ci in range(len(labels)):
+                    cnt = {val: 0.0 for val in values}
+                    tot = sm * len(values)
+                    for v, yy, wt in zip(col, y, w):
+                        if yy == ci:
+                            cnt[str(v)] += wt
+                            tot += wt
+                    table[str(ci)] = {val: float(np.log((cnt[val] + sm) / tot))
+                                      for val in values}
+                stats.append({"kind": "cat", "table": table})
+            else:
+                v = np.asarray(col, np.float64)
+                mu, var = [], []
+                for ci in range(len(labels)):
+                    sub, sw = v[y == ci], w[y == ci]
+                    tot = max(sw.sum(), 1e-12)
+                    if sub.size:
+                        m_ = float((sub * sw).sum() / tot)
+                        mu.append(m_)
+                        var.append(float(((sub - m_) ** 2 * sw).sum() / tot + 1e-9))
+                    else:
+                        mu.append(0.0)
+                        var.append(1.0)
+                stats.append({"kind": "gauss", "mu": mu, "var": var})
+        label_type = t.schema.type_of(label_col)
+        self._output = NaiveBayesModelConverter().save_model({
+            "feature_cols": cols, "is_cat": is_cat,
+            "labels": [_typed(l, label_type) for l in labels],
+            "label_type": label_type,
+            "stats": stats, "log_prior": np.log(prior / prior.sum())})
+        return self
+
+
+class NaiveBayesModelMapper(ModelMapper):
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model = None
+
+    def load_model(self, model_table: MTable):
+        self.model = NaiveBayesModelConverter().load_model(model_table)
+
+    def map_table(self, data: MTable) -> MTable:
+        m = self.model
+        k = len(m["labels"])
+        n = data.num_rows
+        scores = np.tile(m["log_prior"], (n, 1))
+        for c, stat in zip(m["feature_cols"], m["stats"]):
+            col = data.col(c)
+            if stat["kind"] == "cat":
+                floor = np.log(1e-12)
+                for ci in range(k):
+                    table = stat["table"][str(ci)]
+                    scores[:, ci] += np.asarray(
+                        [table.get(str(v), floor) for v in col])
+            else:
+                v = np.asarray(col, np.float64)
+                mu = np.asarray(stat["mu"])
+                var = np.asarray(stat["var"])
+                scores += (-0.5 * np.log(2 * np.pi * var)[None, :]
+                           - 0.5 * (v[:, None] - mu[None, :]) ** 2 / var[None, :])
+        pick = scores.argmax(1)
+        probs = np.exp(scores - scores.max(1, keepdims=True))
+        probs /= probs.sum(1, keepdims=True)
+        preds = np.empty(n, object)
+        preds[:] = [m["labels"][i] for i in pick]
+        pred_col = self.params._m.get("prediction_col", "pred")
+        detail_col = self.params._m.get("prediction_detail_col")
+        cols, types, vals = [pred_col], [m["label_type"]], [preds]
+        if detail_col:
+            details = np.asarray(
+                [json.dumps({str(l): float(p) for l, p in zip(m["labels"], row)})
+                 for row in probs], object)
+            cols.append(detail_col)
+            types.append(AlinkTypes.STRING)
+            vals.append(details)
+        helper = OutputColsHelper(data.schema, cols, types,
+                                  self.params._m.get("reserved_cols"))
+        return helper.build_output(data, vals)
+
+
+class NaiveBayesPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
+                               HasPredictionDetailCol, HasReservedCols):
+    MAPPER_CLS = NaiveBayesModelMapper

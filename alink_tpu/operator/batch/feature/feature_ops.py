@@ -1,0 +1,415 @@
+"""Feature engineering operators.
+
+Re-design of common/feature/ (25 files, SURVEY §2.5): OneHot,
+QuantileDiscretizer (device-sort percentiles replace the reference's
+distributed pSort, common/dataproc/SortUtils.java:38-47), Bucketizer,
+Binarizer, FeatureHasher (murmur-into-fixed-dim, FTRLExample.java:46-57),
+ChiSqSelector, PCA (jnp.linalg SVD/eig replaces Breeze), DCT (jnp.fft).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ....common.mtable import MTable
+from ....common.params import InValidator, ParamInfo, Params, RangeValidator
+from ....common.types import AlinkTypes, TableSchema
+from ....common.vector import DenseVector, SparseVector, VectorUtil
+from ....mapper.base import Mapper, ModelMapper, OutputColsHelper
+from ....model.converters import SimpleModelDataConverter, decode_array, encode_array
+from ....params.shared import (HasFeatureCols, HasLabelCol, HasOutputCol,
+                               HasOutputCols, HasReservedCols, HasSelectedCol,
+                               HasSelectedCols, HasVectorCol)
+from ...base import BatchOperator
+from ..utils.model_map import ModelMapBatchOp
+
+
+# ---------------------------------------------------------------------------
+# OneHot
+# ---------------------------------------------------------------------------
+
+class OneHotModelConverter(SimpleModelDataConverter):
+    def serialize_model(self, model: Dict[str, List[str]]):
+        return Params({"cols": list(model)}), [json.dumps(model)]
+
+    def deserialize_model(self, meta, data):
+        return json.loads(data[0])
+
+
+class OneHotTrainBatchOp(BatchOperator, HasSelectedCols):
+    """reference: feature/OneHotTrainBatchOp — vocab per selected column."""
+
+    def link_from(self, in_op: BatchOperator) -> "OneHotTrainBatchOp":
+        t = in_op.get_output_table()
+        cols = self.get_selected_cols()
+        model = {c: sorted({str(v) for v in t.col(c) if v is not None})
+                 for c in cols}
+        self._output = OneHotModelConverter().save_model(model)
+        return self
+
+
+class OneHotModelMapper(ModelMapper):
+    """Encodes selected columns into ONE sparse vector (reference
+    OneHotModelMapper: output is a SparseVector over the concatenated vocab
+    space, with a final slot per column for unseen values)."""
+
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model = None
+
+    def load_model(self, model_table: MTable):
+        self.model = OneHotModelConverter().load_model(model_table)
+
+    def map_table(self, data: MTable) -> MTable:
+        cols = list(self.model.keys())
+        offsets, lookup = {}, {}
+        off = 0
+        for c in cols:
+            vocab = self.model[c]
+            offsets[c] = off
+            lookup[c] = {t: i for i, t in enumerate(vocab)}
+            off += len(vocab) + 1  # +1 unseen slot
+        total = off
+        out_col = self.params._m.get("output_col") or "one_hot"
+        vecs = np.empty(data.num_rows, object)
+        col_arrays = {c: data.col(c) for c in cols}
+        for i in range(data.num_rows):
+            idx = []
+            for c in cols:
+                v = col_arrays[c][i]
+                j = lookup[c].get(str(v), len(lookup[c])) if v is not None \
+                    else len(lookup[c])
+                idx.append(offsets[c] + j)
+            vecs[i] = SparseVector(total, idx, np.ones(len(idx)))
+        helper = OutputColsHelper(data.schema, [out_col], [AlinkTypes.SPARSE_VECTOR],
+                                  self.params._m.get("reserved_cols"))
+        return helper.build_output(data, [vecs])
+
+
+class OneHotPredictBatchOp(ModelMapBatchOp, HasOutputCol, HasReservedCols):
+    MAPPER_CLS = OneHotModelMapper
+
+
+# ---------------------------------------------------------------------------
+# Quantile discretizer / bucketizer / binarizer
+# ---------------------------------------------------------------------------
+
+class QuantileModelConverter(SimpleModelDataConverter):
+    def serialize_model(self, model: Dict[str, List[float]]):
+        return Params({"cols": list(model)}), [json.dumps(model)]
+
+    def deserialize_model(self, meta, data):
+        return {k: [float(x) for x in v] for k, v in json.loads(data[0]).items()}
+
+
+class QuantileDiscretizerTrainBatchOp(BatchOperator, HasSelectedCols):
+    """reference: feature/QuantileDiscretizerTrainBatchOp — split points at
+    uniform quantiles (device sort replaces SortUtils.pSort)."""
+    NUM_BUCKETS = ParamInfo("num_buckets", int, default=2,
+                            validator=RangeValidator(2, None))
+
+    def link_from(self, in_op: BatchOperator) -> "QuantileDiscretizerTrainBatchOp":
+        t = in_op.get_output_table()
+        nb = self.get_num_buckets()
+        model = {}
+        for c in self.get_selected_cols():
+            v = np.asarray(t.col(c), np.float64)
+            v = v[~np.isnan(v)]
+            qs = np.quantile(v, np.linspace(0, 1, nb + 1)[1:-1]) if v.size else []
+            model[c] = sorted(set(float(q) for q in np.atleast_1d(qs)))
+        self._output = QuantileModelConverter().save_model(model)
+        return self
+
+
+class _BucketMapperBase(ModelMapper):
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model = None
+
+    def load_model(self, model_table: MTable):
+        self.model = QuantileModelConverter().load_model(model_table)
+
+    def map_table(self, data: MTable) -> MTable:
+        cols = list(self.model.keys())
+        out_cols = self.params._m.get("output_cols") or cols
+        outs = []
+        for c in cols:
+            cuts = np.asarray(self.model[c], np.float64)
+            v = np.asarray(data.col(c), np.float64)
+            outs.append(np.searchsorted(cuts, v, side="right").astype(np.int64))
+        helper = OutputColsHelper(data.schema, out_cols,
+                                  [AlinkTypes.LONG] * len(out_cols))
+        return helper.build_output(data, outs)
+
+
+class QuantileDiscretizerPredictBatchOp(ModelMapBatchOp, HasOutputCols):
+    MAPPER_CLS = _BucketMapperBase
+
+
+class BucketizerBatchOp(BatchOperator, HasSelectedCols, HasOutputCols):
+    """reference: feature/BucketizerBatchOp — explicit cut points, no model."""
+    CUTS_ARRAY = ParamInfo("cuts_array", list, "per-column cut points", optional=False)
+
+    def link_from(self, in_op: BatchOperator) -> "BucketizerBatchOp":
+        t = in_op.get_output_table()
+        cols = self.get_selected_cols()
+        out_cols = self.params._m.get("output_cols") or cols
+        outs = []
+        for c, cuts in zip(cols, self.get_cuts_array()):
+            v = np.asarray(t.col(c), np.float64)
+            outs.append(np.searchsorted(np.asarray(cuts, np.float64), v,
+                                        side="right").astype(np.int64))
+        helper = OutputColsHelper(t.schema, out_cols, [AlinkTypes.LONG] * len(out_cols))
+        self._output = helper.build_output(t, outs)
+        return self
+
+
+class BinarizerBatchOp(BatchOperator, HasSelectedCol, HasOutputCol):
+    """reference: feature/BinarizerBatchOp."""
+    THRESHOLD = ParamInfo("threshold", float, default=0.0)
+
+    def link_from(self, in_op: BatchOperator) -> "BinarizerBatchOp":
+        t = in_op.get_output_table()
+        c = self.get_selected_col()
+        out = self.params._m.get("output_col") or c
+        v = np.asarray(t.col(c), np.float64)
+        helper = OutputColsHelper(t.schema, [out], [AlinkTypes.DOUBLE])
+        self._output = helper.build_output(t, [(v > self.get_threshold()).astype(np.float64)])
+        return self
+
+
+# ---------------------------------------------------------------------------
+# FeatureHasher (murmur32 into fixed dim — the Criteo front-end)
+# ---------------------------------------------------------------------------
+
+def murmur32(data: bytes, seed: int = 0) -> int:
+    """MurmurHash3 x86 32-bit (the reference relies on Flink's murmur)."""
+    c1, c2 = 0xcc9e2d51, 0x1b873593
+    h = seed & 0xFFFFFFFF
+    length = len(data)
+    rounded = length - (length & 3)
+    for i in range(0, rounded, 4):
+        k = int.from_bytes(data[i:i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xe6546b64) & 0xFFFFFFFF
+    k = 0
+    tail = data[rounded:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= length
+    h ^= h >> 16
+    h = (h * 0x85ebca6b) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xc2b2ae35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+class FeatureHasherBatchOp(BatchOperator, HasSelectedCols, HasOutputCol,
+                           HasReservedCols):
+    """reference: feature/FeatureHasherBatchOp (FTRLExample.java:46-57):
+    categorical cols hash (name=value), numeric cols hash (name) with the
+    value as weight; output one SparseVector of NUM_FEATURES dims."""
+    NUM_FEATURES = ParamInfo("num_features", int, default=1 << 18,
+                             validator=RangeValidator(1, None))
+    CATEGORICAL_COLS = ParamInfo("categorical_cols", list, "treat as categorical")
+
+    def link_from(self, in_op: BatchOperator) -> "FeatureHasherBatchOp":
+        t = in_op.get_output_table()
+        cols = self.get_selected_cols() or t.col_names
+        out_col = self.params._m.get("output_col") or "output"
+        dim = self.get_num_features()
+        declared_cat = set(self.get_categorical_cols() or [])
+        cat = {c: (c in declared_cat or
+                   not AlinkTypes.is_numeric(t.schema.type_of(c))) for c in cols}
+        arrays = {c: t.col(c) for c in cols}
+        # numeric feature slots are fixed per column
+        num_slot = {c: murmur32(c.encode()) % dim for c in cols if not cat[c]}
+        vecs = np.empty(t.num_rows, object)
+        for i in range(t.num_rows):
+            acc: Dict[int, float] = {}
+            for c in cols:
+                v = arrays[c][i]
+                if v is None:
+                    continue
+                if cat[c]:
+                    slot = murmur32(f"{c}={v}".encode()) % dim
+                    acc[slot] = acc.get(slot, 0.0) + 1.0
+                else:
+                    acc[num_slot[c]] = acc.get(num_slot[c], 0.0) + float(v)
+            vecs[i] = SparseVector(dim, list(acc.keys()), list(acc.values()))
+        helper = OutputColsHelper(t.schema, [out_col], [AlinkTypes.SPARSE_VECTOR],
+                                  self.params._m.get("reserved_cols"))
+        self._output = helper.build_output(t, [vecs])
+        return self
+
+
+# ---------------------------------------------------------------------------
+# ChiSqSelector
+# ---------------------------------------------------------------------------
+
+class ChiSqSelectorBatchOp(BatchOperator, HasSelectedCols, HasLabelCol):
+    """reference: feature/ChiSqSelectorBatchOp — rank columns by chi-square
+    statistic against the label; output the selected column subset."""
+    NUM_TOP_FEATURES = ParamInfo("num_top_features", int, default=10)
+
+    def link_from(self, in_op: BatchOperator) -> "ChiSqSelectorBatchOp":
+        from ...common.statistics.hypothesis import chi_square_test
+        t = in_op.get_output_table()
+        cols = self.get_selected_cols()
+        label = t.col(self.get_label_col())
+        scored = []
+        for c in cols:
+            stat, p, _ = chi_square_test(t.col(c), label)
+            scored.append((p, c, stat))
+        scored.sort(key=lambda x: x[0])
+        chosen = [c for _, c, _ in scored[: self.get_num_top_features()]]
+        keep = [c for c in t.col_names if c in set(chosen) or c not in set(cols)]
+        self._output = t.select(keep)
+        self._side_outputs = [MTable({"col": [c for _, c, _ in scored],
+                                      "p_value": [p for p, _, _ in scored],
+                                      "chi2": [s for _, _, s in scored]})]
+        return self
+
+
+# ---------------------------------------------------------------------------
+# PCA
+# ---------------------------------------------------------------------------
+
+class PcaModelConverter(SimpleModelDataConverter):
+    def serialize_model(self, model):
+        mean, std, components, explained = model
+        meta = Params({"k": components.shape[0]})
+        return meta, [encode_array(mean), encode_array(std),
+                      encode_array(components), encode_array(explained)]
+
+    def deserialize_model(self, meta, data):
+        return (decode_array(data[0]), decode_array(data[1]),
+                decode_array(data[2]), decode_array(data[3]))
+
+
+class PcaTrainBatchOp(BatchOperator, HasSelectedCols, HasVectorCol):
+    """reference: feature/pca/PcaTrainBatchOp — SVD of centered data
+    (device jnp.linalg.svd replaces the Breeze eig path)."""
+    K = ParamInfo("k", int, "principal components", optional=False,
+                  validator=RangeValidator(1, None))
+    CALCULATION_TYPE = ParamInfo("calculation_type", str, default="CORR",
+                                 validator=InValidator(["CORR", "COV"]))
+
+    def link_from(self, in_op: BatchOperator) -> "PcaTrainBatchOp":
+        import jax.numpy as jnp
+        t = in_op.get_output_table()
+        X = _extract_matrix(t, self.params._m.get("selected_cols"),
+                            self.params._m.get("vector_col"))
+        k = self.get_k()
+        mean = X.mean(0)
+        Xc = X - mean
+        if self.get_calculation_type().upper() == "CORR":
+            std = X.std(0)
+            std = np.where(std < 1e-12, 1.0, std)
+            Xc = Xc / std
+        else:
+            std = np.ones_like(mean)
+        _, s, vt = np.linalg.svd(np.asarray(jnp.asarray(Xc), np.float64),
+                                 full_matrices=False)
+        var = (s ** 2) / max(X.shape[0] - 1, 1)
+        explained = var / max(var.sum(), 1e-300)
+        self._output = PcaModelConverter().save_model(
+            (mean, std, vt[:k], explained[:k]))
+        return self
+
+
+class PcaModelMapper(ModelMapper):
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model = None
+
+    def load_model(self, model_table: MTable):
+        self.model = PcaModelConverter().load_model(model_table)
+
+    def map_table(self, data: MTable) -> MTable:
+        mean, std, comps, _ = self.model
+        X = _extract_matrix(data, self.params._m.get("selected_cols"),
+                            self.params._m.get("vector_col"))
+        Z = ((X - mean) / std) @ comps.T
+        out_col = self.params._m.get("prediction_col") \
+            or self.params._m.get("output_col") or "pca"
+        vecs = np.empty(len(Z), object)
+        vecs[:] = [DenseVector(z) for z in Z]
+        helper = OutputColsHelper(data.schema, [out_col], [AlinkTypes.DENSE_VECTOR],
+                                  self.params._m.get("reserved_cols"))
+        return helper.build_output(data, [vecs])
+
+
+class PcaPredictBatchOp(ModelMapBatchOp, HasSelectedCols, HasVectorCol,
+                        HasOutputCol, HasReservedCols):
+    MAPPER_CLS = PcaModelMapper
+    PREDICTION_COL = ParamInfo("prediction_col", str, "output vector column")
+
+
+# ---------------------------------------------------------------------------
+# DCT
+# ---------------------------------------------------------------------------
+
+class DCTBatchOp(BatchOperator, HasSelectedCol, HasOutputCol):
+    """reference: dataproc/DCTBatchOp over FFT.java — orthonormal DCT-II
+    via jnp.fft."""
+    INVERSE = ParamInfo("inverse", bool, default=False)
+
+    def link_from(self, in_op: BatchOperator) -> "DCTBatchOp":
+        import jax.numpy as jnp
+        t = in_op.get_output_table()
+        c = self.get_selected_col()
+        vecs = [VectorUtil.parse(v).to_dense().data for v in t.col(c)]
+        X = np.stack(vecs)
+        Y = np.asarray(_dct2_ortho(jnp.asarray(X), inverse=self.get_inverse()))
+        out = self.params._m.get("output_col") or c
+        col = np.empty(len(Y), object)
+        col[:] = [DenseVector(y) for y in Y]
+        helper = OutputColsHelper(t.schema, [out], [AlinkTypes.DENSE_VECTOR])
+        self._output = helper.build_output(t, [col])
+        return self
+
+
+def _dct2_ortho(X, inverse=False):
+    import jax.numpy as jnp
+    n = X.shape[1]
+    if not inverse:
+        ext = jnp.concatenate([X, X[:, ::-1]], axis=1)
+        spec = jnp.fft.fft(ext, axis=1)[:, :n]
+        phase = jnp.exp(-1j * jnp.pi * jnp.arange(n) / (2 * n))
+        y = jnp.real(spec * phase) / 2.0
+        scale = jnp.concatenate([jnp.asarray([1.0 / np.sqrt(n)]),
+                                 jnp.full((n - 1,), np.sqrt(2.0 / n))])
+        return y * scale
+    # inverse via transpose property of the orthonormal DCT matrix
+    k = jnp.arange(n)
+    basis = jnp.cos(jnp.pi * (2 * k[None, :] + 1) * k[:, None] / (2 * n))
+    scale = jnp.concatenate([jnp.asarray([jnp.sqrt(1.0 / n)]),
+                             jnp.full((n - 1,), jnp.sqrt(2.0 / n))])
+    M = basis * scale[:, None]
+    return X @ M
+
+
+def _extract_matrix(t: MTable, selected_cols, vector_col) -> np.ndarray:
+    from ...common.dataproc.feature_extract import extract_design
+    design = extract_design(t, selected_cols, vector_col, np.float64)
+    if design["kind"] == "dense":
+        return design["X"]
+    from ....common.vector import SparseBatch
+    return SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)

@@ -346,8 +346,12 @@ class MinusBatchOp(BatchOperator):
         keep = []
         for i, r in enumerate(ta.rows()):
             k = tuple(_hashable(v) for v in r)
-            if bset.get(k, 0) > 0:
-                bset[k] -= 1
+            if self._ALL:
+                # multiset semantics: consume one b-occurrence per match
+                if bset.get(k, 0) > 0:
+                    bset[k] -= 1
+                    continue
+            elif k in bset:
                 continue
             keep.append(i)
         self._output = ta.take_rows(keep)

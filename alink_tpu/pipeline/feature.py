@@ -1,0 +1,116 @@
+"""Pipeline wrappers — feature engineering + dataproc scalers
+(reference pipeline/feature/ and pipeline/dataproc/)."""
+
+from __future__ import annotations
+
+from typing import Optional, Type
+
+from ..operator.base import BatchOperator
+from ..operator.batch.dataproc.indexers import (IndexToStringPredictBatchOp,
+                                                StringIndexerPredictBatchOp,
+                                                StringIndexerTrainBatchOp)
+from ..operator.batch.dataproc.scalers import (
+    ImputerPredictBatchOp, ImputerTrainBatchOp, MaxAbsScalerPredictBatchOp,
+    MaxAbsScalerTrainBatchOp, MinMaxScalerPredictBatchOp,
+    MinMaxScalerTrainBatchOp, StandardScalerPredictBatchOp,
+    StandardScalerTrainBatchOp, _ColScalerMapper)
+from ..operator.batch.dataproc.vector_ops import (
+    VectorAssemblerBatchOp, VectorMaxAbsScalerTrainBatchOp,
+    VectorMinMaxScalerTrainBatchOp, VectorNormalizeBatchOp,
+    VectorScalerModelMapper, VectorStandardScalerTrainBatchOp)
+from ..operator.batch.feature.feature_ops import (
+    BinarizerBatchOp, BucketizerBatchOp, DCTBatchOp, FeatureHasherBatchOp,
+    OneHotModelMapper, OneHotPredictBatchOp, OneHotTrainBatchOp,
+    PcaModelMapper, PcaPredictBatchOp, PcaTrainBatchOp, _BucketMapperBase,
+    QuantileDiscretizerTrainBatchOp)
+from ..operator.batch.dataproc.indexers import StringIndexerModelMapper
+from .base import Estimator, MapModel, Model, Trainer, Transformer, _as_op
+
+
+class BatchOpTransformer(Transformer):
+    """Stateless transformer backed by a batch op (reference MapTransformer)."""
+
+    OP_CLS: Optional[Type[BatchOperator]] = None
+
+    def transform(self, in_op) -> BatchOperator:
+        return self.OP_CLS(self.params.clone()).link_from(_as_op(in_op))
+
+
+def _trainer(name, train_op, mapper, extra_bases=()):
+    model_cls = type(name + "Model", (MapModel,) + tuple(extra_bases),
+                     {"MAPPER_CLS": mapper})
+    cls = type(name, (Trainer,) + tuple(extra_bases),
+               {"TRAIN_OP_CLS": train_op, "MODEL_CLS": model_cls})
+    # inherit train-op params for kwargs validation
+    cls._PARAM_INFOS = {**train_op._PARAM_INFOS, **cls._PARAM_INFOS}
+    model_cls._PARAM_INFOS = {**train_op._PARAM_INFOS, **model_cls._PARAM_INFOS}
+    return cls, model_cls
+
+
+StandardScaler, StandardScalerModel = _trainer(
+    "StandardScaler", StandardScalerTrainBatchOp, _ColScalerMapper)
+MinMaxScaler, MinMaxScalerModel = _trainer(
+    "MinMaxScaler", MinMaxScalerTrainBatchOp, _ColScalerMapper)
+MaxAbsScaler, MaxAbsScalerModel = _trainer(
+    "MaxAbsScaler", MaxAbsScalerTrainBatchOp, _ColScalerMapper)
+Imputer, ImputerModel = _trainer("Imputer", ImputerTrainBatchOp, _ColScalerMapper)
+OneHotEncoder, OneHotEncoderModel = _trainer(
+    "OneHotEncoder", OneHotTrainBatchOp, OneHotModelMapper)
+QuantileDiscretizer, QuantileDiscretizerModel = _trainer(
+    "QuantileDiscretizer", QuantileDiscretizerTrainBatchOp, _BucketMapperBase)
+StringIndexer, StringIndexerModel = _trainer(
+    "StringIndexer", StringIndexerTrainBatchOp, StringIndexerModelMapper)
+Pca, PcaModel = _trainer("Pca", PcaTrainBatchOp, PcaModelMapper)
+VectorStandardScaler, VectorStandardScalerModel = _trainer(
+    "VectorStandardScaler", VectorStandardScalerTrainBatchOp, VectorScalerModelMapper)
+VectorMinMaxScaler, VectorMinMaxScalerModel = _trainer(
+    "VectorMinMaxScaler", VectorMinMaxScalerTrainBatchOp, VectorScalerModelMapper)
+VectorMaxAbsScaler, VectorMaxAbsScalerModel = _trainer(
+    "VectorMaxAbsScaler", VectorMaxAbsScalerTrainBatchOp, VectorScalerModelMapper)
+
+# kwargs validation needs predict params too (output_col etc.)
+for _cls in (StringIndexer, StringIndexerModel):
+    _cls._PARAM_INFOS = {**_cls._PARAM_INFOS,
+                         **StringIndexerPredictBatchOp._PARAM_INFOS}
+for _cls in (OneHotEncoder, OneHotEncoderModel, Pca, PcaModel,
+             QuantileDiscretizer, QuantileDiscretizerModel,
+             StandardScaler, StandardScalerModel,
+             VectorStandardScaler, VectorStandardScalerModel):
+    from ..params.shared import HasOutputCol, HasOutputCols, HasReservedCols
+    _cls._PARAM_INFOS = {**_cls._PARAM_INFOS,
+                         **{i.name: i for i in (HasOutputCol.OUTPUT_COL,
+                                                HasOutputCols.OUTPUT_COLS,
+                                                HasReservedCols.RESERVED_COLS)}}
+for _cls in (Pca, PcaModel):
+    _cls._PARAM_INFOS = {**_cls._PARAM_INFOS,
+                         "prediction_col": PcaPredictBatchOp.PREDICTION_COL}
+
+
+class Binarizer(BatchOpTransformer):
+    OP_CLS = BinarizerBatchOp
+    _PARAM_INFOS = BinarizerBatchOp._PARAM_INFOS
+
+
+class Bucketizer(BatchOpTransformer):
+    OP_CLS = BucketizerBatchOp
+    _PARAM_INFOS = BucketizerBatchOp._PARAM_INFOS
+
+
+class FeatureHasher(BatchOpTransformer):
+    OP_CLS = FeatureHasherBatchOp
+    _PARAM_INFOS = FeatureHasherBatchOp._PARAM_INFOS
+
+
+class VectorAssembler(BatchOpTransformer):
+    OP_CLS = VectorAssemblerBatchOp
+    _PARAM_INFOS = VectorAssemblerBatchOp._PARAM_INFOS
+
+
+class VectorNormalizer(BatchOpTransformer):
+    OP_CLS = VectorNormalizeBatchOp
+    _PARAM_INFOS = VectorNormalizeBatchOp._PARAM_INFOS
+
+
+class DCT(BatchOpTransformer):
+    OP_CLS = DCTBatchOp
+    _PARAM_INFOS = DCTBatchOp._PARAM_INFOS

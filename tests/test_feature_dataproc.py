@@ -1,0 +1,241 @@
+"""Feature-engineering / dataproc / statistics / SQL operator tests."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common import MTable, DenseVector, SparseVector, VectorUtil
+from alink_tpu.operator.base import TableSourceBatchOp
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.batch.dataproc import (SampleBatchOp, SplitBatchOp,
+                                               AppendIdBatchOp, WeightSampleBatchOp)
+from alink_tpu.operator.batch.dataproc.scalers import (
+    StandardScalerTrainBatchOp, StandardScalerPredictBatchOp,
+    MinMaxScalerTrainBatchOp, MinMaxScalerPredictBatchOp,
+    ImputerTrainBatchOp, ImputerPredictBatchOp)
+from alink_tpu.operator.batch.dataproc.indexers import (
+    StringIndexerTrainBatchOp, StringIndexerPredictBatchOp,
+    IndexToStringPredictBatchOp)
+from alink_tpu.operator.batch.dataproc.vector_ops import (
+    VectorAssemblerBatchOp, VectorNormalizeBatchOp, VectorSliceBatchOp,
+    VectorStandardScalerTrainBatchOp, VectorStandardScalerPredictBatchOp)
+from alink_tpu.operator.batch.feature.feature_ops import (
+    OneHotTrainBatchOp, OneHotPredictBatchOp, QuantileDiscretizerTrainBatchOp,
+    QuantileDiscretizerPredictBatchOp, BucketizerBatchOp, BinarizerBatchOp,
+    FeatureHasherBatchOp, PcaTrainBatchOp, PcaPredictBatchOp, DCTBatchOp,
+    ChiSqSelectorBatchOp)
+from alink_tpu.operator.batch.statistics.stat_ops import (
+    SummarizerBatchOp, CorrelationBatchOp, ChiSquareTestBatchOp,
+    VectorSummarizerBatchOp)
+from alink_tpu.operator.batch.sql import (SelectBatchOp, WhereBatchOp,
+                                          GroupByBatchOp, JoinBatchOp,
+                                          UnionAllBatchOp, MinusBatchOp)
+
+
+def _num_src(seed=0, n=100):
+    rng = np.random.RandomState(seed)
+    return MemSourceBatchOp(
+        [(float(a), float(b), ["x", "y", "z"][i % 3]) for i, (a, b) in
+         enumerate(zip(rng.randn(n) * 5 + 2, rng.rand(n) * 10))],
+        "a DOUBLE, b DOUBLE, cat STRING")
+
+
+def test_standard_scaler():
+    src = _num_src()
+    model = StandardScalerTrainBatchOp(selected_cols=["a", "b"]).link_from(src)
+    out = StandardScalerPredictBatchOp().link_from(model, src).collect_mtable()
+    a = np.asarray(out.col("a"))
+    assert abs(a.mean()) < 1e-9 and abs(a.std(ddof=1) - 1.0) < 1e-9
+
+
+def test_minmax_scaler():
+    src = _num_src()
+    model = MinMaxScalerTrainBatchOp(selected_cols=["a"]).link_from(src)
+    out = MinMaxScalerPredictBatchOp().link_from(model, src).collect_mtable()
+    a = np.asarray(out.col("a"))
+    assert a.min() == pytest.approx(0) and a.max() == pytest.approx(1)
+
+
+def test_imputer():
+    rows = [(1.0,), (np.nan,), (3.0,)]
+    src = MemSourceBatchOp(rows, "v DOUBLE")
+    model = ImputerTrainBatchOp(selected_cols=["v"], strategy="MEAN").link_from(src)
+    out = ImputerPredictBatchOp().link_from(model, src).collect_mtable()
+    assert list(out.col("v")) == [1.0, 2.0, 3.0]
+
+
+def test_string_indexer_roundtrip():
+    src = _num_src()
+    model = StringIndexerTrainBatchOp(selected_col="cat",
+                                      string_order_type="alphabet_asc").link_from(src)
+    idx = (StringIndexerPredictBatchOp(selected_col="cat", output_col="cat_id")
+           .link_from(model, src)).collect_mtable()
+    assert set(idx.col("cat_id")) == {0, 1, 2}
+    back = (IndexToStringPredictBatchOp(selected_col="cat_id", output_col="cat2")
+            .link_from(model, TableSourceBatchOp(idx))).collect_mtable()
+    assert list(back.col("cat2")) == list(idx.col("cat"))
+
+
+def test_one_hot():
+    src = _num_src()
+    model = OneHotTrainBatchOp(selected_cols=["cat"]).link_from(src)
+    out = (OneHotPredictBatchOp(output_col="oh").link_from(model, src)
+           ).collect_mtable()
+    v = out.col("oh")[0]
+    assert isinstance(v, SparseVector) and v.n == 4  # 3 values + unseen slot
+    assert v.values.sum() == 1.0
+
+
+def test_quantile_and_bucketizer_and_binarizer():
+    src = _num_src()
+    model = QuantileDiscretizerTrainBatchOp(selected_cols=["b"],
+                                            num_buckets=4).link_from(src)
+    out = QuantileDiscretizerPredictBatchOp().link_from(model, src).collect_mtable()
+    counts = np.bincount(np.asarray(out.col("b"), np.int64))
+    assert len(counts) == 4 and counts.min() > 15  # roughly uniform buckets
+    b2 = BucketizerBatchOp(selected_cols=["b"], cuts_array=[[5.0]]).link_from(src)
+    assert set(b2.collect_mtable().col("b")) == {0, 1}
+    b3 = BinarizerBatchOp(selected_col="b", threshold=5.0).link_from(src)
+    assert set(b3.collect_mtable().col("b")) == {0.0, 1.0}
+
+
+def test_feature_hasher():
+    src = _num_src(n=20)
+    out = (FeatureHasherBatchOp(selected_cols=["a", "cat"], num_features=64,
+                                output_col="vec").link_from(src)).collect_mtable()
+    v = out.col("vec")[0]
+    assert isinstance(v, SparseVector) and v.n == 64
+    assert v.number_of_values() == 2  # one numeric + one categorical slot
+
+
+def test_pca():
+    rng = np.random.RandomState(0)
+    base = rng.randn(200, 2)
+    X = np.concatenate([base, base @ [[1.0], [2.0]]], axis=1)  # 3rd col dependent
+    src = MemSourceBatchOp([tuple(r) for r in X], "x DOUBLE, y DOUBLE, z DOUBLE")
+    model = PcaTrainBatchOp(selected_cols=["x", "y", "z"], k=2,
+                            calculation_type="COV").link_from(src)
+    out = (PcaPredictBatchOp(selected_cols=["x", "y", "z"], prediction_col="p")
+           .link_from(model, src)).collect_mtable()
+    Z = np.stack([v.data for v in out.col("p")])
+    assert Z.shape == (200, 2)
+    # 2 components capture all variance of rank-2 data
+    from alink_tpu.operator.batch.feature.feature_ops import PcaModelConverter
+    _, _, _, explained = PcaModelConverter().load_model(model.get_output_table())
+    assert explained.sum() > 0.999
+
+
+def test_dct_roundtrip():
+    rng = np.random.RandomState(0)
+    rows = [(DenseVector(rng.randn(8)),) for _ in range(5)]
+    src = MemSourceBatchOp(rows, ["vec"])
+    f = DCTBatchOp(selected_col="vec", output_col="f").link_from(src)
+    inv = DCTBatchOp(selected_col="f", output_col="back", inverse=True).link_from(f)
+    out = inv.collect_mtable()
+    for orig, back in zip(out.col("vec"), out.col("back")):
+        assert np.allclose(orig.data, back.data, atol=1e-8)
+
+
+def test_vector_ops():
+    rows = [(1.0, DenseVector([2.0, 3.0])), (4.0, DenseVector([5.0, 6.0]))]
+    src = MemSourceBatchOp(rows, ["num", "vec"])
+    out = (VectorAssemblerBatchOp(selected_cols=["num", "vec"], output_col="all")
+           .link_from(src)).collect_mtable()
+    assert list(out.col("all")[0].data) == [1.0, 2.0, 3.0]
+    nrm = (VectorNormalizeBatchOp(selected_col="vec").link_from(src)
+           ).collect_mtable()
+    assert nrm.col("vec")[0].norm_l2() == pytest.approx(1.0)
+    sl = (VectorSliceBatchOp(selected_col="vec", indices=[1]).link_from(src)
+          ).collect_mtable()
+    assert list(sl.col("vec")[0].data) == [3.0]
+
+
+def test_vector_standard_scaler():
+    rows = [(DenseVector([1.0, 10.0]),), (DenseVector([3.0, 30.0]),)]
+    src = MemSourceBatchOp(rows, ["v"])
+    m = VectorStandardScalerTrainBatchOp(selected_col="v").link_from(src)
+    out = (VectorStandardScalerPredictBatchOp(selected_col="v")
+           .link_from(m, src)).collect_mtable()
+    Z = np.stack([v.data for v in out.col("v")])
+    assert np.allclose(Z.mean(0), 0)
+
+
+def test_summarizer_and_correlation():
+    src = _num_src()
+    s = SummarizerBatchOp(selected_cols=["a", "b"]).link_from(src).collect_summary()
+    a = np.asarray(src.collect_mtable().col("a"))
+    assert s.mean("a") == pytest.approx(a.mean())
+    assert s.standard_deviation("a") == pytest.approx(a.std(ddof=1))
+    C = (CorrelationBatchOp(selected_cols=["a", "b"]).link_from(src)
+         ).collect_correlation()
+    assert C.shape == (2, 2) and C[0, 0] == 1.0
+    C2 = (CorrelationBatchOp(selected_cols=["a", "b"], method="SPEARMAN")
+          .link_from(src)).collect_correlation()
+    assert abs(C2[0, 1]) <= 1.0
+
+
+def test_chi_square():
+    # strongly dependent: cat determines label
+    rows = [("a", "x"), ("a", "x"), ("b", "y"), ("b", "y")] * 10
+    src = MemSourceBatchOp(rows, "cat STRING, label STRING")
+    out = (ChiSquareTestBatchOp(selected_cols=["cat"], label_col="label")
+           .link_from(src)).collect_mtable()
+    assert out.col("p")[0] < 1e-6
+    sel = (ChiSqSelectorBatchOp(selected_cols=["cat"], label_col="label",
+                                num_top_features=1).link_from(src))
+    assert "cat" in sel.get_col_names()
+
+
+def test_sql_ops():
+    src = _num_src(n=30)
+    sel = SelectBatchOp(clause="a, b*2 as b2, cat").link_from(src).collect_mtable()
+    assert np.allclose(sel.col("b2"), np.asarray(src.collect_mtable().col("b")) * 2)
+    w = WhereBatchOp(clause="cat == 'x' and a > 0").link_from(src).collect_mtable()
+    assert all(c == "x" for c in w.col("cat"))
+    g_op = GroupByBatchOp(group_by_predicate="cat",
+                          select_clause="cat, count(*) as n, avg(a) as ma"
+                          ).link_from(src)
+    g = g_op.collect_mtable()
+    assert g.num_rows == 3 and sum(g.col("n")) == 30
+    j = (JoinBatchOp(join_predicate="a.cat = b.cat",
+                     select_clause="*")
+         .link_from(src.first_n(3), g_op))
+    assert j.get_output_table().num_rows == 3
+    u = UnionAllBatchOp().link_from(src, src)
+    assert u.get_output_table().num_rows == 60
+    m = MinusBatchOp().link_from(u, src)
+    assert m.get_output_table().num_rows == 0
+
+
+def test_sampling_ops():
+    src = _num_src(n=200)
+    s = SampleBatchOp(ratio=0.3, seed=1).link_from(src)
+    assert 30 <= s.get_output_table().num_rows <= 90
+    a, b = SplitBatchOp(fraction=0.75, seed=2).link_from(src), None
+    left, right = a.get_output_table(), a.get_side_output(0).get_output_table()
+    assert left.num_rows == 150 and right.num_rows == 50
+    ids = AppendIdBatchOp().link_from(src).collect_mtable()
+    assert list(ids.col("append_id")) == list(range(200))
+    ws = WeightSampleBatchOp(weight_col="b", ratio=0.2, seed=3).link_from(src)
+    assert ws.get_output_table().num_rows == 40
+
+
+def test_pipeline_feature_stages():
+    from alink_tpu.pipeline import Pipeline
+    from alink_tpu.pipeline.feature import StandardScaler, OneHotEncoder
+    from alink_tpu.pipeline.classification import LogisticRegression
+    rng = np.random.RandomState(0)
+    n = 200
+    a = rng.randn(n)
+    cat = np.where(rng.rand(n) > 0.5, "m", "f")
+    y = np.where(a + 0.05 * rng.randn(n) > 0.5, "pos", "neg")
+    src = MemSourceBatchOp(list(zip(a * 10 + 5, cat, y)),
+                           "a DOUBLE, cat STRING, label STRING")
+    pipe = Pipeline(
+        StandardScaler(selected_cols=["a"]),
+        LogisticRegression(feature_cols=["a"], label_col="label",
+                           prediction_col="pred"))
+    model, out = pipe.fit_and_transform(src)
+    acc = np.mean([p == l for p, l in
+                   zip(out.collect_mtable().col("pred"),
+                       out.collect_mtable().col("label"))])
+    assert acc > 0.85

@@ -1,0 +1,148 @@
+"""FM and NaiveBayes end-to-end tests."""
+
+import json
+import numpy as np
+import pytest
+
+from alink_tpu.common import DenseVector, SparseVector
+from alink_tpu.operator.base import TableSourceBatchOp
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.batch.classification.fm_ops import (
+    FmClassifierTrainBatchOp, FmRegressorTrainBatchOp, FmPredictBatchOp)
+from alink_tpu.operator.batch.classification.naive_bayes import (
+    NaiveBayesTextTrainBatchOp, NaiveBayesTextPredictBatchOp,
+    NaiveBayesTrainBatchOp, NaiveBayesPredictBatchOp)
+from alink_tpu.operator.batch.evaluation import EvalBinaryClassBatchOp
+
+
+def test_fm_classifier_interaction_data():
+    # XOR-ish data: label depends on the PRODUCT of two features — linear
+    # models fail, FM's factorized interactions succeed.
+    rng = np.random.RandomState(0)
+    n = 600
+    X = rng.randn(n, 2)
+    y = np.where(X[:, 0] * X[:, 1] > 0, "pos", "neg")
+    src = MemSourceBatchOp(list(zip(X[:, 0], X[:, 1], y)),
+                           "x1 DOUBLE, x2 DOUBLE, label STRING")
+    train = FmClassifierTrainBatchOp(
+        feature_cols=["x1", "x2"], label_col="label", num_factor=4,
+        num_epochs=50, learn_rate=0.1, seed=7).link_from(src)
+    out = (FmPredictBatchOp(prediction_col="pred", prediction_detail_col="d")
+           .link_from(train, src)).collect_mtable()
+    acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
+    assert acc > 0.8
+    m = (EvalBinaryClassBatchOp(label_col="label", prediction_detail_col="d")
+         .link_from(TableSourceBatchOp(out))).collect_metrics()
+    assert m.get("AUC") > 0.85
+    # loss decreased
+    info = train.get_side_output(0).get_output_table()
+    losses = np.asarray(info.col("loss"))
+    assert losses[-1] < losses[0]
+
+
+def test_fm_regressor():
+    rng = np.random.RandomState(1)
+    n = 500
+    X = rng.randn(n, 3)
+    y = 2.0 + X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+    src = MemSourceBatchOp([tuple(r) + (t,) for r, t in zip(X, y)],
+                           "a DOUBLE, b DOUBLE, c DOUBLE, y DOUBLE")
+    train = FmRegressorTrainBatchOp(feature_cols=["a", "b", "c"], label_col="y",
+                                    num_factor=4, num_epochs=60,
+                                    learn_rate=0.1).link_from(src)
+    out = (FmPredictBatchOp(prediction_col="p").link_from(train, src)
+           ).collect_mtable()
+    resid = np.abs(np.asarray(out.col("p")) - y)
+    assert resid.mean() < 0.45
+
+
+def test_fm_sparse_input():
+    rng = np.random.RandomState(2)
+    n, d = 400, 50
+    rows = []
+    for i in range(n):
+        idx = rng.choice(d, 5, replace=False)
+        val = np.ones(5)
+        label = "a" if (idx < 25).sum() >= 3 else "b"
+        rows.append((SparseVector(d, idx, val), label))
+    src = MemSourceBatchOp(rows, ["vec", "label"])
+    train = FmClassifierTrainBatchOp(vector_col="vec", label_col="label",
+                                     num_factor=4, num_epochs=40,
+                                     learn_rate=0.2).link_from(src)
+    out = (FmPredictBatchOp(prediction_col="pred").link_from(train, src)
+           ).collect_mtable()
+    acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
+    assert acc > 0.85
+
+
+def test_naive_bayes_text_multinomial():
+    # term-count vectors, two topics
+    rng = np.random.RandomState(0)
+    rows = []
+    for _ in range(200):
+        topic = rng.rand() < 0.5
+        rates = np.asarray([5, 3, 0.2, 0.1] if topic else [0.2, 0.1, 5, 3])
+        counts = rng.poisson(rates).astype(float)
+        rows.append((DenseVector(counts), "sport" if topic else "politics"))
+    src = MemSourceBatchOp(rows, ["vec", "label"])
+    train = NaiveBayesTextTrainBatchOp(vector_col="vec",
+                                       label_col="label").link_from(src)
+    out = (NaiveBayesTextPredictBatchOp(prediction_col="pred",
+                                        prediction_detail_col="d")
+           .link_from(train, src)).collect_mtable()
+    acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
+    assert acc > 0.95
+
+
+def test_naive_bayes_text_bernoulli():
+    rng = np.random.RandomState(1)
+    rows = []
+    for _ in range(200):
+        topic = rng.rand() < 0.5
+        p = np.asarray([0.9, 0.8, 0.1, 0.1] if topic else [0.1, 0.1, 0.9, 0.8])
+        bits = (rng.rand(4) < p).astype(float)
+        rows.append((DenseVector(bits), "t1" if topic else "t2"))
+    src = MemSourceBatchOp(rows, ["vec", "label"])
+    train = NaiveBayesTextTrainBatchOp(vector_col="vec", label_col="label",
+                                       model_type="Bernoulli").link_from(src)
+    out = (NaiveBayesTextPredictBatchOp(prediction_col="pred")
+           .link_from(train, src)).collect_mtable()
+    acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
+    assert acc > 0.9
+
+
+def test_naive_bayes_mixed_columns():
+    rng = np.random.RandomState(3)
+    n = 300
+    color = np.where(rng.rand(n) < 0.5, "red", "blue")
+    size = np.where(color == "red", rng.randn(n) + 3, rng.randn(n))
+    label = np.where(color == "red", "A", "B")
+    src = MemSourceBatchOp(list(zip(color, size, label)),
+                           "color STRING, size DOUBLE, label STRING")
+    train = NaiveBayesTrainBatchOp(feature_cols=["color", "size"],
+                                   label_col="label").link_from(src)
+    out = (NaiveBayesPredictBatchOp(prediction_col="pred", prediction_detail_col="d")
+           .link_from(train, src)).collect_mtable()
+    acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
+    assert acc > 0.95
+
+
+def test_one_vs_rest():
+    from alink_tpu.pipeline.fm_nb import OneVsRest
+    from alink_tpu.pipeline.classification import LogisticRegression
+    rng = np.random.RandomState(4)
+    n = 300
+    X = rng.randn(n, 2)
+    y = np.select([X[:, 0] > 0.5, X[:, 0] < -0.5], ["hi", "lo"], "mid")
+    src = MemSourceBatchOp(list(zip(X[:, 0], X[:, 1], y)),
+                           "a DOUBLE, b DOUBLE, label STRING")
+    ovr = OneVsRest(LogisticRegression(feature_cols=["a", "b"], label_col="label",
+                                       prediction_col="pred",
+                                       prediction_detail_col="d"))
+    model = ovr.fit(src)
+    out = model.transform(src).collect_mtable()
+    acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
+    assert acc > 0.9
+    probs = json.loads(out.col("d")[0])
+    assert set(probs) == {"hi", "lo", "mid"}
+    assert abs(sum(probs.values()) - 1.0) < 1e-6
