@@ -1,0 +1,148 @@
+"""MultilayerPerceptron batch operators.
+
+Re-design of batch/classification/MultilayerPerceptronTrainBatchOp.java
+(+ predict) — FeedForwardTrainer over the shared distributed L-BFGS.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+from ....common.mtable import MTable
+from ....common.params import ParamInfo, Params, RangeValidator
+from ....common.types import AlinkTypes, TableSchema
+from ....mapper.base import ModelMapper, OutputColsHelper
+from ....model.converters import (SimpleModelDataConverter, decode_array,
+                                  encode_array)
+from ....params.shared import (HasEpsilonDefaultAs000001, HasFeatureCols,
+                               HasL2, HasLabelCol, HasMaxIterDefaultAs100,
+                               HasPredictionCol, HasPredictionDetailCol,
+                               HasReservedCols, HasSeed, HasVectorCol)
+from ...base import BatchOperator
+from ...common.ann.mlp import MlpObjFunc, mlp_forward
+from ...common.dataproc.feature_extract import extract_design, resolve_feature_cols
+from ...common.linear.base import index_labels
+from ...common.optim.optimizers import OptimParams, optimize
+from ..utils.model_map import ModelMapBatchOp
+
+
+class MlpModelConverter(SimpleModelDataConverter):
+    def serialize_model(self, model):
+        meta = Params({"layer_sizes": model["layer_sizes"],
+                       "labels": [str(l) for l in model["labels"]],
+                       "label_type": model["label_type"],
+                       "feature_cols": model["feature_cols"],
+                       "vector_col": model["vector_col"],
+                       "standardization": model.get("standardization", True)})
+        return meta, [encode_array(model["coef"]), encode_array(model["mean"]),
+                      encode_array(model["std"])]
+
+    def deserialize_model(self, meta, data):
+        labels = meta._m.get("labels", [])
+        lt = meta._m.get("label_type", AlinkTypes.STRING)
+        if lt in (AlinkTypes.LONG, AlinkTypes.INT):
+            labels = [int(float(v)) for v in labels]
+        elif lt in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
+            labels = [float(v) for v in labels]
+        return {"layer_sizes": [int(x) for x in meta._m["layer_sizes"]],
+                "labels": labels, "label_type": lt,
+                "feature_cols": meta._m.get("feature_cols"),
+                "vector_col": meta._m.get("vector_col"),
+                "coef": decode_array(data[0]), "mean": decode_array(data[1]),
+                "std": decode_array(data[2])}
+
+
+class MultilayerPerceptronTrainBatchOp(BatchOperator, HasLabelCol, HasFeatureCols,
+                                       HasVectorCol, HasMaxIterDefaultAs100,
+                                       HasEpsilonDefaultAs000001, HasL2, HasSeed):
+    LAYERS = ParamInfo("layers", list, "hidden+output sizes, e.g. [8, 3]; "
+                       "input size is inferred", optional=False)
+
+    def link_from(self, in_op: BatchOperator):
+        import jax
+        t = in_op.get_output_table()
+        dtype = np.float64 if jax.config.jax_enable_x64 else np.float32
+        vector_col = self.params._m.get("vector_col")
+        feature_cols = self.params._m.get("feature_cols")
+        label_col = self.get_label_col()
+        if not vector_col:
+            feature_cols = resolve_feature_cols(t, feature_cols, label_col)
+        design = extract_design(t, feature_cols, vector_col, dtype)
+        X = design["X"] if design["kind"] == "dense" else None
+        if X is None:
+            from ....common.vector import SparseBatch
+            X = SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(dtype)
+        labels, y = index_labels(t.col(label_col))
+        k = len(labels)
+        hidden = [int(h) for h in self.get_layers()]
+        if hidden and hidden[-1] == k:
+            hidden = hidden[:-1]
+        layer_sizes = [X.shape[1]] + hidden + [k]
+        mean, std = X.mean(0), X.std(0)
+        std = np.where(std < 1e-12, 1.0, std)
+        Xs = (X - mean) / std
+        obj = MlpObjFunc(layer_sizes, l2=float(self.params._m.get("l2", 0.0) or 0.0))
+        rng = np.random.RandomState(self.get_seed())
+        w0 = (rng.randn(obj.dim) * 0.5 / np.sqrt(max(layer_sizes[0], 1))).astype(dtype)
+        coef, curve, steps = optimize(
+            obj, {"X": Xs, "y": y.astype(dtype), "w": np.ones(len(y), dtype)},
+            OptimParams(method="LBFGS", max_iter=self.get_max_iter(),
+                        epsilon=self.get_epsilon(), seed=self.get_seed()),
+            warm_start=w0)
+        self._output = MlpModelConverter().save_model({
+            "layer_sizes": layer_sizes, "labels": labels,
+            "label_type": t.schema.type_of(label_col),
+            "feature_cols": feature_cols, "vector_col": vector_col,
+            "coef": np.asarray(coef, np.float64), "mean": mean.astype(np.float64),
+            "std": std.astype(np.float64)})
+        self._side_outputs = [MTable({"iter": np.arange(1, len(curve) + 1),
+                                      "loss": np.asarray(curve, np.float64)})]
+        return self
+
+
+class MlpModelMapper(ModelMapper):
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model = None
+
+    def load_model(self, model_table: MTable):
+        self.model = MlpModelConverter().load_model(model_table)
+
+    def map_table(self, data: MTable) -> MTable:
+        import jax.numpy as jnp
+        m = self.model
+        design = extract_design(data, m["feature_cols"], m["vector_col"],
+                                np.float64, vector_size=m["layer_sizes"][0])
+        X = design["X"] if design["kind"] == "dense" else None
+        if X is None:
+            from ....common.vector import SparseBatch
+            X = SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)
+        Xs = (X - m["mean"]) / m["std"]
+        logits = np.asarray(mlp_forward(jnp.asarray(m["coef"]), jnp.asarray(Xs),
+                                        m["layer_sizes"]))
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        probs = e / e.sum(1, keepdims=True)
+        pick = probs.argmax(1)
+        preds = np.empty(len(pick), object)
+        preds[:] = [m["labels"][i] for i in pick]
+        pred_col = self.params._m.get("prediction_col", "pred")
+        detail_col = self.params._m.get("prediction_detail_col")
+        cols, types, vals = [pred_col], [m["label_type"]], [preds]
+        if detail_col:
+            details = np.asarray(
+                [json.dumps({str(l): float(p) for l, p in zip(m["labels"], row)})
+                 for row in probs], object)
+            cols.append(detail_col)
+            types.append(AlinkTypes.STRING)
+            vals.append(details)
+        helper = OutputColsHelper(data.schema, cols, types,
+                                  self.params._m.get("reserved_cols"))
+        return helper.build_output(data, vals)
+
+
+class MultilayerPerceptronPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
+                                         HasPredictionDetailCol, HasReservedCols):
+    MAPPER_CLS = MlpModelMapper

@@ -1,0 +1,312 @@
+"""Tree-family batch operators: GBDT, RandomForest, DecisionTree
+(classification + regression).
+
+Re-design of batch/classification/{GbdtTrainBatchOp, RandomForestTrainBatchOp,
+DecisionTreeTrainBatchOp} (+Reg variants, + predict ops) over the
+histogram-parallel device builder (common/tree/).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+from ....common.mtable import MTable
+from ....common.params import ParamInfo, Params, RangeValidator
+from ....common.types import AlinkTypes, TableSchema
+from ....mapper.base import ModelMapper, OutputColsHelper
+from ....model.converters import (SimpleModelDataConverter, decode_array,
+                                  encode_array)
+from ....params.shared import (HasFeatureCols, HasLabelCol, HasPredictionCol,
+                               HasPredictionDetailCol, HasReservedCols, HasSeed,
+                               HasVectorCol, HasWeightCol)
+from ...base import BatchOperator
+from ...common.dataproc.feature_extract import extract_design, resolve_feature_cols
+from ...common.tree.hist import bins_to_thresholds, tree_apply_values
+from ...common.tree.trainers import TreeTrainParams, forest_train, gbdt_train
+from ..utils.model_map import ModelMapBatchOp
+
+
+class TreeModelData:
+    def __init__(self, algo: str, is_regression: bool, max_depth: int,
+                 features: np.ndarray, thresholds: np.ndarray,
+                 leaf_values: np.ndarray, base_score: float, learning_rate: float,
+                 labels: List, feature_cols: Optional[List[str]],
+                 vector_col: Optional[str], label_type: str = AlinkTypes.STRING):
+        self.algo = algo
+        self.is_regression = is_regression
+        self.max_depth = max_depth
+        self.features = features          # (T, 2^d - 1) int
+        self.thresholds = thresholds      # (T, 2^d - 1) float
+        self.leaf_values = leaf_values    # (T, 2^d) or (T, 2^d, k)
+        self.base_score = base_score
+        self.learning_rate = learning_rate
+        self.labels = labels
+        self.feature_cols = feature_cols
+        self.vector_col = vector_col
+        self.label_type = label_type
+
+
+class TreeModelDataConverter(SimpleModelDataConverter):
+    """reference: common/tree/TreeModelDataConverter.java"""
+
+    def serialize_model(self, m: TreeModelData):
+        meta = Params({
+            "algo": m.algo, "is_regression": m.is_regression,
+            "max_depth": m.max_depth, "base_score": m.base_score,
+            "learning_rate": m.learning_rate,
+            "labels": [str(l) for l in m.labels], "label_type": m.label_type,
+            "feature_cols": m.feature_cols, "vector_col": m.vector_col})
+        return meta, [encode_array(m.features), encode_array(m.thresholds),
+                      encode_array(m.leaf_values)]
+
+    def deserialize_model(self, meta, data):
+        labels = meta._m.get("labels", [])
+        lt = meta._m.get("label_type", AlinkTypes.STRING)
+        if lt in (AlinkTypes.LONG, AlinkTypes.INT):
+            labels = [int(float(v)) for v in labels]
+        elif lt in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
+            labels = [float(v) for v in labels]
+        return TreeModelData(
+            meta._m["algo"], bool(meta._m["is_regression"]),
+            int(meta._m["max_depth"]),
+            decode_array(data[0], np.int64), decode_array(data[1]),
+            decode_array(data[2]), float(meta._m.get("base_score", 0.0)),
+            float(meta._m.get("learning_rate", 1.0)), labels,
+            meta._m.get("feature_cols"), meta._m.get("vector_col"), lt)
+
+
+class _TreeTrainParamsMixin(HasLabelCol, HasFeatureCols, HasVectorCol,
+                            HasWeightCol, HasSeed):
+    NUM_TREES = ParamInfo("num_trees", int, default=100,
+                          validator=RangeValidator(1, None))
+    MAX_DEPTH = ParamInfo("max_depth", int, default=5,
+                          validator=RangeValidator(1, 14))
+    MAX_BINS = ParamInfo("max_bins", int, default=64,
+                         validator=RangeValidator(2, 256))
+    MIN_SAMPLES_PER_LEAF = ParamInfo("min_samples_per_leaf", int, default=2)
+    LEARNING_RATE = ParamInfo("learning_rate", float, default=0.3)
+    SUBSAMPLING_RATIO = ParamInfo("subsampling_ratio", float, default=1.0)
+    FEATURE_SUBSAMPLING_RATIO = ParamInfo("feature_subsampling_ratio", float,
+                                          default=1.0)
+    REG_LAMBDA = ParamInfo("reg_lambda", float, default=1.0)
+
+
+def _extract_xy(op, t: MTable, regression: bool):
+    vector_col = op.params._m.get("vector_col")
+    feature_cols = op.params._m.get("feature_cols")
+    label_col = op.get_label_col()
+    weight_col = op.params._m.get("weight_col")
+    if not vector_col:
+        feature_cols = resolve_feature_cols(
+            t, feature_cols, label_col, exclude=[weight_col] if weight_col else [])
+    design = extract_design(t, feature_cols, vector_col, np.float64)
+    X = design["X"] if design["kind"] == "dense" else None
+    if X is None:
+        from ....common.vector import SparseBatch
+        X = SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)
+    raw = t.col(label_col)
+    label_type = t.schema.type_of(label_col)
+    if regression:
+        labels, y = [], np.asarray(raw, np.float64)
+    else:
+        labels = sorted({str(v) for v in raw})
+        y = np.asarray([labels.index(str(v)) for v in raw], np.float64)
+        if label_type in (AlinkTypes.LONG, AlinkTypes.INT):
+            labels = [int(float(v)) for v in labels]
+        elif label_type in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
+            labels = [float(v) for v in labels]
+    w = (np.asarray(t.col(weight_col), np.float64) if weight_col
+         else np.ones(len(y)))
+    return X, y, w, labels, feature_cols, vector_col, label_type
+
+
+def _tree_params(op) -> TreeTrainParams:
+    return TreeTrainParams(
+        num_trees=op.get_num_trees(), max_depth=op.get_max_depth(),
+        n_bins=op.get_max_bins(), learning_rate=op.get_learning_rate(),
+        min_samples_leaf=op.get_min_samples_per_leaf(),
+        reg_lambda=op.get_reg_lambda(),
+        subsample_ratio=op.get_subsampling_ratio(),
+        feature_subsample_ratio=op.get_feature_subsampling_ratio(),
+        seed=op.get_seed())
+
+
+class GbdtTrainBatchOp(BatchOperator, _TreeTrainParamsMixin):
+    """reference: batch/classification/GbdtTrainBatchOp.java (binary)."""
+    IS_REGRESSION = False
+
+    def link_from(self, in_op: BatchOperator):
+        t = in_op.get_output_table()
+        X, y, w, labels, fc, vc, lt = _extract_xy(t=t, op=self,
+                                                  regression=self.IS_REGRESSION)
+        if not self.IS_REGRESSION and len(labels) != 2:
+            raise ValueError(f"GBDT classifier is binary; got labels {labels}")
+        p = _tree_params(self)
+        tf, tb, tv, edges, base, curve = gbdt_train(
+            X, y, p, self.IS_REGRESSION, sample_weight=w)
+        thr = np.stack([bins_to_thresholds(np.asarray(tf[i]), np.asarray(tb[i]),
+                                           edges) for i in range(p.num_trees)])
+        model = TreeModelData(
+            "gbdt", self.IS_REGRESSION, p.max_depth, np.asarray(tf), thr,
+            np.asarray(tv), base, p.learning_rate, labels, fc, vc, lt)
+        self._output = TreeModelDataConverter().save_model(model)
+        self._side_outputs = [MTable({"tree": np.arange(1, len(curve) + 1),
+                                      "loss": curve.astype(np.float64)})]
+        return self
+
+
+class GbdtRegTrainBatchOp(GbdtTrainBatchOp):
+    """reference: batch/regression/GbdtRegTrainBatchOp.java"""
+    IS_REGRESSION = True
+
+
+class RandomForestTrainBatchOp(BatchOperator, _TreeTrainParamsMixin):
+    """reference: batch/classification/RandomForestTrainBatchOp.java"""
+    IS_REGRESSION = False
+    NUM_TREES = ParamInfo("num_trees", int, default=10,
+                          validator=RangeValidator(1, None))
+    SUBSAMPLING_RATIO = ParamInfo("subsampling_ratio", float, default=0.8)
+    FEATURE_SUBSAMPLING_RATIO = ParamInfo("feature_subsampling_ratio", float,
+                                          default=0.7)
+
+    def link_from(self, in_op: BatchOperator):
+        t = in_op.get_output_table()
+        X, y, w, labels, fc, vc, lt = _extract_xy(t=t, op=self,
+                                                  regression=self.IS_REGRESSION)
+        p = _tree_params(self)
+        if self.IS_REGRESSION:
+            stats = np.stack([y * w, y * y * w, w], axis=1)
+            kind = "variance"
+        else:
+            k = len(labels)
+            onehot = np.eye(k)[y.astype(int)] * w[:, None]
+            stats = np.concatenate([onehot, w[:, None]], axis=1)
+            kind = "gini"
+        tf, tb, tv, edges = forest_train(X, stats, p, kind)
+        thr = np.stack([bins_to_thresholds(np.asarray(tf[i]), np.asarray(tb[i]),
+                                           edges) for i in range(p.num_trees)])
+        model = TreeModelData(
+            "rf", self.IS_REGRESSION, p.max_depth, np.asarray(tf), thr,
+            np.asarray(tv), 0.0, 1.0, labels, fc, vc, lt)
+        self._output = TreeModelDataConverter().save_model(model)
+        return self
+
+
+class RandomForestRegTrainBatchOp(RandomForestTrainBatchOp):
+    IS_REGRESSION = True
+
+
+class DecisionTreeTrainBatchOp(RandomForestTrainBatchOp):
+    """reference: batch/classification/DecisionTreeTrainBatchOp.java"""
+    NUM_TREES = ParamInfo("num_trees", int, default=1,
+                          validator=RangeValidator(1, 1))
+    SUBSAMPLING_RATIO = ParamInfo("subsampling_ratio", float, default=1.0)
+    FEATURE_SUBSAMPLING_RATIO = ParamInfo("feature_subsampling_ratio", float,
+                                          default=1.0)
+
+
+class DecisionTreeRegTrainBatchOp(DecisionTreeTrainBatchOp):
+    IS_REGRESSION = True
+
+
+class TreeModelMapper(ModelMapper):
+    """Host-side batched forest traversal (reference common/tree/predictors/)."""
+
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model: Optional[TreeModelData] = None
+
+    def load_model(self, model_table: MTable):
+        self.model = TreeModelDataConverter().load_model(model_table)
+
+    def map_table(self, data: MTable) -> MTable:
+        m = self.model
+        design = extract_design(data, m.feature_cols, m.vector_col, np.float64)
+        X = design["X"] if design["kind"] == "dense" else None
+        if X is None:
+            from ....common.vector import SparseBatch
+            X = SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)
+        T = m.features.shape[0]
+        n = X.shape[0]
+        if m.algo == "gbdt":
+            score = np.full(n, m.base_score)
+            for t in range(T):
+                leaf = tree_apply_values(X, m.features[t], m.thresholds[t],
+                                         m.max_depth)
+                score += m.learning_rate * m.leaf_values[t][leaf]
+            if m.is_regression:
+                return self._emit(data, score, None, None)
+            p_pos = 1.0 / (1.0 + np.exp(-np.clip(score, -500, 500)))
+            probs = np.stack([1 - p_pos, p_pos], axis=1)  # labels sorted asc
+            return self._emit(data, None, probs, m.labels)
+        # random forest / decision tree
+        if m.is_regression:
+            acc = np.zeros(n)
+            for t in range(T):
+                leaf = tree_apply_values(X, m.features[t], m.thresholds[t],
+                                         m.max_depth)
+                acc += m.leaf_values[t][leaf]
+            return self._emit(data, acc / T, None, None)
+        k = m.leaf_values.shape[2]
+        probs = np.zeros((n, k))
+        for t in range(T):
+            leaf = tree_apply_values(X, m.features[t], m.thresholds[t],
+                                     m.max_depth)
+            probs += m.leaf_values[t][leaf]
+        probs /= np.maximum(probs.sum(1, keepdims=True), 1e-12)
+        return self._emit(data, None, probs, m.labels)
+
+    def _emit(self, data, scores, probs, labels):
+        m = self.model
+        pred_col = self.params._m.get("prediction_col", "pred")
+        detail_col = self.params._m.get("prediction_detail_col")
+        reserved = self.params._m.get("reserved_cols")
+        if probs is None:
+            helper = OutputColsHelper(data.schema, [pred_col],
+                                      [AlinkTypes.DOUBLE], reserved)
+            return helper.build_output(data, [scores])
+        pick = probs.argmax(1)
+        preds = np.empty(len(pick), object)
+        preds[:] = [labels[i] for i in pick]
+        cols, types, vals = [pred_col], [m.label_type], [preds]
+        if detail_col:
+            details = np.asarray(
+                [json.dumps({str(l): float(p) for l, p in zip(labels, row)})
+                 for row in probs], object)
+            cols.append(detail_col)
+            types.append(AlinkTypes.STRING)
+            vals.append(details)
+        helper = OutputColsHelper(data.schema, cols, types, reserved)
+        return helper.build_output(data, vals)
+
+
+class _TreePredictBase(ModelMapBatchOp, HasPredictionCol, HasPredictionDetailCol,
+                       HasReservedCols):
+    MAPPER_CLS = TreeModelMapper
+
+
+class GbdtPredictBatchOp(_TreePredictBase):
+    pass
+
+
+class GbdtRegPredictBatchOp(_TreePredictBase):
+    pass
+
+
+class RandomForestPredictBatchOp(_TreePredictBase):
+    pass
+
+
+class RandomForestRegPredictBatchOp(_TreePredictBase):
+    pass
+
+
+class DecisionTreePredictBatchOp(_TreePredictBase):
+    pass
+
+
+class DecisionTreeRegPredictBatchOp(_TreePredictBase):
+    pass

@@ -1,0 +1,246 @@
+"""GMM + BisectingKMeans batch operators.
+
+Re-design of batch/clustering/ GmmTrainBatchOp/GmmPredictBatchOp
+(common/clustering/GmmModelData + MultivariateGaussian in
+statistics/basicstatistic/) and BisectingKMeansTrainBatchOp.
+
+GMM: EM on the BSP engine — the E-step responsibilities and the M-step
+sufficient stats (sum_r, sum_r*x, sum_r*xx^T) are fused device kernels,
+summed across workers with one psum per superstep.
+BisectingKMeans: host-driven splitting loop (tree structure on host),
+device k=2 KMeans per split.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ....common.mtable import MTable
+from ....common.params import ParamInfo, Params, RangeValidator
+from ....common.types import AlinkTypes, TableSchema
+from ....engine import AllReduce, IterativeComQueue
+from ....mapper.base import ModelMapper, OutputColsHelper
+from ....model.converters import (SimpleModelDataConverter, decode_array,
+                                  encode_array)
+from ....params.shared import (HasFeatureCols, HasMaxIterDefaultAs100,
+                               HasPredictionCol, HasPredictionDetailCol,
+                               HasReservedCols, HasSeed, HasVectorCol)
+from ...base import BatchOperator
+from ...common.clustering.kmeans import kmeans_plus_plus_init, kmeans_train
+from ...common.dataproc.feature_extract import extract_design, resolve_feature_cols
+from ..utils.model_map import ModelMapBatchOp
+from .kmeans_ops import (KMeansModelData, KMeansModelDataConverter,
+                         KMeansModelMapper, _KMeansParams)
+
+
+def _table_to_matrix(op, t: MTable):
+    vector_col = op.params._m.get("vector_col")
+    feature_cols = op.params._m.get("feature_cols")
+    if not vector_col:
+        feature_cols = resolve_feature_cols(t, feature_cols)
+    design = extract_design(t, feature_cols, vector_col, np.float64)
+    X = design["X"] if design["kind"] == "dense" else None
+    if X is None:
+        from ....common.vector import SparseBatch
+        X = SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)
+    return X, feature_cols, vector_col
+
+
+# ---------------------------------------------------------------------------
+# GMM
+# ---------------------------------------------------------------------------
+
+class GmmModelDataConverter(SimpleModelDataConverter):
+    """reference: common/clustering/GmmModelData.java"""
+
+    def serialize_model(self, model):
+        meta = Params({"k": model["means"].shape[0],
+                       "vector_col": model["vector_col"],
+                       "feature_cols": model["feature_cols"]})
+        return meta, [encode_array(model["weights"]), encode_array(model["means"]),
+                      encode_array(model["covs"])]
+
+    def deserialize_model(self, meta, data):
+        return {"weights": decode_array(data[0]), "means": decode_array(data[1]),
+                "covs": decode_array(data[2]),
+                "vector_col": meta._m.get("vector_col"),
+                "feature_cols": meta._m.get("feature_cols")}
+
+
+def _log_gauss(X, means, covs):
+    """(n, k) log N(x | mu_c, Sigma_c) via batched cholesky."""
+    d = X.shape[1]
+    chol = jnp.linalg.cholesky(covs)                       # (k, d, d)
+    diff = X[:, None, :] - means[None, :, :]               # (n, k, d)
+    inv_chol = jnp.linalg.inv(chol)                        # small d: explicit inverse
+    sol = jnp.einsum("kij,nkj->nki", inv_chol, diff)       # (n, k, d)
+    maha = (sol ** 2).sum(-1)
+    logdet = 2.0 * jnp.log(jnp.diagonal(chol, axis1=1, axis2=2)).sum(-1)
+    return -0.5 * (d * jnp.log(2 * jnp.pi) + logdet[None, :] + maha)
+
+
+def gmm_train(X: np.ndarray, k: int, max_iter: int = 100, tol: float = 1e-4,
+              seed: int = 0, reg: float = 1e-6):
+    n, d = X.shape
+    init_means = kmeans_plus_plus_init(X, k, seed)
+    data = np.concatenate([X, np.ones((n, 1))], 1)
+
+    def estep_mstep(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("means", jnp.asarray(init_means))
+            ctx.put_obj("covs", jnp.tile(jnp.eye(d)[None], (k, 1, 1)))
+            ctx.put_obj("weights", jnp.full((k,), 1.0 / k))
+            ctx.put_obj("loglik", jnp.asarray(-jnp.inf))
+            ctx.put_obj("delta", jnp.asarray(jnp.inf))
+        block = ctx.get_obj("data")
+        Xb, wb = block[:, :d], block[:, d]
+        lg = _log_gauss(Xb, ctx.get_obj("means"), ctx.get_obj("covs"))
+        lg = lg + jnp.log(jnp.maximum(ctx.get_obj("weights"), 1e-300))[None, :]
+        lse = jax.scipy.special.logsumexp(lg, axis=1)
+        resp = jnp.exp(lg - lse[:, None]) * wb[:, None]     # (n, k)
+        s0 = resp.sum(0)                                    # (k,)
+        s1 = resp.T @ Xb                                    # (k, d)
+        s2 = jnp.einsum("nk,ni,nj->kij", resp, Xb, Xb)      # (k, d, d)
+        ll = (lse * wb).sum()
+        ctx.put_obj("stats", {"s0": s0, "s1": s1, "s2": s2,
+                              "ll": jnp.stack([ll, wb.sum()])})
+
+    def update(ctx):
+        st = ctx.get_obj("stats")
+        s0, s1, s2 = st["s0"], st["s1"], st["s2"]
+        tot = jnp.maximum(s0.sum(), 1e-12)
+        means = s1 / jnp.maximum(s0[:, None], 1e-12)
+        covs = (s2 / jnp.maximum(s0[:, None, None], 1e-12)
+                - means[:, :, None] * means[:, None, :])
+        covs = covs + reg * jnp.eye(d)[None]
+        ctx.put_obj("means", means)
+        ctx.put_obj("covs", covs)
+        ctx.put_obj("weights", s0 / tot)
+        ll = st["ll"][0] / jnp.maximum(st["ll"][1], 1e-12)
+        ctx.put_obj("delta", jnp.abs(ll - ctx.get_obj("loglik")))
+        ctx.put_obj("loglik", ll)
+
+    res = (IterativeComQueue(max_iter=max_iter, seed=seed)
+           .init_with_partitioned_data("data", data)
+           .add(estep_mstep)
+           .add(AllReduce("stats"))
+           .add(update)
+           .set_compare_criterion(lambda ctx: ctx.get_obj("delta") < tol)
+           .exec())
+    return (res.get("weights"), res.get("means"), res.get("covs"),
+            float(res.get("loglik")), res.step_count)
+
+
+class GmmTrainBatchOp(BatchOperator, HasVectorCol, HasFeatureCols,
+                      HasMaxIterDefaultAs100, HasSeed):
+    K = ParamInfo("k", int, default=2, validator=RangeValidator(1, None))
+    EPSILON = ParamInfo("epsilon", float, default=1e-4)
+
+    def link_from(self, in_op: BatchOperator) -> "GmmTrainBatchOp":
+        t = in_op.get_output_table()
+        X, feature_cols, vector_col = _table_to_matrix(self, t)
+        weights, means, covs, ll, steps = gmm_train(
+            X, self.get_k(), self.get_max_iter(), self.get_epsilon(),
+            self.get_seed())
+        self._output = GmmModelDataConverter().save_model({
+            "weights": np.asarray(weights), "means": np.asarray(means),
+            "covs": np.asarray(covs), "vector_col": vector_col,
+            "feature_cols": feature_cols})
+        self._steps = steps
+        return self
+
+
+class GmmModelMapper(ModelMapper):
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model = None
+
+    def load_model(self, model_table: MTable):
+        self.model = GmmModelDataConverter().load_model(model_table)
+
+    def map_table(self, data: MTable) -> MTable:
+        m = self.model
+        design = extract_design(data, m["feature_cols"], m["vector_col"], np.float64)
+        X = design["X"] if design["kind"] == "dense" else None
+        if X is None:
+            from ....common.vector import SparseBatch
+            X = SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)
+        lg = np.asarray(_log_gauss(jnp.asarray(X), jnp.asarray(m["means"]),
+                                   jnp.asarray(m["covs"])))
+        lg = lg + np.log(np.maximum(m["weights"], 1e-300))[None, :]
+        probs = np.exp(lg - lg.max(1, keepdims=True))
+        probs /= probs.sum(1, keepdims=True)
+        ids = probs.argmax(1).astype(np.int64)
+        pred_col = self.params._m.get("prediction_col", "cluster_id")
+        detail_col = self.params._m.get("prediction_detail_col")
+        cols, types, vals = [pred_col], [AlinkTypes.LONG], [ids]
+        if detail_col:
+            details = np.asarray([json.dumps({str(i): float(p)
+                                              for i, p in enumerate(row)})
+                                  for row in probs], object)
+            cols.append(detail_col)
+            types.append(AlinkTypes.STRING)
+            vals.append(details)
+        helper = OutputColsHelper(data.schema, cols, types,
+                                  self.params._m.get("reserved_cols"))
+        return helper.build_output(data, vals)
+
+
+class GmmPredictBatchOp(ModelMapBatchOp, HasPredictionCol, HasPredictionDetailCol,
+                        HasReservedCols):
+    MAPPER_CLS = GmmModelMapper
+
+
+# ---------------------------------------------------------------------------
+# Bisecting KMeans
+# ---------------------------------------------------------------------------
+
+class BisectingKMeansTrainBatchOp(BatchOperator, _KMeansParams):
+    """reference: batch/clustering/BisectingKMeansTrainBatchOp.java —
+    repeatedly bisect the largest-SSE cluster with k=2 KMeans."""
+
+    def link_from(self, in_op: BatchOperator) -> "BisectingKMeansTrainBatchOp":
+        t = in_op.get_output_table()
+        X, feature_cols, vector_col = _table_to_matrix(self, t)
+        k = self.get_k()
+        assign = np.zeros(X.shape[0], np.int64)
+        centroids = [X.mean(0)]
+        while len(centroids) < k:
+            sse = [((X[assign == c] - centroids[c]) ** 2).sum()
+                   for c in range(len(centroids))]
+            target = int(np.argmax(sse))
+            mask = assign == target
+            if mask.sum() < 2:
+                break
+            sub_c, _, _ = kmeans_train(
+                X[mask], 2, max_iter=self.get_max_iter(), tol=self.get_epsilon(),
+                seed=self.get_seed() + len(centroids))
+            sub_ids, _ = _assign_np(X[mask], np.asarray(sub_c))
+            new_id = len(centroids)
+            idxs = np.nonzero(mask)[0]
+            assign[idxs[sub_ids == 1]] = new_id
+            centroids[target] = np.asarray(sub_c[0])
+            centroids.append(np.asarray(sub_c[1]))
+        cents = np.stack(centroids)
+        weights = np.asarray([(assign == c).sum() for c in range(len(centroids))],
+                             np.float64)
+        model = KMeansModelData(cents, weights, self.get_distance_type(),
+                                vector_col, feature_cols)
+        self._output = KMeansModelDataConverter().save_model(model)
+        return self
+
+
+def _assign_np(X, C):
+    D = ((X[:, None, :] - C[None, :, :]) ** 2).sum(-1)
+    ids = D.argmin(1)
+    return ids, D[np.arange(len(X)), ids]
+
+
+class BisectingKMeansPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
+                                    HasReservedCols):
+    MAPPER_CLS = KMeansModelMapper

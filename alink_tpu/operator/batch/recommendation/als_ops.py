@@ -1,0 +1,159 @@
+"""ALS batch operators.
+
+Re-design of batch/recommendation/ AlsTrainBatchOp, AlsPredictBatchOp,
+AlsTopKPredictBatchOp + AlsModelDataConverter (common/recommendation/).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+from ....common.mtable import MTable
+from ....common.params import ParamInfo, Params, RangeValidator
+from ....common.types import AlinkTypes, TableSchema
+from ....model.converters import (SimpleModelDataConverter, decode_array,
+                                  encode_array)
+from ....params.shared import HasPredictionCol, HasReservedCols, HasSeed
+from ...base import BatchOperator
+from ...common.recommendation.als import AlsTrainParams, als_train
+
+
+class AlsModelData:
+    def __init__(self, user_ids: List, item_ids: List, user_factors: np.ndarray,
+                 item_factors: np.ndarray, user_col: str, item_col: str,
+                 rate_col: str):
+        self.user_ids = user_ids
+        self.item_ids = item_ids
+        self.user_factors = user_factors
+        self.item_factors = item_factors
+        self.user_col, self.item_col, self.rate_col = user_col, item_col, rate_col
+
+
+class AlsModelDataConverter(SimpleModelDataConverter):
+    """reference: common/recommendation/AlsModelDataConverter.java"""
+
+    def serialize_model(self, m: AlsModelData):
+        meta = Params({"user_col": m.user_col, "item_col": m.item_col,
+                       "rate_col": m.rate_col,
+                       "user_ids": [str(u) for u in m.user_ids],
+                       "item_ids": [str(i) for i in m.item_ids]})
+        return meta, [encode_array(m.user_factors), encode_array(m.item_factors)]
+
+    def deserialize_model(self, meta, data):
+        return AlsModelData(
+            list(meta._m.get("user_ids", [])), list(meta._m.get("item_ids", [])),
+            decode_array(data[0]), decode_array(data[1]),
+            meta._m.get("user_col", "user"), meta._m.get("item_col", "item"),
+            meta._m.get("rate_col", "rating"))
+
+
+class AlsTrainBatchOp(BatchOperator, HasSeed):
+    """reference: batch/recommendation/AlsTrainBatchOp.java"""
+    USER_COL = ParamInfo("user_col", str, optional=False)
+    ITEM_COL = ParamInfo("item_col", str, optional=False)
+    RATE_COL = ParamInfo("rate_col", str, optional=False)
+    RANK = ParamInfo("rank", int, default=10, validator=RangeValidator(1, None))
+    NUM_ITER = ParamInfo("num_iter", int, default=10,
+                         validator=RangeValidator(1, None))
+    LAMBDA = ParamInfo("lambda_", float, default=0.1, aliases=("lambda",))
+    IMPLICIT_PREFS = ParamInfo("implicit_prefs", bool, default=False)
+    ALPHA = ParamInfo("alpha", float, default=40.0)
+    NONNEGATIVE = ParamInfo("nonnegative", bool, default=False)
+
+    def link_from(self, in_op: BatchOperator) -> "AlsTrainBatchOp":
+        t = in_op.get_output_table()
+        uc, ic, rc = self.get_user_col(), self.get_item_col(), self.get_rate_col()
+        users_raw = t.col(uc)
+        items_raw = t.col(ic)
+        user_ids = sorted({_c(v) for v in users_raw}, key=str)
+        item_ids = sorted({_c(v) for v in items_raw}, key=str)
+        u_lookup = {v: i for i, v in enumerate(user_ids)}
+        i_lookup = {v: i for i, v in enumerate(item_ids)}
+        users = np.asarray([u_lookup[_c(v)] for v in users_raw], np.int32)
+        items = np.asarray([i_lookup[_c(v)] for v in items_raw], np.int32)
+        ratings = np.asarray(t.col(rc), np.float64)
+        p = AlsTrainParams(
+            rank=self.get_rank(), num_iter=self.get_num_iter(),
+            lambda_reg=self.get_lambda_(), implicit_prefs=self.get_implicit_prefs(),
+            alpha=self.get_alpha(), nonnegative=self.get_nonnegative(),
+            seed=self.get_seed())
+        uf, if_, curve = als_train(users, items, ratings, p,
+                                   num_users=len(user_ids),
+                                   num_items=len(item_ids))
+        model = AlsModelData(user_ids, item_ids, np.asarray(uf, np.float64),
+                             np.asarray(if_, np.float64), uc, ic, rc)
+        self._output = AlsModelDataConverter().save_model(model)
+        self._side_outputs = [MTable({"iter": np.arange(1, len(curve) + 1),
+                                      "train_rmse": curve.astype(np.float64)})]
+        return self
+
+
+def _c(v):
+    return v.item() if isinstance(v, np.generic) else v
+
+
+class AlsPredictBatchOp(BatchOperator, HasPredictionCol, HasReservedCols):
+    """Predict the rating of (user, item) rows (reference AlsPredictBatchOp)."""
+    USER_COL = ParamInfo("user_col", str, optional=False)
+    ITEM_COL = ParamInfo("item_col", str, optional=False)
+
+    def link_from(self, model_op: BatchOperator, data_op: BatchOperator):
+        m = AlsModelDataConverter().load_model(model_op.get_output_table())
+        t = data_op.get_output_table()
+        u_lookup = {v: i for i, v in enumerate(m.user_ids)}
+        i_lookup = {v: i for i, v in enumerate(m.item_ids)}
+        preds = np.zeros(t.num_rows)
+        for r, (u, i) in enumerate(zip(t.col(self.get_user_col()),
+                                       t.col(self.get_item_col()))):
+            ui = u_lookup.get(str(_c(u)) if str(_c(u)) in u_lookup else _c(u))
+            ii = i_lookup.get(str(_c(i)) if str(_c(i)) in i_lookup else _c(i))
+            if ui is None or ii is None:
+                preds[r] = np.nan
+            else:
+                preds[r] = float(m.user_factors[ui] @ m.item_factors[ii])
+        from ....mapper.base import OutputColsHelper
+        helper = OutputColsHelper(t.schema,
+                                  [self.params._m.get("prediction_col", "pred")],
+                                  [AlinkTypes.DOUBLE],
+                                  self.params._m.get("reserved_cols"))
+        self._output = helper.build_output(t, [preds])
+        return self
+
+
+class AlsTopKPredictBatchOp(BatchOperator, HasPredictionCol):
+    """Top-K item recommendations per user row (reference AlsTopKPredictBatchOp)."""
+    USER_COL = ParamInfo("user_col", str, optional=False)
+    TOP_K = ParamInfo("top_k", int, default=10)
+
+    def link_from(self, model_op: BatchOperator, data_op: BatchOperator):
+        m = AlsModelDataConverter().load_model(model_op.get_output_table())
+        t = data_op.get_output_table()
+        u_lookup = {v: i for i, v in enumerate(m.user_ids)}
+        k = min(self.get_top_k(), len(m.item_ids))
+        recs = np.empty(t.num_rows, object)
+        # one matmul for all requested users (MXU-sized batch)
+        uidx = []
+        for u in t.col(self.get_user_col()):
+            key = str(_c(u)) if str(_c(u)) in u_lookup else _c(u)
+            uidx.append(u_lookup.get(key, -1))
+        uidx = np.asarray(uidx)
+        valid = uidx >= 0
+        scores = m.user_factors[np.maximum(uidx, 0)] @ m.item_factors.T
+        top = np.argsort(-scores, axis=1)[:, :k]
+        for r in range(t.num_rows):
+            if not valid[r]:
+                recs[r] = None
+                continue
+            recs[r] = json.dumps({
+                "object": [str(m.item_ids[j]) for j in top[r]],
+                "rate": [float(scores[r, j]) for j in top[r]]})
+        from ....mapper.base import OutputColsHelper
+        helper = OutputColsHelper(t.schema,
+                                  [self.params._m.get("prediction_col",
+                                                      "recommendations")],
+                                  [AlinkTypes.STRING])
+        self._output = helper.build_output(t, [recs])
+        return self

@@ -1,0 +1,534 @@
+"""GLM / Isotonic / AFT survival regression.
+
+Re-design of:
+  - operator/common/regression/glm/ (FamilyLink.java, famliy/, link/ — IRLS)
+    as a distributed IRLS: per-worker X^T W X / X^T W z partials, one psum,
+    device solve per iteration.
+  - isotonicReg/ (parallel pool-adjacent-violators) as host PAV.
+  - AftSurvivalReg (common/linear/AftRegObjFunc.java) as a Weibull AFT
+    objective with autodiff gradients on the shared L-BFGS engine.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ....common.mtable import MTable
+from ....common.params import InValidator, ParamInfo, Params, RangeValidator
+from ....common.types import AlinkTypes, TableSchema
+from ....engine import AllReduce, IterativeComQueue
+from ....mapper.base import ModelMapper, OutputColsHelper
+from ....model.converters import (SimpleModelDataConverter, decode_array,
+                                  encode_array)
+from ....params.shared import (HasEpsilonDefaultAs000001, HasFeatureCols,
+                               HasLabelCol, HasMaxIterDefaultAs100,
+                               HasPredictionCol, HasReservedCols, HasWeightCol)
+from ...base import BatchOperator
+from ...common.dataproc.feature_extract import resolve_feature_cols
+from ...common.optim.objfunc import OptimObjFunc
+from ...common.optim.optimizers import OptimParams, optimize
+from ..utils.model_map import ModelMapBatchOp
+
+
+# ---------------------------------------------------------------------------
+# GLM family/link algebra (reference glm/famliy/*, glm/link/*)
+# ---------------------------------------------------------------------------
+
+class _Family:
+    name = ""
+
+    def variance(self, mu):
+        raise NotImplementedError
+
+    def default_link(self) -> str:
+        return "Identity"
+
+    def clip_mu(self, mu):
+        return mu
+
+
+class Gaussian(_Family):
+    name = "Gaussian"
+
+    def variance(self, mu):
+        return jnp.ones_like(mu)
+
+
+class Binomial(_Family):
+    name = "Binomial"
+
+    def variance(self, mu):
+        return mu * (1 - mu)
+
+    def default_link(self):
+        return "Logit"
+
+    def clip_mu(self, mu):
+        return jnp.clip(mu, 1e-10, 1 - 1e-10)
+
+
+class Poisson(_Family):
+    name = "Poisson"
+
+    def variance(self, mu):
+        return mu
+
+    def default_link(self):
+        return "Log"
+
+    def clip_mu(self, mu):
+        return jnp.maximum(mu, 1e-10)
+
+
+class Gamma(_Family):
+    name = "Gamma"
+
+    def variance(self, mu):
+        return mu ** 2
+
+    def default_link(self):
+        return "Inverse"
+
+    def clip_mu(self, mu):
+        return jnp.maximum(mu, 1e-10)
+
+
+class Tweedie(_Family):
+    name = "Tweedie"
+
+    def __init__(self, variance_power=1.5):
+        self.p = variance_power
+
+    def variance(self, mu):
+        return mu ** self.p
+
+    def default_link(self):
+        return "Log"
+
+    def clip_mu(self, mu):
+        return jnp.maximum(mu, 1e-10)
+
+
+class _Link:
+    name = ""
+
+    def link(self, mu):
+        raise NotImplementedError
+
+    def unlink(self, eta):  # mu = g^-1(eta)
+        raise NotImplementedError
+
+    def derivative(self, mu):  # g'(mu)
+        raise NotImplementedError
+
+
+class Identity(_Link):
+    name = "Identity"
+
+    def link(self, mu):
+        return mu
+
+    def unlink(self, eta):
+        return eta
+
+    def derivative(self, mu):
+        return jnp.ones_like(mu)
+
+
+class Log(_Link):
+    name = "Log"
+
+    def link(self, mu):
+        return jnp.log(mu)
+
+    def unlink(self, eta):
+        return jnp.exp(jnp.clip(eta, -500, 500))
+
+    def derivative(self, mu):
+        return 1.0 / mu
+
+
+class Logit(_Link):
+    name = "Logit"
+
+    def link(self, mu):
+        return jnp.log(mu / (1 - mu))
+
+    def unlink(self, eta):
+        return jax.nn.sigmoid(eta)
+
+    def derivative(self, mu):
+        return 1.0 / (mu * (1 - mu))
+
+
+class Inverse(_Link):
+    name = "Inverse"
+
+    def link(self, mu):
+        return 1.0 / mu
+
+    def unlink(self, eta):
+        return 1.0 / jnp.where(jnp.abs(eta) < 1e-10, 1e-10, eta)
+
+    def derivative(self, mu):
+        return -1.0 / mu ** 2
+
+
+class Sqrt(_Link):
+    name = "Sqrt"
+
+    def link(self, mu):
+        return jnp.sqrt(mu)
+
+    def unlink(self, eta):
+        return eta ** 2
+
+    def derivative(self, mu):
+        return 0.5 / jnp.sqrt(mu)
+
+
+FAMILIES = {"gaussian": Gaussian, "binomial": Binomial, "poisson": Poisson,
+            "gamma": Gamma, "tweedie": Tweedie}
+LINKS = {"identity": Identity, "log": Log, "logit": Logit, "inverse": Inverse,
+         "sqrt": Sqrt}
+
+
+def glm_irls(X: np.ndarray, y: np.ndarray, w: np.ndarray, family: _Family,
+             link: _Link, max_iter: int = 25, tol: float = 1e-6,
+             reg: float = 0.0):
+    """Distributed IRLS; X already has the intercept column. Returns
+    (beta, deviance-ish curve, steps)."""
+    n, d = X.shape
+    data = np.concatenate([X, y[:, None], w[:, None]], 1)
+
+    def partials(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("beta", jnp.zeros(d, X.dtype))
+            ctx.put_obj("delta", jnp.asarray(jnp.inf, X.dtype))
+        block = ctx.get_obj("data")
+        Xb, yb, wb = block[:, :d], block[:, d], block[:, d + 1]
+        beta = ctx.get_obj("beta")
+        eta = Xb @ beta
+        mu = family.clip_mu(link.unlink(eta))
+        gp = link.derivative(mu)
+        wt = wb / jnp.maximum(family.variance(mu) * gp ** 2, 1e-12)
+        z = eta + (yb - mu) * gp
+        XtWX = (Xb * wt[:, None]).T @ Xb
+        XtWz = (Xb * wt[:, None]).T @ z
+        ctx.put_obj("normal", {"A": XtWX, "b": XtWz})
+
+    def solve(ctx):
+        nm = ctx.get_obj("normal")
+        A = nm["A"] + (reg + 1e-10) * jnp.eye(d, dtype=nm["A"].dtype)
+        beta_new = jnp.linalg.solve(A, nm["b"])
+        beta = ctx.get_obj("beta")
+        ctx.put_obj("delta", jnp.linalg.norm(beta_new - beta) /
+                    jnp.maximum(1.0, jnp.linalg.norm(beta_new)))
+        ctx.put_obj("beta", beta_new)
+
+    res = (IterativeComQueue(max_iter=max_iter)
+           .init_with_partitioned_data("data", data)
+           .add(partials)
+           .add(AllReduce("normal"))
+           .add(solve)
+           .set_compare_criterion(lambda ctx: ctx.get_obj("delta") < tol)
+           .exec())
+    return res.get("beta"), res.step_count
+
+
+class GlmModelConverter(SimpleModelDataConverter):
+    def serialize_model(self, model):
+        meta = Params({k: v for k, v in model.items() if k != "beta"})
+        return meta, [encode_array(model["beta"])]
+
+    def deserialize_model(self, meta, data):
+        out = dict(meta._m)
+        out["beta"] = decode_array(data[0])
+        return out
+
+
+class GlmTrainBatchOp(BatchOperator, HasLabelCol, HasFeatureCols, HasWeightCol,
+                      HasMaxIterDefaultAs100, HasEpsilonDefaultAs000001):
+    """reference: batch/regression/GlmTrainBatchOp.java"""
+    FAMILY = ParamInfo("family", str, default="Gaussian")
+    LINK = ParamInfo("link", str, "link function; family default when unset")
+    VARIANCE_POWER = ParamInfo("variance_power", float, default=1.5)
+    REG_PARAM = ParamInfo("reg_param", float, default=0.0)
+    FIT_INTERCEPT = ParamInfo("fit_intercept", bool, default=True)
+
+    def link_from(self, in_op: BatchOperator) -> "GlmTrainBatchOp":
+        import jax as _jax
+        t = in_op.get_output_table()
+        dtype = np.float64 if _jax.config.jax_enable_x64 else np.float32
+        label_col = self.get_label_col()
+        cols = resolve_feature_cols(t, self.params._m.get("feature_cols"),
+                                    label_col)
+        X = t.numeric_block(cols, dtype)
+        if self.get_fit_intercept():
+            X = np.concatenate([np.ones((X.shape[0], 1), dtype), X], 1)
+        y = np.asarray(t.col(label_col), dtype)
+        w = (np.asarray(t.col(self.params._m["weight_col"]), dtype)
+             if self.params._m.get("weight_col") else np.ones(len(y), dtype))
+        fam_name = self.get_family().lower()
+        fam = (Tweedie(self.get_variance_power()) if fam_name == "tweedie"
+               else FAMILIES[fam_name]())
+        link_name = (self.params._m.get("link") or fam.default_link()).lower()
+        link = LINKS[link_name]()
+        beta, steps = glm_irls(X, y, w, fam, link, self.get_max_iter(),
+                               self.get_epsilon(), self.get_reg_param())
+        self._output = GlmModelConverter().save_model({
+            "beta": np.asarray(beta, np.float64), "family": fam.name,
+            "link": link.name, "feature_cols": cols,
+            "fit_intercept": self.get_fit_intercept(),
+            "variance_power": self.get_variance_power()})
+        self._steps = steps
+        return self
+
+
+class GlmModelMapper(ModelMapper):
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model = None
+
+    def load_model(self, model_table: MTable):
+        self.model = GlmModelConverter().load_model(model_table)
+
+    def map_table(self, data: MTable) -> MTable:
+        m = self.model
+        X = data.numeric_block(m["feature_cols"], np.float64)
+        if m.get("fit_intercept", True):
+            X = np.concatenate([np.ones((X.shape[0], 1)), X], 1)
+        eta = X @ m["beta"]
+        link = LINKS[m["link"].lower()]()
+        mu = np.asarray(link.unlink(jnp.asarray(eta)))
+        pred_col = self.params._m.get("prediction_col", "pred")
+        link_pred_col = self.params._m.get("link_pred_result_col")
+        cols, types, vals = [pred_col], [AlinkTypes.DOUBLE], [mu]
+        if link_pred_col:
+            cols.append(link_pred_col)
+            types.append(AlinkTypes.DOUBLE)
+            vals.append(eta)
+        helper = OutputColsHelper(data.schema, cols, types,
+                                  self.params._m.get("reserved_cols"))
+        return helper.build_output(data, vals)
+
+
+class GlmPredictBatchOp(ModelMapBatchOp, HasPredictionCol, HasReservedCols):
+    MAPPER_CLS = GlmModelMapper
+    LINK_PRED_RESULT_COL = ParamInfo("link_pred_result_col", str)
+
+
+class GlmEvaluationBatchOp(BatchOperator, HasLabelCol):
+    """reference: batch/regression/GlmEvaluationBatchOp — deviance stats."""
+    PREDICTION_COL = ParamInfo("prediction_col", str, optional=False)
+    FAMILY = ParamInfo("family", str, default="Gaussian")
+
+    def link_from(self, in_op: BatchOperator) -> "GlmEvaluationBatchOp":
+        t = in_op.get_output_table()
+        y = np.asarray(t.col(self.get_label_col()), np.float64)
+        mu = np.asarray(t.col(self.get_prediction_col()), np.float64)
+        fam = self.get_family().lower()
+        eps = 1e-10
+        if fam == "poisson":
+            dev = 2 * np.sum(np.where(y > 0, y * np.log(np.maximum(y, eps) /
+                                                        np.maximum(mu, eps)), 0)
+                             - (y - mu))
+        elif fam == "binomial":
+            dev = -2 * np.sum(y * np.log(np.maximum(mu, eps))
+                              + (1 - y) * np.log(np.maximum(1 - mu, eps)))
+        elif fam == "gamma":
+            dev = 2 * np.sum(-np.log(np.maximum(y, eps) / np.maximum(mu, eps))
+                             + (y - mu) / np.maximum(mu, eps))
+        else:
+            dev = float(((y - mu) ** 2).sum())
+        null_mu = y.mean()
+        self._output = MTable([(json.dumps({
+            "deviance": float(dev), "degreeOfFreedom": int(len(y) - 1),
+            "aic": float("nan"),
+            "nullDeviance": float(((y - null_mu) ** 2).sum())
+            if fam == "gaussian" else float("nan")}),)],
+            TableSchema(["summary"], [AlinkTypes.STRING]))
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Isotonic regression (host PAV)
+# ---------------------------------------------------------------------------
+
+class IsotonicModelConverter(SimpleModelDataConverter):
+    def serialize_model(self, model):
+        meta = Params({"feature_col": model["feature_col"],
+                       "vector_col": model.get("vector_col"),
+                       "feature_index": model.get("feature_index", 0)})
+        return meta, [encode_array(model["boundaries"]),
+                      encode_array(model["values"])]
+
+    def deserialize_model(self, meta, data):
+        return {"feature_col": meta._m.get("feature_col"),
+                "vector_col": meta._m.get("vector_col"),
+                "feature_index": meta._m.get("feature_index", 0),
+                "boundaries": decode_array(data[0]), "values": decode_array(data[1])}
+
+
+def pav(x: np.ndarray, y: np.ndarray, w: np.ndarray):
+    """Pool-adjacent-violators (reference isotonicReg/ PAV)."""
+    order = np.argsort(x, kind="mergesort")
+    xs, ys, ws = x[order], y[order].astype(np.float64), w[order].astype(np.float64)
+    vals: List[float] = []
+    wts: List[float] = []
+    xs_out: List[float] = []
+    for xi, yi, wi in zip(xs, ys, ws):
+        vals.append(yi)
+        wts.append(wi)
+        xs_out.append(xi)
+        while len(vals) > 1 and vals[-2] > vals[-1]:
+            v = (vals[-2] * wts[-2] + vals[-1] * wts[-1]) / (wts[-2] + wts[-1])
+            w2 = wts[-2] + wts[-1]
+            vals[-2:] = [v]
+            wts[-2:] = [w2]
+            xs_out[-2:] = [xs_out[-1]]
+    return np.asarray(xs_out), np.asarray(vals)
+
+
+class IsotonicRegTrainBatchOp(BatchOperator, HasLabelCol, HasWeightCol):
+    """reference: batch/regression/IsotonicRegTrainBatchOp.java"""
+    FEATURE_COL = ParamInfo("feature_col", str, optional=False)
+
+    def link_from(self, in_op: BatchOperator) -> "IsotonicRegTrainBatchOp":
+        t = in_op.get_output_table()
+        x = np.asarray(t.col(self.get_feature_col()), np.float64)
+        y = np.asarray(t.col(self.get_label_col()), np.float64)
+        w = (np.asarray(t.col(self.params._m["weight_col"]), np.float64)
+             if self.params._m.get("weight_col") else np.ones(len(y)))
+        bx, bv = pav(x, y, w)
+        self._output = IsotonicModelConverter().save_model({
+            "feature_col": self.get_feature_col(), "boundaries": bx, "values": bv})
+        return self
+
+
+class IsotonicModelMapper(ModelMapper):
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model = None
+
+    def load_model(self, model_table: MTable):
+        self.model = IsotonicModelConverter().load_model(model_table)
+
+    def map_table(self, data: MTable) -> MTable:
+        m = self.model
+        x = np.asarray(data.col(m["feature_col"]), np.float64)
+        bx, bv = m["boundaries"], m["values"]
+        # linear interpolation between boundaries (reference behavior)
+        preds = np.interp(x, bx, bv)
+        helper = OutputColsHelper(data.schema,
+                                  [self.params._m.get("prediction_col", "pred")],
+                                  [AlinkTypes.DOUBLE],
+                                  self.params._m.get("reserved_cols"))
+        return helper.build_output(data, [preds])
+
+
+class IsotonicRegPredictBatchOp(ModelMapBatchOp, HasPredictionCol, HasReservedCols):
+    MAPPER_CLS = IsotonicModelMapper
+
+
+# ---------------------------------------------------------------------------
+# AFT survival regression (Weibull, autodiff on the L-BFGS stack)
+# ---------------------------------------------------------------------------
+
+class _AftObjFunc(OptimObjFunc):
+    """Weibull AFT log-likelihood (reference common/linear/AftRegObjFunc.java).
+
+    coef = [beta (d,), log_sigma]; data carries y = log(time), and the
+    censor indicator rides the extra column "c" (1 = event, 0 = censored).
+    """
+
+    def __init__(self, d: int, l1=0.0, l2=0.0):
+        super().__init__(d + 1, l1, l2)
+        self.d = d
+
+    def _nll_sum(self, coef, X, logt, c, w):
+        beta, log_sigma = coef[:self.d], coef[self.d]
+        sigma = jnp.exp(log_sigma)
+        eps = (logt - X @ beta) / sigma
+        # event: log f = eps - e^eps - log sigma ; censored: log S = -e^eps
+        log_f = eps - jnp.exp(eps) - log_sigma
+        log_s = -jnp.exp(eps)
+        return -(w * jnp.where(c > 0, log_f, log_s)).sum()
+
+    def calc_grad_shard(self, data, coef):
+        X, y, w, c = data["X"], data["y"], data["w"], data["c"]
+        loss, grad = jax.value_and_grad(self._nll_sum)(coef, X, y, c, w)
+        return grad, loss, w.sum()
+
+    def line_losses_shard(self, data, coef, direction, steps):
+        X, y, w, c = data["X"], data["y"], data["w"], data["c"]
+
+        def one(s):
+            return self._nll_sum(coef - s * direction, X, y, c, w)
+
+        return jax.vmap(one)(steps)
+
+
+class AftSurvivalRegTrainBatchOp(BatchOperator, HasFeatureCols, HasLabelCol,
+                                 HasMaxIterDefaultAs100,
+                                 HasEpsilonDefaultAs000001):
+    """reference: batch/regression/AftSurvivalRegTrainBatchOp.java"""
+    CENSOR_COL = ParamInfo("censor_col", str, optional=False)
+    WITH_INTERCEPT = ParamInfo("with_intercept", bool, default=True)
+
+    def link_from(self, in_op: BatchOperator) -> "AftSurvivalRegTrainBatchOp":
+        import jax as _jax
+        t = in_op.get_output_table()
+        dtype = np.float64 if _jax.config.jax_enable_x64 else np.float32
+        label_col = self.get_label_col()
+        cols = resolve_feature_cols(t, self.params._m.get("feature_cols"),
+                                    label_col, exclude=[self.get_censor_col()])
+        X = t.numeric_block(cols, dtype)
+        if self.get_with_intercept():
+            X = np.concatenate([np.ones((X.shape[0], 1), dtype), X], 1)
+        time = np.asarray(t.col(label_col), dtype)
+        c = np.asarray(t.col(self.get_censor_col()), dtype)
+        obj = _AftObjFunc(X.shape[1])
+        data = {"X": X, "y": np.log(np.maximum(time, 1e-12)),
+                "w": np.ones(len(time), dtype), "c": c}
+        coef, curve, steps = optimize(
+            obj, data, OptimParams(method="LBFGS",
+                                   max_iter=self.get_max_iter(),
+                                   epsilon=self.get_epsilon()))
+        self._output = GlmModelConverter().save_model({
+            "beta": np.asarray(coef, np.float64), "family": "AFT",
+            "link": "Log", "feature_cols": cols,
+            "fit_intercept": self.get_with_intercept()})
+        return self
+
+
+class AftModelMapper(ModelMapper):
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.model = None
+
+    def load_model(self, model_table: MTable):
+        self.model = GlmModelConverter().load_model(model_table)
+
+    def map_table(self, data: MTable) -> MTable:
+        m = self.model
+        X = data.numeric_block(m["feature_cols"], np.float64)
+        if m.get("fit_intercept", True):
+            X = np.concatenate([np.ones((X.shape[0], 1)), X], 1)
+        beta = m["beta"][:-1]
+        preds = np.exp(X @ beta)   # median-ish survival time scale
+        helper = OutputColsHelper(data.schema,
+                                  [self.params._m.get("prediction_col", "pred")],
+                                  [AlinkTypes.DOUBLE],
+                                  self.params._m.get("reserved_cols"))
+        return helper.build_output(data, [preds])
+
+
+class AftSurvivalRegPredictBatchOp(ModelMapBatchOp, HasPredictionCol,
+                                   HasReservedCols):
+    MAPPER_CLS = AftModelMapper

@@ -1,0 +1,80 @@
+"""Multilayer perceptron objective — TPU-native.
+
+Re-design of the reference ann/ package (23 files, 1,174 LoC:
+FeedForwardTopology.multiLayerPerceptron, AffineLayer, SigmoidFunction,
+SoftmaxLayerWithCrossEntropyLoss, Stacker, AnnObjFunc): all weights are
+flattened into ONE coefficient vector (the Stacker contract) so the MLP
+plugs into the same distributed L-BFGS engine as the linear models
+(MultilayerPerceptronTrainBatchOp.java:146-147). Gradients come from
+``jax.grad`` instead of hand-written layer backprop.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..optim.objfunc import OptimObjFunc, matvec
+
+
+def stack_sizes(layer_sizes: Sequence[int]) -> int:
+    """Total flattened parameter count (reference Stacker)."""
+    total = 0
+    for a, b in zip(layer_sizes[:-1], layer_sizes[1:]):
+        total += a * b + b
+    return total
+
+
+def unstack(coef, layer_sizes: Sequence[int]) -> List[Tuple]:
+    """coef -> [(W (in,out), b (out,)), ...]."""
+    out = []
+    pos = 0
+    for a, b in zip(layer_sizes[:-1], layer_sizes[1:]):
+        W = coef[pos:pos + a * b].reshape(a, b)
+        pos += a * b
+        bias = coef[pos:pos + b]
+        pos += b
+        out.append((W, bias))
+    return out
+
+
+def mlp_forward(coef, X, layer_sizes: Sequence[int]):
+    """Logits of the final layer; sigmoid hidden activations (reference
+    SigmoidFunction between AffineLayers)."""
+    h = X
+    layers = unstack(coef, layer_sizes)
+    for i, (W, b) in enumerate(layers):
+        z = h @ W + b
+        h = z if i == len(layers) - 1 else jax.nn.sigmoid(z)
+    return h
+
+
+class MlpObjFunc(OptimObjFunc):
+    """Cross-entropy over softmax outputs (reference
+    SoftmaxLayerWithCrossEntropyLoss + AnnObjFunc)."""
+
+    def __init__(self, layer_sizes: Sequence[int], l2: float = 0.0):
+        super().__init__(stack_sizes(layer_sizes), l1=0.0, l2=l2)
+        self.layer_sizes = list(layer_sizes)
+
+    def _loss_sum(self, coef, X, y, w):
+        logits = mlp_forward(coef, X, self.layer_sizes)
+        lse = jax.nn.logsumexp(logits, axis=1)
+        picked = jnp.take_along_axis(logits, y[:, None].astype(jnp.int32), 1)[:, 0]
+        return (w * (lse - picked)).sum()
+
+    def calc_grad_shard(self, data, coef):
+        X, y, w = data["X"], data["y"], data["w"]
+        loss, grad = jax.value_and_grad(self._loss_sum)(coef, X, y, w)
+        return grad, loss, w.sum()
+
+    def line_losses_shard(self, data, coef, direction, steps):
+        X, y, w = data["X"], data["y"], data["w"]
+
+        def one(s):
+            return self._loss_sum(coef - s * direction, X, y, w)
+
+        return jax.vmap(one)(steps)

@@ -119,6 +119,7 @@ def _pseudo_grad(g_plain, coef, l1, reg_mask):
 def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
                   history: int = _HISTORY):
     dim = obj.dim
+    data_keys = tuple(data)
     dtype = np.asarray(data["y"]).dtype
     if dtype not in (np.float32, np.float64):
         dtype = np.float32
@@ -145,7 +146,7 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
             ctx.put_obj("step_scale", jnp.asarray(1.0, dtype))
             ctx.put_obj("loss_curve", jnp.full((max_iter,), jnp.nan, dtype))
             ctx.put_obj("conv", jnp.asarray(False))
-        shard = _shard_views(ctx)
+        shard = _shard_views(ctx, data_keys)
         g, loss, wsum = obj.calc_grad_shard(shard, ctx.get_obj("coef"))
         ctx.put_obj("glw", jnp.concatenate([g, jnp.stack([loss, wsum])]))
 
@@ -193,7 +194,7 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
         ctx.put_obj("pg", g_dir)
 
         steps = jnp.asarray(steps_ladder) * ctx.get_obj("step_scale")
-        shard = _shard_views(ctx)
+        shard = _shard_views(ctx, data_keys)
         ctx.put_obj("line_losses", obj.line_losses_shard(shard, coef, d, steps))
         ctx.put_obj("steps", steps)
 
@@ -241,6 +242,7 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
 
 def _sgd(obj, data, params, env, warm_start):
     dim = obj.dim
+    data_keys = tuple(data)
     dtype = np.asarray(data["y"]).dtype
     if dtype not in (np.float32, np.float64):
         dtype = np.float32
@@ -253,7 +255,7 @@ def _sgd(obj, data, params, env, warm_start):
             ctx.put_obj("coef", ctx.get_obj("coef0"))
             ctx.put_obj("loss_curve", jnp.full((max_iter,), jnp.nan, dtype))
             ctx.put_obj("conv", jnp.asarray(False))
-        shard = _shard_views(ctx)
+        shard = _shard_views(ctx, data_keys)
         # per-worker random sub-sample each superstep, on-device RNG
         mask = jax.random.bernoulli(ctx.rng_key(), frac, shard["y"].shape)
         sub = dict(shard)
@@ -300,6 +302,7 @@ def _sgd(obj, data, params, env, warm_start):
 
 def _newton(obj, data, params, env, warm_start):
     dim = obj.dim
+    data_keys = tuple(data)
     dtype = np.asarray(data["y"]).dtype
     if dtype not in (np.float32, np.float64):
         dtype = np.float32
@@ -311,7 +314,7 @@ def _newton(obj, data, params, env, warm_start):
             ctx.put_obj("coef", ctx.get_obj("coef0"))
             ctx.put_obj("loss_curve", jnp.full((max_iter,), jnp.nan, dtype))
             ctx.put_obj("conv", jnp.asarray(False))
-        shard = _shard_views(ctx)
+        shard = _shard_views(ctx, data_keys)
         H, g, loss, wsum = obj.hessian_shard(shard, ctx.get_obj("coef"))
         ctx.put_obj("H", H)
         ctx.put_obj("glw", jnp.concatenate([g, jnp.stack([loss, wsum])]))
@@ -348,13 +351,9 @@ def _newton(obj, data, params, env, warm_start):
 
 # ---------------------------------------------------------------------------
 
-def _shard_views(ctx):
-    """Collect the partitioned training arrays visible to this worker."""
-    shard = {}
-    for k in ("X", "idx", "val", "y", "w"):
-        if ctx.contains_obj(k):
-            shard[k] = ctx.get_obj(k)
-    return shard
+def _shard_views(ctx, keys):
+    """Collect this worker's shards of the partitioned training arrays."""
+    return {k: ctx.get_obj(k) for k in keys}
 
 
 def _trim_curve(curve: np.ndarray) -> np.ndarray:

@@ -1,0 +1,206 @@
+"""Histogram-based tree building — TPU-native core.
+
+Re-design of common/tree/ (36 files, 7,290 LoC) around one device kernel:
+level-wise growth of a perfect binary tree over quantile-binned features.
+
+reference mechanism (parallelcart/, SURVEY §2.3):
+  ConstructLocalBin      -> per-worker histogram build (scatter-add here)
+  AllReduce("gbdtBin")   -> lax.psum inside the stage
+  CalBestSplit (sharded) -> full (node,feature,bin) gain tensor + argmax
+                            on device (no DistributedInfo range sharding —
+                            the MXU/VPU scans all of it at once)
+  Split / UpdateTreeData -> node-id descent array update
+
+Trees are dense arrays (perfect binary tree of ``max_depth``): unsplit nodes
+store feature = -1 and route everything left, so shapes stay static for XLA.
+Generic over a per-sample stat vector (SURVEY §7: "tree structure on host,
+bin statistics on device"):
+  regression  stats (y, y^2, 1)      variance gain
+  classify    stats (onehot(y), 1)   gini gain
+  gbdt        stats (g, h, 1)        xgboost-style gain g^2/(h+lambda)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------------------
+# host-side quantile binning
+# ---------------------------------------------------------------------------
+
+def make_bin_edges(X: np.ndarray, n_bins: int) -> np.ndarray:
+    """(F, n_bins-1) per-feature quantile cut points (padded with +inf)."""
+    n, F = X.shape
+    edges = np.full((F, n_bins - 1), np.inf)
+    for f in range(F):
+        qs = np.quantile(X[:, f], np.linspace(0, 1, n_bins + 1)[1:-1])
+        uq = np.unique(qs)
+        edges[f, :len(uq)] = uq
+    return edges
+
+
+def bin_data(X: np.ndarray, edges: np.ndarray) -> np.ndarray:
+    """(n, F) int32 bin ids in [0, n_bins)."""
+    n, F = X.shape
+    out = np.empty((n, F), np.int32)
+    for f in range(F):
+        e = edges[f]
+        out[:, f] = np.searchsorted(e[np.isfinite(e)], X[:, f], side="right")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# gain / leaf functions over cumulated stat histograms
+# ---------------------------------------------------------------------------
+
+def variance_gain(left, right, total, min_leaf):
+    """stats = (sum_y, sum_y2, count): SSE reduction."""
+    def sse(s):
+        return s[..., 1] - s[..., 0] ** 2 / jnp.maximum(s[..., 2], 1e-12)
+    ok = (left[..., 2] >= min_leaf) & (right[..., 2] >= min_leaf)
+    g = sse(total) - sse(left) - sse(right)
+    return jnp.where(ok, g, -jnp.inf)
+
+
+def variance_leaf(stats):
+    return stats[..., 0] / jnp.maximum(stats[..., 2], 1e-12)
+
+
+def gini_gain(left, right, total, min_leaf):
+    """stats = (c_0..c_{k-1}, count): weighted gini impurity decrease."""
+    def imp(s):
+        cnt = jnp.maximum(s[..., -1], 1e-12)
+        return cnt - (s[..., :-1] ** 2).sum(-1) / cnt
+    ok = (left[..., -1] >= min_leaf) & (right[..., -1] >= min_leaf)
+    g = imp(total) - imp(left) - imp(right)
+    return jnp.where(ok, g, -jnp.inf)
+
+
+def gini_leaf(stats):
+    return stats[..., :-1] / jnp.maximum(stats[..., -1:], 1e-12)
+
+
+def make_xgb_gain(reg_lambda: float):
+    def xgb_gain(left, right, total, min_leaf):
+        """stats = (g, h, count)."""
+        def score(s):
+            return s[..., 0] ** 2 / (s[..., 1] + reg_lambda)
+        ok = (left[..., 2] >= min_leaf) & (right[..., 2] >= min_leaf)
+        g = 0.5 * (score(left) + score(right) - score(total))
+        return jnp.where(ok, g, -jnp.inf)
+    return xgb_gain
+
+
+def make_xgb_leaf(reg_lambda: float):
+    def xgb_leaf(stats):
+        return -stats[..., 0] / (stats[..., 1] + reg_lambda)
+    return xgb_leaf
+
+
+# ---------------------------------------------------------------------------
+# the level-wise builder (traceable; runs inside shard_map stages)
+# ---------------------------------------------------------------------------
+
+def build_tree(binned, stats, max_depth: int, n_bins: int,
+               gain_fn, leaf_fn, min_samples_leaf: float = 1.0,
+               min_gain: float = 1e-9, feature_mask=None, axis_name=None):
+    """Grow one tree; returns (features, split_bins, leaf_values, node_id).
+
+    binned: (n, F) int32; stats: (n, m) — zero rows are inert (padding /
+    bagging handled by zeroing stats); feature_mask: (F,) 1/0 per-tree
+    column subsample; axis_name: psum histograms across this mesh axis.
+    features/split_bins: (2^max_depth - 1,) level-order; leaf_values:
+    (2^max_depth, ...) from leaf_fn; node_id: (n,) final leaf per sample.
+    """
+    n, F = binned.shape
+    m = stats.shape[1]
+    dt = stats.dtype
+    node_id = jnp.zeros(n, jnp.int32)
+    feats_out, bins_out = [], []
+
+    for level in range(max_depth):
+        n_nodes = 1 << level
+        flat_idx = (node_id[:, None] * F + jnp.arange(F)[None, :]) * n_bins + binned
+        hist = jnp.zeros((n_nodes * F * n_bins, m), dt)
+        hist = hist.at[flat_idx.reshape(-1)].add(
+            jnp.repeat(stats, F, axis=0))
+        if axis_name is not None:
+            hist = jax.lax.psum(hist, axis_name)
+        hist = hist.reshape(n_nodes, F, n_bins, m)
+        cum = jnp.cumsum(hist, axis=2)
+        total = cum[:, :, -1:, :]
+        left = cum[:, :, :-1, :]                      # split "bin <= b"
+        right = total - left
+        gains = gain_fn(left, right, total, min_samples_leaf)  # (nodes,F,B-1)
+        if feature_mask is not None:
+            gains = jnp.where(feature_mask[None, :, None] > 0, gains, -jnp.inf)
+        flat_g = gains.reshape(n_nodes, F * (n_bins - 1))
+        best = jnp.argmax(flat_g, axis=1)
+        best_gain = jnp.take_along_axis(flat_g, best[:, None], 1)[:, 0]
+        best_f = (best // (n_bins - 1)).astype(jnp.int32)
+        best_b = (best % (n_bins - 1)).astype(jnp.int32)
+        split = best_gain > min_gain
+        feats_out.append(jnp.where(split, best_f, -1))
+        bins_out.append(jnp.where(split, best_b, 0))
+        # descend: right iff split and bin > best_b
+        nf = feats_out[-1][node_id]
+        nb = bins_out[-1][node_id]
+        sample_bin = jnp.take_along_axis(binned, jnp.maximum(nf, 0)[:, None], 1)[:, 0]
+        go_right = (nf >= 0) & (sample_bin > nb)
+        node_id = node_id * 2 + go_right.astype(jnp.int32)
+
+    n_leaves = 1 << max_depth
+    leaf_hist = jnp.zeros((n_leaves, m), dt).at[node_id].add(stats)
+    if axis_name is not None:
+        leaf_hist = jax.lax.psum(leaf_hist, axis_name)
+    features = jnp.concatenate(feats_out)
+    split_bins = jnp.concatenate(bins_out)
+    return features, split_bins, leaf_fn(leaf_hist), node_id, leaf_hist
+
+
+def tree_apply_binned(binned, features, split_bins, max_depth: int):
+    """Final leaf index for each row, descending the dense tree (traceable)."""
+    n = binned.shape[0]
+    node = jnp.zeros(n, jnp.int32)
+    offset = 0
+    for level in range(max_depth):
+        gi = offset + node
+        f = features[gi]
+        b = split_bins[gi]
+        sample_bin = jnp.take_along_axis(binned, jnp.maximum(f, 0)[:, None], 1)[:, 0]
+        go_right = (f >= 0) & (sample_bin > b)
+        node = node * 2 + go_right.astype(jnp.int32)
+        offset += 1 << level
+    return node
+
+
+def bins_to_thresholds(features: np.ndarray, split_bins: np.ndarray,
+                       edges: np.ndarray) -> np.ndarray:
+    """Real-valued split thresholds for host-side serving: x > thr -> right."""
+    thr = np.zeros(features.shape, np.float64)
+    for i, (f, b) in enumerate(zip(features, split_bins)):
+        thr[i] = edges[int(f), int(b)] if f >= 0 else 0.0
+    return thr
+
+
+def tree_apply_values(X: np.ndarray, features: np.ndarray, thresholds: np.ndarray,
+                      max_depth: int) -> np.ndarray:
+    """Host/numpy descent on raw feature values."""
+    n = X.shape[0]
+    node = np.zeros(n, np.int64)
+    offset = 0
+    for level in range(max_depth):
+        gi = offset + node
+        f = features[gi].astype(np.int64)
+        thr = thresholds[gi]
+        x = X[np.arange(n), np.maximum(f, 0)]
+        go_right = (f >= 0) & (x > thr)
+        node = node * 2 + go_right
+        offset += 1 << level
+    return node

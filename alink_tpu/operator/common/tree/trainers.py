@@ -1,0 +1,171 @@
+"""Distributed tree trainers on the BSP engine.
+
+Re-design of:
+  GBDT  — BaseGbdtTrainBatchOp.java:204-224 histogram boosting (one tree per
+          superstep; histograms psum'd per level inside the stage)
+  RF    — BaseRandomForestTrainBatchOp.java:152-163,264 (reference trains
+          whole trees per worker; here trees are built histogram-parallel —
+          same model class, bagging via per-tree weight masks + feature
+          column subsampling)
+  DecisionTree — RF with one tree, no subsampling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ....common.mlenv import MLEnvironment
+from ....engine import IterativeComQueue
+from .hist import (bin_data, build_tree, gini_gain, gini_leaf, make_bin_edges,
+                   make_xgb_gain, make_xgb_leaf, tree_apply_binned,
+                   variance_gain, variance_leaf)
+
+
+@dataclass
+class TreeTrainParams:
+    num_trees: int = 100
+    max_depth: int = 5
+    n_bins: int = 64
+    learning_rate: float = 0.3         # gbdt shrinkage
+    min_samples_leaf: int = 1
+    reg_lambda: float = 1.0            # gbdt leaf regularization
+    subsample_ratio: float = 1.0       # bagging row fraction
+    feature_subsample_ratio: float = 1.0
+    seed: int = 0
+
+
+def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
+               is_regression: bool, env: Optional[MLEnvironment] = None,
+               sample_weight: Optional[np.ndarray] = None):
+    """Returns (features (T, 2^d-1), split_bins, leaf_values (T, 2^d), edges,
+    base_score, loss_curve)."""
+    n, F = X.shape
+    dtype = np.float32
+    edges = make_bin_edges(X, p.n_bins)
+    binned = bin_data(X, edges)
+    w = np.ones(n, dtype) if sample_weight is None else np.asarray(sample_weight, dtype)
+    y = np.asarray(y, dtype)
+    base = float((y * w).sum() / max(w.sum(), 1e-12)) if is_regression else 0.0
+    d = p.max_depth
+    T = p.num_trees
+    gain_fn = make_xgb_gain(p.reg_lambda)
+    leaf_fn = make_xgb_leaf(p.reg_lambda)
+    n_internal, n_leaves = (1 << d) - 1, 1 << d
+
+    def grow(ctx):
+        if ctx.is_init_step:
+            nloc = ctx.get_obj("binned").shape[0]
+            ctx.put_obj("F", jnp.full((nloc,), base, dtype))
+            ctx.put_obj("trees_f", jnp.zeros((T, n_internal), jnp.int32))
+            ctx.put_obj("trees_b", jnp.zeros((T, n_internal), jnp.int32))
+            ctx.put_obj("trees_v", jnp.zeros((T, n_leaves), dtype))
+            ctx.put_obj("loss_curve", jnp.zeros((T,), dtype))
+        binned_l = ctx.get_obj("binned")
+        yl = ctx.get_obj("y")
+        wl = ctx.get_obj("w")
+        Fcur = ctx.get_obj("F")
+        if is_regression:
+            g = (Fcur - yl) * wl
+            h = wl
+            loss = 0.5 * ((Fcur - yl) ** 2 * wl).sum()
+        else:
+            prob = jax.nn.sigmoid(Fcur)
+            g = (prob - yl) * wl           # y in {0,1}
+            h = jnp.maximum(prob * (1 - prob), 1e-6) * wl
+            loss = (wl * (jnp.logaddexp(0.0, Fcur) - yl * Fcur)).sum()
+        # bagging + feature subsample, per tree
+        key = ctx.rng_key()
+        if p.subsample_ratio < 1.0:
+            bag = jax.random.bernoulli(key, p.subsample_ratio, g.shape)
+            g = g * bag
+            h = h * bag
+            wb = wl * bag
+        else:
+            wb = wl
+        fmask = (jax.random.uniform(jax.random.fold_in(key, 1), (F,))
+                 < p.feature_subsample_ratio).astype(dtype) \
+            if p.feature_subsample_ratio < 1.0 else None
+        stats = jnp.stack([g, h, wb], axis=1)
+        tf, tb, tv, node_id, _ = build_tree(
+            binned_l, stats, d, p.n_bins, gain_fn, leaf_fn,
+            min_samples_leaf=float(p.min_samples_leaf), feature_mask=fmask,
+            axis_name="d")
+        t = ctx.step_no - 1
+        ctx.put_obj("trees_f", jax.lax.dynamic_update_index_in_dim(
+            ctx.get_obj("trees_f"), tf, t, 0))
+        ctx.put_obj("trees_b", jax.lax.dynamic_update_index_in_dim(
+            ctx.get_obj("trees_b"), tb, t, 0))
+        ctx.put_obj("trees_v", jax.lax.dynamic_update_index_in_dim(
+            ctx.get_obj("trees_v"), tv.astype(dtype), t, 0))
+        ctx.put_obj("F", Fcur + p.learning_rate * tv[node_id].astype(dtype))
+        lw = jax.lax.psum(jnp.stack([loss, wl.sum()]), "d")
+        ctx.put_obj("loss_curve", jax.lax.dynamic_update_index_in_dim(
+            ctx.get_obj("loss_curve"), lw[0] / jnp.maximum(lw[1], 1e-12), t, 0))
+
+    queue = (IterativeComQueue(env=env, max_iter=T, seed=p.seed)
+             .init_with_partitioned_data("binned", binned)
+             .init_with_partitioned_data("y", y)
+             .init_with_partitioned_data("w", w)
+             .add(grow))
+    res = queue.exec()
+    return (res.get("trees_f"), res.get("trees_b"), res.get("trees_v"),
+            edges, base, np.asarray(res.get("loss_curve")))
+
+
+def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
+                 kind: str, env: Optional[MLEnvironment] = None):
+    """Random forest / decision tree. ``y_stats``: (n, m) per-sample stats —
+    (onehot(y), 1) for classification (kind="gini") or (y, y^2, 1) for
+    regression (kind="variance"). Returns (features, split_bins,
+    leaf_values (T, 2^d, ...), edges)."""
+    n, F = X.shape
+    dtype = np.float32
+    edges = make_bin_edges(X, p.n_bins)
+    binned = bin_data(X, edges)
+    d = p.max_depth
+    T = p.num_trees
+    m = y_stats.shape[1]
+    gain_fn = gini_gain if kind == "gini" else variance_gain
+    leaf_fn = gini_leaf if kind == "gini" else variance_leaf
+    leaf_w = (m - 1) if kind == "gini" else 1
+    n_internal, n_leaves = (1 << d) - 1, 1 << d
+
+    def grow(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("trees_f", jnp.zeros((T, n_internal), jnp.int32))
+            ctx.put_obj("trees_b", jnp.zeros((T, n_internal), jnp.int32))
+            shape = (T, n_leaves, leaf_w) if kind == "gini" else (T, n_leaves)
+            ctx.put_obj("trees_v", jnp.zeros(shape, dtype))
+        binned_l = ctx.get_obj("binned")
+        stats = ctx.get_obj("stats")
+        key = ctx.rng_key()
+        if p.subsample_ratio < 1.0:
+            bag = jax.random.bernoulli(key, p.subsample_ratio,
+                                       (stats.shape[0],)).astype(dtype)
+            stats = stats * bag[:, None]
+        fmask = (jax.random.uniform(jax.random.fold_in(key, 1), (F,))
+                 < p.feature_subsample_ratio).astype(dtype) \
+            if p.feature_subsample_ratio < 1.0 else None
+        tf, tb, tv, _, _ = build_tree(
+            binned_l, stats, d, p.n_bins, gain_fn, leaf_fn,
+            min_samples_leaf=float(p.min_samples_leaf), feature_mask=fmask,
+            axis_name="d")
+        t = ctx.step_no - 1
+        ctx.put_obj("trees_f", jax.lax.dynamic_update_index_in_dim(
+            ctx.get_obj("trees_f"), tf, t, 0))
+        ctx.put_obj("trees_b", jax.lax.dynamic_update_index_in_dim(
+            ctx.get_obj("trees_b"), tb, t, 0))
+        ctx.put_obj("trees_v", jax.lax.dynamic_update_index_in_dim(
+            ctx.get_obj("trees_v"), tv.astype(dtype), t, 0))
+
+    queue = (IterativeComQueue(env=env, max_iter=T, seed=p.seed)
+             .init_with_partitioned_data("binned", binned)
+             .init_with_partitioned_data("stats", y_stats.astype(dtype))
+             .add(grow))
+    res = queue.exec()
+    return (res.get("trees_f"), res.get("trees_b"), res.get("trees_v"), edges)

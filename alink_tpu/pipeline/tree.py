@@ -1,0 +1,20 @@
+"""Pipeline wrappers — tree family (reference pipeline/classification+regression)."""
+
+from ..operator.batch.classification.tree_ops import (
+    DecisionTreeRegTrainBatchOp, DecisionTreeTrainBatchOp, GbdtRegTrainBatchOp,
+    GbdtTrainBatchOp, RandomForestRegTrainBatchOp, RandomForestTrainBatchOp,
+    TreeModelMapper)
+from .fm_nb import _wrap
+
+GbdtClassifier, GbdtClassifierModel = _wrap("GbdtClassifier", GbdtTrainBatchOp,
+                                            TreeModelMapper)
+GbdtRegressor, GbdtRegressorModel = _wrap("GbdtRegressor", GbdtRegTrainBatchOp,
+                                          TreeModelMapper)
+RandomForestClassifier, RandomForestClassifierModel = _wrap(
+    "RandomForestClassifier", RandomForestTrainBatchOp, TreeModelMapper)
+RandomForestRegressor, RandomForestRegressorModel = _wrap(
+    "RandomForestRegressor", RandomForestRegTrainBatchOp, TreeModelMapper)
+DecisionTreeClassifier, DecisionTreeClassifierModel = _wrap(
+    "DecisionTreeClassifier", DecisionTreeTrainBatchOp, TreeModelMapper)
+DecisionTreeRegressor, DecisionTreeRegressorModel = _wrap(
+    "DecisionTreeRegressor", DecisionTreeRegTrainBatchOp, TreeModelMapper)

@@ -1,0 +1,102 @@
+"""GLM / Isotonic / AFT tests."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.batch.regression.glm_ops import (
+    GlmTrainBatchOp, GlmPredictBatchOp, GlmEvaluationBatchOp,
+    IsotonicRegTrainBatchOp, IsotonicRegPredictBatchOp,
+    AftSurvivalRegTrainBatchOp, AftSurvivalRegPredictBatchOp, GlmModelConverter,
+    pav)
+
+
+def test_glm_poisson():
+    rng = np.random.RandomState(0)
+    n = 500
+    x1, x2 = rng.randn(n) * 0.5, rng.randn(n) * 0.5
+    lam = np.exp(0.5 + 1.0 * x1 - 0.7 * x2)
+    y = rng.poisson(lam).astype(float)
+    src = MemSourceBatchOp(list(zip(x1, x2, y)), "a DOUBLE, b DOUBLE, y DOUBLE")
+    train = GlmTrainBatchOp(feature_cols=["a", "b"], label_col="y",
+                            family="Poisson").link_from(src)
+    m = GlmModelConverter().load_model(train.get_output_table())
+    assert np.allclose(m["beta"], [0.5, 1.0, -0.7], atol=0.15)
+    out = (GlmPredictBatchOp(prediction_col="mu", link_pred_result_col="eta")
+           .link_from(train, src)).collect_mtable()
+    assert np.corrcoef(np.asarray(out.col("mu")), lam)[0, 1] > 0.95
+    ev = (GlmEvaluationBatchOp(label_col="y", prediction_col="mu",
+                               family="Poisson").link_from(
+        train.from_table(out))).collect_mtable()
+    import json
+    assert json.loads(ev.row(0)[0])["deviance"] > 0
+
+
+def test_glm_binomial_logit():
+    rng = np.random.RandomState(1)
+    n = 800
+    x = rng.randn(n)
+    p = 1 / (1 + np.exp(-(0.3 + 2.0 * x)))
+    y = (rng.rand(n) < p).astype(float)
+    src = MemSourceBatchOp(list(zip(x, y)), "x DOUBLE, y DOUBLE")
+    train = GlmTrainBatchOp(feature_cols=["x"], label_col="y",
+                            family="Binomial").link_from(src)
+    m = GlmModelConverter().load_model(train.get_output_table())
+    assert abs(m["beta"][1] - 2.0) < 0.4
+
+
+def test_glm_gamma_log_link():
+    rng = np.random.RandomState(2)
+    n = 600
+    x = rng.rand(n)
+    mu = np.exp(1.0 + 1.5 * x)
+    shape = 5.0
+    y = rng.gamma(shape, mu / shape)
+    src = MemSourceBatchOp(list(zip(x, y)), "x DOUBLE, y DOUBLE")
+    train = GlmTrainBatchOp(feature_cols=["x"], label_col="y", family="Gamma",
+                            link="Log").link_from(src)
+    m = GlmModelConverter().load_model(train.get_output_table())
+    assert abs(m["beta"][1] - 1.5) < 0.3
+
+
+def test_isotonic():
+    rng = np.random.RandomState(3)
+    x = np.sort(rng.rand(200) * 10)
+    y = np.log1p(x) + 0.2 * rng.randn(200)
+    src = MemSourceBatchOp(list(zip(x, y)), "x DOUBLE, y DOUBLE")
+    train = IsotonicRegTrainBatchOp(feature_col="x", label_col="y").link_from(src)
+    out = (IsotonicRegPredictBatchOp(prediction_col="p").link_from(train, src)
+           ).collect_mtable()
+    p = np.asarray(out.col("p"))
+    # fitted curve is monotone nondecreasing in x order
+    order = np.argsort(np.asarray(out.col("x")))
+    assert (np.diff(p[order]) >= -1e-9).all()
+    assert np.abs(p - np.log1p(x)).mean() < 0.15
+
+
+def test_pav_simple():
+    bx, bv = pav(np.asarray([1.0, 2, 3, 4]), np.asarray([1.0, 3, 2, 4]),
+                 np.ones(4))
+    assert (np.diff(bv) >= 0).all()
+    assert bv[1] == pytest.approx(2.5)  # pooled violators
+
+
+def test_aft_survival():
+    rng = np.random.RandomState(4)
+    n = 600
+    x = rng.randn(n)
+    scale = np.exp(1.0 + 0.8 * x)
+    t_true = scale * rng.weibull(2.0, n)
+    censor_time = np.quantile(t_true, 0.8)
+    observed = np.minimum(t_true, censor_time)
+    event = (t_true <= censor_time).astype(float)
+    src = MemSourceBatchOp(list(zip(x, observed, event)),
+                           "x DOUBLE, time DOUBLE, status DOUBLE")
+    train = AftSurvivalRegTrainBatchOp(feature_cols=["x"], label_col="time",
+                                       censor_col="status").link_from(src)
+    m = GlmModelConverter().load_model(train.get_output_table())
+    # beta = [intercept, slope, log_sigma]; slope recovers 0.8
+    assert abs(m["beta"][1] - 0.8) < 0.15
+    out = (AftSurvivalRegPredictBatchOp(prediction_col="p")
+           .link_from(train, src)).collect_mtable()
+    assert np.corrcoef(np.log(np.asarray(out.col("p"))), np.log(scale))[0, 1] > 0.95

@@ -1,0 +1,68 @@
+"""MLPC, GMM, BisectingKMeans tests."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.batch.classification.mlpc_ops import (
+    MultilayerPerceptronTrainBatchOp, MultilayerPerceptronPredictBatchOp)
+from alink_tpu.operator.batch.clustering.gmm_bisecting import (
+    GmmTrainBatchOp, GmmPredictBatchOp, BisectingKMeansTrainBatchOp,
+    BisectingKMeansPredictBatchOp)
+
+
+def test_mlpc_nonlinear():
+    # circles: inner vs outer ring — linear models can't, MLP can
+    rng = np.random.RandomState(0)
+    n = 400
+    r = np.where(rng.rand(n) < 0.5, 0.5, 2.0)
+    theta = rng.rand(n) * 2 * np.pi
+    X = np.stack([r * np.cos(theta), r * np.sin(theta)], 1) + 0.05 * rng.randn(n, 2)
+    y = np.where(r < 1.0, "inner", "outer")
+    src = MemSourceBatchOp(list(zip(X[:, 0], X[:, 1], y)),
+                           "x DOUBLE, y DOUBLE, label STRING")
+    train = MultilayerPerceptronTrainBatchOp(
+        feature_cols=["x", "y"], label_col="label", layers=[16, 8],
+        max_iter=300, seed=1).link_from(src)
+    out = (MultilayerPerceptronPredictBatchOp(prediction_col="pred",
+                                              prediction_detail_col="d")
+           .link_from(train, src)).collect_mtable()
+    acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
+    assert acc > 0.95
+    losses = np.asarray(train.get_side_output(0).get_output_table().col("loss"))
+    assert losses[-1] < losses[0]
+
+
+def test_gmm_two_blobs():
+    rng = np.random.RandomState(1)
+    X = np.vstack([rng.randn(150, 2) * 0.5 + [0, 0],
+                   rng.randn(150, 2) * [1.5, 0.3] + [5, 2]])
+    src = MemSourceBatchOp([tuple(r) for r in X], "a DOUBLE, b DOUBLE")
+    train = GmmTrainBatchOp(k=2, feature_cols=["a", "b"], max_iter=100,
+                            seed=0).link_from(src)
+    out = (GmmPredictBatchOp(prediction_col="cid", prediction_detail_col="d")
+           .link_from(train, src)).collect_mtable()
+    ids = np.asarray(out.col("cid"))
+    assert len(set(ids[:150])) == 1 and len(set(ids[150:])) == 1
+    assert ids[0] != ids[150]
+    # anisotropic covariance learned
+    from alink_tpu.operator.batch.clustering.gmm_bisecting import GmmModelDataConverter
+    m = GmmModelDataConverter().load_model(train.get_output_table())
+    cid2 = ids[150]
+    cov2 = m["covs"][cid2]
+    assert cov2[0, 0] > cov2[1, 1] * 4  # elongated along x
+
+
+def test_bisecting_kmeans():
+    rng = np.random.RandomState(2)
+    X = np.vstack([rng.randn(60, 2) * 0.3 + c
+                   for c in [[0, 0], [4, 4], [0, 6], [8, 0]]])
+    src = MemSourceBatchOp([tuple(r) for r in X], "a DOUBLE, b DOUBLE")
+    train = BisectingKMeansTrainBatchOp(k=4, feature_cols=["a", "b"]).link_from(src)
+    out = (BisectingKMeansPredictBatchOp(prediction_col="cid")
+           .link_from(train, src)).collect_mtable()
+    ids = np.asarray(out.col("cid"))
+    for g in range(4):
+        seg = ids[g * 60:(g + 1) * 60]
+        assert len(set(seg)) == 1
+    assert len(set(ids.tolist())) == 4

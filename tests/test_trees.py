@@ -1,0 +1,124 @@
+"""Tree-family tests: GBDT / RandomForest / DecisionTree, cls + reg."""
+
+import json
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.base import TableSourceBatchOp
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.batch.classification.tree_ops import (
+    GbdtTrainBatchOp, GbdtPredictBatchOp, GbdtRegTrainBatchOp,
+    GbdtRegPredictBatchOp, RandomForestTrainBatchOp, RandomForestPredictBatchOp,
+    DecisionTreeTrainBatchOp, DecisionTreePredictBatchOp,
+    RandomForestRegTrainBatchOp, RandomForestRegPredictBatchOp,
+    TreeModelDataConverter)
+from alink_tpu.operator.batch.evaluation import EvalBinaryClassBatchOp
+
+
+def _nonlinear_cls(n=800, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 4)
+    # axis-aligned nonlinear rule — tree-friendly, linear-hostile
+    y = np.where((X[:, 0] > 0.5) ^ (X[:, 1] > 0.5), "pos", "neg")
+    cols = "a DOUBLE, b DOUBLE, c DOUBLE, d DOUBLE, label STRING"
+    return MemSourceBatchOp([tuple(r) + (t,) for r, t in zip(X, y)], cols), X, y
+
+
+def test_gbdt_classifier():
+    src, X, y = _nonlinear_cls()
+    train = GbdtTrainBatchOp(feature_cols=["a", "b", "c", "d"],
+                             label_col="label", num_trees=30, max_depth=4,
+                             learning_rate=0.3).link_from(src)
+    out = (GbdtPredictBatchOp(prediction_col="pred", prediction_detail_col="dt")
+           .link_from(train, src)).collect_mtable()
+    acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
+    assert acc > 0.95
+    m = (EvalBinaryClassBatchOp(label_col="label", prediction_detail_col="dt")
+         .link_from(TableSourceBatchOp(out))).collect_metrics()
+    assert m.get("AUC") > 0.98
+    losses = np.asarray(train.get_side_output(0).get_output_table().col("loss"))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_gbdt_regression():
+    rng = np.random.RandomState(1)
+    n = 600
+    X = rng.rand(n, 3)
+    y = np.sin(4 * X[:, 0]) + (X[:, 1] > 0.6) * 2.0 + 0.05 * rng.randn(n)
+    src = MemSourceBatchOp([tuple(r) + (t,) for r, t in zip(X, y)],
+                           "a DOUBLE, b DOUBLE, c DOUBLE, y DOUBLE")
+    train = GbdtRegTrainBatchOp(feature_cols=["a", "b", "c"], label_col="y",
+                                num_trees=60, max_depth=4,
+                                learning_rate=0.2).link_from(src)
+    out = (GbdtRegPredictBatchOp(prediction_col="p").link_from(train, src)
+           ).collect_mtable()
+    rmse = np.sqrt(np.mean((np.asarray(out.col("p")) - y) ** 2))
+    assert rmse < 0.25
+
+
+def test_random_forest_multiclass():
+    rng = np.random.RandomState(2)
+    n = 600
+    X = rng.rand(n, 3)
+    y = np.select([X[:, 0] > 0.66, X[:, 0] > 0.33], ["hi", "mid"], "lo")
+    src = MemSourceBatchOp([tuple(r) + (t,) for r, t in zip(X, y)],
+                           "a DOUBLE, b DOUBLE, c DOUBLE, label STRING")
+    train = RandomForestTrainBatchOp(feature_cols=["a", "b", "c"],
+                                     label_col="label", num_trees=20,
+                                     max_depth=5, seed=5).link_from(src)
+    out = (RandomForestPredictBatchOp(prediction_col="pred",
+                                      prediction_detail_col="d")
+           .link_from(train, src)).collect_mtable()
+    acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
+    assert acc > 0.93
+    probs = json.loads(out.col("d")[0])
+    assert set(probs) == {"hi", "mid", "lo"}
+
+
+def test_decision_tree_and_converter_roundtrip():
+    rng = np.random.RandomState(3)
+    X = rng.rand(400, 4)
+    y = np.where((X[:, 0] > 0.5) & (X[:, 1] > 0.3), "pos", "neg")
+    src = MemSourceBatchOp(
+        [tuple(r) + (t,) for r, t in zip(X, y)],
+        "a DOUBLE, b DOUBLE, c DOUBLE, d DOUBLE, label STRING")
+    train = DecisionTreeTrainBatchOp(feature_cols=["a", "b", "c", "d"],
+                                     label_col="label", max_depth=4).link_from(src)
+    model = TreeModelDataConverter().load_model(train.get_output_table())
+    assert model.features.shape == (1, 15)
+    out = (DecisionTreePredictBatchOp(prediction_col="pred")
+           .link_from(train, src)).collect_mtable()
+    acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
+    assert acc > 0.95
+
+
+def test_random_forest_regression():
+    rng = np.random.RandomState(4)
+    n = 500
+    X = rng.rand(n, 2)
+    y = X[:, 0] * 3 + (X[:, 1] > 0.5)
+    src = MemSourceBatchOp([tuple(r) + (t,) for r, t in zip(X, y)],
+                           "a DOUBLE, b DOUBLE, y DOUBLE")
+    train = RandomForestRegTrainBatchOp(feature_cols=["a", "b"], label_col="y",
+                                        num_trees=30, max_depth=7,
+                                        feature_subsampling_ratio=1.0,
+                                        subsampling_ratio=0.9).link_from(src)
+    out = (RandomForestRegPredictBatchOp(prediction_col="p")
+           .link_from(train, src)).collect_mtable()
+    rmse = np.sqrt(np.mean((np.asarray(out.col("p")) - y) ** 2))
+    assert rmse < 0.35
+
+
+def test_gbdt_integer_labels():
+    src, X, y = _nonlinear_cls(n=300, seed=5)
+    rows = [(float(a), float(b), 1 if t == "pos" else 0)
+            for (a, b, _, _), t in zip(X, y)]
+    src2 = MemSourceBatchOp(rows, "a DOUBLE, b DOUBLE, label LONG")
+    train = GbdtTrainBatchOp(feature_cols=["a", "b"], label_col="label",
+                             num_trees=20, max_depth=4).link_from(src2)
+    out = (GbdtPredictBatchOp(prediction_col="pred").link_from(train, src2)
+           ).collect_mtable()
+    assert set(out.col("pred")) <= {0, 1}
+    acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
+    assert acc > 0.9
