@@ -77,6 +77,39 @@ def write_csv(table: MTable, path: str, field_delimiter: str = ",",
             writer.writerow(out)
 
 
+def format_csv_rows(table: MTable, field_delimiter: str = ",",
+                    quote_char: str = '"') -> str:
+    """CSV-encode a table to a string (stream sinks append per micro-batch)."""
+    buf = io.StringIO()
+    writer = csv.writer(buf, delimiter=field_delimiter, quotechar=quote_char)
+    for row in table.rows():
+        out = []
+        for v, t in zip(row, table.schema.types):
+            if v is None:
+                out.append("")
+            elif AlinkTypes.is_vector(t):
+                out.append(VectorUtil.to_string(VectorUtil.parse(v)))
+            else:
+                out.append(v)
+        writer.writerow(out)
+    return buf.getvalue()
+
+
+def format_libsvm_rows(table: MTable, label_col: str, vector_col: str,
+                       start_index: int = 1) -> str:
+    from ..common.vector import DenseVector
+    lines = []
+    for lbl, vec in zip(table.col(label_col), table.col(vector_col)):
+        v = VectorUtil.parse(vec)
+        if isinstance(v, DenseVector):
+            pairs = [(i, x) for i, x in enumerate(v.data) if x != 0]
+        else:
+            pairs = list(zip(v.indices, v.values))
+        body = " ".join(f"{int(i) + start_index}:{x}" for i, x in pairs)
+        lines.append(f"{lbl} {body}\n")
+    return "".join(lines)
+
+
 def read_libsvm(path: str, start_index: int = 1) -> MTable:
     """LibSVM format -> (label DOUBLE, features SPARSE_VECTOR)
     (reference common/io/LibSvmSourceBatchOp)."""

@@ -196,14 +196,23 @@ class TableSourceBatchOp(BatchOperator):
 class StreamOperator(AlgoOperator):
     """Stream operator base (reference stream/StreamOperator.java).
 
-    A stream is a host-side iterator of MTable micro-batches (the Flink
-    DataStream replacement, SURVEY §7 step 9). Linking composes per-batch
-    transforms lazily; ``StreamOperator.execute()`` drains the whole DAG.
+    A stream is a host-side iterator of **timed micro-batches**
+    ``(event_time, MTable)`` — the Flink DataStream replacement (SURVEY §7
+    step 9). Event time is assigned by sources (batch index by default) and
+    preserved by transforms; multi-input operators (FTRL predict's
+    model+data co-process, windowed eval) merge inputs in event-time order,
+    which reproduces Flink's stream-time semantics without a cluster.
+
+    Linking composes per-batch transforms lazily. Device work inside a
+    micro-batch is jitted; the host loop only sequences batches
+    (micro-batched to amortize dispatch, SURVEY §7 "hard parts").
+    ``StreamOperator.execute()`` drains every registered sink DAG.
     """
 
     def __init__(self, params: Optional[Params] = None, **kwargs):
         super().__init__(params, **kwargs)
-        self._stream_fn: Optional[Callable[[], Any]] = None  # () -> iterator of MTable
+        # () -> iterator of (time, MTable)
+        self._stream_fn: Optional[Callable[[], Any]] = None
         self._schema: Optional[TableSchema] = None
         self._sinks: List[Callable[[MTable], None]] = []
 
@@ -221,24 +230,45 @@ class StreamOperator(AlgoOperator):
     def get_col_names(self) -> List[str]:
         return list(self.get_schema().names)
 
-    def micro_batches(self):
+    def timed_batches(self):
+        """Fresh iterator of (event_time, MTable)."""
         if self._stream_fn is None:
             raise RuntimeError(f"{type(self).__name__} has no stream; link it first")
         return self._stream_fn()
 
+    def micro_batches(self):
+        for _, mt in self.timed_batches():
+            yield mt
+
     def print(self) -> "StreamOperator":
         self._sinks.append(lambda mt: print(mt.to_display_string()))
-        return self
+        return self._register()
 
     def sample(self, ratio: float) -> "StreamOperator":
         from .stream.dataproc import SampleStreamOp
         return SampleStreamOp(ratio=ratio).link_from(self)
 
+    def select(self, fields) -> "StreamOperator":
+        from .stream.sql import SelectStreamOp
+        return SelectStreamOp(clause=fields if isinstance(fields, str)
+                              else ",".join(fields)).link_from(self)
+
+    def where(self, predicate: str) -> "StreamOperator":
+        from .stream.sql import WhereStreamOp
+        return WhereStreamOp(clause=predicate).link_from(self)
+
+    filter = where
+
+    def union_all(self, other: "StreamOperator") -> "StreamOperator":
+        from .stream.sql import UnionAllStreamOp
+        return UnionAllStreamOp().link_from(self, other)
+
     # registry of every stream termination in the session
     _session_streams: List["StreamOperator"] = []
 
     def _register(self):
-        StreamOperator._session_streams.append(self)
+        if self not in StreamOperator._session_streams:
+            StreamOperator._session_streams.append(self)
         return self
 
     @staticmethod

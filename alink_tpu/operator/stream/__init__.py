@@ -1,0 +1,40 @@
+"""Stream operator layer (reference operator/stream/ — 14 categories).
+
+The DataStream substrate is the timed micro-batch runtime in ``core.py``;
+see ``alink_tpu.operator.base.StreamOperator``.
+"""
+
+from .core import BaseStreamTransformOp, FnStreamOp
+from .dataproc import (AppendIdStreamOp, FirstNStreamOp,
+                       NumericalTypeCastStreamOp, SampleStreamOp,
+                       ShuffleStreamOp, SplitStreamOp)
+from .evaluation import (EvalBinaryClassStreamOp, EvalMultiClassStreamOp,
+                         EvalRegressionStreamOp)
+from .onlinelearning import FtrlPredictStreamOp, FtrlTrainStreamOp
+from .predict_ops import *  # noqa: F401,F403 — the *PredictStreamOp family
+from .predict_ops import __all__ as _predict_all
+from .sink.sinks import (CollectSinkStreamOp, CsvSinkStreamOp,
+                         LibSvmSinkStreamOp, TextSinkStreamOp)
+from .source.sources import (CsvSourceStreamOp, LibSvmSourceStreamOp,
+                             MemSourceStreamOp, NumSeqSourceStreamOp,
+                             RandomTableSourceStreamOp, TableSourceStreamOp,
+                             TextSourceStreamOp)
+from .sql import (AsStreamOp, FilterStreamOp, SelectStreamOp, UnionAllStreamOp,
+                  WhereStreamOp, WindowGroupByStreamOp)
+from .utils import MapperStreamOp, ModelMapStreamOp
+
+__all__ = [
+    "BaseStreamTransformOp", "FnStreamOp",
+    "AppendIdStreamOp", "FirstNStreamOp", "NumericalTypeCastStreamOp",
+    "SampleStreamOp", "ShuffleStreamOp", "SplitStreamOp",
+    "EvalBinaryClassStreamOp", "EvalMultiClassStreamOp", "EvalRegressionStreamOp",
+    "FtrlTrainStreamOp", "FtrlPredictStreamOp",
+    "CollectSinkStreamOp", "CsvSinkStreamOp", "LibSvmSinkStreamOp",
+    "TextSinkStreamOp",
+    "CsvSourceStreamOp", "LibSvmSourceStreamOp", "MemSourceStreamOp",
+    "NumSeqSourceStreamOp", "RandomTableSourceStreamOp", "TableSourceStreamOp",
+    "TextSourceStreamOp",
+    "AsStreamOp", "FilterStreamOp", "SelectStreamOp", "UnionAllStreamOp",
+    "WhereStreamOp", "WindowGroupByStreamOp",
+    "MapperStreamOp", "ModelMapStreamOp",
+] + list(_predict_all)

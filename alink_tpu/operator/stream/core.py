@@ -1,0 +1,126 @@
+"""Stream runtime core — per-batch transforms and event-time merging.
+
+The Flink DataStream substrate (reference stream/StreamOperator.java and the
+per-op RichFlatMap/CoFlatMap functions) is replaced by lazy generators of
+``(event_time, MTable)``. Multi-input operators merge their inputs in
+event-time order (``merge_timed``), which is what Flink's arrival-order
+co-processing gives the reference's FtrlPredictStreamOp / windowed eval.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Iterable, Iterator, Optional, Tuple
+
+from ...common.mtable import MTable
+from ...common.types import TableSchema
+from ..base import StreamOperator
+
+TimedBatch = Tuple[float, MTable]
+
+
+def merge_timed(*streams: Iterable[TimedBatch]) -> Iterator[Tuple[float, int, MTable]]:
+    """Merge timed streams in event-time order; yields (time, stream_idx, table).
+
+    Ties break by stream index (earlier input wins), matching the reference's
+    model-stream-then-data convention for co-flat-map operators.
+    """
+    def tag(i, s):
+        for t, mt in s:
+            yield (t, i, mt)
+
+    return heapq.merge(*[tag(i, s) for i, s in enumerate(streams)],
+                       key=lambda x: (x[0], x[1]))
+
+
+# sentinel a _transform may return to end the drain early (FirstN etc.)
+STOP = object()
+
+
+class BaseStreamTransformOp(StreamOperator):
+    """Single-input, per-batch stream transform.
+
+    Subclasses implement ``_open(in_schema) -> out_schema`` (schema + state
+    init per drain) and ``_transform(mt) -> MTable | None | STOP``. Each
+    drain of the DAG replays the stream from the source; per-drain state set
+    in ``_open`` lives on a shallow *copy* of the operator, so concurrent
+    drains of the same instance (diamond DAGs, side streams) don't share
+    mutable state.
+    """
+
+    def _open(self, in_schema: TableSchema) -> TableSchema:
+        return in_schema
+
+    def _transform(self, mt: MTable) -> Optional[MTable]:  # pragma: no cover
+        raise NotImplementedError
+
+    def _close(self):
+        """Yielded-after-input-end hook; return iterable of MTable or None."""
+        return None
+
+    def link_from(self, in_op: StreamOperator) -> "BaseStreamTransformOp":
+        try:
+            self._schema = self._open(in_op.get_schema())
+        except RuntimeError:
+            self._schema = None  # upstream schema data-dependent; resolve on first batch
+
+        def gen():
+            import copy
+            worker = copy.copy(self)  # per-drain mutable state lives here
+            opened = False
+            last_t = 0.0
+            for t, mt in in_op.timed_batches():
+                if not opened:
+                    self._schema = worker._open(mt.schema)
+                    opened = True
+                last_t = t
+                out = worker._transform(mt)
+                if out is STOP:
+                    break
+                if out is not None and out.num_rows > 0:
+                    yield (t, out)
+            tail = worker._close()
+            if tail:
+                for out in tail:
+                    if out is not None and out.num_rows > 0:
+                        yield (last_t, out)
+
+        self._stream_fn = gen
+        return self
+
+
+class BatchApplyStreamOp(BaseStreamTransformOp):
+    """Apply a stateless batch op class to every micro-batch."""
+
+    def _batch_cls(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def _open(self, in_schema):
+        from ..base import BatchOperator
+        probe = self._batch_cls()(self.params.clone())
+        probe.link_from(BatchOperator.from_table(MTable([], in_schema)))
+        return probe.get_schema()
+
+    def _transform(self, mt):
+        from ..base import BatchOperator
+        op = self._batch_cls()(self.params.clone())
+        op.link_from(BatchOperator.from_table(mt))
+        return op.get_output_table()
+
+
+class FnStreamOp(BaseStreamTransformOp):
+    """Ad-hoc per-batch function stream op (UDF-style, reference
+    stream/utils UDF ops)."""
+
+    def __init__(self, fn: Callable[[MTable], Optional[MTable]],
+                 schema_fn: Optional[Callable[[TableSchema], TableSchema]] = None,
+                 params=None, **kwargs):
+        super().__init__(params, **kwargs)
+        self._fn = fn
+        self._schema_fn = schema_fn
+
+    def _open(self, in_schema):
+        return self._schema_fn(in_schema) if self._schema_fn else in_schema
+
+    def _transform(self, mt):
+        return self._fn(mt)

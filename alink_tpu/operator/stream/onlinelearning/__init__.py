@@ -1,0 +1,3 @@
+from .ftrl import FtrlPredictStreamOp, FtrlTrainStreamOp
+
+__all__ = ["FtrlTrainStreamOp", "FtrlPredictStreamOp"]

@@ -1,0 +1,87 @@
+"""Stream predict variants of every model-backed batch operator.
+
+The reference ships a ``*PredictStreamOp`` next to nearly every
+``*PredictBatchOp`` (operator/stream/{classification,regression,clustering,
+dataproc,feature}/...StreamOp.java); all of them are the same shape — load
+the (batch-trained) model once, map the stream through the model mapper
+(stream/utils/ModelMapStreamOp). Here they are derived mechanically from
+the batch predict classes: same mapper kernel, same params, applied per
+micro-batch.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .utils import ModelMapStreamOp
+
+_BATCH_PREDICT_OPS = {
+    # classification
+    "LogisticRegressionPredictStreamOp": ("..batch.classification.linear", "LogisticRegressionPredictBatchOp"),
+    "LinearSvmPredictStreamOp": ("..batch.classification.linear", "LinearSvmPredictBatchOp"),
+    "SoftmaxPredictStreamOp": ("..batch.classification.linear", "SoftmaxPredictBatchOp"),
+    "PerceptronPredictStreamOp": ("..batch.classification.linear", "PerceptronPredictBatchOp"),
+    "NaiveBayesTextPredictStreamOp": ("..batch.classification.naive_bayes", "NaiveBayesTextPredictBatchOp"),
+    "NaiveBayesPredictStreamOp": ("..batch.classification.naive_bayes", "NaiveBayesPredictBatchOp"),
+    "FmPredictStreamOp": ("..batch.classification.fm_ops", "FmPredictBatchOp"),
+    "MultilayerPerceptronPredictStreamOp": ("..batch.classification.mlpc_ops", "MultilayerPerceptronPredictBatchOp"),
+    "GbdtPredictStreamOp": ("..batch.classification.tree_ops", "GbdtPredictBatchOp"),
+    "GbdtRegPredictStreamOp": ("..batch.classification.tree_ops", "GbdtRegPredictBatchOp"),
+    "RandomForestPredictStreamOp": ("..batch.classification.tree_ops", "RandomForestPredictBatchOp"),
+    "RandomForestRegPredictStreamOp": ("..batch.classification.tree_ops", "RandomForestRegPredictBatchOp"),
+    "DecisionTreePredictStreamOp": ("..batch.classification.tree_ops", "DecisionTreePredictBatchOp"),
+    "DecisionTreeRegPredictStreamOp": ("..batch.classification.tree_ops", "DecisionTreeRegPredictBatchOp"),
+    # regression
+    "LinearRegPredictStreamOp": ("..batch.regression.linear", "LinearRegPredictBatchOp"),
+    "RidgeRegPredictStreamOp": ("..batch.regression.linear", "RidgeRegPredictBatchOp"),
+    "LassoRegPredictStreamOp": ("..batch.regression.linear", "LassoRegPredictBatchOp"),
+    "LinearSvrPredictStreamOp": ("..batch.regression.linear", "LinearSvrPredictBatchOp"),
+    "GlmPredictStreamOp": ("..batch.regression.glm_ops", "GlmPredictBatchOp"),
+    "IsotonicRegPredictStreamOp": ("..batch.regression.glm_ops", "IsotonicRegPredictBatchOp"),
+    "AftSurvivalRegPredictStreamOp": ("..batch.regression.glm_ops", "AftSurvivalRegPredictBatchOp"),
+    # clustering
+    "KMeansPredictStreamOp": ("..batch.clustering.kmeans_ops", "KMeansPredictBatchOp"),
+    "GmmPredictStreamOp": ("..batch.clustering.gmm_bisecting", "GmmPredictBatchOp"),
+    "BisectingKMeansPredictStreamOp": ("..batch.clustering.gmm_bisecting", "BisectingKMeansPredictBatchOp"),
+    # dataproc / feature
+    "StandardScalerPredictStreamOp": ("..batch.dataproc.scalers", "StandardScalerPredictBatchOp"),
+    "MinMaxScalerPredictStreamOp": ("..batch.dataproc.scalers", "MinMaxScalerPredictBatchOp"),
+    "MaxAbsScalerPredictStreamOp": ("..batch.dataproc.scalers", "MaxAbsScalerPredictBatchOp"),
+    "ImputerPredictStreamOp": ("..batch.dataproc.scalers", "ImputerPredictBatchOp"),
+    "VectorStandardScalerPredictStreamOp": ("..batch.dataproc.vector_ops", "VectorStandardScalerPredictBatchOp"),
+    "VectorMinMaxScalerPredictStreamOp": ("..batch.dataproc.vector_ops", "VectorMinMaxScalerPredictBatchOp"),
+    "VectorMaxAbsScalerPredictStreamOp": ("..batch.dataproc.vector_ops", "VectorMaxAbsScalerPredictBatchOp"),
+    "StringIndexerPredictStreamOp": ("..batch.dataproc.indexers", "StringIndexerPredictBatchOp"),
+    "MultiStringIndexerPredictStreamOp": ("..batch.dataproc.indexers", "MultiStringIndexerPredictBatchOp"),
+    "IndexToStringPredictStreamOp": ("..batch.dataproc.indexers", "IndexToStringPredictBatchOp"),
+    "OneHotPredictStreamOp": ("..batch.feature.feature_ops", "OneHotPredictBatchOp"),
+    "QuantileDiscretizerPredictStreamOp": ("..batch.feature.feature_ops", "QuantileDiscretizerPredictBatchOp"),
+    "PcaPredictStreamOp": ("..batch.feature.feature_ops", "PcaPredictBatchOp"),
+}
+
+__all__ = sorted(_BATCH_PREDICT_OPS)
+
+
+def _build():
+    import importlib
+
+    from ...common.params import WithParams
+    from ..base import AlgoOperator
+    mod = sys.modules[__name__]
+    for name, (batch_module, batch_name) in _BATCH_PREDICT_OPS.items():
+        bm = importlib.import_module(batch_module, package=__name__.rsplit(".", 1)[0])
+        batch_cls = getattr(bm, batch_name)
+        # carry over the pure param mixins (Has*) but nothing operator-typed:
+        # mixins are plain classes harvested by the WithParams metaclass
+        bases = tuple(b for b in batch_cls.__mro__
+                      if not issubclass(b, WithParams) and b is not object)
+        cls = type(name, (ModelMapStreamOp,) + bases, {
+            "MAPPER_CLS": batch_cls.MAPPER_CLS,
+            "__doc__": f"Stream variant of {batch_name} "
+                       f"(reference stream predict op of the same family).",
+            "__module__": __name__,
+        })
+        setattr(mod, name, cls)
+
+
+_build()

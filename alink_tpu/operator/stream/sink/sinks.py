@@ -1,0 +1,113 @@
+"""Stream sink operators.
+
+Re-design of operator/stream/sink/ (CsvSinkStreamOp, LibSvmSinkStreamOp,
+TextSinkStreamOp) plus CollectSinkStreamOp — the in-memory sink the tests
+drain into (reference tests use CollectSinkStreamOp / StreamOperator
+print + execute).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ....common.mtable import MTable
+from ....common.params import Params
+from ....io.csv import format_csv_rows, format_libsvm_rows
+from ...base import StreamOperator
+
+
+class BaseSinkStreamOp(StreamOperator):
+    def _consume(self, mt: MTable):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def link_from(self, in_op: StreamOperator) -> "BaseSinkStreamOp":
+        try:
+            self._schema = in_op.get_schema()
+        except RuntimeError:
+            self._schema = None  # upstream schema data-dependent
+
+        self._stream_fn = in_op.timed_batches
+        self._sinks.append(self._consume)
+        return self._register()
+
+
+class CollectSinkStreamOp(BaseSinkStreamOp):
+    """Collect every micro-batch into one host table."""
+
+    def __init__(self, params: Optional[Params] = None, **kwargs):
+        super().__init__(params, **kwargs)
+        self._batches: List[MTable] = []
+
+    def _consume(self, mt: MTable):
+        self._batches.append(mt)
+
+    def get_and_remove_values(self) -> Optional[MTable]:
+        out = None
+        for mt in self._batches:
+            out = mt if out is None else out.concat_rows(mt)
+        self._batches = []
+        return out
+
+
+class CsvSinkStreamOp(BaseSinkStreamOp):
+    """reference: stream/sink/CsvSinkStreamOp (append per micro-batch)."""
+
+    def __init__(self, file_path: str, field_delimiter: str = ",",
+                 params=None, **kwargs):
+        super().__init__(params, **kwargs)
+        self.file_path = file_path
+        self.field_delimiter = field_delimiter
+        self._started = False
+
+    def link_from(self, in_op):
+        self._started = False
+        return super().link_from(in_op)
+
+    def _consume(self, mt: MTable):
+        mode = "a" if self._started else "w"
+        with open(self.file_path, mode) as f:
+            f.write(format_csv_rows(mt, self.field_delimiter))
+        self._started = True
+
+
+class LibSvmSinkStreamOp(BaseSinkStreamOp):
+    """reference: stream/sink/LibSvmSinkStreamOp."""
+
+    def __init__(self, file_path: str, label_col: str, vector_col: str,
+                 params=None, **kwargs):
+        super().__init__(params, **kwargs)
+        self.file_path = file_path
+        self.label_col = label_col
+        self.vector_col = vector_col
+        self._started = False
+
+    def link_from(self, in_op):
+        self._started = False
+        return super().link_from(in_op)
+
+    def _consume(self, mt: MTable):
+        mode = "a" if self._started else "w"
+        with open(self.file_path, mode) as f:
+            f.write(format_libsvm_rows(mt, self.label_col, self.vector_col))
+        self._started = True
+
+
+class TextSinkStreamOp(BaseSinkStreamOp):
+    """reference: stream/sink/TextSinkStreamOp (single string column)."""
+
+    def __init__(self, file_path: str, params=None, **kwargs):
+        super().__init__(params, **kwargs)
+        self.file_path = file_path
+        self._started = False
+
+    def link_from(self, in_op):
+        self._started = False
+        return super().link_from(in_op)
+
+    def _consume(self, mt: MTable):
+        mode = "a" if self._started else "w"
+        col = mt.col_names[0]
+        with open(self.file_path, mode) as f:
+            for v in mt.col(col):
+                f.write(f"{v}\n")
+        self._started = True

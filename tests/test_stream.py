@@ -1,0 +1,214 @@
+"""Streaming layer tests: sources/sinks, transforms, windowed eval, FTRL.
+
+Mirrors the reference's stream tests (stream op + StreamOperator.execute +
+collected results; FTRL example DAG FTRLExample.java:18-113).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from alink_tpu.common import MTable
+from alink_tpu.operator.base import StreamOperator
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.batch.classification import (
+    LogisticRegressionTrainBatchOp, LogisticRegressionPredictBatchOp)
+from alink_tpu.operator.stream import (
+    AppendIdStreamOp, CollectSinkStreamOp, EvalBinaryClassStreamOp,
+    FtrlPredictStreamOp, FtrlTrainStreamOp, LogisticRegressionPredictStreamOp,
+    MemSourceStreamOp, NumSeqSourceStreamOp, SampleStreamOp, SelectStreamOp,
+    SplitStreamOp, UnionAllStreamOp, WhereStreamOp, WindowGroupByStreamOp)
+
+
+def _drain(op):
+    sink = CollectSinkStreamOp().link_from(op)
+    StreamOperator.execute()
+    return sink.get_and_remove_values()
+
+
+def test_mem_source_micro_batches():
+    src = MemSourceStreamOp({"x": np.arange(10.0)}, batch_size=3)
+    batches = [mt.num_rows for mt in src.micro_batches()]
+    assert batches == [3, 3, 3, 1]
+    out = _drain(src)
+    np.testing.assert_array_equal(out.col("x"), np.arange(10.0))
+
+
+def test_stream_sql_chain():
+    src = NumSeqSourceStreamOp(1, 20, col_name="n", batch_size=4)
+    out = _drain(SelectStreamOp(clause="n, n*2 as dbl")
+                 .link_from(WhereStreamOp(clause="n % 2 == 0").link_from(src)))
+    np.testing.assert_array_equal(out.col("n"), np.arange(2, 21, 2))
+    np.testing.assert_array_equal(out.col("dbl"), np.arange(2, 21, 2) * 2)
+
+
+def test_stream_union_sample_split_append_id():
+    a = MemSourceStreamOp({"x": np.arange(0.0, 10.0)}, batch_size=5)
+    b = MemSourceStreamOp({"x": np.arange(100.0, 110.0)}, batch_size=5)
+    u = UnionAllStreamOp().link_from(a, b)
+    out = _drain(AppendIdStreamOp().link_from(u))
+    assert out.num_rows == 20
+    np.testing.assert_array_equal(out.col("append_id"), np.arange(20))
+
+    s = SampleStreamOp(ratio=0.5, seed=7).link_from(a)
+    sampled = _drain(s)
+    assert 0 < sampled.num_rows < 10
+
+    sp = SplitStreamOp(fraction=0.5, seed=3).link_from(a)
+    main = _drain(sp)
+    rest = _drain(sp.get_side_stream())
+    assert main.num_rows + rest.num_rows == 10
+
+
+def test_window_group_by():
+    # 12 batches of 1 row, event time = batch index; windows of 3s
+    rows = [("a", float(i)) for i in range(12)]
+    src = MemSourceStreamOp(rows, ["k", "v"], batch_size=1, time_per_batch=1.0)
+    w = WindowGroupByStreamOp(group_by_clause="k",
+                              select_clause="k, sum(v) as s, count(*) as c",
+                              window_length=3.0).link_from(src)
+    out = _drain(w)
+    # windows [0,3) [3,6) [6,9) [9,12)
+    assert list(out.col("c")) == [3, 3, 3, 3]
+    assert list(out.col("s")) == [3.0, 12.0, 21.0, 30.0]
+
+
+def test_hopping_window_group_by():
+    # HOP(length=4, slide=2) over t=0..7 one row each: windows [-2,2) [0,4)
+    # [2,6) [4,8) [6,10) — overlapping rows must appear in BOTH windows
+    rows = [("a", float(i)) for i in range(8)]
+    src = MemSourceStreamOp(rows, ["k", "v"], batch_size=1, time_per_batch=1.0)
+    w = WindowGroupByStreamOp(group_by_clause="k",
+                              select_clause="k, sum(v) as s",
+                              window_length=4.0,
+                              slide_length=2.0).link_from(src)
+    sums = list(_drain(w).col("s"))
+    assert sums == [1.0, 6.0, 14.0, 22.0, 13.0]  # 0+1, 0+..3, 2+..5, 4+..7, 6+7
+
+
+def test_diamond_dag_independent_drains():
+    # the same op instance drained twice concurrently (diamond) must not
+    # share per-drain state
+    src = MemSourceStreamOp({"x": np.arange(6.0)}, batch_size=2)
+    ap = AppendIdStreamOp().link_from(src)
+    u = UnionAllStreamOp().link_from(ap, ap)
+    out = _drain(u)
+    ids = sorted(out.col("append_id"))
+    assert ids == [0, 0, 1, 1, 2, 2, 3, 3, 4, 4, 5, 5]
+
+
+def test_first_n_stops_upstream():
+    pulled = []
+
+    class CountingSource(MemSourceStreamOp):
+        def _set_table(self, table):
+            super()._set_table(table)
+            inner = self._stream_fn
+
+            def counted():
+                for t, mt in inner():
+                    pulled.append(t)
+                    yield (t, mt)
+            self._stream_fn = counted
+            return self
+
+    from alink_tpu.operator.stream import FirstNStreamOp
+    src = CountingSource({"x": np.arange(100.0)}, batch_size=10)
+    out = _drain(FirstNStreamOp(n=10).link_from(src))
+    assert out.num_rows == 10
+    assert len(pulled) <= 2  # does not drain the remaining 8 batches
+
+
+def _make_lr_fixture(n=400, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 3)
+    w = np.array([1.5, -2.0, 0.7])
+    y = (X @ w + 0.3 * rng.randn(n) > 0).astype(np.int64)
+    return MTable({"f0": X[:, 0], "f1": X[:, 1], "f2": X[:, 2], "label": y})
+
+
+def test_stream_model_predict_and_eval():
+    table = _make_lr_fixture()
+    batch_src = MemSourceBatchOp(table)
+    model = LogisticRegressionTrainBatchOp(
+        feature_cols=["f0", "f1", "f2"], label_col="label",
+        max_iter=60).link_from(batch_src)
+
+    stream_src = MemSourceStreamOp(table, batch_size=64)
+    pred = LogisticRegressionPredictStreamOp(
+        model, prediction_col="pred", prediction_detail_col="detail"
+    ).link_from(stream_src)
+    out = _drain(pred)
+    acc = np.mean(np.asarray(out.col("pred")) == np.asarray(out.col("label")))
+    assert acc > 0.9
+
+    # windowed + cumulative eval rows
+    pred2 = LogisticRegressionPredictStreamOp(
+        model, prediction_col="pred", prediction_detail_col="detail"
+    ).link_from(MemSourceStreamOp(table, batch_size=64))
+    ev = EvalBinaryClassStreamOp(label_col="label",
+                                 prediction_detail_col="detail",
+                                 time_interval=2.0).link_from(pred2)
+    rows = _drain(ev)
+    stats = list(rows.col("Statistics"))
+    assert "window" in stats and "all" in stats
+    last_all = [json.loads(d) for s, d in zip(stats, rows.col("Data"))
+                if s == "all"][-1]
+    assert last_all["AUC"] > 0.9
+
+
+def test_ftrl_train_and_hot_reload_predict():
+    table = _make_lr_fixture(n=600, seed=11)
+    batch_src = MemSourceBatchOp(table.first_n(100))
+    warm = LogisticRegressionTrainBatchOp(
+        feature_cols=["f0", "f1", "f2"], label_col="label",
+        max_iter=10).link_from(batch_src)
+
+    train_stream = MemSourceStreamOp(table, batch_size=32, time_per_batch=1.0)
+    ftrl = FtrlTrainStreamOp(
+        warm, label_col="label", feature_cols=["f0", "f1", "f2"],
+        alpha=0.5, beta=1.0, l1=0.001, l2=0.001,
+        time_interval=5.0).link_from(train_stream)
+
+    data_stream = MemSourceStreamOp(table, batch_size=32, time_per_batch=1.0)
+    pred = FtrlPredictStreamOp(
+        warm, prediction_col="pred", prediction_detail_col="detail"
+    ).link_from(ftrl, data_stream)
+    out = _drain(pred)
+    assert out.num_rows == 600
+    acc = np.mean(np.asarray(out.col("pred")) == np.asarray(out.col("label")))
+    assert acc > 0.85
+
+    # the model stream itself is valid LinearModel rows: load last snapshot
+    snapshots = list(ftrl.micro_batches())
+    assert len(snapshots) >= 2
+    final = snapshots[-1]
+    scored = LogisticRegressionPredictBatchOp(prediction_col="p").link_from(
+        MemSourceBatchOp(final).alias("model_id, model_info, label_value")
+        if False else MemSourceBatchOp(final), MemSourceBatchOp(table))
+    acc2 = np.mean(np.asarray(scored.get_output_table().col("p"))
+                   == np.asarray(table.col("label")))
+    assert acc2 > 0.85
+
+
+def test_ftrl_improves_on_weak_warm_start():
+    """FTRL online updates should beat a deliberately under-trained model."""
+    table = _make_lr_fixture(n=800, seed=23)
+    weak = LogisticRegressionTrainBatchOp(
+        feature_cols=["f0", "f1", "f2"], label_col="label",
+        max_iter=1).link_from(MemSourceBatchOp(table.first_n(24)))
+
+    ftrl = FtrlTrainStreamOp(
+        weak, label_col="label", feature_cols=["f0", "f1", "f2"],
+        alpha=1.0, time_interval=1e9).link_from(
+        MemSourceStreamOp(table, batch_size=64))
+    final_model = list(ftrl.micro_batches())[-1]
+
+    def batch_acc(model_table):
+        scored = LogisticRegressionPredictBatchOp(prediction_col="p").link_from(
+            MemSourceBatchOp(model_table), MemSourceBatchOp(table))
+        return np.mean(np.asarray(scored.get_output_table().col("p"))
+                       == np.asarray(table.col("label")))
+
+    assert batch_acc(final_model) >= batch_acc(weak.get_output_table())
