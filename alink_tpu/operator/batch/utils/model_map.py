@@ -14,6 +14,23 @@ from ....mapper.base import ModelMapper
 from ...base import BatchOperator
 
 
+class MapBatchOp(BatchOperator):
+    """Stateless mapper applied to the whole table (reference
+    batch/utils/MapBatchOp.java)."""
+
+    MAPPER_CLS = None
+
+    def __init__(self, params: Optional[Params] = None, mapper_cls=None, **kwargs):
+        super().__init__(params, **kwargs)
+        if mapper_cls is not None:
+            self.MAPPER_CLS = mapper_cls
+
+    def link_from(self, in_op: BatchOperator) -> "MapBatchOp":
+        mapper = self.MAPPER_CLS(in_op.get_schema(), self.params)
+        self._output = mapper.map_table(in_op.get_output_table())
+        return self
+
+
 class ModelMapBatchOp(BatchOperator):
     MAPPER_CLS: Optional[Type[ModelMapper]] = None
 

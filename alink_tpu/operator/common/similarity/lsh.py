@@ -1,0 +1,168 @@
+"""Locality-sensitive hashing for approximate vector similarity joins.
+
+Re-design of common/feature/BaseLSH + MinHashLSH + BucketRandomProjectionLSH
+and batch/similarity/ ApproxVectorSimilarityJoinLSHBatchOp / TopNLSHBatchOp.
+
+TPU-first: hashing and the candidate re-scoring are batched device matmuls
+(projections are one (n, d) @ (d, h) on the MXU; candidate distances are
+batched gathers + norms); only the bucket grouping is host-side hashing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ....common.mtable import MTable
+from ....common.vector import DenseVector, SparseVector, VectorUtil
+
+
+def _to_dense(vecs, dim: Optional[int] = None) -> np.ndarray:
+    parsed = [VectorUtil.parse(v) for v in vecs]
+    if dim is None:
+        dim = 0
+        for v in parsed:
+            dim = max(dim, v.size() if isinstance(v, DenseVector)
+                      else (v.n if v.n >= 0 else int(v.indices[-1]) + 1))
+    X = np.zeros((len(parsed), dim))
+    for i, v in enumerate(parsed):
+        if isinstance(v, DenseVector):
+            X[i, :v.size()] = v.data
+        else:
+            X[i, v.indices.astype(int)] = v.values
+    return X
+
+
+class BucketRandomProjectionLSH:
+    """Euclidean-distance LSH: h(x) = floor((x·w + b) / bucket_width)
+    (reference common/feature/BucketRandomProjectionLSH)."""
+
+    def __init__(self, dim: int, num_projections: int = 10,
+                 num_hash_tables: int = 2, bucket_width: float = 1.0,
+                 seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.W = rng.randn(dim, num_hash_tables * num_projections)
+        self.b = rng.rand(num_hash_tables * num_projections) * bucket_width
+        self.bucket_width = bucket_width
+        self.num_tables = num_hash_tables
+        self.num_proj = num_projections
+
+    def hash(self, X: np.ndarray) -> np.ndarray:
+        """(n, tables, proj) integer bucket ids — one device matmul."""
+        import jax.numpy as jnp
+        H = np.asarray(jnp.floor((jnp.asarray(X) @ self.W + self.b)
+                                 / self.bucket_width), np.int64)
+        return H.reshape(X.shape[0], self.num_tables, self.num_proj)
+
+    def keys(self, X: np.ndarray) -> List[List[Tuple]]:
+        H = self.hash(X)
+        return [[tuple(H[i, t]) for t in range(self.num_tables)]
+                for i in range(X.shape[0])]
+
+    @staticmethod
+    def distance(a: np.ndarray, B: np.ndarray) -> np.ndarray:
+        return np.linalg.norm(B - a, axis=-1)
+
+
+class MinHashLSH:
+    """Jaccard-distance LSH over the non-zero index set
+    (reference common/feature/MinHashLSH)."""
+
+    PRIME = (1 << 31) - 1
+
+    def __init__(self, num_hash: int = 16, num_bands: int = 4, seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.a = rng.randint(1, self.PRIME, size=num_hash).astype(np.int64)
+        self.b = rng.randint(0, self.PRIME, size=num_hash).astype(np.int64)
+        self.num_hash = num_hash
+        self.num_bands = num_bands
+
+    def signature(self, active: Sequence[int]) -> np.ndarray:
+        if len(active) == 0:
+            return np.full(self.num_hash, self.PRIME, np.int64)
+        idx = np.asarray(list(active), np.int64)[:, None]
+        h = (self.a * (idx + 1) + self.b) % self.PRIME
+        return h.min(axis=0)
+
+    def keys_for(self, active: Sequence[int]) -> List[Tuple]:
+        sig = self.signature(active)
+        per = max(1, self.num_hash // self.num_bands)
+        return [tuple(sig[t * per:(t + 1) * per]) for t in range(self.num_bands)]
+
+    @staticmethod
+    def jaccard_dist(a: set, b: set) -> float:
+        if not a and not b:
+            return 0.0
+        u = len(a | b)
+        return 1.0 - (len(a & b) / u if u else 0.0)
+
+
+def approx_join(left: MTable, right: MTable, left_col: str, right_col: str,
+                left_id: str, right_id: str, threshold: float,
+                metric: str = "EUCLIDEAN", top_n: Optional[int] = None,
+                seed: int = 0, **lsh_kw) -> List[Tuple]:
+    """Candidate pairs via shared LSH buckets, exact re-score, filter.
+
+    Returns rows (left_id, right_id, distance). ``top_n`` keeps the N
+    nearest rights per left (TopN variant); otherwise threshold filter
+    (Join variant).
+    """
+    lv, rv = left.col(left_col), right.col(right_col)
+    if metric.upper() == "JACCARD":
+        lsh = MinHashLSH(seed=seed, **lsh_kw)
+
+        def active_set(x):
+            v = VectorUtil.parse(x)
+            if isinstance(v, SparseVector):
+                return set(v.indices.astype(int))
+            return set(np.nonzero(np.asarray(v.data))[0])
+
+        lsets = [active_set(x) for x in lv]
+        rsets = [active_set(x) for x in rv]
+        buckets: Dict[Tuple, List[int]] = {}
+        for j, s in enumerate(rsets):
+            for t, key in enumerate(lsh.keys_for(s)):
+                buckets.setdefault((t, key), []).append(j)
+        out = []
+        for i, s in enumerate(lsets):
+            cands = set()
+            for t, key in enumerate(lsh.keys_for(s)):
+                cands.update(buckets.get((t, key), ()))
+            scored = [(left.col(left_id)[i], right.col(right_id)[j],
+                       lsh.jaccard_dist(s, rsets[j])) for j in cands]
+            out.extend(_pick(scored, threshold, top_n))
+        return out
+
+    X, Y = _to_dense(lv), _to_dense(rv)
+    d = max(X.shape[1], Y.shape[1])
+    if X.shape[1] < d:
+        X = np.pad(X, ((0, 0), (0, d - X.shape[1])))
+    if Y.shape[1] < d:
+        Y = np.pad(Y, ((0, 0), (0, d - Y.shape[1])))
+    lsh = BucketRandomProjectionLSH(d, seed=seed, **lsh_kw)
+    rkeys = lsh.keys(Y)
+    buckets = {}
+    for j, keys in enumerate(rkeys):
+        for t, key in enumerate(keys):
+            buckets.setdefault((t, key), []).append(j)
+    lkeys = lsh.keys(X)
+    out = []
+    for i, keys in enumerate(lkeys):
+        cands = set()
+        for t, key in enumerate(keys):
+            cands.update(buckets.get((t, key), ()))
+        if not cands:
+            continue
+        js = sorted(cands)
+        dist = lsh.distance(X[i], Y[js])
+        scored = [(left.col(left_id)[i], right.col(right_id)[j], float(dv))
+                  for j, dv in zip(js, dist)]
+        out.extend(_pick(scored, threshold, top_n))
+    return out
+
+
+def _pick(scored: List[Tuple], threshold: float, top_n: Optional[int]):
+    if top_n is not None:
+        return sorted(scored, key=lambda r: r[2])[:top_n]
+    return [r for r in scored if r[2] <= threshold]

@@ -10,6 +10,8 @@ from .dataproc import (AppendIdStreamOp, FirstNStreamOp,
                        ShuffleStreamOp, SplitStreamOp)
 from .evaluation import (EvalBinaryClassStreamOp, EvalMultiClassStreamOp,
                          EvalRegressionStreamOp)
+from .nlp import (NGramStreamOp, RegexTokenizerStreamOp, SegmentStreamOp,
+                  StopWordsRemoverStreamOp, TokenizerStreamOp)
 from .onlinelearning import FtrlPredictStreamOp, FtrlTrainStreamOp
 from .predict_ops import *  # noqa: F401,F403 — the *PredictStreamOp family
 from .predict_ops import __all__ as _predict_all
@@ -29,6 +31,8 @@ __all__ = [
     "SampleStreamOp", "ShuffleStreamOp", "SplitStreamOp",
     "EvalBinaryClassStreamOp", "EvalMultiClassStreamOp", "EvalRegressionStreamOp",
     "FtrlTrainStreamOp", "FtrlPredictStreamOp",
+    "NGramStreamOp", "RegexTokenizerStreamOp", "SegmentStreamOp",
+    "StopWordsRemoverStreamOp", "TokenizerStreamOp",
     "CollectSinkStreamOp", "CsvSinkStreamOp", "LibSvmSinkStreamOp",
     "TextSinkStreamOp",
     "CsvSourceStreamOp", "LibSvmSourceStreamOp", "MemSourceStreamOp",

@@ -48,7 +48,6 @@ class SplitStreamOp(BaseStreamTransformOp, HasSeed):
 
     def _transform(self, mt):
         mask = self._rng.random(mt.num_rows) < float(self.get_fraction())
-        self._last_rest = mt.filter_mask(~mask)
         return mt.filter_mask(mask)
 
     def get_side_stream(self) -> "StreamOperator":

@@ -56,9 +56,12 @@ class _BaseEvalStreamOp(StreamOperator):
                 if window_end is None:
                     window_end = (np.floor(t / interval) + 1) * interval
                 while t >= window_end:
-                    out = emit(window, total)
-                    if out is not None:
-                        yield (window_end, out)
+                    # fire only for windows that saw data (Flink timeWindowAll
+                    # does not fire empty windows)
+                    if window is not None:
+                        out = emit(window, total)
+                        if out is not None:
+                            yield (window_end, out)
                     window = None
                     window_end += interval
                 window = mt if window is None else window.concat_rows(mt)

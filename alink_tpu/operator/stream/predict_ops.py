@@ -57,6 +57,10 @@ _BATCH_PREDICT_OPS = {
     "OneHotPredictStreamOp": ("..batch.feature.feature_ops", "OneHotPredictBatchOp"),
     "QuantileDiscretizerPredictStreamOp": ("..batch.feature.feature_ops", "QuantileDiscretizerPredictBatchOp"),
     "PcaPredictStreamOp": ("..batch.feature.feature_ops", "PcaPredictBatchOp"),
+    # nlp
+    "DocCountVectorizerPredictStreamOp": ("..batch.nlp", "DocCountVectorizerPredictBatchOp"),
+    "DocHashCountVectorizerPredictStreamOp": ("..batch.nlp", "DocHashCountVectorizerPredictBatchOp"),
+    "Word2VecPredictStreamOp": ("..batch.nlp", "Word2VecPredictBatchOp"),
 }
 
 __all__ = sorted(_BATCH_PREDICT_OPS)

@@ -41,9 +41,12 @@ def _trainer(name, train_op, mapper, extra_bases=()):
                      {"MAPPER_CLS": mapper})
     cls = type(name, (Trainer,) + tuple(extra_bases),
                {"TRAIN_OP_CLS": train_op, "MODEL_CLS": model_cls})
-    # inherit train-op params for kwargs validation
-    cls._PARAM_INFOS = {**train_op._PARAM_INFOS, **cls._PARAM_INFOS}
-    model_cls._PARAM_INFOS = {**train_op._PARAM_INFOS, **model_cls._PARAM_INFOS}
+    # inherit train-op + mapper params for kwargs validation
+    mapper_infos = getattr(mapper, "_PARAM_INFOS", {})
+    cls._PARAM_INFOS = {**train_op._PARAM_INFOS, **mapper_infos,
+                        **cls._PARAM_INFOS}
+    model_cls._PARAM_INFOS = {**train_op._PARAM_INFOS, **mapper_infos,
+                              **model_cls._PARAM_INFOS}
     return cls, model_cls
 
 

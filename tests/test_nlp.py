@@ -1,0 +1,212 @@
+"""NLP suite tests: tokenizers, vectorizers, segmenter, Word2Vec,
+similarity metrics, LSH joins."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common import MTable, SparseVector, DenseVector
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.batch.nlp import (
+    DocCountVectorizerPredictBatchOp, DocCountVectorizerTrainBatchOp,
+    DocHashCountVectorizerPredictBatchOp, DocHashCountVectorizerTrainBatchOp,
+    NGramBatchOp, RegexTokenizerBatchOp, SegmentBatchOp,
+    StopWordsRemoverBatchOp, TokenizerBatchOp, WordCountBatchOp,
+    Word2VecPredictBatchOp, Word2VecTrainBatchOp)
+from alink_tpu.operator.batch.similarity import (
+    ApproxVectorSimilarityJoinLSHBatchOp, ApproxVectorSimilarityTopNLSHBatchOp,
+    StringSimilarityPairwiseBatchOp, TextSimilarityPairwiseBatchOp)
+from alink_tpu.operator.common.similarity.metrics import (
+    cosine_sim, jaccard_sim, lcs, levenshtein, levenshtein_sim)
+
+_DOCS = [
+    ("That is an English book",),
+    ("Have a good day",),
+    ("This is a good book",),
+    ("Good day to read a book",),
+]
+
+
+def _src():
+    return MemSourceBatchOp(_DOCS, ["sentence"])
+
+
+def test_tokenizer_and_ngram_and_stopwords():
+    tok = TokenizerBatchOp(selected_col="sentence", output_col="tok").link_from(_src())
+    assert tok.get_output_table().col("tok")[0] == "that is an english book"
+
+    ng = NGramBatchOp(selected_col="sentence", output_col="ng", n=2).link_from(_src())
+    assert ng.get_output_table().col("ng")[1] == "Have_a a_good good_day"
+
+    sw = StopWordsRemoverBatchOp(selected_col="tok", output_col="sw"
+                                 ).link_from(tok)
+    assert sw.get_output_table().col("sw")[0] == "english book"
+
+    rx = RegexTokenizerBatchOp(selected_col="sentence", output_col="rx",
+                               pattern=r"[a-z]+", gaps=False,
+                               to_lower_case=False).link_from(_src())
+    assert rx.get_output_table().col("rx")[0] == "hat is an nglish book"
+
+
+def test_word_count():
+    wc = WordCountBatchOp(selected_col="sentence").link_from(
+        TokenizerBatchOp(selected_col="sentence").link_from(_src()))
+    t = wc.get_output_table()
+    d = dict(zip(t.col("word"), t.col("cnt")))
+    assert d["book"] == 3 and d["good"] == 3 and d["english"] == 1
+
+
+def test_doc_count_vectorizer_tfidf_roundtrip():
+    train = DocCountVectorizerTrainBatchOp(
+        selected_col="sentence", feature_type="TF_IDF").link_from(
+        TokenizerBatchOp(selected_col="sentence").link_from(_src()))
+    pred = DocCountVectorizerPredictBatchOp(
+        selected_col="sentence", output_col="vec").link_from(
+        train, TokenizerBatchOp(selected_col="sentence").link_from(_src()))
+    vecs = pred.get_output_table().col("vec")
+    assert all(isinstance(v, SparseVector) for v in vecs)
+    # same vocab size across docs; doc 0 has 5 distinct tokens
+    assert vecs[0].indices.size == 5
+    # common words (in every doc) have idf log(5/5) -> tf*idf small but >0
+    assert vecs[0].values.min() >= 0
+
+
+def test_doc_hash_vectorizer():
+    train = DocHashCountVectorizerTrainBatchOp(
+        selected_col="sentence", num_features=1 << 10).link_from(_src())
+    pred = DocHashCountVectorizerPredictBatchOp(
+        selected_col="sentence", output_col="vec").link_from(train, _src())
+    v = pred.get_output_table().col("vec")[0]
+    assert isinstance(v, SparseVector) and v.n == 1 << 10
+    assert v.indices.size == 5  # 5 distinct tokens
+
+
+def test_segmenter():
+    rows = [("我们喜欢机器学习和自然语言处理",), ("今天天气非常好",),
+            ("hello 世界 world",)]
+    seg = SegmentBatchOp(selected_col="sentence").link_from(
+        MemSourceBatchOp(rows, ["sentence"]))
+    out = list(seg.get_output_table().col("sentence"))
+    assert out[0] == "我们 喜欢 机器学习 和 自然语言处理"
+    assert "天气" in out[1].split() and "非常" in out[1].split()
+    assert out[2].split()[0] == "hello" and "world" in out[2].split()
+    # user dict adds an OOV word
+    seg2 = SegmentBatchOp(selected_col="sentence",
+                          user_defined_dict=["天气非常"]).link_from(
+        MemSourceBatchOp(rows, ["sentence"]))
+    assert "天气非常" in seg2.get_output_table().col("sentence")[1].split()
+
+
+def test_word2vec_embeddings_capture_cooccurrence():
+    # two disjoint topic clusters; w2v should embed same-topic words closer
+    rng = np.random.RandomState(0)
+    topic_a = ["apple", "banana", "cherry", "fruit"]
+    topic_b = ["gear", "engine", "wheel", "motor"]
+    docs = []
+    for _ in range(120):
+        t = topic_a if rng.rand() < 0.5 else topic_b
+        docs.append((" ".join(rng.choice(t, 6)),))
+    train = Word2VecTrainBatchOp(selected_col="doc", vector_size=16,
+                                 min_count=1, num_iter=12, window=3,
+                                 learning_rate=0.05, batch_size=128,
+                                 seed=3).link_from(MemSourceBatchOp(docs, ["doc"]))
+    model = train.get_output_table()
+    vecs = {w: np.asarray(v.data) for w, v in zip(model.col("word"), model.col("vec"))}
+
+    def cos(a, b):
+        return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12))
+
+    within = cos(vecs["apple"], vecs["banana"])
+    across = cos(vecs["apple"], vecs["engine"])
+    assert within > across
+
+    pred = Word2VecPredictBatchOp(selected_col="doc", output_col="emb").link_from(
+        train, MemSourceBatchOp([("apple banana",), ("engine wheel",)], ["doc"]))
+    embs = pred.get_output_table().col("emb")
+    assert isinstance(embs[0], DenseVector) and embs[0].size() == 16
+
+
+def test_string_similarity_metrics():
+    assert levenshtein("kitten", "sitting") == 3
+    assert levenshtein_sim("abc", "abc") == 1.0
+    assert lcs("ABCBDAB", "BDCABA") == 4
+    assert jaccard_sim("abcd", "abcd") == 1.0
+    assert 0 <= cosine_sim("hello world", "hello there") <= 1
+
+    t = MemSourceBatchOp([("kitten", "sitting"), ("same", "same")], ["a", "b"])
+    op = StringSimilarityPairwiseBatchOp(
+        selected_cols=["a", "b"], metric="LEVENSHTEIN",
+        output_col="d").link_from(t)
+    assert list(op.get_output_table().col("d")) == [3.0, 0.0]
+
+    txt = MemSourceBatchOp([("good day to you", "good day to me")], ["a", "b"])
+    ts = TextSimilarityPairwiseBatchOp(selected_cols=["a", "b"],
+                                       metric="LCS", output_col="d").link_from(txt)
+    assert ts.get_output_table().col("d")[0] == 3.0  # 3 common tokens
+
+
+def test_lsh_join_and_topn():
+    rng = np.random.RandomState(4)
+    base = rng.randn(20, 8)
+    left_rows = [(i, DenseVector(base[i])) for i in range(20)]
+    # rights = slightly perturbed lefts
+    right_rows = [(100 + i, DenseVector(base[i] + 0.01 * rng.randn(8)))
+                  for i in range(20)]
+    left = MemSourceBatchOp(left_rows, ["lid", "vec"])
+    right = MemSourceBatchOp(right_rows, ["rid", "vec"])
+    join = ApproxVectorSimilarityJoinLSHBatchOp(
+        left_col="vec", right_col="vec", left_id_col="lid", right_id_col="rid",
+        distance_threshold=0.5).link_from(left, right)
+    t = join.get_output_table()
+    pairs = {(int(a), int(b)) for a, b in zip(t.col("lid"), t.col("rid"))}
+    hits = sum((i, 100 + i) in pairs for i in range(20))
+    assert hits >= 15  # LSH recall of the true near-duplicates
+
+    topn = ApproxVectorSimilarityTopNLSHBatchOp(
+        left_col="vec", right_col="vec", left_id_col="lid", right_id_col="rid",
+        top_n=1).link_from(left, right)
+    tt = topn.get_output_table()
+    ok = sum(int(b) == int(a) + 100 for a, b in zip(tt.col("lid"), tt.col("rid")))
+    assert ok >= 15
+
+
+def test_lsh_jaccard_dense_vectors():
+    # regression: dense vectors must use their true nonzero sets
+    left = MemSourceBatchOp([(0, DenseVector([1.0, 0.0, 1.0, 0.0]))], ["lid", "v"])
+    right = MemSourceBatchOp([(0, DenseVector([0.0, 1.0, 1.0, 0.0])),
+                              (1, DenseVector([1.0, 0.0, 1.0, 0.0]))], ["rid", "v"])
+    join = ApproxVectorSimilarityJoinLSHBatchOp(
+        left_col="v", right_col="v", left_id_col="lid", right_id_col="rid",
+        metric="JACCARD", distance_threshold=1.0).link_from(left, right)
+    t = join.get_output_table()
+    dist = {int(r): d for r, d in zip(t.col("rid"), t.col("distance"))}
+    assert dist.get(1) == 0.0                       # identical support
+    assert 1 not in dist or dist[1] == 0.0
+    if 0 in dist:
+        assert abs(dist[0] - 2.0 / 3.0) < 1e-12     # |{0,2}∩{1,2}|=1, |∪|=3
+
+
+def test_nlp_pipeline():
+    from alink_tpu.pipeline import Pipeline
+    from alink_tpu.pipeline.nlp import (DocCountVectorizer, Tokenizer,
+                                        StopWordsRemover)
+    p = Pipeline(
+        Tokenizer(selected_col="sentence"),
+        StopWordsRemover(selected_col="sentence"),
+        DocCountVectorizer(selected_col="sentence", output_col="vec",
+                           feature_type="TF"))
+    model = p.fit(_src())
+    out = model.transform(_src()).get_output_table()
+    assert isinstance(out.col("vec")[0], SparseVector)
+
+
+def test_nlp_stream_ops():
+    from alink_tpu.operator.base import StreamOperator
+    from alink_tpu.operator.stream import (CollectSinkStreamOp,
+                                           MemSourceStreamOp,
+                                           TokenizerStreamOp)
+    src = MemSourceStreamOp(list(_DOCS), ["sentence"], batch_size=2)
+    tok = TokenizerStreamOp(selected_col="sentence").link_from(src)
+    sink = CollectSinkStreamOp().link_from(tok)
+    StreamOperator.execute()
+    out = sink.get_and_remove_values()
+    assert out.col("sentence")[0] == "that is an english book"
