@@ -73,6 +73,14 @@ class ComContext:
     def remove_obj(self, name: str):
         self._carry.pop(name, None)
 
+    # -- communication ---------------------------------------------------
+    def all_reduce_sum(self, value):
+        """Inline psum of a value pytree (communication/AllReduce.java:85-120
+        for the common in-stage case; the stage-based ``AllReduce`` class
+        remains for queue-structured use)."""
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(x, self.AXIS), value)
+
     # -- randomness ------------------------------------------------------
     def rng_key(self):
         """Per-worker, per-step PRNG key (mini-batch SGD sampling etc.)."""

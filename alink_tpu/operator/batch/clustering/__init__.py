@@ -1,1 +1,2 @@
 from .kmeans_ops import KMeansTrainBatchOp, KMeansPredictBatchOp
+from .lda_ops import LdaTrainBatchOp, LdaPredictBatchOp

@@ -17,3 +17,21 @@ class KMeans(Trainer, _KMeansParams, HasPredictionCol, HasReservedCols):
     TRAIN_OP_CLS = KMeansTrainBatchOp
     MODEL_CLS = KMeansModel
     PREDICTION_DISTANCE_COL = KMeansPredictBatchOp.PREDICTION_DISTANCE_COL
+
+
+from ..operator.batch.clustering.lda_ops import (LdaModelMapper,  # noqa: E402
+                                                 LdaTrainBatchOp, _LdaTrainParams)
+from ..params.shared import HasPredictionDetailCol  # noqa: E402
+
+
+class LdaModel(MapModel, HasPredictionCol, HasPredictionDetailCol,
+               HasReservedCols):
+    """reference: pipeline/clustering/LdaModel.java"""
+    MAPPER_CLS = LdaModelMapper
+
+
+class Lda(Trainer, _LdaTrainParams, HasPredictionCol, HasPredictionDetailCol,
+          HasReservedCols):
+    """reference: pipeline/clustering/Lda.java"""
+    TRAIN_OP_CLS = LdaTrainBatchOp
+    MODEL_CLS = LdaModel

@@ -1,0 +1,72 @@
+"""LDA tests — synthetic two-topic corpus; both EM and online methods must
+recover the topic split (reference test style: LdaTrainBatchOpTest asserts
+fit+transform end-to-end)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.batch.clustering.lda_ops import (
+    LdaModelDataConverter, LdaPredictBatchOp, LdaTrainBatchOp)
+from alink_tpu.pipeline.base import Pipeline
+from alink_tpu.pipeline.clustering import Lda
+
+
+SPORT = ["ball game team win score play match goal",
+         "team play ball match score win",
+         "game win team goal ball score",
+         "match play goal win game team ball",
+         "score goal match team play win"]
+COOK = ["salt oil pan cook recipe dish flavor taste",
+        "recipe dish salt cook taste oil",
+        "cook pan flavor dish recipe salt",
+        "taste oil cook salt dish pan recipe",
+        "flavor dish taste cook oil recipe"]
+
+
+def _src():
+    docs = []
+    for i in range(4):
+        docs += [(s + f" extra{i}",) for s in SPORT]
+        docs += [(c + f" extra{i}",) for c in COOK]
+    return MemSourceBatchOp(docs, "doc STRING"), len(SPORT) * 4
+
+
+@pytest.mark.parametrize("method", ["em", "online"])
+def test_lda_separates_topics(method):
+    src, n_sport = _src()
+    train = LdaTrainBatchOp(selected_col="doc", topic_num=2, method=method,
+                            num_iter=30, subsampling_rate=0.8,
+                            seed=7).link_from(src)
+    model = LdaModelDataConverter().load_model(train.get_output_table())
+    assert model.gamma.shape[1] == 2
+    assert len(model.vocab) > 10
+    assert model.log_perplexity > 0
+
+    pred = LdaPredictBatchOp(selected_col="doc", prediction_col="topic",
+                             prediction_detail_col="detail").link_from(train, src)
+    out = pred.collect_mtable()
+    topics = np.asarray(out.col("topic"))
+    sport_topics, cook_topics = topics[:n_sport], topics[n_sport:]
+    # interleaved blocks of 5; majority label per group must differ
+    s_maj = np.bincount(topics[np.arange(len(topics)) % 10 < 5], minlength=2).argmax()
+    c_maj = np.bincount(topics[np.arange(len(topics)) % 10 >= 5], minlength=2).argmax()
+    assert s_maj != c_maj
+    det = json.loads(out.col("detail")[0])
+    assert len(det) == 2 and abs(sum(det) - 1.0) < 1e-3
+
+
+def test_lda_pipeline_roundtrip(tmp_path):
+    src, _ = _src()
+    lda = Lda(selected_col="doc", topic_num=2, num_iter=15, seed=3,
+              prediction_col="topic")
+    pm = Pipeline(lda).fit(src)
+    out1 = pm.transform(src).collect_mtable()
+    path = str(tmp_path / "lda_model")
+    pm.save(path)
+    from alink_tpu.pipeline.base import PipelineModel
+    out2 = PipelineModel.load(path).transform(src).collect_mtable()
+    assert np.array_equal(np.asarray(out1.col("topic")),
+                          np.asarray(out2.col("topic")))
