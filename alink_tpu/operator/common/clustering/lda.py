@@ -31,7 +31,6 @@ TPU-first changes vs the reference:
 
 from __future__ import annotations
 
-import math
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -127,7 +126,7 @@ def online_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
     Each superstep every worker samples ``subsample`` of its resident doc
     shard, runs the batched E-step, and the psum'd sufficient stats drive
     one natural-gradient lambda update with rho_t = (tau0+t)^-kappa.
-    Returns (lambda (k,V), alpha (k,), loglik, perplexity).
+    Returns (lambda (k,V), alpha (k,), loglik, log_perplexity).
     """
     if alpha <= 0:
         alpha = 1.0 / k
@@ -179,9 +178,10 @@ def online_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
             q = -n_sel * jax.scipy.special.polygamma(1, avec)
             z = n_sel * jax.scipy.special.polygamma(1, avec.sum())
             b = (grad / q).sum() / (1.0 / z + (1.0 / q).sum())
-            danger = (avec - rho * (grad - b) / q) <= 0
-            avec_new = jnp.where(danger.any(), avec,
-                                 avec - rho * (grad - b) / q)
+            # reject the step if any component would go non-positive OR the
+            # minibatch was empty (n_sel=0 makes q=-0 -> b=NaN)
+            danger = ((avec - rho * (grad - b) / q) <= 0).any() | (n_sel < 1)
+            avec_new = jnp.where(danger, avec, avec - rho * (grad - b) / q)
             ctx.put_obj("alpha_vec", avec_new)
         # corpus bound: score the *fitted* minibatch docs and scale to the
         # corpus (the standard SVI estimate) — unselected docs' gamma is
@@ -198,8 +198,8 @@ def online_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
     lam = res.get("lambda")
     avec = res.get("alpha_vec")
     score = float(res.get("score"))
-    perp = math.exp(-score / max(total_words, 1.0))
-    return np.asarray(lam), np.asarray(avec), score, perp
+    log_perp = -score / max(total_words, 1.0)
+    return np.asarray(lam), np.asarray(avec), score, log_perp
 
 
 def em_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
@@ -213,7 +213,7 @@ def em_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
     matrix. Doc-topic state stays partition-resident in the carry (the
     analogue of the reference's per-task topic assignments cached in
     SessionSharedObjs). Returns (wordTopicCounts (V,k), topicCounts (k,),
-    alpha, beta, loglik, perplexity).
+    alpha, beta, loglik, log_perplexity).
 
     alpha/beta here are the *actual* Dirichlet priors (the reference's
     Gibbs path shifts its defaults by +1 for the collapsed predictive
@@ -260,8 +260,8 @@ def em_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
     res = q.exec()
     wt = np.asarray(res.get("wt"))                                # (k, V)
     score = float(res.get("score"))
-    perp = math.exp(-score / max(total_words, 1.0))
-    return wt.T, wt.sum(1), alpha, beta, score, perp
+    log_perp = -score / max(total_words, 1.0)
+    return wt.T, wt.sum(1), alpha, beta, score, log_perp
 
 
 def lda_infer(ids: np.ndarray, cnts: np.ndarray, word_topic: np.ndarray,

@@ -1,3 +1,7 @@
 from .base import (Pipeline, PipelineModel, PipelineStage, Estimator, Transformer,
                    Model, MapModel, Trainer, LocalPredictor)
 from . import classification, regression
+from .tuning import (ParamGrid, GridSearchCV, GridSearchTVSplit,
+                     BinaryClassificationTuningEvaluator,
+                     MultiClassClassificationTuningEvaluator,
+                     RegressionTuningEvaluator, ClusterTuningEvaluator)

@@ -1,0 +1,97 @@
+"""FpGrowth / PrefixSpan tests — hand-checkable fixtures (reference test
+style: FpGrowthBatchOpTest/PrefixSpanBatchOpTest assert itemset+rule rows)."""
+
+import numpy as np
+
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.batch.associationrule import (FpGrowthBatchOp,
+                                                      PrefixSpanBatchOp)
+from alink_tpu.operator.common.associationrule import (fp_growth, prefix_span)
+
+
+def test_fp_growth_kernel():
+    # classic example: {0,1} in 3 of 4 transactions
+    trans = [[0, 1], [0, 1, 2], [0, 1, 3], [0, 2]]
+    pats = fp_growth(trans, min_support=2)
+    assert pats[(0,)] == 4
+    assert pats[(1,)] == 3
+    assert pats[(0, 1)] == 3
+    assert pats[(0, 2)] == 2
+    assert (1, 2) not in pats
+    # max_pattern_length truncates
+    assert all(len(p) <= 1 for p in fp_growth(trans, 2, max_pattern_length=1))
+
+
+def test_fp_growth_op_itemsets_and_rules():
+    rows = [("A,B,C,D",), ("B,C,E",), ("A,B,C,E",), ("B,D,E",), ("A,B,C,D",)]
+    op = FpGrowthBatchOp(items_col="items", min_support_count=3,
+                         min_confidence=0.6).link_from(
+        MemSourceBatchOp(rows, "items STRING"))
+    out = op.collect_mtable()
+    sup = {r[0]: r[1] for r in out.to_rows()}
+    assert sup["B"] == 5 and sup["C"] == 4 and sup["B,C"] == 4
+    assert sup["A,B,C"] == 3 and "D,E" not in sup
+    rules = op.get_side_output(0).collect_mtable()
+    rmap = {r[0]: r for r in rules.to_rows()}
+    # C=>B has confidence 4/4=1.0, lift = 1.0/(5/5)=1.0
+    assert "C=>B" in rmap
+    _, cnt, lift, sup_pct, conf, tc = rmap["C=>B"]
+    assert conf == 1.0 and abs(lift - 1.0) < 1e-9 and tc == 4
+    assert abs(sup_pct - 0.8) < 1e-9
+
+
+def test_prefix_span_kernel():
+    # sequences of single-item elements
+    seqs = [[frozenset("a"), frozenset("b"), frozenset("c")],
+            [frozenset("a"), frozenset("c")],
+            [frozenset("a"), frozenset("b")],
+            [frozenset("b"), frozenset("c")]]
+    pats = prefix_span(seqs, min_support=2)
+    f = lambda *els: tuple(frozenset(e) for e in els)
+    assert pats[f("a")] == 3
+    assert pats[f("a", "b")] == 2
+    assert pats[f("a", "c")] == 2
+    assert pats[f("b", "c")] == 2
+    assert f("c", "a") not in pats
+    # multi-item element containment
+    seqs2 = [[frozenset("ab"), frozenset("c")],
+             [frozenset({"a", "b"}), frozenset("c")],
+             [frozenset("a"), frozenset("c")]]
+    pats2 = prefix_span(seqs2, min_support=2)
+    assert pats2[(frozenset({"a", "b"}),)] == 2
+    assert pats2[(frozenset({"a", "b"}), frozenset("c"))] == 2
+
+
+def test_prefix_span_op():
+    rows = [("a;a,b,c;a,c;d;c,f",), ("a,d;c;b,c;a,e",),
+            ("e,f;a,b;d,f;c;b",), ("e;g;a,f;c;b;c",)]
+    op = PrefixSpanBatchOp(items_col="seq", min_support_count=3,
+                           min_confidence=0.5).link_from(
+        MemSourceBatchOp(rows, "seq STRING"))
+    out = op.collect_mtable()
+    sup = {r[0]: r[1] for r in out.to_rows()}
+    assert sup["a"] == 4 and sup["b"] == 4 and sup["c"] == 4
+    assert sup["a;c"] == 4 and sup["a;c;b"] == 3 and sup["a;b"] == 4
+    rules = op.get_side_output(0).collect_mtable()
+    rmap = {r[0]: r for r in rules.to_rows()}
+    assert "a;c=>b" in rmap
+    _, chain, supp, conf, tc = rmap["a;c=>b"]
+    assert chain == 3 and tc == 3 and abs(conf - 0.75) < 1e-9
+
+
+def test_sos_outlier():
+    import numpy as np
+    from alink_tpu.operator.batch.outlier import SosBatchOp
+    rng = np.random.RandomState(0)
+    pts = rng.randn(40, 2) * 0.5
+    pts = np.vstack([pts, [[8.0, 8.0]]])          # one clear outlier
+    rows = [(f"{x} {y}",) for x, y in pts]
+    src_rows = rows
+    from alink_tpu.operator.batch.source import MemSourceBatchOp
+    op = SosBatchOp(vector_col="vec", prediction_col="score",
+                    perplexity=5.0).link_from(
+        MemSourceBatchOp(src_rows, "vec STRING"))
+    out = op.collect_mtable()
+    s = np.asarray(out.col("score"))
+    assert s.argmax() == 40          # the planted outlier scores highest
+    assert s[40] > 0.9 and np.median(s[:40]) < s[40]
