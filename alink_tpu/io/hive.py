@@ -1,0 +1,62 @@
+"""Hive connector (gated).
+
+Re-design of connectors/connector-hive (HiveDB.java, HiveBatchSource,
+Hive{Source,Sink}BatchOp). No Hive client ships in this image; ``HiveDB``
+binds lazily to ``pyhive`` and raises a clear ImportError otherwise —
+gated, not stubbed: with pyhive installed the DB-API path below is live,
+since HiveDB reuses the JdbcDB query/write machinery unchanged.
+"""
+
+from __future__ import annotations
+
+from ..common.params import ParamInfo
+from ..operator.base import BatchOperator
+from ..operator.batch.sink.sinks import DBSinkBatchOp
+from ..operator.batch.source.sources import DBSourceBatchOp
+from .db import JdbcDB
+
+
+class HiveDB(JdbcDB):
+    """reference: connectors/connector-hive HiveDB.java"""
+
+    PARAM_STYLE = "%s"
+
+    def __init__(self, name: str, host: str, port: int = 10000,
+                 database: str = "default", username: str = None):
+        def factory():
+            try:
+                from pyhive import hive
+            except ImportError as e:
+                raise ImportError(
+                    "HiveDB needs pyhive (pip install 'pyhive[hive]'); "
+                    "not installed in this image") from e
+            return hive.Connection(host=host, port=port, database=database,
+                                   username=username)
+
+        super().__init__(name, factory)
+        self.database = database
+
+    def list_table_names(self):
+        return [str(r[0]) for r in self.query("SHOW TABLES").to_rows()]
+
+
+class _HasHiveDB:
+    """Hive connection params + shared db resolution."""
+    HOST = ParamInfo("host", str, optional=False)
+    PORT = ParamInfo("port", int, default=10000)
+    DB_NAME = ParamInfo("db_name", str, default="default")
+    USERNAME = ParamInfo("username", str)
+
+    def _make_db(self):
+        p = self.params._m
+        return HiveDB(f"hive:{p.get('db_name', 'default')}", p["host"],
+                      int(p.get("port", 10000)),
+                      p.get("db_name", "default"), p.get("username"))
+
+
+class HiveSourceBatchOp(_HasHiveDB, DBSourceBatchOp):
+    """reference: connector-hive HiveSourceBatchOp"""
+
+
+class HiveSinkBatchOp(_HasHiveDB, DBSinkBatchOp):
+    """reference: connector-hive HiveSinkBatchOp"""

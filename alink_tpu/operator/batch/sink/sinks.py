@@ -52,3 +52,26 @@ class MemSinkBatchOp(BatchOperator):
         self._output = in_op.get_output_table()
         self.rows = self._output.to_rows()
         return self
+
+
+from ....io.db import HasDB as _HasDB
+from ....io.db import HasMySqlDB as _HasMySqlDB
+
+
+class DBSinkBatchOp(_HasDB, BatchOperator):
+    """Write the input table into a registered BaseDB
+    (reference: batch/sink/DBSinkBatchOp.java)."""
+    OUTPUT_TABLE_NAME = ParamInfo("output_table_name", str, optional=False)
+    OVERWRITE_SINK = ParamInfo("overwrite_sink", bool, default=False)
+
+    def link_from(self, in_op: BatchOperator) -> "DBSinkBatchOp":
+        t = in_op.get_output_table()
+        self._db().write_table(self.params._m["output_table_name"], t,
+                               append=not self.params._m.get("overwrite_sink",
+                                                             False))
+        self.set_output_table(t)
+        return self
+
+
+class MySqlSinkBatchOp(_HasMySqlDB, DBSinkBatchOp):
+    """reference: batch/sink/MySqlSinkBatchOp.java"""

@@ -115,3 +115,31 @@ class RandomTableSourceBatchOp(BatchOperator):
 
     def link_from(self, *inputs):
         raise RuntimeError("RandomTableSourceBatchOp is a source")
+
+
+from ....io.db import HasDB as _HasDB
+from ....io.db import HasMySqlDB as _HasMySqlDB
+
+
+class DBSourceBatchOp(_HasDB, BatchOperator):
+    """Read a table (or free query) from a registered BaseDB
+    (reference: batch/source/DBSourceBatchOp.java over common/io/BaseDB)."""
+    INPUT_TABLE_NAME = ParamInfo("input_table_name", str, "table to read")
+    QUERY = ParamInfo("query", str, "free-form SELECT overriding table name")
+
+    def link_from(self, *inputs) -> "DBSourceBatchOp":
+        q = self.params._m.get("query")
+        db = self._db()
+        self.set_output_table(db.query(q) if q else
+                              db.read_table(self.params._m["input_table_name"]))
+        return self
+
+    # sources are roots: allow use without link_from
+    def get_output_table(self):
+        if self._output is None:
+            self.link_from()
+        return self._output
+
+
+class MySqlSourceBatchOp(_HasMySqlDB, DBSourceBatchOp):
+    """reference: batch/source/MySqlSourceBatchOp.java"""

@@ -1,0 +1,102 @@
+"""Format-conversion matrix + JsonValue tests (reference
+batch/dataproc/format/* and JsonValueBatchOp tests)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import alink_tpu.operator.batch.dataproc.format as F
+from alink_tpu.operator.batch.dataproc import JsonValueBatchOp
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.stream.dataproc.format import (JsonValueStreamOp,
+                                                       KvToJsonStreamOp)
+from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+from alink_tpu.operator.stream.sink.sinks import CollectSinkStreamOp
+from alink_tpu.operator.base import StreamOperator
+
+
+def _src():
+    return MemSourceBatchOp([(1, "a", 0.5), (2, "b", 1.5)],
+                            "id LONG, name STRING, score DOUBLE")
+
+
+def test_matrix_completeness():
+    # 5 formats pairwise (20) + 5 *ToTriple + 5 TripleTo* + AnyToTriple
+    assert len(F.FORMAT_OPS) == 31
+    for a in ("Columns", "Csv", "Json", "Kv", "Vector"):
+        for b in ("Columns", "Csv", "Json", "Kv", "Vector", "Triple"):
+            if a != b:
+                assert f"{a}To{b}BatchOp" in F.FORMAT_OPS, (a, b)
+        assert f"TripleTo{a}BatchOp" in F.FORMAT_OPS
+
+
+def test_columns_json_roundtrip():
+    j = F.ColumnsToJsonBatchOp(selected_cols=["name", "score"], json_col="js",
+                               reserved_cols=["id"]).link_from(_src())
+    assert json.loads(j.collect_mtable().col("js")[0]) == {"name": "a",
+                                                           "score": 0.5}
+    back = F.JsonToColumnsBatchOp(
+        json_col="js", schema_str="name STRING, score DOUBLE").link_from(j)
+    out = back.collect_mtable()
+    assert list(out.col("name")) == ["a", "b"]
+    np.testing.assert_allclose(np.asarray(out.col("score")), [0.5, 1.5])
+
+
+def test_kv_vector_csv():
+    kv = MemSourceBatchOp([("0:1.5,3:2.0",), ("1:7.0",)], "kv STRING")
+    v = F.KvToVectorBatchOp(kv_col="kv", vector_col="vec",
+                            vector_size=4).link_from(kv)
+    assert v.collect_mtable().col("vec")[0] == "$4$0:1.5 3:2.0"
+    back = F.VectorToKvBatchOp(vector_col="vec", kv_col="kv2").link_from(v)
+    assert back.collect_mtable().col("kv2")[1] == "1:7.0"
+    csv = F.KvToCsvBatchOp(kv_col="kv", csv_col="c",
+                           schema_str="f0 DOUBLE, f1 DOUBLE").link_from(kv)
+    # kv keys 0/1 -> schema names f0/f1 not present => empty fields
+    assert csv.get_schema().names[-1] == "c"
+
+
+def test_triple_roundtrip():
+    tri = F.ColumnsToTripleBatchOp(selected_cols=["name", "score"]).link_from(_src())
+    rows = tri.collect_mtable().to_rows()
+    assert ("column" in tri.get_schema().names and len(rows) == 4)
+    back = F.TripleToJsonBatchOp(triple_row_col="row", triple_column_col="column",
+                                 triple_value_col="value",
+                                 json_col="js").link_from(tri)
+    out = back.collect_mtable()
+    assert json.loads(out.col("js")[0])["name"] == "a"
+
+
+def test_json_value_batch_and_stream():
+    rows = [('{"a": {"b": [1, 2, 3]}, "c": "x"}',),
+            ('{"a": {"b": [9]}, "c": "y"}',)]
+    src = MemSourceBatchOp(rows, "js STRING")
+    op = JsonValueBatchOp(selected_col="js", json_path=["$.a.b[0]", "$.c"],
+                          output_cols=["b0", "c"]).link_from(src)
+    out = op.collect_mtable()
+    assert list(out.col("b0")) == ["1", "9"]
+    assert list(out.col("c")) == ["x", "y"]
+    # missing path errors unless skip_failed
+    with pytest.raises(ValueError):
+        JsonValueBatchOp(selected_col="js", json_path=["$.zz"],
+                         output_cols=["z"]).link_from(src)
+    ok = JsonValueBatchOp(selected_col="js", json_path=["$.zz"],
+                          output_cols=["z"], skip_failed=True).link_from(src)
+    assert list(ok.collect_mtable().col("z")) == [None, None]
+
+    s = MemSourceStreamOp(rows, "js STRING", batch_size=1)
+    sop = JsonValueStreamOp(selected_col="js", json_path=["$.c"],
+                            output_cols=["c"]).link_from(s)
+    sink = CollectSinkStreamOp().link_from(sop)
+    StreamOperator.execute()
+    got = sink.get_and_remove_values().to_rows()
+    assert [r[-1] for r in got] == ["x", "y"]
+
+
+def test_kv_to_json_stream():
+    s = MemSourceStreamOp([("k:1",), ("k:2",)], "kv STRING", batch_size=1)
+    sop = KvToJsonStreamOp(kv_col="kv", json_col="js").link_from(s)
+    sink = CollectSinkStreamOp().link_from(sop)
+    StreamOperator.execute()
+    got = sink.get_and_remove_values().to_rows()
+    assert json.loads(got[0][-1]) == {"k": "1"}

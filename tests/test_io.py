@@ -1,0 +1,112 @@
+"""IO layer tests: DB source/sink over sqlite, retract sink, DirectReader
+bridges, Kafka connector against the in-memory fake (reference connector
+tests run builder-config without a live broker, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from alink_tpu.io.db import BaseDB, SqliteDB
+from alink_tpu.io.directreader import (DbDataBridge, DirectReader,
+                                       DirectReaderPropertiesStore,
+                                       MemoryDataBridge)
+from alink_tpu.io.kafka import FakeKafka, KafkaSinkStreamOp, KafkaSourceStreamOp
+from alink_tpu.operator.base import StreamOperator
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.batch.source.sources import DBSourceBatchOp
+from alink_tpu.operator.batch.sink.sinks import DBSinkBatchOp
+from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+from alink_tpu.operator.stream.sink.sinks import (CollectSinkStreamOp,
+                                                  DBSinkStreamOp,
+                                                  JdbcRetractSinkStreamOp)
+
+
+def _rows():
+    return MemSourceBatchOp([(1, "a", 0.5), (2, "b", 1.5), (3, "c", 2.5)],
+                            "id LONG, name STRING, score DOUBLE")
+
+
+def test_db_sink_source_roundtrip():
+    db = SqliteDB("t1")
+    DBSinkBatchOp(db=db, output_table_name="people").link_from(_rows())
+    out = DBSourceBatchOp(db=db, input_table_name="people").collect_mtable()
+    assert out.num_rows == 3 and list(out.col("name")) == ["a", "b", "c"]
+    q = DBSourceBatchOp(db=db, query="SELECT id, score FROM people WHERE score > 1"
+                        ).collect_mtable()
+    assert q.num_rows == 2 and q.col_names == ["id", "score"]
+    # overwrite vs append
+    DBSinkBatchOp(db=db, output_table_name="people").link_from(_rows())
+    assert db.read_table("people").num_rows == 6
+    DBSinkBatchOp(db=db, output_table_name="people",
+                  overwrite_sink=True).link_from(_rows())
+    assert db.read_table("people").num_rows == 3
+    # registry lookup by name
+    assert BaseDB.of("t1") is db
+
+
+def test_stream_db_and_retract_sinks():
+    db = SqliteDB("t2")
+    s = MemSourceStreamOp([(1, 0.1), (2, 0.2), (1, 0.9), (2, 0.8)],
+                          "k LONG, v DOUBLE", batch_size=2)
+    DBSinkStreamOp(db=db, output_table_name="raw").link_from(s)
+    StreamOperator.execute()
+    assert db.read_table("raw").num_rows == 4
+
+    s2 = MemSourceStreamOp([(1, 0.1), (2, 0.2), (1, 0.9), (2, 0.8)],
+                           "k LONG, v DOUBLE", batch_size=2)
+    JdbcRetractSinkStreamOp(db=db, output_table_name="latest",
+                            key_cols=["k"]).link_from(s2)
+    StreamOperator.execute()
+    out = db.read_table("latest")
+    assert out.num_rows == 2
+    got = dict(zip([int(k) for k in out.col("k")],
+                   [float(v) for v in out.col("v")]))
+    assert got == {1: 0.9, 2: 0.8}
+
+    # same key twice within ONE micro-batch: last write wins
+    s3 = MemSourceStreamOp([(7, 0.1), (7, 0.7)], "k LONG, v DOUBLE",
+                           batch_size=2)
+    JdbcRetractSinkStreamOp(db=db, output_table_name="latest",
+                            key_cols=["k"]).link_from(s3)
+    StreamOperator.execute()
+    out2 = db.query("SELECT v FROM latest WHERE k = 7")
+    assert out2.num_rows == 1 and abs(float(out2.col("v")[0]) - 0.7) < 1e-12
+
+
+def test_direct_reader_policies():
+    src = _rows()
+    bridge = DirectReader.collect(src)
+    assert isinstance(bridge, MemoryDataBridge)
+    assert len(bridge.read()) == 3
+    assert len(bridge.read(lambda r: r[0] > 1)) == 2
+
+    db = SqliteDB("t3")
+    DirectReaderPropertiesStore.set_properties({
+        "direct.reader.policy": "db", "direct.reader.db.name": "t3"})
+    try:
+        bridge2 = DirectReader.collect(src)
+        assert isinstance(bridge2, DbDataBridge)
+        assert bridge2.read_mtable().num_rows == 3
+    finally:
+        DirectReaderPropertiesStore.set_properties({})
+
+
+def test_kafka_fake_roundtrip():
+    broker = FakeKafka()
+    s = MemSourceStreamOp([(1, "x"), (2, "y")], "id LONG, tag STRING",
+                          batch_size=1)
+    KafkaSinkStreamOp(producer=broker, topic="t",
+                      format="json").link_from(s)
+    StreamOperator.execute()
+    assert len(broker.topics["t"]) == 2
+
+    src = KafkaSourceStreamOp(consumer=broker, topic="t", format="json",
+                              schema_str="id LONG, tag STRING")
+    sink = CollectSinkStreamOp().link_from(src)
+    StreamOperator.execute()
+    out = sink.get_and_remove_values()
+    assert out.num_rows == 2 and list(out.col("tag")) == ["x", "y"]
+
+
+def test_kafka_gated_without_client():
+    with pytest.raises(ImportError):
+        KafkaSourceStreamOp(topic="t", schema_str="a LONG")
