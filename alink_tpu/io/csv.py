@@ -34,9 +34,42 @@ def _parse_cell(s: str, type_: str):
     return s
 
 
+def _read_csv_native(path: str, schema: TableSchema, field_delimiter: str,
+                     quote_char: str, ignore_first_line: bool):
+    """Numeric-only fast path through the native parser (parser.cpp
+    csv_dims/csv_fill). Returns an MTable or None to fall back."""
+    if len(field_delimiter) != 1 or path.startswith(("http://", "https://")):
+        return None
+    num = {AlinkTypes.DOUBLE, AlinkTypes.FLOAT, AlinkTypes.LONG, AlinkTypes.INT}
+    if not all(t.upper() in num for t in schema.types):
+        return None
+    from ..native import parse_numeric_csv_bytes
+    with open(path, "rb") as f:
+        data = f.read()
+    if quote_char.encode() in data:
+        return None
+    if ignore_first_line:
+        nl = data.find(b"\n")
+        data = data[nl + 1:] if nl >= 0 else b""
+    m = parse_numeric_csv_bytes(data, field_delimiter)
+    if m is None or m.shape[1] != len(schema.names) or np.isnan(m).any():
+        return None  # missing cells need the None-aware python path
+    cols = {}
+    for j, (n, t) in enumerate(zip(schema.names, schema.types)):
+        c = m[:, j]
+        if t.upper() in (AlinkTypes.LONG, AlinkTypes.INT):
+            c = c.astype(np.int64)
+        cols[n] = c
+    return MTable(cols, schema)
+
+
 def read_csv(path: str, schema: TableSchema, field_delimiter: str = ",",
              quote_char: str = '"', skip_blank: bool = True,
              ignore_first_line: bool = False) -> MTable:
+    fast = _read_csv_native(path, schema, field_delimiter, quote_char,
+                            ignore_first_line)
+    if fast is not None:
+        return fast
     if path.startswith(("http://", "https://")):
         raw = urlopen(path).read().decode("utf-8")  # pragma: no cover - no egress in CI
         f = io.StringIO(raw)
@@ -112,7 +145,28 @@ def format_libsvm_rows(table: MTable, label_col: str, vector_col: str,
 
 def read_libsvm(path: str, start_index: int = 1) -> MTable:
     """LibSVM format -> (label DOUBLE, features SPARSE_VECTOR)
-    (reference common/io/LibSvmSourceBatchOp)."""
+    (reference common/io/LibSvmSourceBatchOp).
+
+    Parses through the native C++ two-pass parser
+    (alink_tpu/native/parser.cpp svm_count/svm_fill) when available;
+    falls back to the pure-Python loop.
+    """
+    from ..common.vector import SparseVector
+    from ..native import get_lib, parse_libsvm_bytes
+    if get_lib() is not None:
+        with open(path, "rb") as f:
+            data = f.read()
+        labels_a, indptr, indices, values = parse_libsvm_bytes(data,
+                                                               start_index)
+        max_idx = int(indices.max()) + 1 if indices.size else 0
+        col = [SparseVector(max_idx, indices[indptr[i]:indptr[i + 1]],
+                            values[indptr[i]:indptr[i + 1]])
+               for i in range(len(labels_a))]
+        return MTable({"label": labels_a, "features": col},
+                      TableSchema(["label", "features"],
+                                  [AlinkTypes.DOUBLE,
+                                   AlinkTypes.SPARSE_VECTOR]))
+    # pure-Python fallback streams line-by-line (no whole-file slurp)
     labels: List[float] = []
     vecs = []
     max_idx = 0
@@ -130,7 +184,6 @@ def read_libsvm(path: str, start_index: int = 1) -> MTable:
             if idx:
                 max_idx = max(max_idx, max(idx) + 1)
             vecs.append((idx, val))
-    from ..common.vector import SparseVector
     col = [SparseVector(max_idx, i, v) for i, v in vecs]
     return MTable({"label": np.asarray(labels), "features": col},
                   TableSchema(["label", "features"],

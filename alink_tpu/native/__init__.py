@@ -1,0 +1,135 @@
+"""Native runtime — build-on-demand C++ parsers via ctypes.
+
+The shared library is compiled from ``parser.cpp`` with the system
+toolchain on first use and cached next to the source; set
+``ALINK_NO_NATIVE=1`` to force the pure-Python fallbacks (io/csv.py keeps
+working either way). ctypes + a C ABI replaces JNI (the reference loads
+netlib and its CSV fast path through JNI, common/linalg/BLAS.java:17-26;
+our BLAS story is XLA — the native layer is only for host-side IO).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "parser.cpp")
+_LIB_PATH = os.path.join(_HERE, "_parser.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    for cc in ("c++", "g++", "cc", "gcc"):
+        try:
+            r = subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+                 "-o", _LIB_PATH],
+                capture_output=True, timeout=120)
+            if r.returncode == 0:
+                return _LIB_PATH
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None when unavailable/disabled."""
+    global _lib, _tried
+    if os.environ.get("ALINK_NO_NATIVE"):
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        path = _LIB_PATH
+        if (not os.path.exists(path)
+                or os.path.getmtime(path) < os.path.getmtime(_SRC)):
+            path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        c = ctypes.c_char_p
+        i64 = ctypes.c_int64
+        pi64 = ctypes.POINTER(ctypes.c_int64)
+        pd = ctypes.POINTER(ctypes.c_double)
+        pi32 = ctypes.POINTER(ctypes.c_int32)
+        lib.svm_count.argtypes = [c, i64, pi64, pi64, pi64]
+        lib.svm_fill.argtypes = [c, i64, i64, pd, pi64, pi32, pd]
+        lib.csv_dims.argtypes = [c, i64, ctypes.c_char, pi64, pi64]
+        lib.csv_fill.argtypes = [c, i64, ctypes.c_char, i64, pd]
+        lib.vec_count.argtypes = [c, i64, pi64, pi64, pi64]
+        lib.vec_fill.argtypes = [c, i64, pi64, pi32, pd]
+        _lib = lib
+        return _lib
+
+
+def _p(arr, typ):
+    return arr.ctypes.data_as(ctypes.POINTER(typ))
+
+
+def parse_libsvm_bytes(data: bytes, start_index: int = 1
+                       ) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                           np.ndarray, np.ndarray]]:
+    """(labels, indptr, indices, values) CSR arrays, or None w/o native."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    nnz = ctypes.c_int64()
+    mx = ctypes.c_int64()
+    lib.svm_count(data, len(data), ctypes.byref(rows), ctypes.byref(nnz),
+                  ctypes.byref(mx))
+    labels = np.empty(rows.value, np.float64)
+    indptr = np.empty(rows.value + 1, np.int64)
+    indices = np.empty(nnz.value, np.int32)
+    values = np.empty(nnz.value, np.float64)
+    lib.svm_fill(data, len(data), start_index, _p(labels, ctypes.c_double),
+                 _p(indptr, ctypes.c_int64), _p(indices, ctypes.c_int32),
+                 _p(values, ctypes.c_double))
+    return labels, indptr, indices, values
+
+
+def parse_numeric_csv_bytes(data: bytes, delim: str = ","
+                            ) -> Optional[np.ndarray]:
+    """(rows, cols) float64 matrix with NaN for empty cells, or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    d = ctypes.c_char(delim.encode()[0:1])
+    lib.csv_dims(data, len(data), d, ctypes.byref(rows), ctypes.byref(cols))
+    out = np.empty((rows.value, cols.value), np.float64)
+    lib.csv_fill(data, len(data), d, cols.value, _p(out, ctypes.c_double))
+    return out
+
+
+def parse_vector_lines(data: bytes) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                                      np.ndarray, int]]:
+    """Batch-parse newline-separated sparse-vector literals into
+    (indptr, indices, values, dim) CSR arrays, or None w/o native."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    nnz = ctypes.c_int64()
+    mx = ctypes.c_int64()
+    lib.vec_count(data, len(data), ctypes.byref(rows), ctypes.byref(nnz),
+                  ctypes.byref(mx))
+    indptr = np.empty(rows.value + 1, np.int64)
+    indices = np.empty(nnz.value, np.int32)
+    values = np.empty(nnz.value, np.float64)
+    lib.vec_fill(data, len(data), _p(indptr, ctypes.c_int64),
+                 _p(indices, ctypes.c_int32), _p(values, ctypes.c_double))
+    return indptr, indices, values, int(mx.value)

@@ -254,8 +254,10 @@ class TripleToAnyBase(BatchOperator):
                                   optional=False)
     TRIPLE_VALUE_COL = ParamInfo("triple_value_col", str, "value column",
                                  optional=False)
-    # writer params (same descriptors as BaseFormatTransBatchOp)
-    RESERVED_COLS = BaseFormatTransBatchOp.RESERVED_COLS
+    # writer params (same descriptors as BaseFormatTransBatchOp).
+    # NOTE: no RESERVED_COLS — triples are grouped into rows, so the only
+    # passthrough identity is the row column itself; accepting the param
+    # and ignoring it would be a silent lie.
     CSV_COL = BaseFormatTransBatchOp.CSV_COL
     SCHEMA_STR = BaseFormatTransBatchOp.SCHEMA_STR
     CSV_FIELD_DELIMITER = BaseFormatTransBatchOp.CSV_FIELD_DELIMITER

@@ -25,6 +25,10 @@ def extract_design(table: MTable, feature_cols: Optional[Sequence[str]],
     {"kind": "sparse", "idx": (n,nnz), "val": (n,nnz)}, plus "dim".
     """
     if vector_col:
+        fast = _native_sparse_fast_path(table.col(vector_col), vector_size,
+                                        dtype)
+        if fast is not None:
+            return fast
         vecs = [VectorUtil.parse(v) for v in table.col(vector_col)]
         any_sparse = any(isinstance(v, SparseVector) for v in vecs)
         dim = vector_size or 0
@@ -45,6 +49,40 @@ def extract_design(table: MTable, feature_cols: Optional[Sequence[str]],
         raise ValueError("either feature_cols or vector_col must be set")
     X = table.numeric_block(list(feature_cols), dtype)
     return {"kind": "dense", "X": X, "dim": X.shape[1]}
+
+
+def _native_sparse_fast_path(col, vector_size, dtype) -> Optional[Dict]:
+    """Batch-parse string sparse-vector literals through the native parser
+    (alink_tpu/native/parser.cpp vec_count/vec_fill) when every value is a
+    "$n$i:v ..." / "i:v ..." literal — the Criteo-style hot path. Returns
+    the padded sparse design dict, or None to fall back to per-row parse.
+    """
+    vals = list(col)
+    if not vals:
+        return None
+    for v in vals[: min(len(vals), 8)]:
+        if not isinstance(v, str) or (":" not in v):
+            return None
+    if not all(isinstance(v, str) and ":" in v for v in vals):
+        return None
+    from ....native import parse_vector_lines
+    parsed = parse_vector_lines(("\n".join(vals) + "\n").encode())
+    if parsed is None:
+        return None
+    indptr, indices, values, mx = parsed
+    n = len(vals)
+    if indptr.shape[0] != n + 1:
+        return None  # blank lines collapsed; fall back to exact per-row path
+    dim = max(int(vector_size or 0), mx)
+    lens = np.diff(indptr)
+    width = max(int(lens.max()), 1)
+    # CSR -> padded (n, width); padding repeats index 0 with value 0
+    idx = np.zeros((n, width), np.int32)
+    val = np.zeros((n, width), dtype)
+    pos = np.arange(width)[None, :] < lens[:, None]
+    idx[pos] = indices
+    val[pos] = values.astype(dtype)
+    return {"kind": "sparse", "idx": idx, "val": val, "dim": dim}
 
 
 def resolve_feature_cols(table: MTable, feature_cols, label_col=None,
