@@ -228,10 +228,19 @@ class _WithParamsMeta(type):
     def __new__(mcls, name, bases, ns):
         cls = super().__new__(mcls, name, bases, ns)
         infos = {}
+        # inherit param maps assigned post-hoc on bases (the _trainer /
+        # `_PARAM_INFOS = SomeBatchOp._PARAM_INFOS` delegation patterns)
+        for klass in reversed(cls.__mro__[1:]):
+            base_infos = klass.__dict__.get("_PARAM_INFOS")
+            if isinstance(base_infos, dict):
+                infos.update(base_infos)
         for klass in reversed(cls.__mro__):
             for k, v in vars(klass).items():
                 if isinstance(v, ParamInfo):
                     infos[v.name] = v
+        declared = ns.get("_PARAM_INFOS")
+        if isinstance(declared, dict):
+            infos.update(declared)
         cls._PARAM_INFOS = infos
         for pname, info in infos.items():
             setter = f"set_{pname}"

@@ -90,10 +90,24 @@ class BaseStreamTransformOp(StreamOperator):
 
 
 class BatchApplyStreamOp(BaseStreamTransformOp):
-    """Apply a stateless batch op class to every micro-batch."""
+    """Apply a stateless batch op class to every micro-batch.
 
-    def _batch_cls(self):  # pragma: no cover - interface
-        raise NotImplementedError
+    The class comes either from a subclass overriding ``_batch_cls`` or
+    from the ``batch_cls=`` constructor argument (the same injection
+    pattern as ModelMapStreamOp's ``mapper_cls=``).
+    """
+
+    def __init__(self, params=None, batch_cls=None, **kwargs):
+        super().__init__(params, **kwargs)
+        if batch_cls is not None:
+            self._injected_batch_cls = batch_cls
+
+    def _batch_cls(self):
+        cls = getattr(self, "_injected_batch_cls", None)
+        if cls is None:
+            raise NotImplementedError(
+                f"{type(self).__name__}: override _batch_cls or pass batch_cls=")
+        return cls
 
     def _open(self, in_schema):
         from ..base import BatchOperator

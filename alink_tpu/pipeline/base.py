@@ -137,9 +137,35 @@ class PipelineModel(Model):
         self.transformers: List[Transformer] = list(transformers)
 
     def transform(self, in_op) -> BatchOperator:
+        from ..operator.base import StreamOperator
+        if isinstance(in_op, StreamOperator):
+            return self.transform_stream(in_op)
         cur = _as_op(in_op)
         for t in self.transformers:
             cur = t.transform(cur)
+        return cur
+
+    def transform_stream(self, in_op):
+        """Apply the fitted chain to a stream (reference
+        PipelineModel.transform(StreamOperator), pipeline/PipelineModel.java):
+        MapModels become ModelMapStreamOps; stateless batch-op transformers
+        run per micro-batch."""
+        from ..operator.stream.core import BatchApplyStreamOp
+        from ..operator.stream.utils import ModelMapStreamOp
+        cur = in_op
+        for t in self.transformers:
+            if isinstance(t, PipelineModel):
+                cur = t.transform_stream(cur)
+            elif isinstance(t, MapModel):
+                op = ModelMapStreamOp(
+                    TableSourceBatchOp(t.get_model_data()),
+                    params=t.params.clone(), mapper_cls=t.MAPPER_CLS)
+                cur = op.link_from(cur)
+            elif getattr(t, "OP_CLS", None) is not None:
+                cur = BatchApplyStreamOp(params=t.params.clone(),
+                                         batch_cls=t.OP_CLS).link_from(cur)
+            else:
+                raise TypeError(f"{type(t).__name__} has no stream transform")
         return cur
 
     # -- persistence (reference ModelExporterUtils.java:40-120) -----------

@@ -1,0 +1,54 @@
+"""ALS recommendation example — mirror of the reference ALSExample
+(examples/src/main/java/com/alibaba/alink/ALSExample.java) on a synthetic
+low-rank ratings matrix (MovieLens stand-in; no egress).
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+     PYTHONPATH=. python examples/als_example.py
+"""
+
+import numpy as np
+
+from alink_tpu.common.mlenv import use_local_env
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.batch.recommendation.als_ops import (
+    AlsPredictBatchOp, AlsTopKPredictBatchOp, AlsTrainBatchOp)
+
+
+def synthetic_ratings(n_users=60, n_items=40, rank=4, density=0.3, seed=5):
+    rng = np.random.RandomState(seed)
+    U = rng.randn(n_users, rank)
+    V = rng.randn(n_items, rank)
+    R = U @ V.T
+    rows = []
+    for u in range(n_users):
+        for i in range(n_items):
+            if rng.rand() < density:
+                rows.append((u, i, float(R[u, i])))
+    return rows
+
+
+def main():
+    use_local_env(parallelism=8)
+    rows = synthetic_ratings()
+    src = MemSourceBatchOp(rows, "user LONG, item LONG, rating DOUBLE")
+
+    train = AlsTrainBatchOp(user_col="user", item_col="item",
+                            rate_col="rating", rank=6, num_iter=12,
+                            lambda_=0.05).link_from(src)
+
+    pred = AlsPredictBatchOp(user_col="user", item_col="item",
+                             prediction_col="pred").link_from(train, src)
+    out = pred.collect_mtable()
+    rmse = float(np.sqrt(np.mean((np.asarray(out.col("pred"))
+                                  - np.asarray(out.col("rating"))) ** 2)))
+    print(out.to_display_string(8))
+    print(f"train-set RMSE: {rmse:.4f}")
+
+    topk = AlsTopKPredictBatchOp(user_col="user", prediction_col="recs",
+                                 top_k=5).link_from(
+        train, MemSourceBatchOp([(u,) for u in range(5)], "user LONG"))
+    print(topk.collect_mtable().to_display_string(5))
+
+
+if __name__ == "__main__":
+    main()
