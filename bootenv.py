@@ -12,12 +12,26 @@ import sys
 
 _MARK = "ALINK_TPU_TEST_ENV"
 
-if os.environ.get(_MARK) != "1":
-    env = dict(os.environ)
-    env[_MARK] = "1"
+
+def cpu_mesh_env(n_devices: int, base_env=None) -> dict:
+    """Env vars for a fresh interpreter with an n-device virtual CPU mesh.
+
+    Centralizes the container-specific bootstrap: the sitecustomize registers
+    the axon TPU backend in every python process (disabled via
+    PALLAS_AXON_POOL_IPS) and XLA flags latch at backend init, so the mesh
+    size must be in the env before jax is first touched.
+    """
+    env = dict(os.environ if base_env is None else base_env)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("ALINK_TPU_EXTRA_XLA_FLAGS", "") +
-                        " --xla_force_host_platform_device_count=8").strip()
+                        f" --xla_force_host_platform_device_count={n_devices}"
+                        ).strip()
     env["PALLAS_AXON_POOL_IPS"] = ""  # disable axon sitecustomize TPU hook
+    return env
+
+
+if os.environ.get(_MARK) != "1" and "pytest" in sys.modules:
+    env = cpu_mesh_env(8)
+    env[_MARK] = "1"
     env["JAX_ENABLE_X64"] = "1"  # float64 parity on the CPU test mesh
     os.execvpe(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
