@@ -252,6 +252,8 @@ class VectorUtil:
 
 def _fmt(x: float) -> str:
     x = float(x)
+    if not np.isfinite(x):
+        return repr(x)
     return str(int(x)) + ".0" if x == int(x) and abs(x) < 1e15 else repr(x)
 
 

@@ -20,7 +20,9 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "parser.cpp")
-_LIB_PATH = os.path.join(_HERE, "_parser.so")
+# the dotted basename keeps pkgutil/importlib module discovery from trying
+# to import the ctypes artifact as a CPython extension module
+_LIB_PATH = os.path.join(_HERE, "_parser.native.so")
 _lock = threading.Lock()
 _lib = None
 _tried = False

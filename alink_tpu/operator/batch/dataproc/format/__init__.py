@@ -101,11 +101,20 @@ def _read_vector(op, t: MTable):
 
 # -- writers: dicts -> output columns --------------------------------------
 
+def _from_vector(op) -> bool:
+    # only the Vector reader keys its dicts by component index; KV/JSON data
+    # with digit keys must NOT be remapped positionally
+    return getattr(op, "FROM_FORMAT", "") == "Vector"
+
+
 def _write_columns(op, dicts: List[Dict], t: MTable, reserved: List[str]):
     schema = TableSchema.parse(op.params._m["schema_str"])
     cols = {c: t.col(c) for c in reserved}
-    for n, ty in zip(schema.names, schema.types):
-        cols[n] = [_cast(d.get(n), ty) for d in dicts]
+    vector_in = _from_vector(op)
+    for j, (n, ty) in enumerate(zip(schema.names, schema.types)):
+        # vector-sourced dicts are keyed by component index: map positionally
+        key = str(j) if vector_in else n
+        cols[n] = [_cast(d.get(key), ty) for d in dicts]
     out_names = reserved + [n for n in schema.names]
     out_types = [t.schema.type_of(c) for c in reserved] + list(schema.types)
     return MTable(cols, TableSchema(out_names, out_types))
@@ -119,8 +128,15 @@ def _write_csv(op, dicts, t, reserved):
     out_col = op.params._m["csv_col"]
     delim = op.params._m.get("csv_field_delimiter", ",")
     schema = op.params._m.get("schema_str")
-    keys = (TableSchema.parse(schema).names if schema
-            else sorted({k for d in dicts for k in d}))
+    all_keys = {k for d in dicts for k in d}
+    if schema:
+        keys = TableSchema.parse(schema).names
+        if _from_vector(op):
+            keys = [str(j) for j in range(len(keys))]  # positional
+    elif _from_vector(op):
+        keys = sorted(all_keys, key=int)
+    else:
+        keys = sorted(all_keys)
     vals = [delim.join("" if d.get(k) is None else _fmt_scalar(d[k])
                        for k in keys) for d in dicts]
     return _with_out(op, t, reserved, out_col, vals, AlinkTypes.STRING)
@@ -300,6 +316,11 @@ class TripleToAnyBase(BatchOperator):
 
 # -- generate the named op matrix ------------------------------------------
 
+# reference names the triple-grouping base TripleToAnyBatchOp; it is
+# abstract (TO_FORMAT unset) so it stays out of FORMAT_OPS — the generator
+# matrices must only mint concrete ops from that dict
+TripleToAnyBatchOp = TripleToAnyBase
+
 FORMAT_OPS: Dict[str, type] = {"AnyToTripleBatchOp": AnyToTripleBatchOp}
 
 
@@ -326,4 +347,4 @@ for _dst in _WRITERS:
     FORMAT_OPS[_name] = _mkop(_name, TripleToAnyBase, {"TO_FORMAT": _dst})
 
 globals().update(FORMAT_OPS)
-__all__ += sorted(FORMAT_OPS)
+__all__ += sorted(FORMAT_OPS) + ["TripleToAnyBatchOp"]

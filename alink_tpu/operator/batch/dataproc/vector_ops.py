@@ -278,3 +278,106 @@ class VectorMinMaxScalerPredictBatchOp(VectorStandardScalerPredictBatchOp):
 
 class VectorMaxAbsScalerPredictBatchOp(VectorStandardScalerPredictBatchOp):
     pass
+
+
+# -- vector imputer ---------------------------------------------------------
+
+class VectorImputerTrainBatchOp(BatchOperator, HasSelectedCol, HasVectorCol):
+    """Fill-value model over a vector column (reference
+    dataproc/vector/VectorImputerTrainBatchOp over
+    VectorImputerModelDataConverter.java; strategies MEAN/MIN/MAX/VALUE)."""
+
+    STRATEGY = ParamInfo("strategy", str, default="MEAN",
+                         validator=InValidator(["MEAN", "MIN", "MAX", "VALUE"]))
+    FILL_VALUE = ParamInfo("fill_value", float, "fill for strategy VALUE")
+
+    def link_from(self, in_op: BatchOperator) -> "VectorImputerTrainBatchOp":
+        t = in_op.get_output_table()
+        col = self.params._m.get("selected_col") or self.params._m.get("vector_col")
+        strategy = self.get_strategy().upper()
+        if strategy == "VALUE":
+            fill = np.asarray([self.params._m["fill_value"]], np.float64)
+        else:
+            # NaN-aware per-component stats (the summarizer assumes finite data)
+            X = np.stack([v.to_dense().data for v in _parse_col(t, col)])
+            with np.errstate(invalid="ignore"):
+                fill = {"MEAN": np.nanmean, "MIN": np.nanmin,
+                        "MAX": np.nanmax}[strategy](X, axis=0)
+        self._output = _VectorScalerConverter().save_model(
+            ("imputer:" + strategy, {"fill": np.asarray(fill, np.float64)}))
+        return self
+
+
+class VectorImputerModelMapper(ModelMapper):
+    """reference: dataproc/vector/VectorImputerModelMapper.java — replace
+    NaN entries with the trained fill values."""
+
+    def __init__(self, model_schema, data_schema, params=None, **kwargs):
+        super().__init__(model_schema, data_schema, params, **kwargs)
+        self.fill = None
+
+    def load_model(self, model_table: MTable):
+        _, stats = _VectorScalerConverter().load_model(model_table)
+        self.fill = stats["fill"]
+
+    def _fill_at(self, idx: np.ndarray, row: int) -> np.ndarray:
+        fill = self.fill
+        if len(fill) == 1:  # VALUE strategy: one scalar for every component
+            return np.full(len(idx), fill[0])
+        if idx.size and int(idx.max()) >= len(fill):
+            raise ValueError(
+                f"row {row}: vector component {int(idx.max())} has no trained "
+                f"fill value (model was fit on {len(fill)}-dim vectors)")
+        return fill[idx]
+
+    def map_table(self, data: MTable) -> MTable:
+        col = self.params._m.get("selected_col") or self.params._m.get("vector_col")
+        out_col = self.params._m.get("output_col") or col
+        vecs = np.empty(data.num_rows, object)
+        for i, v in enumerate(_parse_col(data, col)):
+            if isinstance(v, SparseVector):
+                bad = ~np.isfinite(v.values)
+                if bad.any():
+                    vals = v.values.copy()
+                    vals[bad] = self._fill_at(v.indices[bad], i)
+                    vecs[i] = SparseVector(v.n, v.indices.copy(), vals)
+                else:
+                    vecs[i] = v
+            else:
+                x = v.data
+                bad = ~np.isfinite(x)
+                if bad.any():
+                    x = x.copy()
+                    x[bad] = self._fill_at(np.nonzero(bad)[0], i)
+                vecs[i] = DenseVector(x)
+        helper = OutputColsHelper(data.schema, [out_col],
+                                  [data.schema.type_of(col)])
+        return helper.build_output(data, [vecs])
+
+
+class VectorImputerPredictBatchOp(ModelMapBatchOp, HasSelectedCol, HasVectorCol,
+                                  HasOutputCol):
+    MAPPER_CLS = VectorImputerModelMapper
+
+
+class VectorSerializeBatchOp(BatchOperator):
+    """Format every vector-typed column to its string literal (reference
+    batch/utils/VectorSerializeBatchOp.java / VectorSerializeMapper)."""
+
+    def link_from(self, in_op: BatchOperator) -> "VectorSerializeBatchOp":
+        t = in_op.get_output_table()
+        cols = {}
+        types = []
+        for c in t.col_names:
+            ty = t.schema.type_of(c)
+            if AlinkTypes.is_vector(ty):
+                col = np.empty(t.num_rows, object)
+                col[:] = [None if v is None else VectorUtil.to_string(
+                    VectorUtil.parse(v)) for v in t.col(c)]
+                cols[c] = col
+                types.append(AlinkTypes.STRING)
+            else:
+                cols[c] = t.col(c)
+                types.append(ty)
+        self._output = MTable(cols, TableSchema(list(t.col_names), types))
+        return self

@@ -413,3 +413,38 @@ def _extract_matrix(t: MTable, selected_cols, vector_col) -> np.ndarray:
         return design["X"]
     from ....common.vector import SparseBatch
     return SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)
+
+
+class VectorChiSqSelectorBatchOp(BatchOperator, HasVectorCol, HasSelectedCol,
+                                 HasLabelCol):
+    """reference: feature/VectorChiSqSelectorBatchOp — rank vector components
+    by chi-square against the label, keep the top ones (sliced vector out)."""
+    NUM_TOP_FEATURES = ParamInfo("num_top_features", int, default=10)
+
+    def link_from(self, in_op: BatchOperator) -> "VectorChiSqSelectorBatchOp":
+        from ...common.statistics.hypothesis import chi_square_test
+        from ...common.dataproc.feature_extract import extract_design
+        t = in_op.get_output_table()
+        col = self.params._m.get("vector_col") or self.params._m.get("selected_col")
+        design = extract_design(t, None, col)
+        X = design["X"] if design["kind"] == "dense" else None
+        if X is None:
+            from ....common.vector import SparseBatch
+            X = SparseBatch(design["idx"], design["val"],
+                            design["dim"]).to_dense(np.float64)
+        label = t.col(self.get_label_col())
+        scored = []
+        for j in range(X.shape[1]):
+            stat, p, _ = chi_square_test(X[:, j], label)
+            scored.append((p, j, stat))
+        scored.sort(key=lambda x: (x[0], x[1]))
+        chosen = sorted(j for _, j, _ in scored[: self.get_num_top_features()])
+        self._chosen = chosen
+        vecs = np.empty(t.num_rows, object)
+        vecs[:] = [DenseVector(x) for x in X[:, chosen]]
+        helper = OutputColsHelper(t.schema, [col], [AlinkTypes.DENSE_VECTOR])
+        self._output = helper.build_output(t, [vecs])
+        self._side_outputs = [MTable({"index": [j for _, j, _ in scored],
+                                      "p_value": [p for p, _, _ in scored],
+                                      "chi2": [s for _, _, s in scored]})]
+        return self

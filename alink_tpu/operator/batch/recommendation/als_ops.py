@@ -95,31 +95,45 @@ def _c(v):
     return v.item() if isinstance(v, np.generic) else v
 
 
+class AlsRater:
+    """Loaded ALS factors + id lookups, reusable across calls — the stream
+    predict op loads this once and rates every micro-batch with it."""
+
+    def __init__(self, model_table: MTable):
+        self.m = AlsModelDataConverter().load_model(model_table)
+        self.u_lookup = {v: i for i, v in enumerate(self.m.user_ids)}
+        self.i_lookup = {v: i for i, v in enumerate(self.m.item_ids)}
+
+    def rate_table(self, t: MTable, user_col: str, item_col: str,
+                   prediction_col: str, reserved_cols=None) -> MTable:
+        m = self.m
+        preds = np.zeros(t.num_rows)
+        for r, (u, i) in enumerate(zip(t.col(user_col), t.col(item_col))):
+            ui = self.u_lookup.get(
+                str(_c(u)) if str(_c(u)) in self.u_lookup else _c(u))
+            ii = self.i_lookup.get(
+                str(_c(i)) if str(_c(i)) in self.i_lookup else _c(i))
+            if ui is None or ii is None:
+                preds[r] = np.nan
+            else:
+                preds[r] = float(m.user_factors[ui] @ m.item_factors[ii])
+        from ....mapper.base import OutputColsHelper
+        helper = OutputColsHelper(t.schema, [prediction_col],
+                                  [AlinkTypes.DOUBLE], reserved_cols)
+        return helper.build_output(t, [preds])
+
+
 class AlsPredictBatchOp(BatchOperator, HasPredictionCol, HasReservedCols):
     """Predict the rating of (user, item) rows (reference AlsPredictBatchOp)."""
     USER_COL = ParamInfo("user_col", str, optional=False)
     ITEM_COL = ParamInfo("item_col", str, optional=False)
 
     def link_from(self, model_op: BatchOperator, data_op: BatchOperator):
-        m = AlsModelDataConverter().load_model(model_op.get_output_table())
-        t = data_op.get_output_table()
-        u_lookup = {v: i for i, v in enumerate(m.user_ids)}
-        i_lookup = {v: i for i, v in enumerate(m.item_ids)}
-        preds = np.zeros(t.num_rows)
-        for r, (u, i) in enumerate(zip(t.col(self.get_user_col()),
-                                       t.col(self.get_item_col()))):
-            ui = u_lookup.get(str(_c(u)) if str(_c(u)) in u_lookup else _c(u))
-            ii = i_lookup.get(str(_c(i)) if str(_c(i)) in i_lookup else _c(i))
-            if ui is None or ii is None:
-                preds[r] = np.nan
-            else:
-                preds[r] = float(m.user_factors[ui] @ m.item_factors[ii])
-        from ....mapper.base import OutputColsHelper
-        helper = OutputColsHelper(t.schema,
-                                  [self.params._m.get("prediction_col", "pred")],
-                                  [AlinkTypes.DOUBLE],
-                                  self.params._m.get("reserved_cols"))
-        self._output = helper.build_output(t, [preds])
+        rater = AlsRater(model_op.get_output_table())
+        self._output = rater.rate_table(
+            data_op.get_output_table(), self.get_user_col(),
+            self.get_item_col(), self.params._m.get("prediction_col", "pred"),
+            self.params._m.get("reserved_cols"))
         return self
 
 

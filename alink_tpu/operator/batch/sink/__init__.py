@@ -1,3 +1,7 @@
-from .sinks import CsvSinkBatchOp, LibSvmSinkBatchOp, MemSinkBatchOp
+from .sinks import (BaseSinkBatchOp, CsvSinkBatchOp, DBSinkBatchOp,
+                    LibSvmSinkBatchOp, MemSinkBatchOp, MySqlSinkBatchOp,
+                    TextSinkBatchOp)
 
-__all__ = ["CsvSinkBatchOp", "LibSvmSinkBatchOp", "MemSinkBatchOp"]
+__all__ = ["BaseSinkBatchOp", "CsvSinkBatchOp", "DBSinkBatchOp",
+           "LibSvmSinkBatchOp", "MemSinkBatchOp", "MySqlSinkBatchOp",
+           "TextSinkBatchOp"]

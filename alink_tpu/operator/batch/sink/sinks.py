@@ -14,31 +14,54 @@ from ....io.csv import write_csv, write_libsvm
 from ...base import BatchOperator
 
 
-class CsvSinkBatchOp(BatchOperator):
+class BaseSinkBatchOp(BatchOperator):
+    """Common sink shape (reference batch/sink/BaseSinkBatchOp.java):
+    write the input out via ``_sink``, pass the table through."""
+
+    def _sink(self, t: MTable) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def link_from(self, in_op: BatchOperator) -> "BaseSinkBatchOp":
+        t = in_op.get_output_table()
+        self._sink(t)
+        self._output = t
+        return self
+
+
+class CsvSinkBatchOp(BaseSinkBatchOp):
     FILE_PATH = ParamInfo("file_path", str, optional=False)
     FIELD_DELIMITER = ParamInfo("field_delimiter", str, default=",")
     WITH_HEADER = ParamInfo("with_header", bool, default=False)
 
-    def link_from(self, in_op: BatchOperator) -> "CsvSinkBatchOp":
-        t = in_op.get_output_table()
+    def _sink(self, t: MTable) -> None:
         write_csv(t, self.get_file_path(),
                   field_delimiter=self.get_field_delimiter(),
                   with_header=self.get_with_header())
-        self._output = t
-        return self
 
 
-class LibSvmSinkBatchOp(BatchOperator):
+class LibSvmSinkBatchOp(BaseSinkBatchOp):
     FILE_PATH = ParamInfo("file_path", str, optional=False)
     LABEL_COL = ParamInfo("label_col", str, optional=False)
     VECTOR_COL = ParamInfo("vector_col", str, optional=False)
 
-    def link_from(self, in_op: BatchOperator) -> "LibSvmSinkBatchOp":
-        t = in_op.get_output_table()
+    def _sink(self, t: MTable) -> None:
         write_libsvm(t, self.get_file_path(), self.get_label_col(),
                      self.get_vector_col())
-        self._output = t
-        return self
+
+
+class TextSinkBatchOp(BaseSinkBatchOp):
+    """Write a single-column table as plain lines (reference
+    batch/sink/TextSinkBatchOp.java — requires exactly one input column)."""
+
+    FILE_PATH = ParamInfo("file_path", str, optional=False)
+
+    def _sink(self, t: MTable) -> None:
+        if len(t.col_names) != 1:
+            raise ValueError(
+                f"TextSink requires exactly one column, got {t.col_names}")
+        with open(self.get_file_path(), "w") as f:
+            for v in t.col(t.col_names[0]):
+                f.write(("" if v is None else str(v)) + "\n")
 
 
 class MemSinkBatchOp(BatchOperator):

@@ -1,7 +1,9 @@
-from .sources import (MemSourceBatchOp, CsvSourceBatchOp, LibSvmSourceBatchOp,
+from .sources import (BaseSourceBatchOp, MemSourceBatchOp, CsvSourceBatchOp,
+                      DBSourceBatchOp, LibSvmSourceBatchOp, MySqlSourceBatchOp,
                       TextSourceBatchOp, NumSeqSourceBatchOp, RandomTableSourceBatchOp)
 from ...base import TableSourceBatchOp
 
-__all__ = ["MemSourceBatchOp", "CsvSourceBatchOp", "LibSvmSourceBatchOp",
+__all__ = ["BaseSourceBatchOp", "MemSourceBatchOp", "CsvSourceBatchOp",
+           "DBSourceBatchOp", "LibSvmSourceBatchOp", "MySqlSourceBatchOp",
            "TextSourceBatchOp", "NumSeqSourceBatchOp", "RandomTableSourceBatchOp",
            "TableSourceBatchOp"]

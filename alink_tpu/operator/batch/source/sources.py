@@ -18,7 +18,14 @@ from ....io.csv import read_csv, read_libsvm
 from ...base import BatchOperator, TableSourceBatchOp
 
 
-class MemSourceBatchOp(BatchOperator):
+class BaseSourceBatchOp(BatchOperator):
+    """Source base: no inputs (reference batch/source/BaseSourceBatchOp.java)."""
+
+    def link_from(self, *inputs):
+        raise RuntimeError(f"{type(self).__name__} is a source; it takes no inputs")
+
+
+class MemSourceBatchOp(BaseSourceBatchOp):
     """In-memory rows source (reference MemSourceBatchOp)."""
 
     def __init__(self, rows, schema=None, params: Optional[Params] = None, **kwargs):
@@ -30,11 +37,8 @@ class MemSourceBatchOp(BatchOperator):
                 schema = TableSchema.parse(schema)
             self._output = MTable(rows, schema)
 
-    def link_from(self, *inputs):
-        raise RuntimeError("MemSourceBatchOp is a source")
 
-
-class _FileSourceBase(BatchOperator):
+class _FileSourceBase(BaseSourceBatchOp):
     """File sources load lazily so fluent ``set_file_path(...)`` works too."""
 
     def _load(self):  # pragma: no cover - interface
@@ -44,9 +48,6 @@ class _FileSourceBase(BatchOperator):
         if self._output is None:
             self._load()
         return super().get_output_table()
-
-    def link_from(self, *inputs):
-        raise RuntimeError(f"{type(self).__name__} is a source; it takes no inputs")
 
 
 class CsvSourceBatchOp(_FileSourceBase):
@@ -89,7 +90,7 @@ class TextSourceBatchOp(_FileSourceBase):
                               TableSchema([self.get_text_col()], [AlinkTypes.STRING]))
 
 
-class NumSeqSourceBatchOp(BatchOperator):
+class NumSeqSourceBatchOp(BaseSourceBatchOp):
     """Integer sequence [from, to] (reference NumSeqSourceBatchOp)."""
 
     def __init__(self, from_: int = 0, to: int = 0, col_name: str = "num",
@@ -98,11 +99,8 @@ class NumSeqSourceBatchOp(BatchOperator):
         seq = np.arange(from_, to + 1, dtype=np.int64)
         self._output = MTable({col_name: seq}, TableSchema([col_name], [AlinkTypes.LONG]))
 
-    def link_from(self, *inputs):
-        raise RuntimeError("NumSeqSourceBatchOp is a source")
 
-
-class RandomTableSourceBatchOp(BatchOperator):
+class RandomTableSourceBatchOp(BaseSourceBatchOp):
     """Random numeric table (reference RandomTableSourceBatchOp)."""
 
     def __init__(self, num_rows: int, num_cols: int, seed: int = 0,
@@ -113,15 +111,12 @@ class RandomTableSourceBatchOp(BatchOperator):
                 for i in range(num_cols)}
         self._output = MTable(cols)
 
-    def link_from(self, *inputs):
-        raise RuntimeError("RandomTableSourceBatchOp is a source")
-
 
 from ....io.db import HasDB as _HasDB
 from ....io.db import HasMySqlDB as _HasMySqlDB
 
 
-class DBSourceBatchOp(_HasDB, BatchOperator):
+class DBSourceBatchOp(_HasDB, BaseSourceBatchOp):
     """Read a table (or free query) from a registered BaseDB
     (reference: batch/source/DBSourceBatchOp.java over common/io/BaseDB)."""
     INPUT_TABLE_NAME = ParamInfo("input_table_name", str, "table to read")

@@ -166,7 +166,12 @@ def _split_top_level(s: str, sep: str = ",") -> List[str]:
     return [p for p in parts if p]
 
 
-class SelectBatchOp(BatchOperator):
+class BaseSqlApiBatchOp(BatchOperator):
+    """Base of the SQL-clause operators (reference
+    batch/sql/BaseSqlApiBatchOp.java)."""
+
+
+class SelectBatchOp(BaseSqlApiBatchOp):
     """reference: batch/sql/SelectBatchOp — "a, b*2 as c, *"."""
     CLAUSE = _CLAUSE
 
@@ -200,7 +205,7 @@ class SelectBatchOp(BatchOperator):
         return self
 
 
-class AsBatchOp(BatchOperator):
+class AsBatchOp(BaseSqlApiBatchOp):
     """Rename all columns (reference AsBatchOp)."""
     CLAUSE = _CLAUSE
 
@@ -210,7 +215,7 @@ class AsBatchOp(BatchOperator):
         return self
 
 
-class WhereBatchOp(BatchOperator):
+class WhereBatchOp(BaseSqlApiBatchOp):
     CLAUSE = _CLAUSE
 
     def link_from(self, in_op: BatchOperator) -> "WhereBatchOp":
@@ -223,13 +228,13 @@ class FilterBatchOp(WhereBatchOp):
     pass
 
 
-class DistinctBatchOp(BatchOperator):
+class DistinctBatchOp(BaseSqlApiBatchOp):
     def link_from(self, in_op: BatchOperator) -> "DistinctBatchOp":
         self._output = in_op.get_output_table().distinct()
         return self
 
 
-class OrderByBatchOp(BatchOperator):
+class OrderByBatchOp(BaseSqlApiBatchOp):
     CLAUSE = _CLAUSE
     LIMIT = ParamInfo("limit", int, "top-n limit")
     ASCENDING = ParamInfo("ascending", bool, default=True)
@@ -250,7 +255,7 @@ _AGGS = {
 }
 
 
-class GroupByBatchOp(BatchOperator):
+class GroupByBatchOp(BaseSqlApiBatchOp):
     """reference: batch/sql/GroupByBatchOp — group cols + "key, agg(col) as name"."""
     GROUP_BY_PREDICATE = ParamInfo("group_by_predicate", str, optional=False)
     SELECT_CLAUSE = ParamInfo("select_clause", str, optional=False)
@@ -290,7 +295,7 @@ class GroupByBatchOp(BatchOperator):
         return self
 
 
-class UnionAllBatchOp(BatchOperator):
+class UnionAllBatchOp(BaseSqlApiBatchOp):
     def link_from(self, *inputs: BatchOperator) -> "UnionAllBatchOp":
         t = inputs[0].get_output_table()
         for other in inputs[1:]:
@@ -299,14 +304,14 @@ class UnionAllBatchOp(BatchOperator):
         return self
 
 
-class UnionBatchOp(BatchOperator):
+class UnionBatchOp(BaseSqlApiBatchOp):
     def link_from(self, *inputs: BatchOperator) -> "UnionBatchOp":
         t = UnionAllBatchOp().link_from(*inputs).get_output_table()
         self._output = t.distinct()
         return self
 
 
-class IntersectBatchOp(BatchOperator):
+class IntersectBatchOp(BaseSqlApiBatchOp):
     _ALL = False
 
     def link_from(self, a: BatchOperator, b: BatchOperator):
@@ -333,7 +338,7 @@ class IntersectAllBatchOp(IntersectBatchOp):
     _ALL = True
 
 
-class MinusBatchOp(BatchOperator):
+class MinusBatchOp(BaseSqlApiBatchOp):
     _ALL = False
 
     def link_from(self, a: BatchOperator, b: BatchOperator):
@@ -364,7 +369,7 @@ class MinusAllBatchOp(MinusBatchOp):
     _ALL = True
 
 
-class JoinBatchOp(BatchOperator):
+class JoinBatchOp(BaseSqlApiBatchOp):
     """reference: batch/sql/JoinBatchOp (+Left/Right/Full/Cross variants)."""
     JOIN_PREDICATE = ParamInfo("join_predicate", str, "a.col = b.col [and ...]",
                                optional=False)
@@ -406,7 +411,7 @@ class FullOuterJoinBatchOp(JoinBatchOp):
     TYPE = ParamInfo("type", str, default="fullOuterJoin")
 
 
-class CrossBatchOp(BatchOperator):
+class CrossBatchOp(BaseSqlApiBatchOp):
     def link_from(self, a: BatchOperator, b: BatchOperator) -> "CrossBatchOp":
         ta, tb = a.get_output_table(), b.get_output_table()
         na, nb = ta.num_rows, tb.num_rows

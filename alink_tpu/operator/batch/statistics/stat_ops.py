@@ -132,3 +132,30 @@ class ChiSquareTestBatchOp(BatchOperator, HasSelectedCols, HasLabelCol):
             ["colName", "p", "value", "df"],
             [AlinkTypes.STRING, AlinkTypes.DOUBLE, AlinkTypes.DOUBLE, AlinkTypes.DOUBLE]))
         return self
+
+
+class VectorChiSquareTestBatchOp(BatchOperator, HasVectorCol, HasSelectedCol,
+                                 HasLabelCol):
+    """reference: VectorChiSquareTestBatchOp — per-component chi2 of the
+    vector column against the label."""
+
+    def link_from(self, in_op: BatchOperator) -> "VectorChiSquareTestBatchOp":
+        from ...common.dataproc.feature_extract import extract_design
+        t = in_op.get_output_table()
+        col = self.params._m.get("vector_col") or self.params._m.get("selected_col")
+        design = extract_design(t, None, col)
+        X = design["X"] if design["kind"] == "dense" else None
+        if X is None:
+            from ....common.vector import SparseBatch
+            X = SparseBatch(design["idx"], design["val"],
+                            design["dim"]).to_dense(np.float64)
+        label = t.col(self.get_label_col())
+        rows = []
+        for j in range(X.shape[1]):
+            chi2, p, df = chi_square_test(X[:, j], label)
+            rows.append((str(j), p, chi2, float(df)))
+        self._output = MTable(rows, TableSchema(
+            ["colName", "p", "value", "df"],
+            [AlinkTypes.STRING, AlinkTypes.DOUBLE, AlinkTypes.DOUBLE,
+             AlinkTypes.DOUBLE]))
+        return self

@@ -1,1 +1,3 @@
-from .model_map import ModelMapBatchOp
+from .fn_ops import (DataSetWrapperBatchOp, FlatMapBatchOp, PrintBatchOp,
+                     UDFBatchOp, UDTFBatchOp)
+from .model_map import MapBatchOp, ModelMapBatchOp

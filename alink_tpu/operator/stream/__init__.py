@@ -15,15 +15,22 @@ from .nlp import (NGramStreamOp, RegexTokenizerStreamOp, SegmentStreamOp,
 from .onlinelearning import FtrlPredictStreamOp, FtrlTrainStreamOp
 from .predict_ops import *  # noqa: F401,F403 — the *PredictStreamOp family
 from .predict_ops import __all__ as _predict_all
-from .sink.sinks import (CollectSinkStreamOp, CsvSinkStreamOp,
-                         LibSvmSinkStreamOp, TextSinkStreamOp)
-from .source.sources import (CsvSourceStreamOp, LibSvmSourceStreamOp,
-                             MemSourceStreamOp, NumSeqSourceStreamOp,
-                             RandomTableSourceStreamOp, TableSourceStreamOp,
-                             TextSourceStreamOp)
-from .sql import (AsStreamOp, FilterStreamOp, SelectStreamOp, UnionAllStreamOp,
-                  WhereStreamOp, WindowGroupByStreamOp)
-from .utils import MapperStreamOp, ModelMapStreamOp
+from .batch_twins import *  # noqa: F401,F403 — stateless batch-twin stream ops
+from .batch_twins import __all__ as _twin_all
+from .recommendation import AlsPredictStreamOp
+from .sink import (BaseSinkStreamOp, CollectSinkStreamOp, CsvSinkStreamOp,
+                   DBSinkStreamOp, JdbcRetractSinkStreamOp, LibSvmSinkStreamOp,
+                   MySqlSinkStreamOp, TextSinkStreamOp)
+from .source import (BaseSourceStreamOp, CsvSourceStreamOp, DBSourceStreamOp,
+                     LibSvmSourceStreamOp, MemSourceStreamOp,
+                     MySqlSourceStreamOp, NumSeqSourceStreamOp,
+                     RandomTableSourceStreamOp, TableSourceStreamOp,
+                     TextSourceStreamOp)
+from .sql import (AsStreamOp, BaseSqlApiStreamOp, FilterStreamOp,
+                  SelectStreamOp, UnionAllStreamOp, WhereStreamOp,
+                  WindowGroupByStreamOp)
+from .utils import (FlatMapStreamOp, MapperStreamOp, MapStreamOp,
+                    ModelMapStreamOp, PrintStreamOp, UDFStreamOp, UDTFStreamOp)
 
 __all__ = [
     "BaseStreamTransformOp", "FnStreamOp",
@@ -33,12 +40,15 @@ __all__ = [
     "FtrlTrainStreamOp", "FtrlPredictStreamOp",
     "NGramStreamOp", "RegexTokenizerStreamOp", "SegmentStreamOp",
     "StopWordsRemoverStreamOp", "TokenizerStreamOp",
-    "CollectSinkStreamOp", "CsvSinkStreamOp", "LibSvmSinkStreamOp",
-    "TextSinkStreamOp",
-    "CsvSourceStreamOp", "LibSvmSourceStreamOp", "MemSourceStreamOp",
+    "BaseSinkStreamOp", "CollectSinkStreamOp", "CsvSinkStreamOp",
+    "DBSinkStreamOp", "JdbcRetractSinkStreamOp", "LibSvmSinkStreamOp",
+    "MySqlSinkStreamOp", "TextSinkStreamOp",
+    "BaseSourceStreamOp", "CsvSourceStreamOp", "DBSourceStreamOp",
+    "LibSvmSourceStreamOp", "MemSourceStreamOp", "MySqlSourceStreamOp",
     "NumSeqSourceStreamOp", "RandomTableSourceStreamOp", "TableSourceStreamOp",
     "TextSourceStreamOp",
-    "AsStreamOp", "FilterStreamOp", "SelectStreamOp", "UnionAllStreamOp",
-    "WhereStreamOp", "WindowGroupByStreamOp",
-    "MapperStreamOp", "ModelMapStreamOp",
-] + list(_predict_all)
+    "AsStreamOp", "BaseSqlApiStreamOp", "FilterStreamOp", "SelectStreamOp",
+    "UnionAllStreamOp", "WhereStreamOp", "WindowGroupByStreamOp",
+    "FlatMapStreamOp", "MapperStreamOp", "MapStreamOp", "ModelMapStreamOp",
+    "PrintStreamOp", "UDFStreamOp", "UDTFStreamOp", "AlsPredictStreamOp",
+] + list(_predict_all) + list(_twin_all)

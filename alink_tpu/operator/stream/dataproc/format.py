@@ -41,4 +41,8 @@ class JsonValueStreamOp(BatchApplyStreamOp):
         return JsonValueBatchOp
 
 
-__all__ = sorted(FORMAT_STREAM_OPS) + ["JsonValueStreamOp"]
+# the reference's abstract base name for the stream format matrix
+BaseFormatTransStreamOp = BatchApplyStreamOp
+
+__all__ = sorted(FORMAT_STREAM_OPS) + ["JsonValueStreamOp",
+                                       "BaseFormatTransStreamOp"]

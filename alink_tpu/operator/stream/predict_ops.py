@@ -49,6 +49,7 @@ _BATCH_PREDICT_OPS = {
     "MaxAbsScalerPredictStreamOp": ("..batch.dataproc.scalers", "MaxAbsScalerPredictBatchOp"),
     "ImputerPredictStreamOp": ("..batch.dataproc.scalers", "ImputerPredictBatchOp"),
     "VectorStandardScalerPredictStreamOp": ("..batch.dataproc.vector_ops", "VectorStandardScalerPredictBatchOp"),
+    "VectorImputerPredictStreamOp": ("..batch.dataproc.vector_ops", "VectorImputerPredictBatchOp"),
     "VectorMinMaxScalerPredictStreamOp": ("..batch.dataproc.vector_ops", "VectorMinMaxScalerPredictBatchOp"),
     "VectorMaxAbsScalerPredictStreamOp": ("..batch.dataproc.vector_ops", "VectorMaxAbsScalerPredictBatchOp"),
     "StringIndexerPredictStreamOp": ("..batch.dataproc.indexers", "StringIndexerPredictBatchOp"),

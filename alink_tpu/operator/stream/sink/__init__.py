@@ -1,0 +1,7 @@
+from .sinks import (BaseSinkStreamOp, CollectSinkStreamOp, CsvSinkStreamOp,
+                    DBSinkStreamOp, JdbcRetractSinkStreamOp, LibSvmSinkStreamOp,
+                    MySqlSinkStreamOp, TextSinkStreamOp)
+
+__all__ = ["BaseSinkStreamOp", "CollectSinkStreamOp", "CsvSinkStreamOp",
+           "DBSinkStreamOp", "JdbcRetractSinkStreamOp", "LibSvmSinkStreamOp",
+           "MySqlSinkStreamOp", "TextSinkStreamOp"]

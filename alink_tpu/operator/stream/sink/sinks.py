@@ -161,3 +161,10 @@ class JdbcRetractSinkStreamOp(DBSinkStreamOp):
 
 def _pyv(v):
     return v.item() if hasattr(v, "item") else v
+
+
+from ....io.db import HasMySqlDB as _HasMySqlDB
+
+
+class MySqlSinkStreamOp(_HasMySqlDB, DBSinkStreamOp):
+    """reference: stream/sink/MySqlSinkStreamOp.java"""

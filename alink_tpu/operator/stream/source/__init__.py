@@ -1,0 +1,11 @@
+from .sources import (BaseSourceStreamOp, BoundedTableStreamSource,
+                      CsvSourceStreamOp, DBSourceStreamOp, LibSvmSourceStreamOp,
+                      MemSourceStreamOp, MySqlSourceStreamOp,
+                      NumSeqSourceStreamOp, RandomTableSourceStreamOp,
+                      TableSourceStreamOp, TextSourceStreamOp)
+
+__all__ = ["BaseSourceStreamOp", "BoundedTableStreamSource",
+           "CsvSourceStreamOp", "DBSourceStreamOp", "LibSvmSourceStreamOp",
+           "MemSourceStreamOp", "MySqlSourceStreamOp", "NumSeqSourceStreamOp",
+           "RandomTableSourceStreamOp", "TableSourceStreamOp",
+           "TextSourceStreamOp"]

@@ -129,3 +129,40 @@ class RandomTableSourceStreamOp(BoundedTableStreamSource):
         rng = np.random.default_rng(seed)
         cols = {f"col{i}": rng.random(num_rows) for i in range(num_cols)}
         self._set_table(MTable(cols))
+
+
+# the reference's abstract base name for all stream sources
+BaseSourceStreamOp = BoundedTableStreamSource
+
+
+from ....io.db import HasDB as _HasDB
+from ....io.db import HasMySqlDB as _HasMySqlDB
+from ....common.params import ParamInfo as _ParamInfo
+
+
+class DBSourceStreamOp(_HasDB, BoundedTableStreamSource):
+    """Stream a DB table as micro-batches
+    (reference: stream/source/DBSourceStreamOp.java)."""
+    INPUT_TABLE_NAME = _ParamInfo("input_table_name", str, "table to read")
+    QUERY = _ParamInfo("query", str, "free-form SELECT overriding table name")
+
+    def _resolve(self) -> MTable:
+        if self._table is None:
+            q = self.params._m.get("query")
+            db = self._db()
+            table = (db.query(q) if q else
+                     db.read_table(self.params._m["input_table_name"]))
+            self._set_table(table)
+        return self._table
+
+    def timed_batches(self):
+        self._resolve()
+        return super().timed_batches()
+
+    def get_schema(self):
+        self._resolve()
+        return super().get_schema()
+
+
+class MySqlSourceStreamOp(_HasMySqlDB, DBSourceStreamOp):
+    """reference: stream/source/MySqlSourceStreamOp.java"""

@@ -24,6 +24,11 @@ from ..core import BaseStreamTransformOp, BatchApplyStreamOp
 _CLAUSE = ParamInfo("clause", str, "expression clause", optional=False)
 
 
+class BaseSqlApiStreamOp(BaseStreamTransformOp):
+    """Base of the SQL-clause stream operators (reference
+    stream/sql/BaseSqlApiStreamOp.java)."""
+
+
 class SelectStreamOp(BatchApplyStreamOp):
     """reference: stream/sql/SelectStreamOp."""
     CLAUSE = _CLAUSE

@@ -65,3 +65,60 @@ class ModelMapStreamOp(BaseStreamTransformOp):
             self._model_op = inputs[0]
             inputs = inputs[1:]
         return super().link_from(*inputs)
+
+
+class PrintStreamOp(BaseStreamTransformOp):
+    """Print each micro-batch, pass the stream through (reference
+    stream/utils/PrintStreamOp.java)."""
+
+    def _transform(self, mt: MTable):
+        print(mt.to_display_string())
+        return mt
+
+
+class _FnBatchApplyStreamOp(BaseStreamTransformOp):
+    """Apply a user-function batch op (UDF/UDTF/FlatMap) per micro-batch."""
+
+    _BATCH = None  # set by subclass
+
+    def __init__(self, params: Optional[Params] = None, func=None, **kwargs):
+        super().__init__(params, **kwargs)
+        self.func = func
+
+    def set_func(self, func) -> "_FnBatchApplyStreamOp":
+        self.func = func
+        return self
+
+    def _apply(self, mt: MTable) -> MTable:
+        op = self._BATCH(self.params.clone(), func=self.func)
+        op.link_from(BatchOperator.from_table(mt))
+        return op.get_output_table()
+
+    def _open(self, in_schema):
+        return self._apply(MTable([], in_schema)).schema
+
+    def _transform(self, mt: MTable):
+        return self._apply(mt)
+
+
+def _fn_stream_twin(name: str, batch_cls) -> type:
+    ns = {"_BATCH": batch_cls,
+          "__doc__": f"stream twin of {batch_cls.__name__} "
+                     f"(reference stream/utils/{name}.java)",
+          "__module__": __name__}
+    for info in batch_cls.param_infos().values():
+        ns[info.name.upper()] = info
+    return type(_FnBatchApplyStreamOp)(name, (_FnBatchApplyStreamOp,), ns)
+
+
+from ...batch.utils import FlatMapBatchOp as _FlatMapBatchOp
+from ...batch.utils import UDFBatchOp as _UDFBatchOp
+from ...batch.utils import UDTFBatchOp as _UDTFBatchOp
+
+UDFStreamOp = _fn_stream_twin("UDFStreamOp", _UDFBatchOp)
+UDTFStreamOp = _fn_stream_twin("UDTFStreamOp", _UDTFBatchOp)
+FlatMapStreamOp = _fn_stream_twin("FlatMapStreamOp", _FlatMapBatchOp)
+
+# reference stream/utils/MapStreamOp applies a Mapper per record — that is
+# exactly MapperStreamOp's contract
+MapStreamOp = MapperStreamOp

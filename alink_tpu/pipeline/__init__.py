@@ -4,4 +4,5 @@ from . import classification, regression
 from .tuning import (ParamGrid, GridSearchCV, GridSearchTVSplit,
                      BinaryClassificationTuningEvaluator,
                      MultiClassClassificationTuningEvaluator,
-                     RegressionTuningEvaluator, ClusterTuningEvaluator)
+                     RegressionTuningEvaluator, ClusterTuningEvaluator, Report)
+from .extras import *  # noqa: F401,F403 — completes the reference inventory

@@ -1,0 +1,264 @@
+"""Pipeline wrappers completing the reference inventory.
+
+Reference pipeline/ ships one declarative Trainer+Model (or Transformer)
+shell per algorithm (~152 classes, pipeline/Trainer.java:89-104); the bulk
+live in classification.py / regression.py / clustering.py / feature.py /
+tree.py / fm_nb.py / nlp.py here. This module adds the remainder —
+recommendation (ALS), GLM/Isotonic/AFT survival, GMM/BisectingKMeans, MLPC,
+MultiStringIndexer/IndexToString, the vector transformers, the
+format-conversion transformer matrix, and the reference's base-class names
+(EstimatorBase/TransformerBase/ModelBase/PipelineStageBase/MapTransformer/
+LocalPredictable/ModelExporterUtils).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..common.params import Params
+from ..operator.base import BatchOperator, TableSourceBatchOp
+from ..operator.batch.classification.mlpc_ops import (
+    MlpModelMapper, MultilayerPerceptronTrainBatchOp)
+from ..operator.batch.clustering.gmm_bisecting import (
+    BisectingKMeansTrainBatchOp, GmmModelMapper, GmmTrainBatchOp)
+from ..operator.batch.clustering.kmeans_ops import KMeansModelMapper
+from ..operator.batch.dataproc.format import FORMAT_OPS
+from ..operator.batch.dataproc.indexers import (IndexToStringModelMapper,
+                                                MultiStringIndexerTrainBatchOp,
+                                                StringIndexerModelMapper)
+from ..operator.batch.dataproc.vector_ops import (
+    VectorElementwiseProductBatchOp, VectorImputerModelMapper,
+    VectorImputerTrainBatchOp, VectorInteractionBatchOp,
+    VectorPolynomialExpandBatchOp, VectorSizeHintBatchOp, VectorSliceBatchOp,
+    VectorToColumnsBatchOp)
+from ..operator.batch.recommendation.als_ops import (AlsPredictBatchOp,
+                                                     AlsTopKPredictBatchOp,
+                                                     AlsTrainBatchOp)
+from ..operator.batch.regression.glm_ops import (AftModelMapper,
+                                                 AftSurvivalRegTrainBatchOp,
+                                                 GlmModelMapper,
+                                                 GlmTrainBatchOp,
+                                                 IsotonicModelMapper,
+                                                 IsotonicRegTrainBatchOp)
+from ..operator.batch.sql import SelectBatchOp
+from .base import (Estimator, LocalPredictor, MapModel, Model, Pipeline,
+                   PipelineModel, PipelineStage, Trainer, Transformer, _as_op)
+from .feature import BatchOpTransformer, Pca, PcaModel, _trainer
+from .tuning import (BaseGridSearch, BaseTuningEvaluator, BaseTuningModel,
+                     GridSearchCV, GridSearchTVSplit,
+                     MultiClassClassificationTuningEvaluator, ParamGrid)
+
+# -- reference base-class names --------------------------------------------
+
+PipelineStageBase = PipelineStage
+EstimatorBase = Estimator
+TransformerBase = Transformer
+ModelBase = Model
+MapTransformer = BatchOpTransformer
+BaseFormatTrans = BatchOpTransformer
+BaseTuning = BaseGridSearch
+TuningEvaluator = BaseTuningEvaluator
+MulticlassClassificationTuningEvaluator = MultiClassClassificationTuningEvaluator
+
+
+class LocalPredictable:
+    """Marker mixin: stages that can serve embedded (reference
+    pipeline/LocalPredictable.java). ``MapModel`` and ``PipelineModel``
+    implement ``get_local_predictor``."""
+
+
+class ModelExporterUtils:
+    """Pipeline persistence helpers (reference pipeline/ModelExporterUtils.java
+    :40-120 — there CSV-encoded stage tables; here the JSON stage list that
+    PipelineModel.save/load produce)."""
+
+    @staticmethod
+    def save_pipeline_model(model: PipelineModel, path: str) -> None:
+        model.save(path)
+
+    @staticmethod
+    def load_pipeline_model(path: str) -> PipelineModel:
+        return PipelineModel.load(path)
+
+
+GridSearchCVModel = BaseTuningModel
+GridSearchTVSplitModel = BaseTuningModel
+
+
+class PipelineCandidatesBase:
+    """Enumerate (value-combo, grid-items, description) candidates
+    (reference pipeline/tuning/PipelineCandidatesBase.java)."""
+
+    def __init__(self, pipeline: Pipeline, grid: ParamGrid):
+        self.pipeline = pipeline
+        self.grid = grid
+
+    def __iter__(self):
+        import itertools
+        items = self.grid.items if self.grid else []
+        values = [vals for _, _, vals in items]
+        for combo in (itertools.product(*values) if items else [()]):
+            desc = ", ".join(f"{type(st).__name__}.{pi.name}={v}"
+                             for (st, pi, _), v in zip(items, combo))
+            yield combo, items, desc or "(defaults)"
+
+
+class PipelineCandidatesGrid(PipelineCandidatesBase):
+    """reference pipeline/tuning/PipelineCandidatesGrid.java"""
+
+
+# -- remaining trainer/model pairs -----------------------------------------
+
+def _trainer_with_predict(name, train_op, mapper, predict_op):
+    """_trainer + the predict op's params (prediction/output/reserved cols)
+    so kwargs validation accepts them on the estimator and the model."""
+    cls, model_cls = _trainer(name, train_op, mapper)
+    for c in (cls, model_cls):
+        c._PARAM_INFOS = {**c._PARAM_INFOS, **predict_op._PARAM_INFOS}
+    return cls, model_cls
+
+
+from ..operator.batch.clustering.gmm_bisecting import (
+    BisectingKMeansPredictBatchOp, GmmPredictBatchOp)
+from ..operator.batch.classification.mlpc_ops import \
+    MultilayerPerceptronPredictBatchOp
+from ..operator.batch.dataproc.indexers import MultiStringIndexerPredictBatchOp
+from ..operator.batch.dataproc.vector_ops import VectorImputerPredictBatchOp
+from ..operator.batch.regression.glm_ops import (AftSurvivalRegPredictBatchOp,
+                                                 GlmPredictBatchOp,
+                                                 IsotonicRegPredictBatchOp)
+
+GaussianMixture, GaussianMixtureModel = _trainer_with_predict(
+    "GaussianMixture", GmmTrainBatchOp, GmmModelMapper, GmmPredictBatchOp)
+BisectingKMeans, BisectingKMeansModel = _trainer_with_predict(
+    "BisectingKMeans", BisectingKMeansTrainBatchOp, KMeansModelMapper,
+    BisectingKMeansPredictBatchOp)
+GeneralizedLinearRegression, GeneralizedLinearRegressionModel = _trainer_with_predict(
+    "GeneralizedLinearRegression", GlmTrainBatchOp, GlmModelMapper,
+    GlmPredictBatchOp)
+IsotonicRegression, IsotonicRegressionModel = _trainer_with_predict(
+    "IsotonicRegression", IsotonicRegTrainBatchOp, IsotonicModelMapper,
+    IsotonicRegPredictBatchOp)
+AftSurvivalRegression, AftSurvivalRegressionModel = _trainer_with_predict(
+    "AftSurvivalRegression", AftSurvivalRegTrainBatchOp, AftModelMapper,
+    AftSurvivalRegPredictBatchOp)
+MultilayerPerceptronClassifier, MultilayerPerceptronClassificationModel = \
+    _trainer_with_predict(
+        "MultilayerPerceptronClassifier", MultilayerPerceptronTrainBatchOp,
+        MlpModelMapper, MultilayerPerceptronPredictBatchOp)
+MultiStringIndexer, MultiStringIndexerModel = _trainer_with_predict(
+    "MultiStringIndexer", MultiStringIndexerTrainBatchOp,
+    StringIndexerModelMapper, MultiStringIndexerPredictBatchOp)
+VectorImputer, VectorImputerModel = _trainer_with_predict(
+    "VectorImputer", VectorImputerTrainBatchOp, VectorImputerModelMapper,
+    VectorImputerPredictBatchOp)
+
+# reference spells PCA in caps
+PCA = Pca
+PCAModel = PcaModel
+
+
+class IndexToString(MapModel):
+    """Map indices back to labels with a fitted StringIndexer model
+    (reference pipeline/dataproc/IndexToString.java — takes the
+    StringIndexerModel's data)."""
+
+    MAPPER_CLS = IndexToStringModelMapper
+
+
+# -- ALS (block-factor model; predict is a two-input op, not a MapModel) ----
+
+class ALSModel(Model):
+    """Fitted ALS factors (reference pipeline/recommendation/ALSModel)."""
+
+    _PARAM_INFOS = {**AlsTrainBatchOp._PARAM_INFOS,
+                    **AlsPredictBatchOp._PARAM_INFOS}
+
+    def transform(self, in_op) -> BatchOperator:
+        op = AlsPredictBatchOp(self.params.clone())
+        return op.link_from(TableSourceBatchOp(self.get_model_data()),
+                            _as_op(in_op))
+
+    def recommend_top_k(self, in_op, k: int = 10) -> BatchOperator:
+        op = AlsTopKPredictBatchOp(self.params.clone(), top_k=k)
+        return op.link_from(TableSourceBatchOp(self.get_model_data()),
+                            _as_op(in_op))
+
+
+class ALS(Estimator):
+    """reference pipeline/recommendation/ALS.java"""
+
+    _PARAM_INFOS = dict(ALSModel._PARAM_INFOS)
+
+    def fit(self, in_op) -> ALSModel:
+        train = AlsTrainBatchOp(self.params.clone())
+        train.link_from(_as_op(in_op))
+        model = ALSModel(self.params.clone())
+        model.set_model_data(train.get_output_table())
+        return model
+
+
+# -- stateless transformers -------------------------------------------------
+
+def _op_transformer(name: str, op_cls) -> type:
+    return type(BatchOpTransformer)(
+        name, (BatchOpTransformer,),
+        {"OP_CLS": op_cls, "_PARAM_INFOS": dict(op_cls._PARAM_INFOS),
+         "__doc__": f"pipeline transformer over {op_cls.__name__} "
+                    f"(reference pipeline class of the same name)",
+         "__module__": __name__})
+
+
+VectorSlicer = _op_transformer("VectorSlicer", VectorSliceBatchOp)
+VectorInteraction = _op_transformer("VectorInteraction", VectorInteractionBatchOp)
+VectorElementwiseProduct = _op_transformer("VectorElementwiseProduct",
+                                           VectorElementwiseProductBatchOp)
+VectorPolynomialExpand = _op_transformer("VectorPolynomialExpand",
+                                         VectorPolynomialExpandBatchOp)
+VectorSizeHint = _op_transformer("VectorSizeHint", VectorSizeHintBatchOp)
+Select = _op_transformer("Select", SelectBatchOp)
+# VectorToColumns comes from the format matrix below (reference
+# pipeline/dataproc/format/VectorToColumns.java)
+
+# the format-conversion transformer matrix (reference pipeline/dataproc/format/
+# ColumnsToCsv.java etc.) — skip the Triple ops (no pipeline shells upstream)
+FORMAT_TRANSFORMERS = {}
+for _bname, _bcls in FORMAT_OPS.items():
+    if "Triple" in _bname or _bname.startswith(("Base", "Any")):
+        continue
+    _tname = _bname[: -len("BatchOp")]
+    FORMAT_TRANSFORMERS[_tname] = _op_transformer(_tname, _bcls)
+globals().update(FORMAT_TRANSFORMERS)
+
+__all__ = sorted(
+    ["PipelineStageBase", "EstimatorBase", "TransformerBase", "ModelBase",
+     "MapTransformer", "BaseFormatTrans", "BaseTuning", "TuningEvaluator",
+     "MulticlassClassificationTuningEvaluator", "LocalPredictable",
+     "ModelExporterUtils", "BaseTuningModel", "GridSearchCVModel",
+     "GridSearchTVSplitModel", "PipelineCandidatesBase",
+     "PipelineCandidatesGrid", "GaussianMixture", "GaussianMixtureModel",
+     "BisectingKMeans", "BisectingKMeansModel", "GeneralizedLinearRegression",
+     "GeneralizedLinearRegressionModel", "IsotonicRegression",
+     "IsotonicRegressionModel", "AftSurvivalRegression",
+     "AftSurvivalRegressionModel", "MultilayerPerceptronClassifier",
+     "MultilayerPerceptronClassificationModel", "MultiStringIndexer",
+     "MultiStringIndexerModel", "VectorImputer", "VectorImputerModel",
+     "PCA", "PCAModel", "IndexToString", "ALS", "ALSModel", "VectorSlicer",
+     "VectorInteraction", "VectorElementwiseProduct",
+     "VectorPolynomialExpand", "VectorSizeHint", "Select"]
+    + list(FORMAT_TRANSFORMERS))
+
+
+# reference names the tree models *ClassificationModel/*RegressionModel
+from .tree import (DecisionTreeClassifierModel as DecisionTreeClassificationModel,
+                   DecisionTreeRegressorModel as DecisionTreeRegressionModel,
+                   GbdtClassifierModel as GbdtClassificationModel,
+                   GbdtRegressorModel as GbdtRegressionModel,
+                   RandomForestClassifierModel as RandomForestClassificationModel,
+                   RandomForestRegressorModel as RandomForestRegressionModel)
+from .fm_nb import FmClassifierModel as FmModel
+
+__all__ += ["DecisionTreeClassificationModel", "DecisionTreeRegressionModel",
+            "GbdtClassificationModel", "GbdtRegressionModel",
+            "RandomForestClassificationModel", "RandomForestRegressionModel",
+            "FmModel"]

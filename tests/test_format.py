@@ -22,8 +22,12 @@ def _src():
 
 
 def test_matrix_completeness():
-    # 5 formats pairwise (20) + 5 *ToTriple + 5 TripleTo* + AnyToTriple
+    # 5 formats pairwise (20) + 5 *ToTriple + 5 TripleTo* + AnyToTriple;
+    # TripleToAnyBatchOp is the abstract grouping base, exported but not
+    # in the concrete-op matrix
     assert len(F.FORMAT_OPS) == 31
+    assert "TripleToAnyBatchOp" not in F.FORMAT_OPS
+    assert hasattr(F, "TripleToAnyBatchOp")
     for a in ("Columns", "Csv", "Json", "Kv", "Vector"):
         for b in ("Columns", "Csv", "Json", "Kv", "Vector", "Triple"):
             if a != b:
@@ -100,3 +104,22 @@ def test_kv_to_json_stream():
     StreamOperator.execute()
     got = sink.get_and_remove_values().to_rows()
     assert json.loads(got[0][-1]) == {"k": "1"}
+
+
+def test_kv_digit_keys_not_positionally_remapped():
+    # regression: KV dicts whose keys happen to be digits must be matched by
+    # NAME, never remapped to positions like vector-sourced dicts
+    kv = MemSourceBatchOp([("1:2.0,3:4.0",)], "kv STRING")
+    csv = F.KvToCsvBatchOp(kv_col="kv", csv_col="c",
+                           schema_str="1 DOUBLE, 3 DOUBLE").link_from(kv)
+    assert csv.collect_mtable().col("c")[0] == "2.0,4.0"
+    cols = F.KvToColumnsBatchOp(kv_col="kv",
+                                schema_str="5 DOUBLE, 3 DOUBLE").link_from(kv)
+    assert cols.collect_mtable().to_rows()[0] == (None, 4.0)
+
+
+def test_vector_to_csv_positional():
+    v = MemSourceBatchOp([("1.5 2.5",)], "v STRING")
+    csv = F.VectorToCsvBatchOp(vector_col="v", csv_col="c",
+                               schema_str="a DOUBLE, b DOUBLE").link_from(v)
+    assert csv.collect_mtable().col("c")[0] == "1.5,2.5"

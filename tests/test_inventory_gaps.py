@@ -1,0 +1,264 @@
+"""Tests for the operators/wrappers that close the reference inventory
+(SURVEY §2.5): UDF/UDTF/FlatMap/Print, Text sink, VectorImputer,
+VectorSerialize, VectorChiSquareTest/Selector, stream twins, DB stream
+source, ALS stream predict, and the pipeline shells added in
+pipeline/extras.py."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.operator.stream import (AlsPredictStreamOp, BinarizerStreamOp,
+                                       FlatMapStreamOp, MemSourceStreamOp,
+                                       UDFStreamOp, VectorSliceStreamOp)
+from alink_tpu.operator.batch.utils import (FlatMapBatchOp, PrintBatchOp,
+                                            UDFBatchOp, UDTFBatchOp)
+
+
+def _drain(op):
+    return [r for mt in op.micro_batches() for r in mt.to_rows()]
+
+
+class TestFnOps:
+    def setup_method(self):
+        self.src = MemSourceBatchOp([(1.0, 2.0, "ab"), (3.0, 4.0, "c")],
+                                    "x DOUBLE, y DOUBLE, s STRING")
+
+    def test_udf(self):
+        op = UDFBatchOp(selected_cols=["x", "y"],
+                        output_col="z").set_func(lambda x, y: x * y)
+        rows = self.src.link(op).collect()
+        assert [r[-1] for r in rows] == [2.0, 12.0]
+        assert op.get_col_names() == ["x", "y", "s", "z"]
+
+    def test_udf_output_col_replaces(self):
+        op = UDFBatchOp(selected_cols=["x"], output_col="x",
+                        ).set_func(lambda x: -x)
+        rows = self.src.link(op).collect()
+        assert op.get_col_names() == ["y", "s", "x"]
+        assert [r[-1] for r in rows] == [-1.0, -3.0]
+
+    def test_udtf(self):
+        op = UDTFBatchOp(selected_cols=["s"], output_cols=["ch"],
+                         reserved_cols=["x"], result_types=["STRING"]
+                         ).set_func(lambda s: [(c,) for c in s])
+        rows = self.src.link(op).collect()
+        assert rows == [(1.0, "a"), (1.0, "b"), (3.0, "c")]
+
+    def test_flat_map(self):
+        op = FlatMapBatchOp(schema_str="v DOUBLE").set_func(
+            lambda row: [(row[0],), (row[1],)])
+        assert self.src.link(op).collect() == [(1.0,), (2.0,), (3.0,), (4.0,)]
+
+    def test_missing_func_raises(self):
+        with pytest.raises(ValueError):
+            self.src.link(UDFBatchOp(selected_cols=["x"], output_col="z"))
+
+    def test_print_passthrough(self, capsys):
+        out = self.src.link(PrintBatchOp())
+        assert out.collect() == self.src.collect()
+        assert "ab" in capsys.readouterr().out
+
+    def test_udf_stream(self):
+        src = MemSourceStreamOp([(0.5,), (2.5,)], "x DOUBLE", batch_size=1)
+        op = UDFStreamOp(selected_cols=["x"], output_col="y"
+                         ).set_func(lambda x: x + 1).link_from(src)
+        assert _drain(op) == [(0.5, 1.5), (2.5, 3.5)]
+
+    def test_flatmap_stream(self):
+        src = MemSourceStreamOp([(1.0,)], "x DOUBLE", batch_size=4)
+        op = FlatMapStreamOp(schema_str="v DOUBLE").set_func(
+            lambda row: [(row[0],)] * 3).link_from(src)
+        assert _drain(op) == [(1.0,)] * 3
+
+
+class TestSinksAndVectorOps:
+    def test_text_sink(self, tmp_path):
+        from alink_tpu.operator.batch.sink import TextSinkBatchOp
+        p = str(tmp_path / "t.txt")
+        MemSourceBatchOp([("a",), ("b",)], "s STRING").link(
+            TextSinkBatchOp(file_path=p))
+        assert open(p).read().splitlines() == ["a", "b"]
+
+    def test_text_sink_multicol_rejected(self, tmp_path):
+        from alink_tpu.operator.batch.sink import TextSinkBatchOp
+        src = MemSourceBatchOp([("a", "b")], "s STRING, t STRING")
+        with pytest.raises(ValueError):
+            src.link(TextSinkBatchOp(file_path=str(tmp_path / "t.txt")))
+
+    def test_vector_imputer_roundtrip(self):
+        from alink_tpu.operator.batch.dataproc.vector_ops import (
+            VectorImputerPredictBatchOp, VectorImputerTrainBatchOp)
+        src = MemSourceBatchOp([("1.0 nan", ), ("3.0 8.0",)], "v STRING")
+        model = src.link(VectorImputerTrainBatchOp(selected_col="v"))
+        out = VectorImputerPredictBatchOp(selected_col="v").link_from(model, src)
+        vecs = [r[0].to_array() for r in out.collect()]
+        np.testing.assert_allclose(vecs[0], [1.0, 8.0])
+
+    def test_vector_imputer_value_strategy(self):
+        from alink_tpu.operator.batch.dataproc.vector_ops import (
+            VectorImputerPredictBatchOp, VectorImputerTrainBatchOp)
+        src = MemSourceBatchOp([("nan 2.0",)], "v STRING")
+        model = src.link(VectorImputerTrainBatchOp(
+            selected_col="v", strategy="VALUE", fill_value=-1.0))
+        out = VectorImputerPredictBatchOp(selected_col="v").link_from(model, src)
+        np.testing.assert_allclose(out.collect()[0][0].to_array(), [-1.0, 2.0])
+
+    def test_vector_serialize(self):
+        from alink_tpu.operator.batch.dataproc.vector_ops import \
+            VectorSerializeBatchOp
+        src = MemSourceBatchOp([("1.0 2.0",)], "v VECTOR")
+        out = src.link(VectorSerializeBatchOp())
+        assert out.get_schema().type_of("v") == "STRING"
+
+    def test_vector_chi_square_test(self):
+        from alink_tpu.operator.batch.statistics.stat_ops import \
+            VectorChiSquareTestBatchOp
+        src = MemSourceBatchOp(
+            [("1.0 0.0", 0), ("1.0 1.0", 1), ("0.0 0.0", 0), ("0.0 1.0", 1)],
+            "v STRING, label INT")
+        rows = src.link(VectorChiSquareTestBatchOp(
+            vector_col="v", label_col="label")).collect()
+        # component 1 equals the label -> tiny p; component 0 independent -> p=1
+        assert rows[0][1] == pytest.approx(1.0)
+        assert rows[1][1] < 0.05
+
+    def test_vector_chisq_selector(self):
+        from alink_tpu.operator.batch.feature.feature_ops import \
+            VectorChiSqSelectorBatchOp
+        src = MemSourceBatchOp(
+            [("1.0 0.0", 0), ("1.0 1.0", 1), ("0.0 0.0", 0), ("0.0 1.0", 1)],
+            "v STRING, label INT")
+        op = VectorChiSqSelectorBatchOp(vector_col="v", label_col="label",
+                                        num_top_features=1)
+        src.link(op)
+        assert op._chosen == [1]
+
+    def test_stream_twins(self):
+        src = MemSourceStreamOp([(0.2,), (0.9,)], "x DOUBLE", batch_size=1)
+        out = BinarizerStreamOp(selected_col="x", threshold=0.5).link_from(src)
+        assert [r[0] for r in _drain(out)] == [0.0, 1.0]
+        vs = MemSourceStreamOp([("1.0 2.0 3.0",)], "v STRING", batch_size=1)
+        sl = VectorSliceStreamOp(selected_col="v", indices=[2]).link_from(vs)
+        np.testing.assert_allclose(_drain(sl)[0][0].to_array(), [3.0])
+
+
+class TestDbStream:
+    def test_db_source_stream(self, tmp_path):
+        from alink_tpu.io.db import SqliteDB
+        from alink_tpu.operator.batch.sink import DBSinkBatchOp
+        from alink_tpu.operator.stream import DBSourceStreamOp
+        db = SqliteDB("t_inv", path=str(tmp_path / "d.db"))
+        MemSourceBatchOp([(1, "a"), (2, "b"), (3, "c")],
+                         "id LONG, s STRING").link(
+            DBSinkBatchOp(db=db, output_table_name="t"))
+        src = DBSourceStreamOp(db=db, input_table_name="t", batch_size=2)
+        assert len(_drain(src)) == 3
+
+
+class TestPipelineExtras:
+    def test_inventory_names_importable(self):
+        import alink_tpu.pipeline as P
+        for name in ["ALS", "ALSModel", "GaussianMixture", "BisectingKMeans",
+                     "GeneralizedLinearRegression", "IsotonicRegression",
+                     "AftSurvivalRegression", "MultilayerPerceptronClassifier",
+                     "MultiStringIndexer", "IndexToString", "PCA", "PCAModel",
+                     "VectorSlicer", "VectorImputer", "Select",
+                     "EstimatorBase", "TransformerBase", "ModelBase",
+                     "PipelineStageBase", "MapTransformer", "LocalPredictable",
+                     "ModelExporterUtils", "BaseTuning", "TuningEvaluator",
+                     "GridSearchCVModel", "PipelineCandidatesGrid",
+                     "ColumnsToVector", "CsvToColumns", "KvToJson",
+                     "VectorToColumns", "FmModel",
+                     "GbdtClassificationModel",
+                     "RandomForestRegressionModel"]:
+            assert hasattr(P, name), name
+
+    def test_als_pipeline_and_stream(self):
+        src = MemSourceBatchOp(
+            [(0, 0, 4.0), (0, 1, 2.0), (1, 0, 5.0), (1, 1, 1.0)],
+            "u LONG, i LONG, r DOUBLE")
+        from alink_tpu.pipeline import ALS
+        model = ALS(user_col="u", item_col="i", rate_col="r", rank=2,
+                    num_iter=4, prediction_col="p").fit(src)
+        rows = model.transform(src).collect()
+        preds = np.array([r[-1] for r in rows])
+        np.testing.assert_allclose(preds, [4, 2, 5, 1], atol=1.0)
+        # stream predict with the same factors
+        from alink_tpu.operator.base import TableSourceBatchOp
+        stream = MemSourceStreamOp([(0, 0), (1, 1)], "u LONG, i LONG",
+                                   batch_size=1)
+        sp = AlsPredictStreamOp(
+            TableSourceBatchOp(model.get_model_data()),
+            user_col="u", item_col="i", prediction_col="p").link_from(stream)
+        out = _drain(sp)
+        assert len(out) == 2 and abs(out[0][-1] - 4.0) < 1.0
+
+    def test_isotonic_pipeline(self):
+        from alink_tpu.pipeline import IsotonicRegression
+        src = MemSourceBatchOp([(1.0, 0.1), (2.0, 0.5), (3.0, 0.4), (4.0, 0.9)],
+                               "f DOUBLE, label DOUBLE")
+        m = IsotonicRegression(feature_col="f", label_col="label",
+                               prediction_col="p").fit(src)
+        preds = [r[-1] for r in m.transform(src).collect()]
+        assert preds == sorted(preds)  # isotonic: non-decreasing
+
+    def test_mlpc_pipeline(self):
+        from alink_tpu.pipeline import MultilayerPerceptronClassifier
+        rng = np.random.RandomState(0)
+        X = rng.randn(60, 2)
+        y = (X[:, 0] + X[:, 1] > 0).astype(int)
+        rows = [(float(a), float(b), int(c)) for (a, b), c in zip(X, y)]
+        src = MemSourceBatchOp(rows, "f0 DOUBLE, f1 DOUBLE, label INT")
+        m = MultilayerPerceptronClassifier(
+            feature_cols=["f0", "f1"], label_col="label", layers=[8, 2],
+            max_iter=40, prediction_col="p").fit(src)
+        preds = [r[-1] for r in m.transform(src).collect()]
+        acc = np.mean([p == c for p, c in zip(preds, y)])
+        assert acc > 0.8
+
+    def test_format_transformer_roundtrip(self):
+        from alink_tpu.pipeline import ColumnsToVector, VectorToColumns
+        src = MemSourceBatchOp([(1.0, 2.0)], "a DOUBLE, b DOUBLE")
+        v = ColumnsToVector(selected_cols=["a", "b"], vector_col="v",
+                            reserved_cols=[]).transform(src)
+        back = VectorToColumns(vector_col="v",
+                               schema_str="a DOUBLE, b DOUBLE",
+                               reserved_cols=[]).transform(v)
+        assert back.collect()[0][-2:] == (1.0, 2.0)
+
+    def test_model_exporter_utils(self, tmp_path):
+        from alink_tpu.pipeline import (ModelExporterUtils, Pipeline,
+                                        PipelineModel)
+        from alink_tpu.pipeline.extras import VectorSlicer
+        pm = PipelineModel(VectorSlicer(selected_col="v", indices=[0]))
+        p = str(tmp_path / "m.json")
+        ModelExporterUtils.save_pipeline_model(pm, p)
+        loaded = ModelExporterUtils.load_pipeline_model(p)
+        src = MemSourceBatchOp([("3.0 4.0",)], "v STRING")
+        np.testing.assert_allclose(
+            loaded.transform(src).collect()[0][0].to_array(), [3.0])
+
+
+def test_vector_imputer_dim_mismatch_is_clear_error():
+    # regression: predict-time vector longer than the trained fill vector
+    from alink_tpu.operator.batch.dataproc.vector_ops import (
+        VectorImputerPredictBatchOp, VectorImputerTrainBatchOp)
+    train = MemSourceBatchOp([("1.0 2.0",)], "v STRING")
+    model = train.link(VectorImputerTrainBatchOp(selected_col="v"))
+    # NaN inside the trained range of a longer vector imputes fine
+    longer = MemSourceBatchOp([("1.0 nan 5.0",)], "v STRING")
+    out = VectorImputerPredictBatchOp(selected_col="v").link_from(model, longer)
+    np.testing.assert_allclose(out.collect()[0][0].to_array(), [1.0, 2.0, 5.0])
+    # NaN beyond the trained dims is a clear error, not a crash
+    beyond = MemSourceBatchOp([("1.0 2.0 nan",)], "v STRING")
+    with pytest.raises(ValueError, match="no trained fill"):
+        VectorImputerPredictBatchOp(selected_col="v").link_from(model, beyond)
+    # VALUE strategy broadcasts everywhere regardless of length
+    model_v = train.link(VectorImputerTrainBatchOp(
+        selected_col="v", strategy="VALUE", fill_value=0.5))
+    out = VectorImputerPredictBatchOp(selected_col="v").link_from(model_v, beyond)
+    np.testing.assert_allclose(out.collect()[0][0].to_array(), [1.0, 2.0, 0.5])
