@@ -128,15 +128,13 @@ def _write_csv(op, dicts, t, reserved):
     out_col = op.params._m["csv_col"]
     delim = op.params._m.get("csv_field_delimiter", ",")
     schema = op.params._m.get("schema_str")
-    all_keys = {k for d in dicts for k in d}
     if schema:
         keys = TableSchema.parse(schema).names
         if _from_vector(op):
             keys = [str(j) for j in range(len(keys))]  # positional
-    elif _from_vector(op):
-        keys = sorted(all_keys, key=int)
     else:
-        keys = sorted(all_keys)
+        all_keys = {k for d in dicts for k in d}
+        keys = sorted(all_keys, key=int) if _from_vector(op) else sorted(all_keys)
     vals = [delim.join("" if d.get(k) is None else _fmt_scalar(d[k])
                        for k in keys) for d in dicts]
     return _with_out(op, t, reserved, out_col, vals, AlinkTypes.STRING)

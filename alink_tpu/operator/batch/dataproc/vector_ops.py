@@ -315,14 +315,16 @@ class VectorImputerModelMapper(ModelMapper):
     def __init__(self, model_schema, data_schema, params=None, **kwargs):
         super().__init__(model_schema, data_schema, params, **kwargs)
         self.fill = None
+        self.strategy = None
 
     def load_model(self, model_table: MTable):
-        _, stats = _VectorScalerConverter().load_model(model_table)
+        tag, stats = _VectorScalerConverter().load_model(model_table)
+        self.strategy = tag.split(":", 1)[1] if ":" in tag else tag
         self.fill = stats["fill"]
 
     def _fill_at(self, idx: np.ndarray, row: int) -> np.ndarray:
         fill = self.fill
-        if len(fill) == 1:  # VALUE strategy: one scalar for every component
+        if self.strategy == "VALUE":  # one scalar for every component
             return np.full(len(idx), fill[0])
         if idx.size and int(idx.max()) >= len(fill):
             raise ValueError(

@@ -18,6 +18,7 @@ from ....common.mtable import MTable
 from ....common.params import InValidator, ParamInfo, Params, RangeValidator
 from ....common.types import AlinkTypes, TableSchema
 from ....common.vector import DenseVector, SparseVector, VectorUtil
+from ...common.dataproc.feature_extract import extract_dense_matrix
 from ....mapper.base import Mapper, ModelMapper, OutputColsHelper
 from ....model.converters import SimpleModelDataConverter, decode_array, encode_array
 from ....params.shared import (HasFeatureCols, HasLabelCol, HasOutputCol,
@@ -314,7 +315,7 @@ class PcaTrainBatchOp(BatchOperator, HasSelectedCols, HasVectorCol):
     def link_from(self, in_op: BatchOperator) -> "PcaTrainBatchOp":
         import jax.numpy as jnp
         t = in_op.get_output_table()
-        X = _extract_matrix(t, self.params._m.get("selected_cols"),
+        X = extract_dense_matrix(t, self.params._m.get("selected_cols"),
                             self.params._m.get("vector_col"))
         k = self.get_k()
         mean = X.mean(0)
@@ -344,7 +345,7 @@ class PcaModelMapper(ModelMapper):
 
     def map_table(self, data: MTable) -> MTable:
         mean, std, comps, _ = self.model
-        X = _extract_matrix(data, self.params._m.get("selected_cols"),
+        X = extract_dense_matrix(data, self.params._m.get("selected_cols"),
                             self.params._m.get("vector_col"))
         Z = ((X - mean) / std) @ comps.T
         out_col = self.params._m.get("prediction_col") \
@@ -406,15 +407,6 @@ def _dct2_ortho(X, inverse=False):
     return X @ M
 
 
-def _extract_matrix(t: MTable, selected_cols, vector_col) -> np.ndarray:
-    from ...common.dataproc.feature_extract import extract_design
-    design = extract_design(t, selected_cols, vector_col, np.float64)
-    if design["kind"] == "dense":
-        return design["X"]
-    from ....common.vector import SparseBatch
-    return SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)
-
-
 class VectorChiSqSelectorBatchOp(BatchOperator, HasVectorCol, HasSelectedCol,
                                  HasLabelCol):
     """reference: feature/VectorChiSqSelectorBatchOp — rank vector components
@@ -423,15 +415,9 @@ class VectorChiSqSelectorBatchOp(BatchOperator, HasVectorCol, HasSelectedCol,
 
     def link_from(self, in_op: BatchOperator) -> "VectorChiSqSelectorBatchOp":
         from ...common.statistics.hypothesis import chi_square_test
-        from ...common.dataproc.feature_extract import extract_design
         t = in_op.get_output_table()
         col = self.params._m.get("vector_col") or self.params._m.get("selected_col")
-        design = extract_design(t, None, col)
-        X = design["X"] if design["kind"] == "dense" else None
-        if X is None:
-            from ....common.vector import SparseBatch
-            X = SparseBatch(design["idx"], design["val"],
-                            design["dim"]).to_dense(np.float64)
+        X = extract_dense_matrix(t, None, col)
         label = t.col(self.get_label_col())
         scored = []
         for j in range(X.shape[1]):

@@ -101,13 +101,9 @@ class VectorCorrelationBatchOp(BatchOperator, HasVectorCol):
     METHOD = CorrelationBatchOp.METHOD
 
     def link_from(self, in_op: BatchOperator) -> "VectorCorrelationBatchOp":
-        from ...common.dataproc.feature_extract import extract_design
+        from ...common.dataproc.feature_extract import extract_dense_matrix
         t = in_op.get_output_table()
-        design = extract_design(t, None, self.params._m.get("vector_col"))
-        X = design["X"] if design["kind"] == "dense" else None
-        if X is None:
-            from ....common.vector import SparseBatch
-            X = SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)
+        X = extract_dense_matrix(t, None, self.params._m.get("vector_col"))
         C = (pearson_corr(X) if self.get_method().upper() == "PEARSON"
              else spearman_corr(X))
         self._corr = C
@@ -140,15 +136,10 @@ class VectorChiSquareTestBatchOp(BatchOperator, HasVectorCol, HasSelectedCol,
     vector column against the label."""
 
     def link_from(self, in_op: BatchOperator) -> "VectorChiSquareTestBatchOp":
-        from ...common.dataproc.feature_extract import extract_design
+        from ...common.dataproc.feature_extract import extract_dense_matrix
         t = in_op.get_output_table()
         col = self.params._m.get("vector_col") or self.params._m.get("selected_col")
-        design = extract_design(t, None, col)
-        X = design["X"] if design["kind"] == "dense" else None
-        if X is None:
-            from ....common.vector import SparseBatch
-            X = SparseBatch(design["idx"], design["val"],
-                            design["dim"]).to_dense(np.float64)
+        X = extract_dense_matrix(t, None, col)
         label = t.col(self.get_label_col())
         rows = []
         for j in range(X.shape[1]):

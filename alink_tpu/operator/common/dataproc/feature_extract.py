@@ -108,3 +108,15 @@ def add_intercept(design: Dict, dtype=np.float64) -> Dict:
     idx2 = np.concatenate([np.zeros((n, 1), idx.dtype), idx + 1], 1)
     val2 = np.concatenate([np.ones((n, 1), val.dtype), val], 1)
     return {"kind": "sparse", "idx": idx2, "val": val2, "dim": design["dim"] + 1}
+
+
+def extract_dense_matrix(t, selected_cols, vector_col,
+                         dtype=np.float64) -> np.ndarray:
+    """extract_design densified: dense design matrices regardless of the
+    input encoding (sparse designs go through SparseBatch.to_dense)."""
+    design = extract_design(t, selected_cols, vector_col, dtype)
+    if design["kind"] == "dense":
+        return design["X"]
+    from ....common.vector import SparseBatch
+    return SparseBatch(design["idx"], design["val"],
+                       design["dim"]).to_dense(dtype)

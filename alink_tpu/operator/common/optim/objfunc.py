@@ -176,17 +176,37 @@ LOSS_REGISTRY = {
 # design-matrix ops over a data shard
 # ---------------------------------------------------------------------------
 
-def matvec(data: Dict, coef):
-    """margins = X @ coef for dense or padded-COO shard."""
+def matvec(data: Dict, coef, fb_meta=None):
+    """margins = X @ coef for dense, padded-COO, or field-blocked shard.
+
+    Field-blocked shards ({"fb_idx"}) route to the factored-one-hot MXU
+    kernel (ops/fieldblock.py) instead of XLA's serialized random gather.
+    """
     if "X" in data:
         return data["X"] @ coef
+    if "fb_idx" in data:
+        if fb_meta is None:
+            raise ValueError("shard has 'fb_idx' but no FieldBlockMeta was "
+                             "provided (pass fb_meta= to the objective)")
+        from ....ops.fieldblock import fb_matvec
+        return fb_matvec(data["fb_idx"], coef, fb_meta, val=data.get("fb_val"))
     return (data["val"] * coef[data["idx"]]).sum(-1)
 
 
-def rmatvec(data: Dict, c, dim: int):
-    """X^T @ c — gradient accumulation (one-hot scatter-add for sparse)."""
+def rmatvec(data: Dict, c, dim: int, fb_meta=None):
+    """X^T @ c — gradient accumulation.
+
+    Dense: one matmul. Field-blocked: scatter-free factored one-hot
+    (ops/fieldblock.py). Padded-COO: XLA scatter-add (slow on TPU — the
+    general-sparsity fallback)."""
     if "X" in data:
         return data["X"].T @ c
+    if "fb_idx" in data:
+        if fb_meta is None:
+            raise ValueError("shard has 'fb_idx' but no FieldBlockMeta was "
+                             "provided (pass fb_meta= to the objective)")
+        from ....ops.fieldblock import fb_rmatvec
+        return fb_rmatvec(data["fb_idx"], c, fb_meta, val=data.get("fb_val"))
     contrib = data["val"] * c[:, None]
     return jnp.zeros(dim, contrib.dtype).at[data["idx"].reshape(-1)].add(
         contrib.reshape(-1))
@@ -231,24 +251,32 @@ class OptimObjFunc:
 
 
 class UnaryLossObjFunc(OptimObjFunc):
-    """sum_i w_i * loss(x_i . coef, y_i) (reference common/linear/UnaryLossObjFunc.java)."""
+    """sum_i w_i * loss(x_i . coef, y_i) (reference common/linear/UnaryLossObjFunc.java).
+
+    ``fb_meta`` (ops.fieldblock.FieldBlockMeta) enables the field-blocked
+    fast path when the shard carries ``fb_idx``.
+    """
 
     def __init__(self, unary_loss: UnaryLossFunc, dim: int, l1=0.0, l2=0.0,
-                 reg_free_head: int = 0):
+                 reg_free_head: int = 0, fb_meta=None):
         super().__init__(dim, l1, l2, reg_free_head)
         self.unary_loss = unary_loss
+        if fb_meta is not None and fb_meta.dim != self.dim:
+            raise ValueError(f"fb_meta.dim {fb_meta.dim} != objective dim "
+                             f"{self.dim} (dim must be num_fields*field_size)")
+        self.fb_meta = fb_meta
 
     def calc_grad_shard(self, data, coef):
-        eta = matvec(data, coef)
+        eta = matvec(data, coef, self.fb_meta)
         y, w = data["y"], data["w"]
         loss = (w * self.unary_loss.loss(eta, y)).sum()
         c = w * self.unary_loss.derivative(eta, y)
-        grad = rmatvec(data, c, self.dim)
+        grad = rmatvec(data, c, self.dim, self.fb_meta)
         return grad, loss, w.sum()
 
     def line_losses_shard(self, data, coef, direction, steps):
-        eta0 = matvec(data, coef)
-        etad = matvec(data, direction)
+        eta0 = matvec(data, coef, self.fb_meta)
+        etad = matvec(data, direction, self.fb_meta)
         y, w = data["y"], data["w"]
 
         def one(s):

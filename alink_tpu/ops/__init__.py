@@ -1,0 +1,22 @@
+"""TPU-native compute kernels (the framework's "BLAS layer").
+
+The reference dispatches its hot loops to native BLAS through JNI
+(common/linalg/BLAS.java:10-26) and hand-written Java inner loops
+(per-sample gradient loops in common/optim/subfunc/CalcGradient.java:27-54).
+On TPU the equivalents are XLA programs shaped for the MXU plus Pallas
+kernels where XLA's default lowering is wrong for the access pattern —
+most importantly random gather/scatter, which XLA serializes on TPU.
+
+`fieldblock` implements the field-blocked sparse format and its
+factored-one-hot matvec/rmatvec — the TPU answer to the reference's
+SparseVector dot/axpy hot loops.
+"""
+
+from .fieldblock import (FieldBlockMeta, fb_fused_grad_pallas, fb_matvec,
+                         fb_rmatvec, fb_to_flat_indices, flat_to_fb_indices,
+                         hash_to_fields)
+
+__all__ = [
+    "FieldBlockMeta", "fb_matvec", "fb_rmatvec", "fb_fused_grad_pallas",
+    "fb_to_flat_indices", "flat_to_fb_indices", "hash_to_fields",
+]

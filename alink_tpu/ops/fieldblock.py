@@ -1,0 +1,271 @@
+"""Field-blocked sparse format + factored one-hot kernels.
+
+The motivating workload is the reference's Criteo-style CTR pipeline
+(FTRLExample.java:46-57): FeatureHasher murmurs every raw feature into one
+flat space and the linear trainers then do random gather (w[idx]) and
+random scatter-add (grad[idx] += c) per sample — fine on a CPU heap,
+catastrophic on TPU where XLA serializes both (measured ~67 ms for 6.4M
+random accesses on v5e vs ~0.1 ms of equivalent streaming traffic).
+
+TPU-first redesign: hash each input column (field) into its OWN contiguous
+sub-range of the model vector — ``dim = num_fields * field_size`` — so every
+sample holds exactly one local index per field: ``fb_idx`` of shape
+``(n, F)`` with values in ``[0, field_size)``. Field-aware hashing preserves
+the model class (same capacity, per-field collision behaviour is what
+production CTR systems use anyway). With that structure both directions of
+the sparse design-matrix product become MXU matmuls via a *factored one-hot*:
+
+    idx = hi * LO + lo,  LO = 16
+    A[n, f, h] = [hi == h]      (one-hot over field_size/16)
+    B[n, f, l] = [lo == l]      (one-hot over 16)
+
+    matvec:   eta = einsum(A, W, B)           # W: (F, H, LO)
+    rmatvec:  grad = einsum(A, B * c)
+
+The one-hots are never materialized to HBM — XLA fuses the iota-compares
+into the matmul operands. The factoring cuts the one-hot work from
+O(n*dim) to O(n*(H + LO)) per field. Measured on v5e-1: fused logistic
+gradient 19 ms vs 67+66 ms for XLA gather+scatter at n=200k, F=32,
+dim=65536.
+
+A fused Pallas kernel (`fb_fused_grad_pallas`) implements the same math
+with explicit VMEM residency; the XLA path is the default (measured faster
+— XLA's fusion beats the hand-rolled kernel's loop overheads) but the
+kernel is kept as a selectable backend and for the multi-sample-per-field
+variants XLA fuses badly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+LO = 16  # lo-part width; field_size must be a multiple of this
+
+
+@dataclass(frozen=True)
+class FieldBlockMeta:
+    """Shape metadata for a field-blocked design matrix.
+
+    dim = num_fields * field_size; global index of (field k, local j) is
+    ``k * field_size + j`` (field-major), matching the coefficient layout.
+    """
+    num_fields: int
+    field_size: int
+
+    @property
+    def dim(self) -> int:
+        return self.num_fields * self.field_size
+
+    @property
+    def hi_size(self) -> int:
+        return self.field_size // LO
+
+    def __post_init__(self):
+        if self.field_size % LO:
+            raise ValueError(f"field_size must be a multiple of {LO}")
+
+
+def hash_to_fields(columns, field_size: int, seed: int = 0) -> np.ndarray:
+    """Field-aware feature hashing: one column -> one field (host-side).
+
+    The reference hashes all columns into one flat space
+    (FeatureHasherMapper over murmur32); here each column owns a
+    ``field_size`` sub-range so the result is field-blocked by
+    construction. Returns ``fb_idx`` of shape (n, num_columns) int32.
+    """
+    from ..operator.batch.feature.feature_ops import murmur32
+    cols = list(columns)
+    n = len(cols[0])
+    out = np.empty((n, len(cols)), np.int32)
+    for k, col in enumerate(cols):
+        out[:, k] = [murmur32(f"{k}={v}".encode(), seed) % field_size
+                     for v in col]
+    return out
+
+
+def fb_to_flat_indices(fb_idx: np.ndarray, meta: FieldBlockMeta) -> np.ndarray:
+    """(n, F) field-local -> (n, F) global indices into the dim-vector."""
+    offs = (np.arange(meta.num_fields, dtype=np.int64) * meta.field_size)
+    return (np.asarray(fb_idx, np.int64) + offs[None, :]).astype(np.int32)
+
+
+def flat_to_fb_indices(idx: np.ndarray, meta: FieldBlockMeta) -> Optional[np.ndarray]:
+    """Recognize a field-blocked pattern in padded-COO indices.
+
+    Returns (n, F) local indices if every row's k-th entry falls in field
+    k's range (the shape produced by field-aware hashing), else None.
+    """
+    idx = np.asarray(idx)
+    if idx.ndim != 2 or idx.shape[1] != meta.num_fields:
+        return None
+    offs = np.arange(meta.num_fields, dtype=idx.dtype) * meta.field_size
+    local = idx - offs[None, :]
+    if (local < 0).any() or (local >= meta.field_size).any():
+        return None
+    return local.astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# factored one-hot ops (XLA path — default)
+# ---------------------------------------------------------------------------
+
+def _default_dtype():
+    """bf16 on TPU (MXU-native), f32 elsewhere (CPU dot lacks bf16)."""
+    import jax
+    import jax.numpy as jnp
+    return jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+
+
+def _parts(fb_idx, meta: FieldBlockMeta):
+    import jax.numpy as jnp
+    hi = fb_idx // LO
+    lo = fb_idx - hi * LO
+    A = (hi[..., None] == jnp.arange(meta.hi_size)[None, None, :])
+    B = (lo[..., None] == jnp.arange(LO)[None, None, :])
+    return A, B
+
+
+def _w3(coef, meta: FieldBlockMeta):
+    return coef.reshape(meta.num_fields, meta.hi_size, LO)
+
+
+def fb_matvec(fb_idx, coef, meta: FieldBlockMeta, val=None, dtype=None):
+    """eta[i] = sum_k val[i,k] * coef[k*S + fb_idx[i,k]]  — all MXU.
+
+    Replaces the per-sample SparseVector dot of the reference's
+    LinearModelMapper / OptimObjFunc.calcGradient inner loop.
+    """
+    import jax.numpy as jnp
+    dtype = dtype or _default_dtype()
+    A, B = _parts(fb_idx, meta)
+    W = _w3(coef, meta).astype(dtype)
+    rows = jnp.einsum("nfh,fhl->nfl", A.astype(dtype), W,
+                      preferred_element_type=jnp.float32)
+    Bv = B.astype(jnp.float32)
+    if val is not None:
+        Bv = Bv * val[..., None].astype(jnp.float32)
+    return jnp.einsum("nfl,nfl->n", rows, Bv)
+
+
+def fb_rmatvec(fb_idx, c, meta: FieldBlockMeta, val=None, dtype=None):
+    """grad = X^T c for the field-blocked design matrix — scatter-free.
+
+    Replaces the reference's per-sample scatter-add
+    (OptimObjFunc.updateGradient / SparseVector axpy).
+    """
+    import jax.numpy as jnp
+    dtype = dtype or _default_dtype()
+    A, B = _parts(fb_idx, meta)
+    z = c
+    if val is not None:
+        z = z[:, None] * val
+        Z = B.astype(dtype) * z[..., None].astype(dtype)
+    else:
+        Z = B.astype(dtype) * z[:, None, None].astype(dtype)
+    g = jnp.einsum("nfh,nfl->fhl", A.astype(dtype), Z,
+                   preferred_element_type=jnp.float32)
+    return g.reshape(meta.dim)
+
+
+# ---------------------------------------------------------------------------
+# fused Pallas kernel (selectable backend; also the reference implementation
+# for how the math maps to VMEM/MXU explicitly)
+# ---------------------------------------------------------------------------
+
+def fb_fused_grad_pallas(fb_idx_t, y, w, coef, meta: FieldBlockMeta,
+                         deriv_and_loss, chunk: int = 4096,
+                         interpret: bool = False):
+    """One pass over the shard: eta, per-sample derivative, gradient, loss.
+
+    ``fb_idx_t``: (F, n_pad) transposed field-local indices (n_pad a
+    multiple of ``chunk``); ``deriv_and_loss(eta, y, w) -> (c, loss_vec)``
+    is inlined into the kernel (the reference's per-loss classes under
+    common/linear/unarylossfunc/ become VPU code here).
+
+    Grid streams row chunks from HBM; the coefficient table and the
+    gradient accumulator stay VMEM-resident across all grid steps.
+    Returns (grad_flat, eta, loss_sum).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    # interpret mode runs on the host backend, whose dot lacks bf16 support
+    mxu = jnp.float32 if interpret else jnp.bfloat16
+
+    F, S, H = meta.num_fields, meta.field_size, meta.hi_size
+    CH = int(chunk)
+    n_pad = fb_idx_t.shape[1]
+    if n_pad % CH:
+        raise ValueError(f"padded rows {n_pad} not a multiple of chunk {CH}")
+    coef_hl = coef.reshape(F * H, LO)
+
+    def kernel(idx_ref, y_ref, w_ref, coef_ref, grad_ref, eta_ref, acc_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _():
+            grad_ref[...] = jnp.zeros_like(grad_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        hi_iota = jax.lax.broadcasted_iota(jnp.int32, (CH, H), 1)
+        lo_iota = jax.lax.broadcasted_iota(jnp.int32, (CH, LO), 1)
+
+        def fwd(k, eta):
+            q = idx_ref[k, :]
+            hi = (q // LO)[:, None]
+            lo = (q % LO)[:, None]
+            A = (hi == hi_iota).astype(mxu)
+            r0 = pl.multiple_of(k * H, H)
+            ck = coef_ref[pl.ds(r0, H), :].astype(mxu)
+            rows = jnp.dot(A, ck, preferred_element_type=jnp.float32)
+            B = (lo == lo_iota).astype(jnp.float32)
+            return eta + (rows * B).sum(axis=1)
+
+        eta = jax.lax.fori_loop(0, F, fwd, jnp.zeros((CH,), jnp.float32))
+        yv, wv = y_ref[...], w_ref[...]
+        cvec, loss = deriv_and_loss(eta, yv, wv)
+        acc_ref[...] += jnp.sum(loss)[None, None]
+        eta_ref[...] = eta
+        cb = cvec[:, None].astype(mxu)
+
+        def bwd(k, _):
+            q = idx_ref[k, :]
+            hi = (q // LO)[:, None]
+            lo = (q % LO)[:, None]
+            A = (hi == hi_iota).astype(mxu)
+            B = (lo == lo_iota).astype(mxu)
+            g = jax.lax.dot_general(A, B * cb, (((0,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            r0 = pl.multiple_of(k * H, H)
+            grad_ref[pl.ds(r0, H), :] += g
+            return 0
+
+        jax.lax.fori_loop(0, F, bwd, 0)
+
+    grad, eta, loss = pl.pallas_call(
+        kernel,
+        grid=(n_pad // CH,),
+        in_specs=[
+            pl.BlockSpec((F, CH), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((CH,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((CH,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((F * H, LO), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((F * H, LO), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((CH,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((F * H, LO), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad,), jnp.float32),
+            jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(fb_idx_t, y, w, coef_hl)
+    return grad.reshape(meta.dim), eta, loss[0, 0]
