@@ -225,10 +225,21 @@ class FeatureHasherBatchOp(BatchOperator, HasSelectedCols, HasOutputCol,
                            HasReservedCols):
     """reference: feature/FeatureHasherBatchOp (FTRLExample.java:46-57):
     categorical cols hash (name=value), numeric cols hash (name) with the
-    value as weight; output one SparseVector of NUM_FEATURES dims."""
+    value as weight; output one SparseVector of NUM_FEATURES dims.
+
+    ``field_aware=True`` is the TPU-first variant: each column hashes into
+    its OWN sub-range of size ``ceil(num_features / n_cols)`` rounded up
+    to a multiple of 16, so
+    every row has exactly one slot per field (nulls hash like a value,
+    numeric nulls get weight 0). The resulting layout is the field-blocked
+    format (ops/fieldblock.py) that linear trainers auto-detect and run
+    through the factored-one-hot MXU kernels instead of random
+    gather/scatter. The effective dim becomes ``n_cols * field_size``.
+    """
     NUM_FEATURES = ParamInfo("num_features", int, default=1 << 18,
                              validator=RangeValidator(1, None))
     CATEGORICAL_COLS = ParamInfo("categorical_cols", list, "treat as categorical")
+    FIELD_AWARE = ParamInfo("field_aware", bool, default=False)
 
     def link_from(self, in_op: BatchOperator) -> "FeatureHasherBatchOp":
         t = in_op.get_output_table()
@@ -239,21 +250,39 @@ class FeatureHasherBatchOp(BatchOperator, HasSelectedCols, HasOutputCol,
         cat = {c: (c in declared_cat or
                    not AlinkTypes.is_numeric(t.schema.type_of(c))) for c in cols}
         arrays = {c: t.col(c) for c in cols}
-        # numeric feature slots are fixed per column
-        num_slot = {c: murmur32(c.encode()) % dim for c in cols if not cat[c]}
         vecs = np.empty(t.num_rows, object)
-        for i in range(t.num_rows):
-            acc: Dict[int, float] = {}
-            for c in cols:
-                v = arrays[c][i]
-                if v is None:
-                    continue
-                if cat[c]:
-                    slot = murmur32(f"{c}={v}".encode()) % dim
-                    acc[slot] = acc.get(slot, 0.0) + 1.0
-                else:
-                    acc[num_slot[c]] = acc.get(num_slot[c], 0.0) + float(v)
-            vecs[i] = SparseVector(dim, list(acc.keys()), list(acc.values()))
+        if self.get_field_aware():
+            # field size = num_features/n_cols ceiled to a multiple of 16,
+            # so the effective dim (= n_cols * S) is >= num_features
+            S = max(16, -(-dim // len(cols) // 16) * 16)
+            dim = S * len(cols)
+            num_slot = {c: murmur32(c.encode()) % S for c in cols if not cat[c]}
+            for i in range(t.num_rows):
+                idx, val = [], []
+                for k, c in enumerate(cols):
+                    v = arrays[c][i]
+                    if cat[c]:
+                        idx.append(k * S + murmur32(f"{c}={v}".encode()) % S)
+                        val.append(1.0)
+                    else:
+                        idx.append(k * S + num_slot[c])
+                        val.append(float(v) if v is not None else 0.0)
+                vecs[i] = SparseVector(dim, idx, val)
+        else:
+            # numeric feature slots are fixed per column
+            num_slot = {c: murmur32(c.encode()) % dim for c in cols if not cat[c]}
+            for i in range(t.num_rows):
+                acc: Dict[int, float] = {}
+                for c in cols:
+                    v = arrays[c][i]
+                    if v is None:
+                        continue
+                    if cat[c]:
+                        slot = murmur32(f"{c}={v}".encode()) % dim
+                        acc[slot] = acc.get(slot, 0.0) + 1.0
+                    else:
+                        acc[num_slot[c]] = acc.get(num_slot[c], 0.0) + float(v)
+                vecs[i] = SparseVector(dim, list(acc.keys()), list(acc.values()))
         helper = OutputColsHelper(t.schema, [out_col], [AlinkTypes.SPARSE_VECTOR],
                                   self.params._m.get("reserved_cols"))
         self._output = helper.build_output(t, [vecs])

@@ -174,11 +174,40 @@ def train_linear_model(data: MTable, op, model_type: str) -> Tuple[MTable, MTabl
     mean, std = _weighted_moments(design, w)
     if design["kind"] == "sparse":
         mean = np.zeros_like(mean)  # sparse path scales only; no centering
-    if standardize:
-        design = _apply_standardization(design, mean, std)
-    if with_intercept:
-        design = add_intercept(design, dtype)
-    dim = design["dim"]
+
+    # field-blocked fast path (ops/fieldblock.py): field-aware-hashed input
+    # trains through factored-one-hot MXU kernels instead of random
+    # gather/scatter. The intercept becomes a prepended constant field
+    # (local index 0) so fields stay uniform; its unused slots get no
+    # gradient and stay 0.
+    fb = None
+    if design["kind"] == "sparse" and not softmax:
+        from ....ops.fieldblock import detect_fieldblock
+        fb = detect_fieldblock(design["idx"], design["val"], design["dim"])
+    feat_dim = design["dim"]  # pre-intercept feature dim (model vector_size)
+    if fb is not None:
+        fb_idx, fb_val, meta = fb
+        if standardize:
+            from ....ops.fieldblock import fb_to_flat_indices
+            scale = (1.0 / std).astype(dtype)
+            flat = fb_to_flat_indices(fb_idx, meta)
+            fb_val = (scale[flat] if fb_val is None else
+                      fb_val.astype(dtype) * scale[flat])
+        if with_intercept:
+            from ....ops.fieldblock import FieldBlockMeta
+            fb_idx = np.concatenate(
+                [np.zeros((n, 1), fb_idx.dtype), fb_idx], axis=1)
+            if fb_val is not None:
+                fb_val = np.concatenate(
+                    [np.ones((n, 1), fb_val.dtype), fb_val], axis=1)
+            meta = FieldBlockMeta(meta.num_fields + 1, meta.field_size)
+        dim = meta.dim
+    else:
+        if standardize:
+            design = _apply_standardization(design, mean, std)
+        if with_intercept:
+            design = add_intercept(design, dtype)
+        dim = design["dim"]
 
     # -- optimize ---------------------------------------------------------
     method = _default_method(op, l1)
@@ -194,7 +223,9 @@ def train_linear_model(data: MTable, op, model_type: str) -> Tuple[MTable, MTabl
         mini_batch_fraction=float(op.params._m.get("mini_batch_fraction", 0.1)),
         seed=int(op.params._m.get("seed", 0) or 0),
     )
-    reg_free = 1 if with_intercept else 0
+    # the fb intercept field owns the first field_size slots, all reg-free
+    reg_free = 0 if not with_intercept else \
+        (meta.field_size if fb is not None else 1)
     if softmax:
         k = len(labels)
         obj = SoftmaxObjFunc(k, dim, l1=l1, l2=l2, reg_free_cols=reg_free)
@@ -204,12 +235,21 @@ def train_linear_model(data: MTable, op, model_type: str) -> Tuple[MTable, MTabl
         if model_type == LinearModelType.SVR:
             loss_kwargs["epsilon"] = float(op.params._m.get("tau", 0.1))
         obj = UnaryLossObjFunc(loss_cls(**loss_kwargs), dim, l1=l1, l2=l2,
-                               reg_free_head=reg_free)
+                               reg_free_head=reg_free,
+                               fb_meta=meta if fb is not None else None)
 
-    train = {k2: v for k2, v in design.items() if k2 in ("X", "idx", "val")}
+    if fb is not None:
+        train = {"fb_idx": fb_idx}
+        if fb_val is not None:
+            train["fb_val"] = fb_val
+    else:
+        train = {k2: v for k2, v in design.items() if k2 in ("X", "idx", "val")}
     train["y"] = y.astype(dtype)
     train["w"] = w
     coef, loss_curve, steps = optimize(obj, train, optim, env)
+    if fb is not None and with_intercept:
+        # de-augment: [intercept slot, dead slots..., features] -> [b, features]
+        coef = np.concatenate([coef[:1], coef[meta.field_size:]])
 
     # -- de-standardize back to the original feature scale ----------------
     if standardize:
@@ -220,7 +260,7 @@ def train_linear_model(data: MTable, op, model_type: str) -> Tuple[MTable, MTabl
         model_name=f"{model_type} model", linear_model_type=model_type,
         has_intercept=bool(with_intercept), vector_col=vector_col,
         feature_names=feature_cols if not vector_col else None,
-        vector_size=int(design["dim"] - (1 if with_intercept else 0)),
+        vector_size=int(feat_dim),
         coef=np.asarray(coef, np.float64), label_values=labels,
         label_type=label_type, loss_curve=loss_curve)
     model_table = LinearModelDataConverter(label_type).save_model(model)

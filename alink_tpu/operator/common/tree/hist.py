@@ -107,6 +107,29 @@ def make_xgb_leaf(reg_lambda: float):
 # the level-wise builder (traceable; runs inside shard_map stages)
 # ---------------------------------------------------------------------------
 
+def level_hist(binned, stats, node_id, n_nodes: int, n_bins: int,
+               use_onehot: bool, onehot_dtype=None):
+    """(n_nodes, F, n_bins, m) per-(node,feature,bin) stat sums for one level.
+
+    ``use_onehot`` selects a one-hot MXU einsum instead of scatter-add —
+    XLA serializes random scatter on TPU (~2.5x slower than the einsum at
+    64 nodes); on CPU the scatter is the fast path."""
+    import jax.numpy as jnp
+    n, F = binned.shape
+    m = stats.shape[1]
+    dt = stats.dtype
+    if use_onehot:
+        hdt = onehot_dtype or jnp.bfloat16
+        ohN = (node_id[:, None] == jnp.arange(n_nodes)[None, :]).astype(hdt)
+        ohB = (binned[..., None] == jnp.arange(n_bins)[None, None, :]).astype(hdt)
+        Z = ohB[..., None] * stats[:, None, None, :].astype(hdt)
+        return jnp.einsum("in,ifbm->nfbm", ohN, Z,
+                          preferred_element_type=jnp.float32).astype(dt)
+    flat_idx = (node_id[:, None] * F + jnp.arange(F)[None, :]) * n_bins + binned
+    hist = jnp.zeros((n_nodes * F * n_bins, m), dt)
+    hist = hist.at[flat_idx.reshape(-1)].add(jnp.repeat(stats, F, axis=0))
+    return hist.reshape(n_nodes, F, n_bins, m)
+
 def build_tree(binned, stats, max_depth: int, n_bins: int,
                gain_fn, leaf_fn, min_samples_leaf: float = 1.0,
                min_gain: float = 1e-9, feature_mask=None, axis_name=None):
@@ -124,15 +147,12 @@ def build_tree(binned, stats, max_depth: int, n_bins: int,
     node_id = jnp.zeros(n, jnp.int32)
     feats_out, bins_out = [], []
 
+    use_onehot = jax.default_backend() == "tpu"
     for level in range(max_depth):
         n_nodes = 1 << level
-        flat_idx = (node_id[:, None] * F + jnp.arange(F)[None, :]) * n_bins + binned
-        hist = jnp.zeros((n_nodes * F * n_bins, m), dt)
-        hist = hist.at[flat_idx.reshape(-1)].add(
-            jnp.repeat(stats, F, axis=0))
+        hist = level_hist(binned, stats, node_id, n_nodes, n_bins, use_onehot)
         if axis_name is not None:
             hist = jax.lax.psum(hist, axis_name)
-        hist = hist.reshape(n_nodes, F, n_bins, m)
         cum = jnp.cumsum(hist, axis=2)
         total = cum[:, :, -1:, :]
         left = cum[:, :, :-1, :]                      # split "bin <= b"

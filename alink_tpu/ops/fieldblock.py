@@ -92,6 +92,33 @@ def fb_to_flat_indices(fb_idx: np.ndarray, meta: FieldBlockMeta) -> np.ndarray:
     return (np.asarray(fb_idx, np.int64) + offs[None, :]).astype(np.int32)
 
 
+def detect_fieldblock(idx: np.ndarray, val: Optional[np.ndarray], dim: int):
+    """Recognize the field-blocked layout in a padded-COO design.
+
+    Field-aware hashing (FeatureHasherBatchOp(field_aware=True)) emits
+    exactly one entry per field per row, field k's indices inside
+    ``[k*S, (k+1)*S)``; this detects that shape so linear trainers can take
+    the MXU fast path automatically. Returns (fb_idx, fb_val|None, meta)
+    with fb_val None when all values are 1.0, else None when the pattern
+    does not hold (general sparse falls back to COO).
+    """
+    idx = np.asarray(idx)
+    # F >= 2: with a single column every width-1 design would "detect"
+    # vacuously and reroute generic sparse data onto the one-hot path
+    if idx.ndim != 2 or idx.shape[1] < 2:
+        return None
+    F = idx.shape[1]
+    if dim % F or (dim // F) % LO or dim // F < LO:
+        return None
+    meta = FieldBlockMeta(F, dim // F)
+    local = flat_to_fb_indices(idx, meta)
+    if local is None:
+        return None
+    if val is None or np.all(val == 1.0):
+        return local, None, meta
+    return local, np.asarray(val), meta
+
+
 def flat_to_fb_indices(idx: np.ndarray, meta: FieldBlockMeta) -> Optional[np.ndarray]:
     """Recognize a field-blocked pattern in padded-COO indices.
 

@@ -177,3 +177,82 @@ class TestLbfgsFieldBlocked:
         acc = float((np.sign(eta) == y).mean())
         assert acc > 0.97, f"train acc {acc}"
         assert curve[-1] < curve[0] * 0.5
+
+
+class TestTrainerIntegration:
+    """FeatureHasher(field_aware=True) -> linear trainer auto-detects the
+    field-blocked layout and takes the MXU fast path; coefficients must
+    match the generic COO path on identical data."""
+
+    def _table(self, rng, n=240):
+        cat_w = {f"u{j}": rng.randn() * 2 for j in range(30)}
+        rows = []
+        for _ in range(n):
+            c1 = f"u{rng.randint(0, 30)}"
+            c2 = f"i{rng.randint(0, 40)}"
+            x = float(rng.randn())
+            label = 1 if cat_w[c1] + 2 * x > 0 else 0
+            rows.append((c1, c2, x, label))
+        from alink_tpu.operator.batch.source import MemSourceBatchOp
+        return MemSourceBatchOp(rows, "c1 STRING, c2 STRING, x DOUBLE, label INT")
+
+    def test_field_aware_hasher_layout(self):
+        rng = np.random.RandomState(0)
+        src = self._table(rng, 40)
+        from alink_tpu.operator.batch.feature.feature_ops import FeatureHasherBatchOp
+        op = FeatureHasherBatchOp(selected_cols=["c1", "c2", "x"],
+                                  num_features=96, field_aware=True,
+                                  output_col="vec").link_from(src)
+        from alink_tpu.common.vector import VectorUtil
+        S = 32  # 96 // 3
+        for r in op.collect():
+            v = VectorUtil.parse(r[-1])
+            assert v.n == 96 and len(v.indices) == 3
+            for k, j in enumerate(v.indices):
+                assert k * S <= j < (k + 1) * S
+
+    def test_lr_fb_matches_coo(self, monkeypatch):
+        rng = np.random.RandomState(4)
+        src = self._table(rng)
+        from alink_tpu.operator.batch.feature.feature_ops import FeatureHasherBatchOp
+        from alink_tpu.operator.batch.classification.linear import (
+            LogisticRegressionTrainBatchOp, LogisticRegressionPredictBatchOp)
+        hashed = FeatureHasherBatchOp(selected_cols=["c1", "c2", "x"],
+                                      num_features=96, field_aware=True,
+                                      output_col="vec").link_from(src)
+
+        def train():
+            t = LogisticRegressionTrainBatchOp(vector_col="vec",
+                                               label_col="label",
+                                               l2=0.1, max_iter=60)
+            return t.link_from(hashed)
+
+        import alink_tpu.ops.fieldblock as fbmod
+        real_detect = fbmod.detect_fieldblock
+        hits = []
+
+        def spy(*a, **k):
+            r = real_detect(*a, **k)
+            hits.append(r is not None)
+            return r
+
+        monkeypatch.setattr(fbmod, "detect_fieldblock", spy)
+        t_fb = train()
+        assert hits and hits[-1], "fb fast path did not engage"
+        monkeypatch.setattr(fbmod, "detect_fieldblock", lambda *a, **k: None)
+        t_coo = train()
+        monkeypatch.undo()
+
+        from alink_tpu.operator.common.linear.base import LinearModelDataConverter
+        m_fb = LinearModelDataConverter().load_model(t_fb.get_output_table())
+        m_coo = LinearModelDataConverter().load_model(t_coo.get_output_table())
+        np.testing.assert_allclose(m_fb.coef, m_coo.coef, rtol=1e-3, atol=1e-3)
+        assert m_fb.vector_size == 96
+
+        # and predictions flow end-to-end
+        pred = LogisticRegressionPredictBatchOp(prediction_col="p")
+        pred.link_from(t_fb, hashed)
+        labels = [r[3] for r in src.collect()]
+        preds = [r[-1] for r in pred.collect()]
+        acc = np.mean([str(a) == str(b) for a, b in zip(preds, labels)])
+        assert acc > 0.9, acc

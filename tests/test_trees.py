@@ -122,3 +122,21 @@ def test_gbdt_integer_labels():
     assert set(out.col("pred")) <= {0, 1}
     acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
     assert acc > 0.9
+
+
+class TestLevelHist:
+    def test_onehot_matches_scatter(self):
+        """The TPU one-hot einsum histogram must agree with the scatter-add
+        path (exercised here with f32 one-hots since CPU lacks bf16 dots)."""
+        import jax.numpy as jnp
+        from alink_tpu.operator.common.tree.hist import level_hist
+        rng = np.random.RandomState(11)
+        n, F, B, m, n_nodes = 200, 5, 8, 3, 4
+        binned = jnp.asarray(rng.randint(0, B, (n, F)).astype(np.int32))
+        stats = jnp.asarray(rng.randn(n, m).astype(np.float32))
+        node_id = jnp.asarray(rng.randint(0, n_nodes, n).astype(np.int32))
+        a = level_hist(binned, stats, node_id, n_nodes, B, use_onehot=False)
+        b = level_hist(binned, stats, node_id, n_nodes, B, use_onehot=True,
+                       onehot_dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                                   atol=1e-5)
