@@ -465,7 +465,7 @@ class _AftObjFunc(OptimObjFunc):
         loss, grad = jax.value_and_grad(self._nll_sum)(coef, X, y, c, w)
         return grad, loss, w.sum()
 
-    def line_losses_shard(self, data, coef, direction, steps):
+    def line_losses_shard(self, data, coef, direction, steps, eta0=None):
         X, y, w, c = data["X"], data["y"], data["w"], data["c"]
 
         def one(s):

@@ -71,7 +71,7 @@ class MlpObjFunc(OptimObjFunc):
         loss, grad = jax.value_and_grad(self._loss_sum)(coef, X, y, w)
         return grad, loss, w.sum()
 
-    def line_losses_shard(self, data, coef, direction, steps):
+    def line_losses_shard(self, data, coef, direction, steps, eta0=None):
         X, y, w = data["X"], data["y"], data["w"]
 
         def one(s):

@@ -242,7 +242,13 @@ class OptimObjFunc:
         """-> (grad_sum, loss_sum, weight_sum) — unnormalized shard sums."""
         raise NotImplementedError
 
-    def line_losses_shard(self, data, coef, direction, steps):
+    def calc_grad_eta_shard(self, data, coef):
+        """-> (grad, loss, wsum, eta); eta (per-shard margins at coef) may be
+        passed back to line_losses_shard to skip recomputing the matvec."""
+        grad, loss, wsum = self.calc_grad_shard(data, coef)
+        return grad, loss, wsum, None
+
+    def line_losses_shard(self, data, coef, direction, steps, eta0=None):
         """losses at coef - steps[j]*direction -> (num_steps,) shard sums."""
         raise NotImplementedError
 
@@ -267,15 +273,22 @@ class UnaryLossObjFunc(OptimObjFunc):
         self.fb_meta = fb_meta
 
     def calc_grad_shard(self, data, coef):
+        grad, loss, wsum, _ = self.calc_grad_eta_shard(data, coef)
+        return grad, loss, wsum
+
+    def calc_grad_eta_shard(self, data, coef):
+        """(grad, loss, wsum, eta) — eta is reusable by the same-superstep
+        line search (margins at the unmoved coef), saving one matvec pass."""
         eta = matvec(data, coef, self.fb_meta)
         y, w = data["y"], data["w"]
         loss = (w * self.unary_loss.loss(eta, y)).sum()
         c = w * self.unary_loss.derivative(eta, y)
         grad = rmatvec(data, c, self.dim, self.fb_meta)
-        return grad, loss, w.sum()
+        return grad, loss, w.sum(), eta
 
-    def line_losses_shard(self, data, coef, direction, steps):
-        eta0 = matvec(data, coef, self.fb_meta)
+    def line_losses_shard(self, data, coef, direction, steps, eta0=None):
+        if eta0 is None:
+            eta0 = matvec(data, coef, self.fb_meta)
         etad = matvec(data, direction, self.fb_meta)
         y, w = data["y"], data["w"]
 
@@ -342,7 +355,7 @@ class SoftmaxObjFunc(OptimObjFunc):
             grad = g.T.reshape(-1)
         return grad, loss, w.sum()
 
-    def line_losses_shard(self, data, coef, direction, steps):
+    def line_losses_shard(self, data, coef, direction, steps, eta0=None):
         W = coef.reshape(self.k - 1, self.d)
         D = direction.reshape(self.k - 1, self.d)
         y, w = data["y"].astype(jnp.int32), data["w"]

@@ -147,7 +147,9 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
             ctx.put_obj("loss_curve", jnp.full((max_iter,), jnp.nan, dtype))
             ctx.put_obj("conv", jnp.asarray(False))
         shard = _shard_views(ctx, data_keys)
-        g, loss, wsum = obj.calc_grad_shard(shard, ctx.get_obj("coef"))
+        g, loss, wsum, eta = obj.calc_grad_eta_shard(shard, ctx.get_obj("coef"))
+        if eta is not None:
+            ctx.put_obj("eta0", eta)  # reused by the line search (same coef)
         ctx.put_obj("glw", jnp.concatenate([g, jnp.stack([loss, wsum])]))
 
     def direction_and_losses(ctx):
@@ -195,7 +197,9 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
 
         steps = jnp.asarray(steps_ladder) * ctx.get_obj("step_scale")
         shard = _shard_views(ctx, data_keys)
-        ctx.put_obj("line_losses", obj.line_losses_shard(shard, coef, d, steps))
+        eta0 = ctx.get_obj("eta0") if ctx.contains_obj("eta0") else None
+        ctx.put_obj("line_losses",
+                    obj.line_losses_shard(shard, coef, d, steps, eta0=eta0))
         ctx.put_obj("steps", steps)
 
     def update_model(ctx):

@@ -12,13 +12,14 @@ factored-one-hot matvec/rmatvec — the TPU answer to the reference's
 SparseVector dot/axpy hot loops.
 """
 
-from .fieldblock import (FieldBlockMeta, detect_fieldblock,
-                         fb_fused_grad_pallas, fb_matvec, fb_rmatvec,
-                         fb_to_flat_indices, flat_to_fb_indices,
-                         hash_to_fields)
+from .fieldblock import (FieldBlockMeta, detect_fieldblock, fb_fused_grad,
+                         fb_fused_grad_pallas, fb_matvec, fb_matvec_pallas,
+                         fb_pallas_ok, fb_rmatvec, fb_to_flat_indices,
+                         flat_to_fb_indices, hash_to_fields)
 
 __all__ = [
     "FieldBlockMeta", "detect_fieldblock", "fb_matvec", "fb_rmatvec",
+    "fb_fused_grad", "fb_matvec_pallas", "fb_pallas_ok",
     "fb_fused_grad_pallas", "fb_to_flat_indices", "flat_to_fb_indices",
     "hash_to_fields",
 ]

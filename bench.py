@@ -69,8 +69,10 @@ def tpu_run(fb_idx, y, iters: int):
 
     run(1)                   # compile 1-iter program into the cache
     run(1 + iters)           # compile loop program into the cache
-    t1 = run(1)
-    t_full = run(1 + iters)
+    # min-of-3 per program: per-call overhead (retrace + tunnel transfer)
+    # is noisy at the ~0.5 s level, which would swamp the superstep delta
+    t1 = min(run(1) for _ in range(3))
+    t_full = min(run(1 + iters) for _ in range(3))
     return max(t_full - t1, 1e-9), env.num_workers
 
 
@@ -98,7 +100,7 @@ def cpu_baseline(fb_idx, y, iters: int) -> float:
 
 
 def main():
-    n_rows, iters = 200_000, 30
+    n_rows, iters = 200_000, 60
     fb_idx, y = make_data(n_rows)
     tpu_t, n_chips = tpu_run(fb_idx, y, iters)
     tpu_sps = n_rows * iters / tpu_t / max(n_chips, 1)
