@@ -11,6 +11,67 @@ __version__ = "0.1.0"
 
 from .common import (Params, ParamInfo, WithParams, AlinkTypes, TableSchema,
                      DenseVector, SparseVector, VectorUtil, SparseBatch, DenseMatrix,
-                     MTable, MLEnvironment, MLEnvironmentFactory, use_local_env)
+                     MTable, MLEnvironment, MLEnvironmentFactory, use_local_env,
+                     StepTimer, named_stage, trace)
 from .engine import (IterativeComQueue, ComContext, ComputeFunction, AllReduce,
                      AllGather, BroadcastFromWorker0)
+
+# ---------------------------------------------------------------------------
+# flat export surface (the PyAlink idiom: every operator / pipeline stage is
+# importable from the top-level package — README.md:49-58's
+# ``from pyalink.alink import *`` user contract). Resolved lazily (PEP 562)
+# so ``import alink_tpu`` stays cheap; the full submodule walk happens on
+# the first miss only.
+# ---------------------------------------------------------------------------
+
+_EXPORT_ROOTS = ("alink_tpu.operator.batch", "alink_tpu.operator.stream",
+                 "alink_tpu.pipeline", "alink_tpu.io")
+_exports = None
+
+
+def _collect_exports():
+    import importlib
+    import pkgutil
+    mapping = {}
+    for root in _EXPORT_ROOTS:
+        pkg = importlib.import_module(root)
+        mods = [root] + [m.name for m in
+                         pkgutil.walk_packages(pkg.__path__, root + ".")]
+        for name in mods:
+            try:
+                mod = importlib.import_module(name)
+            except Exception:  # optional deps (drivers) may be absent
+                continue
+            for nm, obj in vars(mod).items():
+                if (nm[:1].isupper() and isinstance(obj, type) and
+                        getattr(obj, "__module__", "").startswith("alink_tpu")):
+                    mapping.setdefault(nm, obj)
+    return mapping
+
+
+def __getattr__(name):
+    global _exports
+    if name == "__all__":
+        # star-import support: `from alink_tpu import *` consults __all__
+        # (PEP 562 __getattr__ is reached for it when undefined here)
+        if _exports is None:
+            _exports = _collect_exports()
+        return sorted(set(_exports) |
+                      {n for n in globals() if not n.startswith("_")})
+    if name.startswith("_"):
+        raise AttributeError(name)
+    if _exports is None:
+        _exports = _collect_exports()
+    try:
+        obj = _exports[name]
+    except KeyError:
+        raise AttributeError(f"module 'alink_tpu' has no attribute {name!r}")
+    globals()[name] = obj  # cache for subsequent lookups
+    return obj
+
+
+def __dir__():
+    global _exports
+    if _exports is None:
+        _exports = _collect_exports()
+    return sorted(set(list(globals()) + list(_exports)))

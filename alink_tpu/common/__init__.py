@@ -5,10 +5,12 @@ from .vector import (DenseVector, SparseVector, Vector, VectorUtil, SparseBatch,
 from .mtable import MTable
 from .mlenv import MLEnvironment, MLEnvironmentFactory, use_local_env
 from .lazy import LazyEvaluation, LazyObjectsManager
+from .profiling import StepTimer, named_stage, trace
 
 __all__ = [
     "Params", "ParamInfo", "WithParams", "RangeValidator", "InValidator", "MinValidator",
     "AlinkTypes", "TableSchema", "DenseVector", "SparseVector", "Vector", "VectorUtil",
     "SparseBatch", "DenseMatrix", "MTable", "MLEnvironment", "MLEnvironmentFactory",
     "use_local_env", "LazyEvaluation", "LazyObjectsManager",
+    "StepTimer", "named_stage", "trace",
 ]

@@ -173,15 +173,22 @@ class IterativeComQueue:
         for k, n in totals.items():
             bcast[f"__total_{k}"] = jnp.asarray(n, jnp.int32)
 
+        from ..common.profiling import log_superstep, named_stage
+
         def superstep(carry, static, init_pass):
             ctx = ComContext(carry, static, nw, init_pass)
             for s in stages:
-                s.calc(ctx)
+                # name each compiled stage (the reference .name()s every
+                # dataflow stage for the Flink UI, BaseComQueue.java:172-195)
+                with named_stage(getattr(s, "__name__", type(s).__name__)):
+                    s.calc(ctx)
             if criterion is not None:
                 stop = criterion(ctx)
                 ctx.put_obj("__stop", jnp.asarray(stop, bool).reshape(()))
             else:
                 ctx.put_obj("__stop", jnp.asarray(False))
+            log_superstep(ctx.step_no, task=ctx.task_id,
+                          stop=ctx.get_obj("__stop"))
             return ctx.carry
 
         def run(parts_shard, bcast_rep):

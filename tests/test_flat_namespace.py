@@ -1,0 +1,140 @@
+"""The PyAlink user contract: every operator / pipeline stage of the
+reference inventory (SURVEY §2.5) is importable from the top-level
+``alink_tpu`` namespace (the ``from pyalink.alink import *`` idiom,
+reference README.md:49-58)."""
+
+import alink_tpu
+
+# Reference class names, grouped as in SURVEY §2.5.
+REFERENCE_INVENTORY = [
+    # classification
+    "LogisticRegressionTrainBatchOp", "LogisticRegressionPredictBatchOp",
+    "LinearSvmTrainBatchOp", "LinearSvmPredictBatchOp",
+    "SoftmaxTrainBatchOp", "SoftmaxPredictBatchOp",
+    "FmClassifierTrainBatchOp", "FmClassifierPredictBatchOp",
+    "NaiveBayesTextTrainBatchOp", "NaiveBayesTextPredictBatchOp",
+    "NaiveBayesTrainBatchOp", "NaiveBayesPredictBatchOp",
+    "DecisionTreeTrainBatchOp", "DecisionTreePredictBatchOp",
+    "RandomForestTrainBatchOp", "RandomForestPredictBatchOp",
+    "GbdtTrainBatchOp", "GbdtPredictBatchOp",
+    "MultilayerPerceptronTrainBatchOp", "MultilayerPerceptronPredictBatchOp",
+    # regression
+    "LinearRegTrainBatchOp", "LinearRegPredictBatchOp",
+    "RidgeRegTrainBatchOp", "RidgeRegPredictBatchOp",
+    "LassoRegTrainBatchOp", "LassoRegPredictBatchOp",
+    "AftSurvivalRegTrainBatchOp", "AftSurvivalRegPredictBatchOp",
+    "GlmTrainBatchOp", "GlmPredictBatchOp", "GlmEvaluationBatchOp",
+    "IsotonicRegTrainBatchOp", "IsotonicRegPredictBatchOp",
+    "DecisionTreeRegTrainBatchOp", "DecisionTreeRegPredictBatchOp",
+    "RandomForestRegTrainBatchOp", "RandomForestRegPredictBatchOp",
+    "GbdtRegTrainBatchOp", "GbdtRegPredictBatchOp",
+    "FmRegressorTrainBatchOp", "FmRegressorPredictBatchOp",
+    # clustering
+    "KMeansTrainBatchOp", "KMeansPredictBatchOp",
+    "BisectingKMeansTrainBatchOp", "BisectingKMeansPredictBatchOp",
+    "GmmTrainBatchOp", "GmmPredictBatchOp",
+    "LdaTrainBatchOp", "LdaPredictBatchOp",
+    # recommendation
+    "AlsTrainBatchOp", "AlsPredictBatchOp", "AlsTopKPredictBatchOp",
+    # NLP
+    "Word2VecTrainBatchOp", "Word2VecPredictBatchOp",
+    "DocCountVectorizerTrainBatchOp", "DocCountVectorizerPredictBatchOp",
+    "DocHashCountVectorizerTrainBatchOp", "DocHashCountVectorizerPredictBatchOp",
+    "SegmentBatchOp", "TokenizerBatchOp", "RegexTokenizerBatchOp",
+    "NGramBatchOp", "StopWordsRemoverBatchOp", "WordCountBatchOp",
+    "StringSimilarityPairwiseBatchOp",
+    "ApproxVectorSimilarityJoinLSHBatchOp", "ApproxVectorSimilarityTopNLSHBatchOp",
+    # feature
+    "OneHotTrainBatchOp", "OneHotPredictBatchOp",
+    "QuantileDiscretizerTrainBatchOp", "QuantileDiscretizerPredictBatchOp",
+    "BucketizerBatchOp", "BinarizerBatchOp", "FeatureHasherBatchOp",
+    "ChiSqSelectorBatchOp", "PcaTrainBatchOp", "PcaPredictBatchOp",
+    "DCTBatchOp", "VectorChiSqSelectorBatchOp",
+    # dataproc
+    "StandardScalerTrainBatchOp", "StandardScalerPredictBatchOp",
+    "MinMaxScalerTrainBatchOp", "MinMaxScalerPredictBatchOp",
+    "MaxAbsScalerTrainBatchOp", "MaxAbsScalerPredictBatchOp",
+    "ImputerTrainBatchOp", "ImputerPredictBatchOp",
+    "StringIndexerTrainBatchOp", "StringIndexerPredictBatchOp",
+    "MultiStringIndexerTrainBatchOp", "MultiStringIndexerPredictBatchOp",
+    "IndexToStringPredictBatchOp",
+    "SampleBatchOp", "SampleWithSizeBatchOp", "WeightSampleBatchOp",
+    "SplitBatchOp", "FirstNBatchOp", "AppendIdBatchOp",
+    "NumericalTypeCastBatchOp", "JsonValueBatchOp",
+    "VectorAssemblerBatchOp", "VectorSliceBatchOp", "VectorInteractionBatchOp",
+    "VectorNormalizeBatchOp", "VectorElementwiseProductBatchOp",
+    "VectorPolynomialExpandBatchOp", "VectorSizeHintBatchOp",
+    "VectorStandardScalerTrainBatchOp", "VectorStandardScalerPredictBatchOp",
+    "VectorMinMaxScalerTrainBatchOp", "VectorMinMaxScalerPredictBatchOp",
+    "VectorMaxAbsScalerTrainBatchOp", "VectorMaxAbsScalerPredictBatchOp",
+    "VectorImputerTrainBatchOp", "VectorImputerPredictBatchOp",
+    # format conversion (sample of the 31-op matrix)
+    "VectorToColumnsBatchOp", "ColumnsToVectorBatchOp", "KvToColumnsBatchOp",
+    "ColumnsToKvBatchOp", "JsonToColumnsBatchOp", "ColumnsToJsonBatchOp",
+    "CsvToColumnsBatchOp", "ColumnsToCsvBatchOp", "TripleToColumnsBatchOp",
+    # statistics
+    "SummarizerBatchOp", "VectorSummarizerBatchOp", "CorrelationBatchOp",
+    "VectorCorrelationBatchOp", "ChiSquareTestBatchOp", "VectorChiSquareTestBatchOp",
+    # evaluation
+    "EvalBinaryClassBatchOp", "EvalMultiClassBatchOp",
+    "EvalRegressionBatchOp", "EvalClusterBatchOp",
+    # outlier / association rules
+    "SosBatchOp", "FpGrowthBatchOp", "PrefixSpanBatchOp",
+    # SQL
+    "SelectBatchOp", "AsBatchOp", "WhereBatchOp", "FilterBatchOp",
+    "GroupByBatchOp", "JoinBatchOp", "LeftOuterJoinBatchOp",
+    "RightOuterJoinBatchOp", "FullOuterJoinBatchOp", "UnionBatchOp",
+    "UnionAllBatchOp", "IntersectBatchOp", "IntersectAllBatchOp",
+    "MinusBatchOp", "MinusAllBatchOp", "DistinctBatchOp", "OrderByBatchOp",
+    # sources / sinks
+    "CsvSourceBatchOp", "CsvSinkBatchOp", "LibSvmSourceBatchOp",
+    "LibSvmSinkBatchOp", "TextSourceBatchOp", "TextSinkBatchOp",
+    "MemSourceBatchOp", "NumSeqSourceBatchOp", "TableSourceBatchOp",
+    "MySqlSourceBatchOp", "MySqlSinkBatchOp",
+    # utils
+    "UDFBatchOp", "UDTFBatchOp",
+    # stream layer
+    "MemSourceStreamOp", "CsvSourceStreamOp", "CsvSinkStreamOp",
+    "LogisticRegressionPredictStreamOp", "KMeansPredictStreamOp",
+    "EvalBinaryClassStreamOp", "EvalMultiClassStreamOp",
+    "WindowGroupByStreamOp", "SelectStreamOp", "WhereStreamOp",
+    "SampleStreamOp", "SplitStreamOp", "SegmentStreamOp",
+    "FtrlTrainStreamOp", "FtrlPredictStreamOp",
+    "KafkaSourceStreamOp", "KafkaSinkStreamOp",
+    # pipeline stages
+    "Pipeline", "PipelineModel", "LocalPredictor",
+    "LogisticRegression", "LinearSvm", "Softmax", "LinearRegression",
+    "RandomForestClassifier", "GbdtClassifier", "DecisionTreeClassifier",
+    "KMeans", "BisectingKMeans", "GaussianMixture", "Lda",
+    "NaiveBayesTextClassifier", "FmClassifier", "FmRegressor", "OneVsRest",
+    "StandardScaler", "MinMaxScaler", "MaxAbsScaler", "Imputer",
+    "OneHotEncoder", "QuantileDiscretizer", "Bucketizer", "Binarizer",
+    "FeatureHasher", "VectorAssembler", "Pca", "Segment", "Word2Vec",
+    "DocCountVectorizer", "ALS",
+    # tuning
+    "GridSearchCV", "GridSearchTVSplit", "ParamGrid",
+    "BinaryClassificationTuningEvaluator", "MultiClassClassificationTuningEvaluator",
+    "RegressionTuningEvaluator", "ClusterTuningEvaluator",
+]
+
+
+def test_reference_inventory_resolves_flat():
+    missing = [n for n in REFERENCE_INVENTORY if not hasattr(alink_tpu, n)]
+    assert not missing, f"{len(missing)} reference names missing: {missing}"
+
+
+def test_flat_names_are_classes():
+    assert isinstance(alink_tpu.LogisticRegressionTrainBatchOp, type)
+    assert isinstance(alink_tpu.Pipeline, type)
+
+
+def test_dir_exposes_flat_surface():
+    d = dir(alink_tpu)
+    assert "KMeansTrainBatchOp" in d and "FtrlTrainStreamOp" in d
+
+
+def test_star_import_exports_inventory():
+    ns = {}
+    exec("from alink_tpu import *", ns)
+    assert "KMeansTrainBatchOp" in ns and "Pipeline" in ns
+    assert "FtrlTrainStreamOp" in ns

@@ -1,0 +1,111 @@
+"""Tracing/profiling subsystem (common/profiling.py) — the TPU build's
+answer to the reference's slf4j taskId/stepNo logs + Flink-UI named stages
+(SURVEY §5: step-timer, jax.profiler traces, named compiled stages)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.profiling import (StepTimer, log_superstep, named_stage,
+                                        step_log_enabled, trace)
+
+
+class TestStepTimer:
+    def test_spans_accumulate(self):
+        t = StepTimer()
+        for _ in range(3):
+            with t.span("fit"):
+                time.sleep(0.01)
+        with t.span("predict"):
+            time.sleep(0.01)
+        rows = t.report()
+        assert [r[0] for r in rows] == ["fit", "predict"]
+        name, count, total, mean = rows[0]
+        assert count == 3 and total >= 0.03 and abs(mean - total / 3) < 1e-9
+        assert "fit" in t.pretty() and "count" in t.pretty()
+
+    def test_span_records_on_exception(self):
+        t = StepTimer()
+        with pytest.raises(RuntimeError):
+            with t.span("boom"):
+                raise RuntimeError()
+        assert t.report()[0][1] == 1
+
+    def test_reset(self):
+        t = StepTimer()
+        with t.span("x"):
+            pass
+        t.reset()
+        assert t.report() == [] and "no spans" in t.pretty()
+
+
+class TestNamedStage:
+    def test_names_reach_hlo_metadata(self):
+        """Stage names must survive into the compiled program (the Flink-UI
+        ``.name()`` analogue) so profiler traces attribute device time."""
+        import jax
+        import jax.numpy as jnp
+
+        def f(x):
+            with named_stage("CalcGradientStage"):
+                y = jnp.tanh(x) * 2.0
+            return y
+
+        txt = jax.jit(f).lower(jnp.ones(8)).as_text(debug_info=True)
+        assert "CalcGradientStage" in txt
+
+    def test_engine_stages_are_named(self):
+        """IterativeComQueue names every stage in the lowered program."""
+        import jax
+        from alink_tpu.common.mlenv import MLEnvironmentFactory
+        from alink_tpu.engine import AllReduce, IterativeComQueue
+
+        env = MLEnvironmentFactory.get_default()
+
+        def my_compute_stage(ctx):
+            import jax.numpy as jnp
+            if ctx.is_init_step:
+                ctx.put_obj("acc", jnp.zeros(4))
+            ctx.put_obj("acc", ctx.get_obj("acc") + ctx.get_obj("xs").sum(0))
+
+        q = (IterativeComQueue(env=env, max_iter=3)
+             .init_with_partitioned_data("xs", np.ones((16, 4), np.float32))
+             .add(my_compute_stage)
+             .add(AllReduce("acc")))
+        res = q.exec()
+        assert res.get("acc").shape == (4,)
+
+
+class TestTraceAndStepLog:
+    def test_trace_writes_profile(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        with trace(str(tmp_path)):
+            jax.block_until_ready(jnp.arange(16) * 2)
+        found = [p for p, _, files in os.walk(tmp_path) for f in files
+                 if f.endswith((".xplane.pb", ".json.gz"))]
+        assert found, "profiler trace produced no files"
+
+    def test_step_log_gate(self, monkeypatch):
+        monkeypatch.delenv("ALINK_TPU_STEP_LOG", raising=False)
+        assert not step_log_enabled()
+        log_superstep(1)  # no-op without jax.debug machinery engaged
+        monkeypatch.setenv("ALINK_TPU_STEP_LOG", "1")
+        assert step_log_enabled()
+
+    def test_step_log_emits(self, monkeypatch, capfd):
+        import jax
+        import jax.numpy as jnp
+        monkeypatch.setenv("ALINK_TPU_STEP_LOG", "1")
+
+        @jax.jit
+        def f(s):
+            log_superstep(s, loss=jnp.float32(0.5))
+            return s + 1
+
+        jax.block_until_ready(f(jnp.int32(7)))
+        jax.effects_barrier()
+        out = capfd.readouterr().out
+        assert "superstep 7" in out and "loss=0.5" in out
