@@ -114,6 +114,21 @@ class SparseVector:
         if self.n >= 0 and self.indices.size and int(self.indices[-1]) >= self.n:
             raise ValueError(f"index {int(self.indices[-1])} out of bound {self.n}")
 
+    @classmethod
+    def trusted(cls, size: int, indices: np.ndarray,
+                values: np.ndarray) -> "SparseVector":
+        """Wrap pre-validated arrays without copy/sort/bounds checks.
+
+        For bulk producers (FeatureHasher emits millions of rows whose
+        indices are sorted by construction); caller guarantees sorted int32
+        indices, float64 values, and in-bound entries.
+        """
+        v = cls.__new__(cls)
+        v.n = int(size)
+        v.indices = indices
+        v.values = values
+        return v
+
     def size(self) -> int:
         return self.n
 

@@ -72,6 +72,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.csv_fill.argtypes = [c, i64, ctypes.c_char, i64, pd]
         lib.vec_count.argtypes = [c, i64, pi64, pi64, pi64]
         lib.vec_fill.argtypes = [c, i64, pi64, pi32, pd]
+        lib.murmur_batch.argtypes = [c, pi64, i64, ctypes.c_uint32, i64, pi64]
         _lib = lib
         return _lib
 
@@ -114,6 +115,27 @@ def parse_numeric_csv_bytes(data: bytes, delim: str = ","
     lib.csv_dims(data, len(data), d, ctypes.byref(rows), ctypes.byref(cols))
     out = np.empty((rows.value, cols.value), np.float64)
     lib.csv_fill(data, len(data), d, cols.value, _p(out, ctypes.c_double))
+    return out
+
+
+def murmur32_batch(tokens, seed: int = 0, mod: int = 0) -> Optional[np.ndarray]:
+    """murmur3_32 of each byte-string token, optionally reduced ``% mod``.
+
+    The FeatureHasher encode boundary hashes one token per (row, column)
+    cell; this replaces the per-token Python murmur loop with one C call
+    over a packed buffer. Returns int64 hashes (raw uint32 range when
+    ``mod<=0``), or None when the native library is unavailable.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    lens = np.fromiter((len(t) for t in tokens), np.int64, len(tokens))
+    offsets = np.zeros(len(tokens) + 1, np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    buf = b"".join(tokens)
+    out = np.empty(len(tokens), np.int64)
+    lib.murmur_batch(buf, _p(offsets, ctypes.c_int64), len(tokens),
+                     seed & 0xFFFFFFFF, mod, _p(out, ctypes.c_int64))
     return out
 
 

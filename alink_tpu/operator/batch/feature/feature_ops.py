@@ -221,6 +221,24 @@ def murmur32(data: bytes, seed: int = 0) -> int:
     return h
 
 
+def murmur32_cells(tokens, seed: int = 0, mod: int = 0) -> np.ndarray:
+    """Batch murmur3_32 over byte-string tokens (int64 array).
+
+    Routes through the native C batch hasher (native/parser.cpp
+    ``murmur_batch``) when available — the FeatureHasher encode boundary is
+    one hash per (row, column) cell, which a per-token Python loop cannot
+    sustain at Criteo scale — with the pure-Python ``murmur32`` as the
+    bit-identical fallback.
+    """
+    from ....native import murmur32_batch
+    out = murmur32_batch(tokens, seed=seed, mod=mod)
+    if out is None:
+        it = (murmur32(t, seed) % mod if mod > 0 else murmur32(t, seed)
+              for t in tokens)
+        out = np.fromiter(it, np.int64, len(tokens))
+    return out
+
+
 class FeatureHasherBatchOp(BatchOperator, HasSelectedCols, HasOutputCol,
                            HasReservedCols):
     """reference: feature/FeatureHasherBatchOp (FTRLExample.java:46-57):
@@ -251,37 +269,56 @@ class FeatureHasherBatchOp(BatchOperator, HasSelectedCols, HasOutputCol,
                    not AlinkTypes.is_numeric(t.schema.type_of(c))) for c in cols}
         arrays = {c: t.col(c) for c in cols}
         vecs = np.empty(t.num_rows, object)
+        n = t.num_rows
         if self.get_field_aware():
             # field size = num_features/n_cols ceiled to a multiple of 16,
             # so the effective dim (= n_cols * S) is >= num_features
             S = max(16, -(-dim // len(cols) // 16) * 16)
             dim = S * len(cols)
-            num_slot = {c: murmur32(c.encode()) % S for c in cols if not cat[c]}
-            for i in range(t.num_rows):
-                idx, val = [], []
-                for k, c in enumerate(cols):
-                    v = arrays[c][i]
-                    if cat[c]:
-                        idx.append(k * S + murmur32(f"{c}={v}".encode()) % S)
-                        val.append(1.0)
-                    else:
-                        idx.append(k * S + num_slot[c])
-                        val.append(float(v) if v is not None else 0.0)
-                vecs[i] = SparseVector(dim, idx, val)
+            if dim > np.iinfo(np.int32).max:
+                raise ValueError(
+                    f"field-aware effective dim {dim} exceeds int32 index "
+                    f"range; lower num_features")
+            fb = np.empty((n, len(cols)), np.int64)
+            wv = np.empty((n, len(cols)), np.float64)
+            for k, c in enumerate(cols):
+                a = arrays[c]
+                if cat[c]:
+                    tokens = [f"{c}={v}".encode() for v in a]
+                    fb[:, k] = k * S + murmur32_cells(tokens, mod=S)
+                    wv[:, k] = 1.0
+                else:
+                    fb[:, k] = k * S + murmur32(c.encode()) % S
+                    wv[:, k] = [float(v) if v is not None else 0.0 for v in a]
+            fb32 = fb.astype(np.int32)  # indices sorted by construction
+            for i in range(n):
+                # per-row copies: a retained vector must not pin the whole
+                # (n, n_cols) batch via a view
+                vecs[i] = SparseVector.trusted(dim, fb32[i].copy(),
+                                               wv[i].copy())
         else:
-            # numeric feature slots are fixed per column
-            num_slot = {c: murmur32(c.encode()) % dim for c in cols if not cat[c]}
-            for i in range(t.num_rows):
+            # per-column vectorized hashing; slot -1 marks missing cells
+            slots = np.empty((len(cols), n), np.int64)
+            weights = np.empty((len(cols), n), np.float64)
+            for k, c in enumerate(cols):
+                a = arrays[c]
+                miss = np.fromiter((v is None for v in a), bool, n)
+                if cat[c]:
+                    tokens = [b"" if m else f"{c}={v}".encode()
+                              for m, v in zip(miss, a)]
+                    slots[k] = murmur32_cells(tokens, mod=dim)
+                    weights[k] = 1.0
+                else:
+                    slots[k] = murmur32(c.encode()) % dim
+                    weights[k] = [0.0 if m else float(v)
+                                  for m, v in zip(miss, a)]
+                slots[k][miss] = -1
+            for i in range(n):
                 acc: Dict[int, float] = {}
-                for c in cols:
-                    v = arrays[c][i]
-                    if v is None:
-                        continue
-                    if cat[c]:
-                        slot = murmur32(f"{c}={v}".encode()) % dim
-                        acc[slot] = acc.get(slot, 0.0) + 1.0
-                    else:
-                        acc[num_slot[c]] = acc.get(num_slot[c], 0.0) + float(v)
+                for k in range(len(cols)):
+                    s = slots[k, i]
+                    if s >= 0:
+                        acc[int(s)] = acc.get(int(s), 0.0) + weights[k, i]
                 vecs[i] = SparseVector(dim, list(acc.keys()), list(acc.values()))
         helper = OutputColsHelper(t.schema, [out_col], [AlinkTypes.SPARSE_VECTOR],
                                   self.params._m.get("reserved_cols"))

@@ -76,13 +76,13 @@ def hash_to_fields(columns, field_size: int, seed: int = 0) -> np.ndarray:
     ``field_size`` sub-range so the result is field-blocked by
     construction. Returns ``fb_idx`` of shape (n, num_columns) int32.
     """
-    from ..operator.batch.feature.feature_ops import murmur32
+    from ..operator.batch.feature.feature_ops import murmur32_cells
     cols = list(columns)
     n = len(cols[0])
     out = np.empty((n, len(cols)), np.int32)
     for k, col in enumerate(cols):
-        out[:, k] = [murmur32(f"{k}={v}".encode(), seed) % field_size
-                     for v in col]
+        tokens = [f"{k}={v}".encode() for v in col]
+        out[:, k] = murmur32_cells(tokens, seed=seed, mod=field_size)
     return out
 
 

@@ -114,3 +114,52 @@ def test_native_speedup_sanity():
     t_py = time.perf_counter() - t0
     # be generous: only assert native isn't slower
     assert t_native < t_py, (t_native, t_py)
+
+
+class TestMurmurBatch:
+    def test_matches_pure_python(self):
+        from alink_tpu.native import murmur32_batch
+        from alink_tpu.operator.batch.feature.feature_ops import murmur32
+        tokens = [b"", b"a", b"ab", b"abc", b"abcd", b"abcde",
+                  "col=värde".encode(), b"x" * 1000]
+        for seed in (0, 7, 0xDEADBEEF):
+            got = murmur32_batch(tokens, seed=seed)
+            if got is None:
+                import pytest
+                pytest.skip("native library unavailable")
+            want = [murmur32(t, seed) for t in tokens]
+            assert got.tolist() == want
+
+    def test_mod_reduction(self):
+        from alink_tpu.native import murmur32_batch
+        from alink_tpu.operator.batch.feature.feature_ops import murmur32
+        tokens = [f"f={i}".encode() for i in range(500)]
+        got = murmur32_batch(tokens, mod=97)
+        if got is None:
+            import pytest
+            pytest.skip("native library unavailable")
+        assert got.tolist() == [murmur32(t) % 97 for t in tokens]
+        assert (got >= 0).all() and (got < 97).all()
+
+    def test_hasher_native_matches_python(self, monkeypatch):
+        """FeatureHasherBatchOp output must be bit-identical with and
+        without the native hasher."""
+        from alink_tpu.operator.batch.source import MemSourceBatchOp
+        from alink_tpu.operator.batch.feature.feature_ops import \
+            FeatureHasherBatchOp
+
+        rows = [["u1", 1.5, None], ["u2", None, "x"], [None, -2.0, "y"]]
+        def run():
+            src = MemSourceBatchOp(rows, "a STRING, b DOUBLE, c STRING")
+            out = []
+            for fa in (False, True):
+                op = FeatureHasherBatchOp(selected_cols=["a", "b", "c"],
+                                          num_features=96, field_aware=fa,
+                                          output_col="v").link_from(src)
+                out.append([r[-1] for r in op.collect()])
+            return out
+
+        native = run()
+        monkeypatch.setenv("ALINK_NO_NATIVE", "1")
+        pure = run()
+        assert native == pure
