@@ -325,6 +325,7 @@ FORMAT_OPS: Dict[str, type] = {"AnyToTripleBatchOp": AnyToTripleBatchOp}
 def _mkop(name: str, base: type, ns: Dict) -> type:
     # use the base's metaclass so WithParams accessor generation runs
     ns["__doc__"] = f"reference: batch/dataproc/format/{name}.java"
+    ns.setdefault("__module__", __name__)
     return type(base)(name, (base,), ns)
 
 

@@ -19,7 +19,8 @@ FORMAT_STREAM_OPS: Dict[str, type] = {}
 for _bname, _bcls in FORMAT_OPS.items():
     _sname = _bname.replace("BatchOp", "StreamOp")
     _ns = {"_batch_cls": (lambda cls=_bcls: (lambda self: cls))(),
-           "__doc__": f"stream twin of {_bname}"}
+           "__doc__": f"stream twin of {_bname}",
+           "__module__": __name__}
     # re-declare the batch twin's param descriptors so WithParams accepts
     # the same kwargs on the stream op
     for _info in _bcls.param_infos().values():

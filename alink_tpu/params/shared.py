@@ -13,7 +13,7 @@ __all__ = []
 
 
 def _mix(name, info_attr, info):
-    cls = type(name, (), {info_attr: info})
+    cls = type(name, (), {info_attr: info, "__module__": __name__})
     globals()[name] = cls
     __all__.append(name)
     return cls

@@ -37,10 +37,13 @@ class BatchOpTransformer(Transformer):
 
 
 def _trainer(name, train_op, mapper, extra_bases=()):
+    import sys
+    mod = sys._getframe(1).f_globals.get("__name__", __name__)
     model_cls = type(name + "Model", (MapModel,) + tuple(extra_bases),
-                     {"MAPPER_CLS": mapper})
+                     {"MAPPER_CLS": mapper, "__module__": mod})
     cls = type(name, (Trainer,) + tuple(extra_bases),
-               {"TRAIN_OP_CLS": train_op, "MODEL_CLS": model_cls})
+               {"TRAIN_OP_CLS": train_op, "MODEL_CLS": model_cls,
+                "__module__": mod})
     # inherit train-op + mapper params for kwargs validation
     mapper_infos = getattr(mapper, "_PARAM_INFOS", {})
     cls._PARAM_INFOS = {**train_op._PARAM_INFOS, **mapper_infos,

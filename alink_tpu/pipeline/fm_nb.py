@@ -23,8 +23,12 @@ from .base import Estimator, MapModel, Model, Trainer, Transformer, _as_op
 
 
 def _wrap(name, train_op, mapper):
-    model_cls = type(name + "Model", (MapModel,), {"MAPPER_CLS": mapper})
-    cls = type(name, (Trainer,), {"TRAIN_OP_CLS": train_op, "MODEL_CLS": model_cls})
+    import sys
+    mod = sys._getframe(1).f_globals.get("__name__", __name__)
+    model_cls = type(name + "Model", (MapModel,),
+                     {"MAPPER_CLS": mapper, "__module__": mod})
+    cls = type(name, (Trainer,), {"TRAIN_OP_CLS": train_op,
+                                  "MODEL_CLS": model_cls, "__module__": mod})
     from ..params.shared import (HasPredictionCol, HasPredictionDetailCol,
                                  HasReservedCols)
     extra = {i.name: i for i in (HasPredictionCol.PREDICTION_COL,

@@ -16,7 +16,8 @@ from .feature import BatchOpTransformer, _trainer
 
 
 def _op_transformer(name, op_cls):
-    cls = type(name, (BatchOpTransformer,), {"OP_CLS": op_cls})
+    cls = type(name, (BatchOpTransformer,),
+               {"OP_CLS": op_cls, "__module__": __name__})
     cls._PARAM_INFOS = {**op_cls._PARAM_INFOS, **cls._PARAM_INFOS}
     return cls
 
