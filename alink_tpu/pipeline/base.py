@@ -19,6 +19,17 @@ from ..mapper.base import ModelMapper
 from ..operator.base import BatchOperator, TableSourceBatchOp
 
 
+def caller_module(depth: int = 2) -> str:
+    """__name__ of the module ``depth`` frames up.
+
+    Class factories (_trainer/_wrap) mint classes on behalf of their caller;
+    the minted class's ``__module__`` must name the caller's module or
+    repr/pickle/docs attribution points at the factory instead.
+    """
+    import sys
+    return sys._getframe(depth).f_globals.get("__name__", __name__)
+
+
 class PipelineStage(WithParams):
     def clone(self):
         return type(self)(self.params.clone())

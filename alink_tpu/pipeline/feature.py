@@ -37,8 +37,8 @@ class BatchOpTransformer(Transformer):
 
 
 def _trainer(name, train_op, mapper, extra_bases=()):
-    import sys
-    mod = sys._getframe(1).f_globals.get("__name__", __name__)
+    from .base import caller_module
+    mod = caller_module()
     model_cls = type(name + "Model", (MapModel,) + tuple(extra_bases),
                      {"MAPPER_CLS": mapper, "__module__": mod})
     cls = type(name, (Trainer,) + tuple(extra_bases),

@@ -23,8 +23,8 @@ from .base import Estimator, MapModel, Model, Trainer, Transformer, _as_op
 
 
 def _wrap(name, train_op, mapper):
-    import sys
-    mod = sys._getframe(1).f_globals.get("__name__", __name__)
+    from .base import caller_module
+    mod = caller_module()
     model_cls = type(name + "Model", (MapModel,),
                      {"MAPPER_CLS": mapper, "__module__": mod})
     cls = type(name, (Trainer,), {"TRAIN_OP_CLS": train_op,

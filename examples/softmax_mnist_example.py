@@ -1,0 +1,55 @@
+"""Softmax (multinomial LR) on MNIST-shaped data — mirror of the reference
+``pyalink/mnist.ipynb`` notebook (Softmax over 784-dim sparse vectors),
+with a synthetic digit-like fixture instead of the hosted CSV (no egress).
+
+Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+     PYTHONPATH=. python examples/softmax_mnist_example.py
+"""
+
+import numpy as np
+
+from alink_tpu.common.mlenv import use_local_env
+from alink_tpu.common.vector import SparseVector
+from alink_tpu.operator.batch.evaluation import EvalMultiClassBatchOp
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+from alink_tpu.pipeline import Pipeline
+from alink_tpu.pipeline.classification import Softmax
+
+
+def mnist_like(n: int = 1500, d: int = 784, k: int = 10, seed: int = 3):
+    """Sparse 784-dim rows: each class lights up its own pixel template."""
+    rng = np.random.RandomState(seed)
+    templates = [rng.choice(d, size=40, replace=False) for _ in range(k)]
+    rows = []
+    for _ in range(n):
+        y = rng.randint(k)
+        on = np.unique(np.concatenate(
+            [templates[y][rng.rand(40) < 0.7],
+             rng.choice(d, size=8)]))  # noise pixels
+        vals = np.clip(rng.rand(on.size) * 255, 1, 255)
+        rows.append((str(SparseVector(d, on.tolist(), vals.tolist())), int(y)))
+    return rows
+
+
+def main():
+    use_local_env(parallelism=8)
+    rows = mnist_like()
+    split = int(len(rows) * 0.8)
+    train = MemSourceBatchOp(rows[:split], "vec STRING, label INT")
+    test = MemSourceBatchOp(rows[split:], "vec STRING, label INT")
+
+    pipe = Pipeline(
+        Softmax(vector_col="vec", label_col="label", max_iter=60,
+                prediction_col="pred", prediction_detail_col="detail"),
+    )
+    model = pipe.fit(train)
+    pred = model.transform(test)
+    metrics = (EvalMultiClassBatchOp(label_col="label",
+                                     prediction_col="pred")
+               .link_from(pred).collect_metrics())
+    print("accuracy:", metrics.get("Accuracy"))
+    assert metrics.get("Accuracy") > 0.9
+
+
+if __name__ == "__main__":
+    main()
