@@ -376,23 +376,45 @@ class IsotonicModelConverter(SimpleModelDataConverter):
 
 
 def pav(x: np.ndarray, y: np.ndarray, w: np.ndarray):
-    """Pool-adjacent-violators (reference isotonicReg/ PAV)."""
+    """Pool-adjacent-violators (reference isotonicReg/ PAV).
+
+    Each pooled block keeps BOTH its x-extent endpoints so the fitted
+    function is flat across a block and linear only between blocks — the
+    reference/Spark-ML boundary semantics (a single representative per
+    block would turn constant segments into ramps under interpolation).
+    """
     order = np.argsort(x, kind="mergesort")
     xs, ys, ws = x[order], y[order].astype(np.float64), w[order].astype(np.float64)
-    vals: List[float] = []
-    wts: List[float] = []
-    xs_out: List[float] = []
+    # pool tied x first (weighted mean), as the reference/Spark do —
+    # otherwise duplicate boundaries make the fitted function ill-defined
+    # at tied points
+    xs, first = np.unique(xs, return_index=True)
+    seg = np.repeat(np.arange(len(first)),
+                    np.diff(np.append(first, len(ys))))
+    wsum = np.bincount(seg, ws)
+    ys = np.bincount(seg, ws * ys) / wsum
+    ws = wsum
+    # blocks of [x_min, x_max, value, weight]
+    blocks: List[List[float]] = []
     for xi, yi, wi in zip(xs, ys, ws):
-        vals.append(yi)
-        wts.append(wi)
-        xs_out.append(xi)
-        while len(vals) > 1 and vals[-2] > vals[-1]:
-            v = (vals[-2] * wts[-2] + vals[-1] * wts[-1]) / (wts[-2] + wts[-1])
-            w2 = wts[-2] + wts[-1]
-            vals[-2:] = [v]
-            wts[-2:] = [w2]
-            xs_out[-2:] = [xs_out[-1]]
-    return np.asarray(xs_out), np.asarray(vals)
+        blocks.append([xi, xi, yi, wi])
+        while len(blocks) > 1 and blocks[-2][2] > blocks[-1][2]:
+            b2 = blocks.pop()
+            b1 = blocks[-1]
+            tot = b1[3] + b2[3]
+            b1[2] = (b1[2] * b1[3] + b2[2] * b2[3]) / tot
+            b1[1] = b2[1]
+            b1[3] = tot
+    bx: List[float] = []
+    bv: List[float] = []
+    for xmin, xmax, v, _ in blocks:
+        if not bx or bx[-1] != xmin or bv[-1] != v:
+            bx.append(xmin)
+            bv.append(v)
+        if xmax != xmin:
+            bx.append(xmax)
+            bv.append(v)
+    return np.asarray(bx), np.asarray(bv)
 
 
 class IsotonicRegTrainBatchOp(BatchOperator, HasLabelCol, HasWeightCol):
