@@ -12,13 +12,15 @@ import pytest
 from alink_tpu.operator.batch.source import MemSourceBatchOp
 
 
-def _src(X, y=None, names=None):
+def _src(X, y=None, names=None, float_label=False):
     cols = names or [f"x{i}" for i in range(X.shape[1])]
     rows = [list(map(float, r)) for r in X]
+    label_type = "DOUBLE" if float_label else "INT"
+    cast = float if float_label else int
     if y is not None:
-        rows = [r + [int(v)] for r, v in zip(rows, y)]
+        rows = [r + [cast(v)] for r, v in zip(rows, y)]
         cols = cols + ["label"]
-    schema = ", ".join(f"{c} {'INT' if c == 'label' else 'DOUBLE'}"
+    schema = ", ".join(f"{c} {label_type if c == 'label' else 'DOUBLE'}"
                        for c in cols)
     return MemSourceBatchOp(rows, schema)
 
@@ -64,9 +66,7 @@ class TestLinearParity:
         from alink_tpu.operator.batch.regression import LinearRegTrainBatchOp
         from alink_tpu.operator.common.linear.base import \
             LinearModelDataConverter
-        rows = [list(map(float, r)) + [float(t)] for r, t in zip(X, yv)]
-        src = MemSourceBatchOp(rows, "x0 DOUBLE, x1 DOUBLE, x2 DOUBLE, "
-                                     "x3 DOUBLE, label DOUBLE")
+        src = _src(X, yv, float_label=True)
         t = LinearRegTrainBatchOp(feature_cols=["x0", "x1", "x2", "x3"],
                                   label_col="label", max_iter=300,
                                   epsilon=1e-10)
@@ -205,3 +205,129 @@ class TestNaiveBayesParity:
             q = np.linspace(-1, 11, 101)
             np.testing.assert_allclose(np.interp(q, bx, bv), gold.predict(q),
                                        atol=1e-10)
+
+
+class TestEvalParity:
+    def test_binary_metrics_vs_sklearn(self, data):
+        X, y = data
+        import sklearn.metrics as skm
+
+        from alink_tpu import EvalBinaryClassBatchOp
+        rng = np.random.RandomState(9)
+        score = 1.0 / (1.0 + np.exp(-(X[:, 0] - X[:, 1] + 0.5 * rng.randn(len(y)))))
+        yy = (X[:, 0] - X[:, 1] + 0.8 * rng.randn(len(y)) > 0).astype(int)
+        import json
+        rows = [[int(v), json.dumps({"1": float(s), "0": float(1 - s)})]
+                for v, s in zip(yy, score)]
+        src = MemSourceBatchOp(rows, "label INT, detail STRING")
+        m = (EvalBinaryClassBatchOp(label_col="label",
+                                    prediction_detail_col="detail")
+             .link_from(src).collect_metrics())
+        assert abs(m.get("AUC") - skm.roc_auc_score(yy, score)) < 1e-6
+        pred = (score >= 0.5).astype(int)
+        assert abs(m.get("Accuracy") - skm.accuracy_score(yy, pred)) < 1e-6
+        assert abs(m.get("LogLoss") - skm.log_loss(yy, score)) < 1e-6
+
+    def test_regression_metrics_vs_sklearn(self):
+        import sklearn.metrics as skm
+
+        from alink_tpu import EvalRegressionBatchOp
+        rng = np.random.RandomState(4)
+        yt = rng.randn(200) * 3 + 1
+        yp = yt + rng.randn(200) * 0.7
+        rows = [[float(a), float(b)] for a, b in zip(yt, yp)]
+        src = MemSourceBatchOp(rows, "label DOUBLE, pred DOUBLE")
+        m = (EvalRegressionBatchOp(label_col="label", prediction_col="pred")
+             .link_from(src).collect_metrics())
+        assert abs(m.get("MSE") - skm.mean_squared_error(yt, yp)) < 1e-8
+        assert abs(m.get("MAE") - skm.mean_absolute_error(yt, yp)) < 1e-8
+        assert abs(m.get("R2") - skm.r2_score(yt, yp)) < 1e-8
+
+
+class TestChiSquareParity:
+    def test_vs_scipy(self):
+        import scipy.stats as st
+
+        from alink_tpu import ChiSquareTestBatchOp
+        rng = np.random.RandomState(0)
+        a = rng.randint(0, 3, 150)
+        b = (a + rng.randint(0, 2, 150)) % 3
+        rows = [[int(x), int(yv)] for x, yv in zip(a, b)]
+        src = MemSourceBatchOp(rows, "f INT, label INT")
+        op = ChiSquareTestBatchOp(selected_cols=["f"], label_col="label")
+        op.link_from(src)
+        (_, p, chi2, dof), = op.collect()
+        table = np.zeros((3, 3))
+        for x, yv in zip(a, b):
+            table[x, yv] += 1
+        gold = st.chi2_contingency(table, correction=False)
+        assert abs(chi2 - gold.statistic) < 1e-8
+        assert abs(p - gold.pvalue) < 1e-10
+        assert dof == gold.dof
+
+
+class TestQuantileParity:
+    def test_vs_sklearn_kbins(self):
+        from sklearn.preprocessing import KBinsDiscretizer
+
+        from alink_tpu import (QuantileDiscretizerPredictBatchOp,
+                               QuantileDiscretizerTrainBatchOp)
+        rng = np.random.RandomState(7)
+        x = rng.randn(400) * 2 + 1
+        src = MemSourceBatchOp([[float(v)] for v in x], "f DOUBLE")
+        t = QuantileDiscretizerTrainBatchOp(selected_cols=["f"],
+                                            num_buckets=4).link_from(src)
+        p = QuantileDiscretizerPredictBatchOp().link_from(t, src)
+        got = np.array([int(r[-1]) for r in p.collect()])
+        try:  # quantile_method needs sklearn >= 1.6; older versions default ok
+            sk = KBinsDiscretizer(n_bins=4, encode="ordinal",
+                                  strategy="quantile",
+                                  quantile_method="linear")
+        except TypeError:
+            sk = KBinsDiscretizer(n_bins=4, encode="ordinal",
+                                  strategy="quantile")
+        want = sk.fit_transform(x[:, None])[:, 0].astype(int)
+        assert (got == want).mean() > 0.99  # boundary-point rounding may differ
+
+
+class TestRidgeLassoParity:
+    def test_ridge_coefficients(self):
+        rng = np.random.RandomState(2)
+        X = rng.randn(250, 4)
+        yv = X @ np.array([1.0, -2.0, 0.0, 0.5]) + 2.0 + 0.05 * rng.randn(250)
+        from sklearn.linear_model import Ridge as SkRidge
+
+        from alink_tpu import RidgeRegTrainBatchOp
+        from alink_tpu.operator.common.linear.base import \
+            LinearModelDataConverter
+        lam = 0.5
+        src = _src(X, yv, float_label=True)
+        t = RidgeRegTrainBatchOp(feature_cols=["x0", "x1", "x2", "x3"],
+                                 label_col="label", lambda_=lam / len(yv),
+                                 max_iter=300, epsilon=1e-10,
+                                 standardization=False)
+        t.link_from(src)
+        ours = LinearModelDataConverter().load_model(t.get_output_table())
+        sk = SkRidge(alpha=lam).fit(X, yv)
+        np.testing.assert_allclose(ours.coef[1:], sk.coef_, rtol=2e-2,
+                                   atol=2e-2)
+        np.testing.assert_allclose(ours.coef[0], sk.intercept_, rtol=2e-2,
+                                   atol=4e-2)
+
+    def test_lasso_sparsity(self):
+        """Lasso (OWLQN) must zero out the irrelevant coefficients."""
+        rng = np.random.RandomState(6)
+        X = rng.randn(300, 6)
+        yv = X @ np.array([3.0, 0.0, 0.0, -2.0, 0.0, 0.0]) + 0.05 * rng.randn(300)
+        from alink_tpu import LassoRegTrainBatchOp
+        from alink_tpu.operator.common.linear.base import \
+            LinearModelDataConverter
+        src = _src(X, yv, float_label=True)
+        t = LassoRegTrainBatchOp(feature_cols=[f"x{i}" for i in range(6)],
+                                 label_col="label", lambda_=0.05,
+                                 max_iter=300)
+        t.link_from(src)
+        ours = LinearModelDataConverter().load_model(t.get_output_table())
+        w = ours.coef[1:]
+        assert abs(w[0] - 3.0) < 0.3 and abs(w[3] + 2.0) < 0.3
+        assert np.abs(w[[1, 2, 4, 5]]).max() < 0.05
