@@ -75,3 +75,44 @@ def test_als_implicit():
     for (u, i), r in lookup.items():
         (clicked if r > 0 else unclicked).append(S[u, i])
     assert np.mean(clicked) > np.mean(unclicked)
+
+
+def test_batched_nnls_kkt_and_scipy_parity():
+    """batched_nnls must satisfy the NNLS KKT conditions and agree with
+    scipy.optimize.nnls on pure least-squares instances."""
+    import jax.numpy as jnp
+    from scipy.optimize import nnls as scipy_nnls
+
+    from alink_tpu.operator.common.recommendation.als import batched_nnls
+    rng = np.random.RandomState(0)
+    r = 6
+    Ms = [rng.randn(20, r) for _ in range(20)]
+    ys = [rng.randn(20) for _ in range(20)]
+    A = np.stack([M.T @ M for M in Ms])
+    b = np.stack([M.T @ y for M, y in zip(Ms, ys)])
+    x = np.asarray(batched_nnls(jnp.asarray(A), jnp.asarray(b), num_iter=500))
+    assert (x >= 0).all()
+    # KKT: stationarity on the free set, nonnegative gradient on the active
+    # set, complementary slackness
+    g = np.einsum("nij,nj->ni", A, x) - b
+    active = x <= 1e-6
+    assert np.abs(g[~active]).max() < 1e-3
+    assert g[active].min() > -1e-3
+    assert np.abs(g * x).max() < 1e-3
+    for i in range(20):
+        gold, _ = scipy_nnls(Ms[i], ys[i])
+        np.testing.assert_allclose(x[i], gold, atol=5e-4)
+
+
+def test_als_nonnegative():
+    rows, R = _ratings(frac=0.6)
+    src = MemSourceBatchOp(rows, "user LONG, item LONG, rating DOUBLE")
+    train = AlsTrainBatchOp(user_col="user", item_col="item",
+                            rate_col="rating", rank=5, num_iter=10,
+                            nonnegative=True).link_from(src)
+    m = AlsModelDataConverter().load_model(train.get_output_table())
+    assert (m.user_factors >= 0).all() and (m.item_factors >= 0).all()
+    # reconstruction still works under the constraint (ratings positive)
+    S = m.user_factors @ m.item_factors.T
+    errs = [abs(S[u, i] - r) for u, i, r in rows]
+    assert np.mean(errs) < 0.8, np.mean(errs)
