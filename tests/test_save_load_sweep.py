@@ -1,0 +1,81 @@
+"""Pipeline save -> load -> transform parity sweep across model families.
+
+The reference exercises ModelDataConverter round-trips per algorithm
+(SURVEY §4 "converter round-trips"); this sweep fits one pipeline per
+family, saves it, reloads in-place, and requires bit-identical transform
+output — catching any converter field that fails to survive serialization.
+"""
+
+import numpy as np
+import pytest
+
+from alink_tpu import Pipeline, PipelineModel
+from alink_tpu.operator.batch.source import MemSourceBatchOp
+
+
+def _cls_src(rng, n=120):
+    X = rng.randn(n, 4)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    rows = [[*map(float, r), int(l)] for r, l in zip(X, y)]
+    return MemSourceBatchOp(rows, "a DOUBLE, b DOUBLE, c DOUBLE, d DOUBLE, "
+                                  "label INT")
+
+
+FEATS = ["a", "b", "c", "d"]
+
+
+def _stages():
+    from alink_tpu import (GaussianMixture, GbdtClassifier, Imputer,
+                           KMeans, LinearRegression, LogisticRegression,
+                           MinMaxScaler, QuantileDiscretizer,
+                           RandomForestClassifier, Softmax, StandardScaler)
+    common = dict(prediction_col="pred")
+    return [
+        ("logreg", LogisticRegression(feature_cols=FEATS, label_col="label",
+                                      max_iter=30, **common)),
+        ("softmax", Softmax(feature_cols=FEATS, label_col="label",
+                            max_iter=30, **common)),
+        ("linreg", LinearRegression(feature_cols=FEATS, label_col="label",
+                                    max_iter=30, **common)),
+        ("rf", RandomForestClassifier(feature_cols=FEATS, label_col="label",
+                                      num_trees=5, max_depth=3, **common)),
+        ("gbdt", GbdtClassifier(feature_cols=FEATS, label_col="label",
+                                num_trees=5, max_depth=3, **common)),
+        ("kmeans", KMeans(feature_cols=FEATS, k=3, max_iter=10, **common)),
+        ("gmm", GaussianMixture(feature_cols=FEATS, k=2, max_iter=10,
+                                **common)),
+        ("standard_scaler", StandardScaler(selected_cols=FEATS)),
+        ("minmax_scaler", MinMaxScaler(selected_cols=FEATS)),
+        ("imputer", Imputer(selected_cols=FEATS)),
+        ("quantile", QuantileDiscretizer(selected_cols=FEATS, num_buckets=3)),
+    ]
+
+
+@pytest.mark.parametrize("name,stage", _stages(),
+                         ids=[n for n, _ in _stages()])
+def test_save_load_transform_parity(tmp_path, rng, name, stage):
+    src = _cls_src(rng)
+    model = Pipeline(stage).fit(src)
+    before = model.transform(src).collect()
+    path = str(tmp_path / f"{name}.model")
+    model.save(path)
+    loaded = PipelineModel.load(path)
+    after = loaded.transform(src).collect()
+    assert len(before) == len(after)
+    for r1, r2 in zip(before, after):
+        assert [str(v) for v in r1] == [str(v) for v in r2], name
+
+
+def test_local_predictor_matches_transform(rng):
+    """Embedded serving must agree with batch transform row-for-row."""
+    from alink_tpu import LogisticRegression
+    src = _cls_src(rng)
+    model = Pipeline(LogisticRegression(
+        feature_cols=FEATS, label_col="label", max_iter=30,
+        prediction_col="pred")).fit(src)
+    batch_rows = model.transform(src).collect()
+    pred = model.get_local_predictor()
+    schema = src.get_output_table().schema
+    for row, want in zip(src.collect(), batch_rows):
+        got = pred.map(tuple(row), schema)
+        assert str(got[-1]) == str(want[-1])
