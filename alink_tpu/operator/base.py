@@ -129,6 +129,42 @@ class BatchOperator(AlgoOperator):
         from ..operator.common.statistics.summarizer import summarize_table
         return summarize_table(self.get_output_table())
 
+    # -- train/model-info hooks (reference WithTrainInfo / lazyPrintTrainInfo
+    # and WithModelInfoBatchOp / lazyPrintModelInfo, fired from Trainer.fit
+    # per pipeline/Trainer.java:50-66) ------------------------------------
+    def get_train_info(self) -> MTable:
+        """Per-iteration training telemetry (loss curve etc.) — side output 0
+        by convention across trainers."""
+        if not self._side_outputs:
+            raise RuntimeError(f"{type(self).__name__} emits no train info")
+        return self._side_outputs[0]
+
+    def lazy_print_train_info(self, title: Optional[str] = None) -> "BatchOperator":
+        def show(t: MTable):
+            if title:
+                print(title)
+            print(t.to_display_string())
+        return self._lazy("train_info", self.get_train_info(), show)
+
+    def lazy_collect_train_info(self, callback) -> "BatchOperator":
+        return self._lazy("train_info_collect", self.get_train_info(), callback)
+
+    def get_model_info(self) -> MTable:
+        """Summary of the trained model table (reference
+        ExtractModelInfoBatchOp role); trainers may override with a richer
+        extraction — the default reports schema + row count."""
+        t = self.get_output_table()
+        return MTable({"field": list(t.col_names),
+                       "type": [t.schema.type_of(c) for c in t.col_names],
+                       "num_rows": [t.num_rows] * len(t.col_names)})
+
+    def lazy_print_model_info(self, title: Optional[str] = None) -> "BatchOperator":
+        def show(t: MTable):
+            if title:
+                print(title)
+            print(t.to_display_string())
+        return self._lazy("model_info", self.get_model_info(), show)
+
     # -- SQL-ish conveniences (delegate to MTable; full ops in batch/sql) --
     def select(self, fields) -> "BatchOperator":
         from .batch.sql import SelectBatchOp

@@ -37,9 +37,6 @@ class BaseLinearTrainBatchOp(BatchOperator, _LinearTrainParams):
         self._side_outputs = [info]
         return self
 
-    def get_train_info(self):
-        return self._side_outputs[0]
-
 
 class _LinearPredictParams(HasPredictionCol, HasPredictionDetailCol, HasReservedCols,
                            HasVectorCol):

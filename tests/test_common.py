@@ -125,3 +125,53 @@ def test_dense_matrix():
     assert list(v.data) == [2.0, 4.0]
     sol = m.solve(DenseVector([2.0, 8.0]))
     assert np.allclose(sol.data, [1.0, 2.0])
+
+
+class TestTrainModelInfoHooks:
+    """reference WithTrainInfo/lazyPrintTrainInfo + WithModelInfoBatchOp."""
+
+    def _train(self):
+        import numpy as np
+        from alink_tpu.operator.batch.classification import \
+            LogisticRegressionTrainBatchOp
+        from alink_tpu.operator.batch.source import MemSourceBatchOp
+        rng = np.random.RandomState(0)
+        X = rng.randn(80, 3)
+        y = (X[:, 0] > 0).astype(int)
+        src = MemSourceBatchOp([[*map(float, r), int(l)] for r, l in zip(X, y)],
+                               "a DOUBLE, b DOUBLE, c DOUBLE, label INT")
+        t = LogisticRegressionTrainBatchOp(feature_cols=["a", "b", "c"],
+                                           label_col="label", max_iter=20)
+        return t.link_from(src)
+
+    def test_lazy_print_train_info(self, capsys):
+        t = self._train()
+        t.lazy_print_train_info("== training curve ==")
+        t.execute()
+        out = capsys.readouterr().out
+        assert "== training curve ==" in out
+
+    def test_lazy_collect_and_model_info(self, capsys):
+        got = []
+        t = self._train()
+        t.lazy_collect_train_info(got.append)
+        t.lazy_print_model_info("== model ==")
+        t.execute()
+        assert got and got[0].num_rows >= 1
+        assert "== model ==" in capsys.readouterr().out
+
+    def test_trainer_enable_lazy_print(self, capsys):
+        import numpy as np
+        from alink_tpu import LogisticRegression, Pipeline
+        from alink_tpu.operator.batch.source import MemSourceBatchOp
+        rng = np.random.RandomState(0)
+        X = rng.randn(60, 2)
+        y = (X[:, 0] > 0).astype(int)
+        src = MemSourceBatchOp([[*map(float, r), int(l)] for r, l in zip(X, y)],
+                               "a DOUBLE, b DOUBLE, label INT")
+        est = (LogisticRegression(feature_cols=["a", "b"], label_col="label",
+                                  max_iter=15, prediction_col="p")
+               .enable_lazy_print_train_info("== curve =="))
+        model = Pipeline(est).fit(src)
+        model.transform(src).execute()
+        assert "== curve ==" in capsys.readouterr().out
