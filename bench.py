@@ -60,19 +60,26 @@ def tpu_run(fb_idx, y, iters: int):
     meta = FieldBlockMeta(N_FIELDS, FIELD_SIZE)
     data = {"fb_idx": fb_idx, "y": y, "w": np.ones(len(y), np.float32)}
 
+    wrng = np.random.RandomState(123)
+
     def run(n_iter):
         obj = UnaryLossObjFunc(LogLossFunc(), DIM, l2=1e-4, fb_meta=meta)
+        # distinct tiny warm start per call: defeats any execution-result
+        # memoization between identical (program, inputs) pairs in the
+        # runtime, so every timed call does real device work
+        w0 = (wrng.randn(DIM) * 1e-6).astype(np.float32)
         t0 = time.perf_counter()
         optimize(obj, data, OptimParams(method="LBFGS", max_iter=n_iter,
-                                        epsilon=0.0), env)
+                                        epsilon=0.0), env, warm_start=w0)
         return time.perf_counter() - t0
 
     run(1)                   # compile 1-iter program into the cache
     run(1 + iters)           # compile loop program into the cache
-    # min-of-3 per program: per-call overhead (retrace + tunnel transfer)
-    # is noisy at the ~0.5 s level, which would swamp the superstep delta
-    t1 = min(run(1) for _ in range(3))
-    t_full = min(run(1 + iters) for _ in range(3))
+    # median-of-3 per program: per-call overhead (retrace + tunnel
+    # transfer) is noisy at the ~0.5 s level; the long measured span
+    # (iters supersteps) keeps the delta well above that noise floor
+    t1 = sorted(run(1) for _ in range(3))[1]
+    t_full = sorted(run(1 + iters) for _ in range(3))[1]
     return max(t_full - t1, 1e-9), env.num_workers
 
 
@@ -100,7 +107,7 @@ def cpu_baseline(fb_idx, y, iters: int) -> float:
 
 
 def main():
-    n_rows, iters = 200_000, 60
+    n_rows, iters = 200_000, 300
     fb_idx, y = make_data(n_rows)
     tpu_t, n_chips = tpu_run(fb_idx, y, iters)
     tpu_sps = n_rows * iters / tpu_t / max(n_chips, 1)
