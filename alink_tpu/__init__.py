@@ -12,6 +12,7 @@ __version__ = "0.1.0"
 from .common import (Params, ParamInfo, WithParams, AlinkTypes, TableSchema,
                      DenseVector, SparseVector, VectorUtil, SparseBatch, DenseMatrix,
                      MTable, MLEnvironment, MLEnvironmentFactory, use_local_env,
+                     use_remote_env,
                      StepTimer, named_stage, trace)
 from .engine import (IterativeComQueue, ComContext, ComputeFunction, AllReduce,
                      AllGather, BroadcastFromWorker0)

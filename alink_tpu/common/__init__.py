@@ -3,7 +3,8 @@ from .types import AlinkTypes, TableSchema
 from .vector import (DenseVector, SparseVector, Vector, VectorUtil, SparseBatch,
                      DenseMatrix)
 from .mtable import MTable
-from .mlenv import MLEnvironment, MLEnvironmentFactory, use_local_env
+from .mlenv import (MLEnvironment, MLEnvironmentFactory, use_local_env,
+                    use_remote_env)
 from .lazy import LazyEvaluation, LazyObjectsManager
 from .profiling import StepTimer, named_stage, trace
 
@@ -11,6 +12,6 @@ __all__ = [
     "Params", "ParamInfo", "WithParams", "RangeValidator", "InValidator", "MinValidator",
     "AlinkTypes", "TableSchema", "DenseVector", "SparseVector", "Vector", "VectorUtil",
     "SparseBatch", "DenseMatrix", "MTable", "MLEnvironment", "MLEnvironmentFactory",
-    "use_local_env", "LazyEvaluation", "LazyObjectsManager",
+    "use_local_env", "use_remote_env", "LazyEvaluation", "LazyObjectsManager",
     "StepTimer", "named_stage", "trace",
 ]

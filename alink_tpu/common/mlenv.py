@@ -136,3 +136,51 @@ def use_local_env(parallelism: Optional[int] = None, model_parallelism: int = 1)
     env = MLEnvironment(parallelism=parallelism, model_parallelism=model_parallelism)
     MLEnvironmentFactory.set_default(env)
     return env
+
+
+def use_remote_env(coordinator_address: Optional[str] = None,
+                   num_processes: Optional[int] = None,
+                   process_id: Optional[int] = None,
+                   parallelism: Optional[int] = None,
+                   model_parallelism: int = 1) -> MLEnvironment:
+    """Multi-host entry (reference ``useRemoteEnv``: session on a cluster).
+
+    Where the reference connects the Py4J gateway to a remote Flink cluster,
+    the TPU build joins a multi-host JAX runtime: every host in the slice
+    calls this with the same coordinator address; ``jax.distributed``
+    initializes cross-host ICI/DCN collectives and ``jax.devices()`` then
+    spans ALL hosts' chips, so the returned session's mesh — and therefore
+    every BSP program, psum, and all_gather — runs slice-wide with no other
+    code changes. On Cloud TPU the three arguments are auto-detected from
+    the environment and may be omitted.
+
+    The data each host feeds the engine should be that host's input shard
+    (per-host sharded readers, SURVEY §7 "scaling 8->128 chips").
+    """
+    import jax
+
+    already = getattr(jax.distributed, "is_initialized", None)
+    if not (callable(already) and already()):
+        kwargs = {}
+        if coordinator_address is not None:
+            kwargs["coordinator_address"] = coordinator_address
+        if num_processes is not None:
+            kwargs["num_processes"] = num_processes
+        if process_id is not None:
+            kwargs["process_id"] = process_id
+        try:
+            jax.distributed.initialize(**kwargs)
+        except (RuntimeError, ValueError) as e:
+            # RuntimeError: backends already up (jax touched before
+            # connecting). ValueError: nothing to auto-detect on this host.
+            # A genuinely multi-host request must fail loudly — degrading
+            # would train num_processes independent wrong models — but a
+            # single/unspecified-process session can continue locally.
+            if num_processes is not None and num_processes > 1:
+                raise RuntimeError(
+                    f"use_remote_env: could not join the {num_processes}-"
+                    f"process distributed runtime: {e}") from e
+            print(f"[alink_tpu] use_remote_env: jax.distributed not joined "
+                  f"({e}); continuing with this process's devices only")
+    return use_local_env(parallelism=parallelism,
+                         model_parallelism=model_parallelism)

@@ -175,3 +175,27 @@ class TestTrainModelInfoHooks:
         model = Pipeline(est).fit(src)
         model.transform(src).execute()
         assert "== curve ==" in capsys.readouterr().out
+
+
+def test_use_remote_env_single_host():
+    """use_remote_env degrades to the local mesh when jax.distributed is
+    already initialized or running single-process (CI path)."""
+    import jax
+
+    from alink_tpu.common.mlenv import (MLEnvironmentFactory, use_local_env,
+                                        use_remote_env)
+    prev = MLEnvironmentFactory.get_default()
+    try:
+        # single-process: initialize() with explicit 1-process topology
+        env = use_remote_env(coordinator_address="localhost:12321",
+                             num_processes=1, process_id=0)
+        assert env.num_workers >= 1
+        assert MLEnvironmentFactory.get_default() is env
+        # second call must not re-initialize (idempotent)
+        env2 = use_remote_env()
+        assert env2.num_workers == env.num_workers
+    finally:
+        MLEnvironmentFactory.set_default(prev)
+        import contextlib
+        with contextlib.suppress(Exception):
+            jax.distributed.shutdown()
