@@ -34,23 +34,18 @@ def _parse_cell(s: str, type_: str):
     return s
 
 
-def _read_csv_native(path: str, schema: TableSchema, field_delimiter: str,
-                     quote_char: str, ignore_first_line: bool):
+def _csv_bytes_native(data: bytes, schema: TableSchema, field_delimiter: str,
+                      quote_char: str):
     """Numeric-only fast path through the native parser (parser.cpp
     csv_dims/csv_fill). Returns an MTable or None to fall back."""
-    if len(field_delimiter) != 1 or path.startswith(("http://", "https://")):
+    if len(field_delimiter) != 1:
         return None
     num = {AlinkTypes.DOUBLE, AlinkTypes.FLOAT, AlinkTypes.LONG, AlinkTypes.INT}
     if not all(t.upper() in num for t in schema.types):
         return None
     from ..native import parse_numeric_csv_bytes
-    with open(path, "rb") as f:
-        data = f.read()
     if quote_char.encode() in data:
         return None
-    if ignore_first_line:
-        nl = data.find(b"\n")
-        data = data[nl + 1:] if nl >= 0 else b""
     m = parse_numeric_csv_bytes(data, field_delimiter)
     if m is None or m.shape[1] != len(schema.names) or np.isnan(m).any():
         return None  # missing cells need the None-aware python path
@@ -63,32 +58,70 @@ def _read_csv_native(path: str, schema: TableSchema, field_delimiter: str,
     return MTable(cols, schema)
 
 
-def read_csv(path: str, schema: TableSchema, field_delimiter: str = ",",
-             quote_char: str = '"', skip_blank: bool = True,
-             ignore_first_line: bool = False) -> MTable:
-    fast = _read_csv_native(path, schema, field_delimiter, quote_char,
-                            ignore_first_line)
+def _csv_bytes(data: bytes, schema: TableSchema, field_delimiter: str,
+               quote_char: str, skip_blank: bool) -> MTable:
+    fast = _csv_bytes_native(data, schema, field_delimiter, quote_char)
     if fast is not None:
         return fast
-    if path.startswith(("http://", "https://")):
-        raw = urlopen(path).read().decode("utf-8")  # pragma: no cover - no egress in CI
-        f = io.StringIO(raw)
-    else:
-        f = open(path, "r", encoding="utf-8")
-    try:
-        reader = csv.reader(f, delimiter=field_delimiter, quotechar=quote_char)
-        rows = []
-        for i, rec in enumerate(reader):
-            if ignore_first_line and i == 0:
-                continue
-            if skip_blank and not rec:
-                continue
-            vals = [_parse_cell(rec[j] if j < len(rec) else None, t)
-                    for j, t in enumerate(schema.types)]
-            rows.append(tuple(vals))
-    finally:
-        f.close()
+    reader = csv.reader(io.StringIO(data.decode("utf-8")),
+                        delimiter=field_delimiter, quotechar=quote_char)
+    rows = []
+    for rec in reader:
+        if skip_blank and not rec:
+            continue
+        vals = [_parse_cell(rec[j] if j < len(rec) else None, t)
+                for j, t in enumerate(schema.types)]
+        rows.append(tuple(vals))
     return MTable(rows, schema)
+
+
+def _load_line_bytes(path: str, ignore_first_line: bool,
+                     shard=None) -> bytes:
+    """Bytes of ``path``'s lines for this reader.
+
+    ``shard=(i, n)`` selects the per-host slice (SURVEY §7 sharded sources):
+    glob paths shard round-robin by file; single files shard by
+    newline-aligned byte range (io/sharding.py). Header dropping happens
+    per-file for globs, on shard 0 for byte ranges.
+    """
+    from .sharding import read_file_shard, shard_paths
+
+    def drop_header(b: bytes) -> bytes:
+        nl = b.find(b"\n")
+        return b[nl + 1:] if nl >= 0 else b""
+
+    if path.startswith(("http://", "https://")):
+        if shard is not None and shard[1] > 1:
+            raise ValueError("sharded reads of http sources are unsupported")
+        data = urlopen(path).read()  # pragma: no cover - no egress in CI
+        return drop_header(data) if ignore_first_line else data
+    if shard is None:
+        with open(path, "rb") as f:
+            data = f.read()
+        return drop_header(data) if ignore_first_line else data
+    files = shard_paths(path, *shard)
+    if files is not None:
+        parts = []
+        for p in files:
+            with open(p, "rb") as f:
+                b = f.read()
+            if ignore_first_line:
+                b = drop_header(b)
+            if b and not b.endswith(b"\n"):
+                b += b"\n"
+            parts.append(b)
+        return b"".join(parts)
+    data = read_file_shard(path, *shard)
+    if ignore_first_line and shard[0] == 0:
+        data = drop_header(data)
+    return data
+
+
+def read_csv(path: str, schema: TableSchema, field_delimiter: str = ",",
+             quote_char: str = '"', skip_blank: bool = True,
+             ignore_first_line: bool = False, shard=None) -> MTable:
+    data = _load_line_bytes(path, ignore_first_line, shard)
+    return _csv_bytes(data, schema, field_delimiter, quote_char, skip_blank)
 
 
 def write_csv(table: MTable, path: str, field_delimiter: str = ",",
@@ -143,22 +176,27 @@ def format_libsvm_rows(table: MTable, label_col: str, vector_col: str,
     return "".join(lines)
 
 
-def read_libsvm(path: str, start_index: int = 1) -> MTable:
+def read_libsvm(path: str, start_index: int = 1, shard=None,
+                vector_size=None) -> MTable:
     """LibSVM format -> (label DOUBLE, features SPARSE_VECTOR)
     (reference common/io/LibSvmSourceBatchOp).
 
     Parses through the native C++ two-pass parser
     (alink_tpu/native/parser.cpp svm_count/svm_fill) when available;
     falls back to the pure-Python loop.
+
+    Sharded reads should pass ``vector_size``: the per-shard max-index
+    inference would otherwise give different hosts different widths for
+    the same dataset.
     """
     from ..common.vector import SparseVector
     from ..native import get_lib, parse_libsvm_bytes
+    data = _load_line_bytes(path, ignore_first_line=False, shard=shard)
     if get_lib() is not None:
-        with open(path, "rb") as f:
-            data = f.read()
         labels_a, indptr, indices, values = parse_libsvm_bytes(data,
                                                                start_index)
-        max_idx = int(indices.max()) + 1 if indices.size else 0
+        max_idx = (int(vector_size) if vector_size else
+                   (int(indices.max()) + 1 if indices.size else 0))
         col = [SparseVector(max_idx, indices[indptr[i]:indptr[i + 1]],
                             values[indptr[i]:indptr[i + 1]])
                for i in range(len(labels_a))]
@@ -166,24 +204,25 @@ def read_libsvm(path: str, start_index: int = 1) -> MTable:
                       TableSchema(["label", "features"],
                                   [AlinkTypes.DOUBLE,
                                    AlinkTypes.SPARSE_VECTOR]))
-    # pure-Python fallback streams line-by-line (no whole-file slurp)
+    # pure-Python fallback
     labels: List[float] = []
     vecs = []
     max_idx = 0
-    with open(path, "r", encoding="utf-8") as f:
-        for line in f:
-            parts = line.strip().split()
-            if not parts:
-                continue
-            labels.append(float(parts[0]))
-            idx, val = [], []
-            for p in parts[1:]:
-                k, v = p.split(":")
-                idx.append(int(k) - start_index)
-                val.append(float(v))
-            if idx:
-                max_idx = max(max_idx, max(idx) + 1)
-            vecs.append((idx, val))
+    for line in io.StringIO(data.decode("utf-8")):
+        parts = line.strip().split()
+        if not parts:
+            continue
+        labels.append(float(parts[0]))
+        idx, val = [], []
+        for p in parts[1:]:
+            k, v = p.split(":")
+            idx.append(int(k) - start_index)
+            val.append(float(v))
+        if idx:
+            max_idx = max(max_idx, max(idx) + 1)
+        vecs.append((idx, val))
+    if vector_size:
+        max_idx = int(vector_size)
     col = [SparseVector(max_idx, i, v) for i, v in vecs]
     return MTable({"label": np.asarray(labels), "features": col},
                   TableSchema(["label", "features"],

@@ -39,7 +39,24 @@ class MemSourceBatchOp(BaseSourceBatchOp):
 
 
 class _FileSourceBase(BaseSourceBatchOp):
-    """File sources load lazily so fluent ``set_file_path(...)`` works too."""
+    """File sources load lazily so fluent ``set_file_path(...)`` works too.
+
+    ``sharded=True`` makes each host read only its own slice of the input
+    (glob paths shard by file, single files by newline-aligned byte range
+    — io/sharding.py), the per-host sharded reader SURVEY §7 requires for
+    Criteo-scale inputs; ``shard_index``/``num_shards`` override the
+    default JAX process topology for testing or external schedulers.
+    """
+
+    SHARDED = ParamInfo("sharded", bool, default=False)
+    SHARD_INDEX = ParamInfo("shard_index", int, "override shard index")
+    NUM_SHARDS = ParamInfo("num_shards", int, "override shard count")
+
+    def _shard(self):
+        if not self.get_sharded():
+            return None
+        from ....io.sharding import resolve_shard
+        return resolve_shard(self.get_shard_index(), self.get_num_shards())
 
     def _load(self):  # pragma: no cover - interface
         raise NotImplementedError
@@ -64,7 +81,8 @@ class CsvSourceBatchOp(_FileSourceBase):
             self.get_file_path(), TableSchema.parse(self.get_schema_str()),
             field_delimiter=self.get_field_delimiter(),
             quote_char=self.get_quote_char(),
-            ignore_first_line=self.get_ignore_first_line())
+            ignore_first_line=self.get_ignore_first_line(),
+            shard=self._shard())
 
 
 class LibSvmSourceBatchOp(_FileSourceBase):
@@ -72,9 +90,15 @@ class LibSvmSourceBatchOp(_FileSourceBase):
 
     FILE_PATH = ParamInfo("file_path", str, optional=False)
     START_INDEX = ParamInfo("start_index", int, default=1)
+    VECTOR_SIZE = ParamInfo("vector_size", int,
+                            "fixed feature dim (required for shard-"
+                            "consistent widths)")
 
     def _load(self):
-        self._output = read_libsvm(self.get_file_path(), self.get_start_index())
+        self._output = read_libsvm(self.get_file_path(),
+                                   self.get_start_index(),
+                                   shard=self._shard(),
+                                   vector_size=self.get_vector_size())
 
 
 class TextSourceBatchOp(_FileSourceBase):

@@ -112,3 +112,101 @@ def test_kafka_gated_without_client():
     # the gate instead demands bootstrap_servers (ValueError)
     with pytest.raises((ImportError, ValueError)):
         KafkaSourceStreamOp(topic="t", schema_str="a LONG")
+
+
+class TestShardedSources:
+    """Per-host sharded readers (io/sharding.py; SURVEY §7: input pipelines
+    shard at the source)."""
+
+    def _write(self, tmp_path, n=997, header=False):
+        p = tmp_path / "data.csv"
+        lines = (["a,b\n"] if header else []) + [
+            f"{i},{i * 0.5}\n" for i in range(n)]
+        p.write_text("".join(lines))
+        return str(p), n
+
+    def test_byte_range_shards_partition_exactly(self, tmp_path):
+        from alink_tpu.io.sharding import read_file_shard
+        path, n = self._write(tmp_path)
+        full = open(path, "rb").read()
+        got = b"".join(read_file_shard(path, i, 7) for i in range(7))
+        assert got == full  # disjoint + complete + order-preserving
+
+    def test_csv_source_sharded(self, tmp_path):
+        from alink_tpu.operator.batch.source import CsvSourceBatchOp
+        path, n = self._write(tmp_path, header=True)
+        seen = []
+        for i in range(3):
+            op = CsvSourceBatchOp(file_path=path, schema_str="a INT, b DOUBLE",
+                                  ignore_first_line=True, sharded=True,
+                                  shard_index=i, num_shards=3)
+            seen += [r[0] for r in op.collect()]
+        assert sorted(seen) == list(range(n))
+
+    def test_glob_shards_by_file(self, tmp_path):
+        from alink_tpu.operator.batch.source import CsvSourceBatchOp
+        for k in range(5):
+            (tmp_path / f"part-{k}.csv").write_text(
+                "".join(f"{k * 100 + j},0.0\n" for j in range(10)))
+        seen = []
+        for i in range(2):
+            op = CsvSourceBatchOp(file_path=str(tmp_path / "part-*.csv"),
+                                  schema_str="a INT, b DOUBLE", sharded=True,
+                                  shard_index=i, num_shards=2)
+            seen += [r[0] for r in op.collect()]
+        want = sorted(k * 100 + j for k in range(5) for j in range(10))
+        assert sorted(seen) == want
+
+    def test_libsvm_sharded(self, tmp_path):
+        from alink_tpu.operator.batch.source import LibSvmSourceBatchOp
+        p = tmp_path / "d.svm"
+        p.write_text("".join(f"{i % 2} 1:{i} 3:{i * 2}\n" for i in range(50)))
+        labels = []
+        for i in range(4):
+            op = LibSvmSourceBatchOp(file_path=str(p), sharded=True,
+                                     shard_index=i, num_shards=4)
+            labels += [r[0] for r in op.collect()]
+        assert len(labels) == 50
+
+    def test_default_topology_single_process(self, tmp_path):
+        from alink_tpu.operator.batch.source import CsvSourceBatchOp
+        path, n = self._write(tmp_path, n=20)
+        op = CsvSourceBatchOp(file_path=path, schema_str="a INT, b DOUBLE",
+                              sharded=True)  # process 0 of 1 -> everything
+        assert len(op.collect()) == n
+
+    def test_empty_shard_when_more_shards_than_bytes(self, tmp_path):
+        from alink_tpu.io.sharding import read_file_shard
+        p = tmp_path / "tiny.csv"
+        p.write_text("1,2\n")
+        parts = [read_file_shard(str(p), i, 8) for i in range(8)]
+        assert b"".join(parts) == b"1,2\n"
+        assert sum(1 for x in parts if x) == 1
+
+    def test_libsvm_sharded_fixed_width(self, tmp_path):
+        """vector_size pins a shard-consistent feature dim."""
+        p = tmp_path / "w.svm"
+        p.write_text("1 1000:1.0\n0 2:1.0\n1 3:2.0\n0 1:0.5\n")
+        from alink_tpu.common.vector import VectorUtil
+        from alink_tpu.operator.batch.source import LibSvmSourceBatchOp
+        sizes = set()
+        for i in range(2):
+            op = LibSvmSourceBatchOp(file_path=str(p), sharded=True,
+                                     shard_index=i, num_shards=2,
+                                     vector_size=1024)
+            for r in op.collect():
+                sizes.add(VectorUtil.parse(r[1]).n)
+        assert sizes == {1024}
+
+    def test_literal_path_with_glob_chars(self, tmp_path):
+        from alink_tpu.io.sharding import expand_paths
+        p = tmp_path / "data [v1].csv"
+        p.write_text("1,2\n")
+        assert expand_paths(str(p)) is None  # literal file wins
+
+    def test_shard_index_without_num_shards_raises(self):
+        import pytest as _pytest
+
+        from alink_tpu.io.sharding import resolve_shard
+        with _pytest.raises(ValueError):
+            resolve_shard(shard_index=2)
