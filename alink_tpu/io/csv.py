@@ -195,8 +195,10 @@ def read_libsvm(path: str, start_index: int = 1, shard=None,
     if get_lib() is not None:
         labels_a, indptr, indices, values = parse_libsvm_bytes(data,
                                                                start_index)
-        max_idx = (int(vector_size) if vector_size else
+        max_idx = (int(vector_size) if vector_size is not None else
                    (int(indices.max()) + 1 if indices.size else 0))
+        if vector_size is not None and max_idx <= 0:
+            raise ValueError(f"vector_size must be positive, got {vector_size}")
         col = [SparseVector(max_idx, indices[indptr[i]:indptr[i + 1]],
                             values[indptr[i]:indptr[i + 1]])
                for i in range(len(labels_a))]
@@ -221,8 +223,10 @@ def read_libsvm(path: str, start_index: int = 1, shard=None,
         if idx:
             max_idx = max(max_idx, max(idx) + 1)
         vecs.append((idx, val))
-    if vector_size:
+    if vector_size is not None:
         max_idx = int(vector_size)
+        if max_idx <= 0:
+            raise ValueError(f"vector_size must be positive, got {vector_size}")
     col = [SparseVector(max_idx, i, v) for i, v in vecs]
     return MTable({"label": np.asarray(labels), "features": col},
                   TableSchema(["label", "features"],

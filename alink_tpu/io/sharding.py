@@ -34,9 +34,19 @@ def resolve_shard(shard_index: Optional[int] = None,
             raise ValueError("shard_index given without num_shards")
         import jax
         return jax.process_index(), jax.process_count()
-    if not 0 <= (shard_index or 0) < num_shards:
+    if shard_index is None:
+        # num_shards alone means "shard by host": defaulting to 0 would make
+        # every host read the same 1/n slice and silently drop the rest.
+        import jax
+        if num_shards != jax.process_count():
+            raise ValueError(
+                f"num_shards={num_shards} without shard_index only makes "
+                f"sense when it equals the process count "
+                f"({jax.process_count()}); pass shard_index explicitly")
+        shard_index = jax.process_index()
+    if not 0 <= shard_index < num_shards:
         raise ValueError(f"shard_index {shard_index} not in [0, {num_shards})")
-    return shard_index or 0, num_shards
+    return shard_index, num_shards
 
 
 def expand_paths(pattern: str) -> Optional[List[str]]:

@@ -95,28 +95,62 @@ def _c(v):
     return v.item() if isinstance(v, np.generic) else v
 
 
+def _id_index(ids) -> dict:
+    """id -> row index under both the raw and the string form of the id."""
+    lookup: dict = {}
+    for i, v in enumerate(ids):
+        lookup.setdefault(v, i)
+        lookup.setdefault(str(v), i)
+    return lookup
+
+
+def _encode_ids(col, lookup: dict) -> np.ndarray:
+    """id -> factor-row encode; -1 for unknown ids.
+
+    The column collapses to its distinct values first (np.unique), so only
+    O(distinct) Python-level dict probes run regardless of row count — the
+    factor math afterwards is a single gather + einsum. Columns whose
+    values don't sort (mixed types) fall back to a memoized row loop."""
+    arr = np.asarray(col)
+    try:
+        uniq, inv = np.unique(arr, return_inverse=True)
+    except TypeError:
+        out = np.empty(len(col), np.int64)
+        memo: dict = {}
+        for r, v in enumerate(col):
+            v = _c(v)
+            j = memo.get(v)
+            if j is None:
+                j = lookup.get(str(v), lookup.get(v, -1))
+                memo[v] = j
+            out[r] = j
+        return out
+    codes = np.asarray([lookup.get(str(_c(v)), lookup.get(_c(v), -1))
+                        for v in uniq], np.int64)
+    return codes[inv.reshape(-1)]
+
+
 class AlsRater:
     """Loaded ALS factors + id lookups, reusable across calls — the stream
     predict op loads this once and rates every micro-batch with it."""
 
     def __init__(self, model_table: MTable):
         self.m = AlsModelDataConverter().load_model(model_table)
-        self.u_lookup = {v: i for i, v in enumerate(self.m.user_ids)}
-        self.i_lookup = {v: i for i, v in enumerate(self.m.item_ids)}
+        # ids round-trip to strings through the model table, so index both
+        # the raw and the str form of every id
+        self.u_lookup = _id_index(self.m.user_ids)
+        self.i_lookup = _id_index(self.m.item_ids)
 
     def rate_table(self, t: MTable, user_col: str, item_col: str,
                    prediction_col: str, reserved_cols=None) -> MTable:
         m = self.m
-        preds = np.zeros(t.num_rows)
-        for r, (u, i) in enumerate(zip(t.col(user_col), t.col(item_col))):
-            ui = self.u_lookup.get(
-                str(_c(u)) if str(_c(u)) in self.u_lookup else _c(u))
-            ii = self.i_lookup.get(
-                str(_c(i)) if str(_c(i)) in self.i_lookup else _c(i))
-            if ui is None or ii is None:
-                preds[r] = np.nan
-            else:
-                preds[r] = float(m.user_factors[ui] @ m.item_factors[ii])
+        ui = _encode_ids(t.col(user_col), self.u_lookup)
+        ii = _encode_ids(t.col(item_col), self.i_lookup)
+        valid = (ui >= 0) & (ii >= 0)
+        # one gather per side + a row-wise dot; unknown ids -> NaN
+        preds = np.einsum("ij,ij->i", m.user_factors[np.maximum(ui, 0)],
+                          m.item_factors[np.maximum(ii, 0)])
+        preds = np.where(valid, preds, np.nan)
         from ....mapper.base import OutputColsHelper
         helper = OutputColsHelper(t.schema, [prediction_col],
                                   [AlinkTypes.DOUBLE], reserved_cols)
@@ -145,15 +179,11 @@ class AlsTopKPredictBatchOp(BatchOperator, HasPredictionCol):
     def link_from(self, model_op: BatchOperator, data_op: BatchOperator):
         m = AlsModelDataConverter().load_model(model_op.get_output_table())
         t = data_op.get_output_table()
-        u_lookup = {v: i for i, v in enumerate(m.user_ids)}
+        u_lookup = _id_index(m.user_ids)
         k = min(self.get_top_k(), len(m.item_ids))
         recs = np.empty(t.num_rows, object)
         # one matmul for all requested users (MXU-sized batch)
-        uidx = []
-        for u in t.col(self.get_user_col()):
-            key = str(_c(u)) if str(_c(u)) in u_lookup else _c(u)
-            uidx.append(u_lookup.get(key, -1))
-        uidx = np.asarray(uidx)
+        uidx = _encode_ids(t.col(self.get_user_col()), u_lookup)
         valid = uidx >= 0
         scores = m.user_factors[np.maximum(uidx, 0)] @ m.item_factors.T
         top = np.argsort(-scores, axis=1)[:, :k]

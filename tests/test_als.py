@@ -58,6 +58,53 @@ def test_als_topk_and_cold_user():
     assert out.col("recs")[2] is None  # unseen user
 
 
+def test_als_predict_vectorized_matches_loop():
+    """The gather+einsum predict path must be output-identical to a naive
+    per-row loop over the factor dicts, including NaN for unknown ids."""
+    from alink_tpu.operator.batch.recommendation.als_ops import AlsRater
+    rows, _ = _ratings()
+    src = MemSourceBatchOp(rows, "user LONG, item LONG, rating DOUBLE")
+    train = AlsTrainBatchOp(user_col="user", item_col="item", rate_col="rating",
+                            rank=4, num_iter=5).link_from(src)
+    rng = np.random.RandomState(7)
+    req = [(int(rng.randint(0, 35)), int(rng.randint(0, 24)))  # some unknown
+           for _ in range(5000)]
+    data = MemSourceBatchOp(req, "user LONG, item LONG")
+    rater = AlsRater(train.get_output_table())
+    out = rater.rate_table(data.get_output_table(), "user", "item", "pred")
+    got = np.asarray(out.col("pred"), np.float64)
+    m = rater.m
+    uD = {int(u): f for u, f in zip(m.user_ids, m.user_factors)}
+    iD = {int(i): f for i, f in zip(m.item_ids, m.item_factors)}
+    want = np.asarray([float(uD[u] @ iD[i]) if u in uD and i in iD else np.nan
+                       for u, i in req])
+    assert np.isnan(want).any() and not np.isnan(want).all()
+    np.testing.assert_array_equal(np.isnan(got), np.isnan(want))
+    np.testing.assert_allclose(got[~np.isnan(want)], want[~np.isnan(want)],
+                               rtol=1e-12)
+
+
+def test_als_predict_scales():
+    """1M-row predict should take seconds, not minutes (VERDICT weak #3)."""
+    import time
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.batch.recommendation.als_ops import AlsRater
+    rows, _ = _ratings()
+    src = MemSourceBatchOp(rows, "user LONG, item LONG, rating DOUBLE")
+    train = AlsTrainBatchOp(user_col="user", item_col="item", rate_col="rating",
+                            rank=4, num_iter=2).link_from(src)
+    rater = AlsRater(train.get_output_table())
+    n = 1_000_000
+    rng = np.random.RandomState(1)
+    t = MTable({"user": rng.randint(0, 30, n), "item": rng.randint(0, 20, n)})
+    t0 = time.perf_counter()
+    out = rater.rate_table(t, "user", "item", "pred")
+    dt = time.perf_counter() - t0
+    assert out.num_rows == n
+    assert not np.isnan(np.asarray(out.col("pred"), np.float64)).any()
+    assert dt < 10.0, f"1M-row predict took {dt:.1f}s"
+
+
 def test_als_implicit():
     rows, R = _ratings(frac=0.5)
     # binarize to implicit clicks

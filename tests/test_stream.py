@@ -192,6 +192,82 @@ def test_ftrl_train_and_hot_reload_predict():
     assert acc2 > 0.85
 
 
+def _sparse_lr_fixture(n, dim, nnz, seed):
+    """Sparse-literal LR rows: labels from a planted weight over nnz-hot
+    features, as "$dim$i:v ..." strings."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim) * (rng.rand(dim) < 0.1)
+    w[:nnz * 2] = rng.randn(nnz * 2)  # guarantee signal on frequent slots
+    vecs, ys = [], []
+    for _ in range(n):
+        idx = np.sort(rng.choice(dim, nnz, replace=False))
+        val = rng.randn(nnz)
+        margin = float(val @ w[idx])
+        y = int(margin + 0.1 * rng.randn() > 0)
+        vecs.append("$%d$" % dim + " ".join(
+            f"{i}:{v:.6f}" for i, v in zip(idx, val)))
+        ys.append(y)
+    return MTable({"vec": np.asarray(vecs, object),
+                   "label": np.asarray(ys, np.int64)})
+
+
+def test_ftrl_sparse_matches_dense():
+    """The O(nnz) sparse FTRL program must produce the same model as the
+    dense program fed the densified rows (VERDICT round-2 item 1)."""
+    from alink_tpu.operator.common.linear.base import LinearModelDataConverter
+    from alink_tpu.common.vector import VectorUtil
+
+    dim = 24
+    table = _sparse_lr_fixture(n=256, dim=dim, nnz=5, seed=3)
+    # densify the same rows into dense-vector literals
+    dense_rows = []
+    for s in table.col("vec"):
+        v = VectorUtil.parse(s)
+        x = np.zeros(dim)
+        x[np.asarray(v.indices, int)] = v.values
+        dense_rows.append(" ".join(f"{t:.6f}" for t in x))
+    dense_table = MTable({"vec": np.asarray(dense_rows, object),
+                          "label": table.col("label")})
+
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=3).link_from(
+        MemSourceBatchOp(dense_table.first_n(64)))
+
+    def run(tbl):
+        ftrl = FtrlTrainStreamOp(
+            warm, label_col="label", vector_col="vec", alpha=0.5,
+            l1=0.001, l2=0.001, time_interval=1e9).link_from(
+            MemSourceStreamOp(tbl, batch_size=64))
+        final = list(ftrl.micro_batches())[-1]
+        lt = final.schema.types[2]
+        return LinearModelDataConverter(lt).load_model(final).coef
+
+    coef_sparse = run(table)
+    coef_dense = run(dense_table)
+    np.testing.assert_allclose(coef_sparse, coef_dense, rtol=1e-7, atol=1e-9)
+    assert np.abs(coef_sparse).max() > 0
+
+
+def test_ftrl_sparse_criteo_shape_stays_sparse():
+    """dim=65536 micro-batches must train without densifying: the padded
+    COO block for 256 rows x nnz 8 is ~20 KB; the old dense encode was
+    256*65536*8 bytes = 134 MB per batch."""
+    import time
+    dim = 65536
+    table = _sparse_lr_fixture(n=512, dim=dim, nnz=8, seed=5)
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=1).link_from(
+        MemSourceBatchOp(table.first_n(32)))
+    ftrl = FtrlTrainStreamOp(
+        warm, label_col="label", vector_col="vec", alpha=0.5,
+        time_interval=1e9).link_from(MemSourceStreamOp(table, batch_size=256))
+    t0 = time.perf_counter()
+    final = list(ftrl.micro_batches())[-1]
+    dt = time.perf_counter() - t0
+    assert final.num_rows > 0
+    assert dt < 120.0, f"sparse FTRL at dim=65536 took {dt:.0f}s"
+
+
 def test_ftrl_improves_on_weak_warm_start():
     """FTRL online updates should beat a deliberately under-trained model."""
     table = _make_lr_fixture(n=800, seed=23)
