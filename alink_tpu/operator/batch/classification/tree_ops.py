@@ -34,7 +34,11 @@ class TreeModelData:
                  features: np.ndarray, thresholds: np.ndarray,
                  leaf_values: np.ndarray, base_score: float, learning_rate: float,
                  labels: List, feature_cols: Optional[List[str]],
-                 vector_col: Optional[str], label_type: str = AlinkTypes.STRING):
+                 vector_col: Optional[str], label_type: str = AlinkTypes.STRING,
+                 split_masks: Optional[np.ndarray] = None,
+                 cat_cols: Optional[List[str]] = None,
+                 cat_vocabs: Optional[dict] = None,
+                 importances: Optional[np.ndarray] = None):
         self.algo = algo
         self.is_regression = is_regression
         self.max_depth = max_depth
@@ -47,6 +51,11 @@ class TreeModelData:
         self.feature_cols = feature_cols
         self.vector_col = vector_col
         self.label_type = label_type
+        # categorical support (reference seriestree/CategoricalSplitter):
+        self.split_masks = split_masks    # (T, 2^d - 1, n_bins) bool or None
+        self.cat_cols = cat_cols or []    # feature col names that are categorical
+        self.cat_vocabs = cat_vocabs or {}  # col -> [category strings] (code = index)
+        self.importances = importances    # (F,) summed split gain or None
 
 
 class TreeModelDataConverter(SimpleModelDataConverter):
@@ -58,9 +67,18 @@ class TreeModelDataConverter(SimpleModelDataConverter):
             "max_depth": m.max_depth, "base_score": m.base_score,
             "learning_rate": m.learning_rate,
             "labels": [str(l) for l in m.labels], "label_type": m.label_type,
-            "feature_cols": m.feature_cols, "vector_col": m.vector_col})
-        return meta, [encode_array(m.features), encode_array(m.thresholds),
-                      encode_array(m.leaf_values)]
+            "feature_cols": m.feature_cols, "vector_col": m.vector_col,
+            "cat_cols": m.cat_cols, "cat_vocabs": m.cat_vocabs})
+        blobs = [encode_array(m.features), encode_array(m.thresholds),
+                 encode_array(m.leaf_values)]
+        if m.split_masks is not None:
+            blobs.append(encode_array(m.split_masks.astype(np.int8)))
+        if m.importances is not None:
+            if m.split_masks is None:
+                blobs.append(encode_array(
+                    np.zeros((0,), np.int8)))  # keep blob positions fixed
+            blobs.append(encode_array(np.asarray(m.importances, np.float64)))
+        return meta, blobs
 
     def deserialize_model(self, meta, data):
         labels = meta._m.get("labels", [])
@@ -69,13 +87,19 @@ class TreeModelDataConverter(SimpleModelDataConverter):
             labels = [int(float(v)) for v in labels]
         elif lt in (AlinkTypes.DOUBLE, AlinkTypes.FLOAT):
             labels = [float(v) for v in labels]
+        split_masks = (decode_array(data[3], np.int8).astype(bool)
+                       if len(data) > 3 and decode_array(data[3]).size
+                       else None)
+        importances = decode_array(data[4]) if len(data) > 4 else None
         return TreeModelData(
             meta._m["algo"], bool(meta._m["is_regression"]),
             int(meta._m["max_depth"]),
             decode_array(data[0], np.int64), decode_array(data[1]),
             decode_array(data[2]), float(meta._m.get("base_score", 0.0)),
             float(meta._m.get("learning_rate", 1.0)), labels,
-            meta._m.get("feature_cols"), meta._m.get("vector_col"), lt)
+            meta._m.get("feature_cols"), meta._m.get("vector_col"), lt,
+            split_masks=split_masks, cat_cols=meta._m.get("cat_cols"),
+            cat_vocabs=meta._m.get("cat_vocabs"), importances=importances)
 
 
 class _TreeTrainParamsMixin(HasLabelCol, HasFeatureCols, HasVectorCol,
@@ -92,21 +116,56 @@ class _TreeTrainParamsMixin(HasLabelCol, HasFeatureCols, HasVectorCol,
     FEATURE_SUBSAMPLING_RATIO = ParamInfo("feature_subsampling_ratio", float,
                                           default=1.0)
     REG_LAMBDA = ParamInfo("reg_lambda", float, default=1.0)
+    CATEGORICAL_COLS = ParamInfo("categorical_cols", list, default=None)
+
+
+def _encode_feature_matrix(t: MTable, feature_cols, cat_cols):
+    """(X, cat_mask, cat_vocabs): categorical columns ordinal-encode via a
+    sorted per-column vocabulary (code = vocab index, stored in the model
+    for serving); numeric columns pass through."""
+    n = t.num_rows
+    cat_set = set(cat_cols)
+    X = np.empty((n, len(feature_cols)), np.float64)
+    vocabs = {}
+    for j, c in enumerate(feature_cols):
+        col = t.col(c)
+        if c in cat_set:
+            vocab = sorted({str(v) for v in col})
+            vocabs[c] = vocab
+            lut = {v: i for i, v in enumerate(vocab)}
+            X[:, j] = [lut[str(v)] for v in col]
+        else:
+            X[:, j] = np.asarray(col, np.float64)
+    cat_mask = np.asarray([c in cat_set for c in feature_cols], bool)
+    return X, cat_mask, vocabs
 
 
 def _extract_xy(op, t: MTable, regression: bool):
     vector_col = op.params._m.get("vector_col")
     feature_cols = op.params._m.get("feature_cols")
+    cat_cols = list(op.params._m.get("categorical_cols") or [])
     label_col = op.get_label_col()
     weight_col = op.params._m.get("weight_col")
+    cat_mask, vocabs = None, {}
     if not vector_col:
         feature_cols = resolve_feature_cols(
             t, feature_cols, label_col, exclude=[weight_col] if weight_col else [])
-    design = extract_design(t, feature_cols, vector_col, np.float64)
-    X = design["X"] if design["kind"] == "dense" else None
-    if X is None:
-        from ....common.vector import SparseBatch
-        X = SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)
+        for c in cat_cols:                 # string cols aren't numeric-resolvable
+            if c not in feature_cols:
+                feature_cols = feature_cols + [c]
+        X, cat_mask, vocabs = _encode_feature_matrix(t, feature_cols, cat_cols)
+        if not cat_mask.any():
+            cat_mask = None
+    else:
+        if cat_cols:
+            raise ValueError("categorical_cols requires feature_cols input "
+                             "(vector input has no column identity)")
+        design = extract_design(t, feature_cols, vector_col, np.float64)
+        X = design["X"] if design["kind"] == "dense" else None
+        if X is None:
+            from ....common.vector import SparseBatch
+            X = SparseBatch(design["idx"], design["val"],
+                            design["dim"]).to_dense(np.float64)
     raw = t.col(label_col)
     label_type = t.schema.type_of(label_col)
     if regression:
@@ -120,7 +179,36 @@ def _extract_xy(op, t: MTable, regression: bool):
             labels = [float(v) for v in labels]
     w = (np.asarray(t.col(weight_col), np.float64) if weight_col
          else np.ones(len(y)))
-    return X, y, w, labels, feature_cols, vector_col, label_type
+    return (X, y, w, labels, feature_cols, vector_col, label_type,
+            cat_mask if not vector_col else None, cat_cols, vocabs)
+
+
+def _model_info_table(m: "TreeModelData") -> MTable:
+    """Model summary incl. gain-based feature importances (reference
+    GbdtModelInfo / RandomForestModelInfo feature importance output)."""
+    if m.importances is not None:
+        t = _importance_table(m.feature_cols, m.importances)
+        rows = {"item": np.asarray(
+                    ["algo", "num_trees", "max_depth"]
+                    + [f"importance[{f}]" for f in t.col("feature")], object),
+                "value": np.asarray(
+                    [m.algo, str(m.features.shape[0]), str(m.max_depth)]
+                    + [f"{v:.6f}" for v in t.col("importance")], object)}
+        return MTable(rows)
+    return MTable({"item": np.asarray(["algo", "num_trees", "max_depth"], object),
+                   "value": np.asarray([m.algo, str(m.features.shape[0]),
+                                        str(m.max_depth)], object)})
+
+
+def _importance_table(feature_cols, imp) -> MTable:
+    """Gain-based feature importances, normalized to sum 1 (reference
+    TreeModelInfo feature importance)."""
+    imp = np.asarray(imp, np.float64)
+    tot = imp.sum()
+    names = (list(feature_cols) if feature_cols
+             else [f"f{i}" for i in range(len(imp))])
+    return MTable({"feature": np.asarray(names, object),
+                   "importance": imp / (tot if tot > 0 else 1.0)})
 
 
 def _tree_params(op) -> TreeTrainParams:
@@ -140,22 +228,30 @@ class GbdtTrainBatchOp(BatchOperator, _TreeTrainParamsMixin):
 
     def link_from(self, in_op: BatchOperator):
         t = in_op.get_output_table()
-        X, y, w, labels, fc, vc, lt = _extract_xy(t=t, op=self,
-                                                  regression=self.IS_REGRESSION)
+        (X, y, w, labels, fc, vc, lt, cat_mask, cat_cols,
+         vocabs) = _extract_xy(t=t, op=self, regression=self.IS_REGRESSION)
         if not self.IS_REGRESSION and len(labels) != 2:
             raise ValueError(f"GBDT classifier is binary; got labels {labels}")
         p = _tree_params(self)
-        tf, tb, tv, edges, base, curve = gbdt_train(
-            X, y, p, self.IS_REGRESSION, sample_weight=w)
+        tf, tb, tm, tv, edges, base, curve, imp = gbdt_train(
+            X, y, p, self.IS_REGRESSION, sample_weight=w, cat_mask=cat_mask)
         thr = np.stack([bins_to_thresholds(np.asarray(tf[i]), np.asarray(tb[i]),
                                            edges) for i in range(p.num_trees)])
         model = TreeModelData(
             "gbdt", self.IS_REGRESSION, p.max_depth, np.asarray(tf), thr,
-            np.asarray(tv), base, p.learning_rate, labels, fc, vc, lt)
+            np.asarray(tv), base, p.learning_rate, labels, fc, vc, lt,
+            split_masks=np.asarray(tm), cat_cols=cat_cols, cat_vocabs=vocabs,
+            importances=np.asarray(imp))
         self._output = TreeModelDataConverter().save_model(model)
         self._side_outputs = [MTable({"tree": np.arange(1, len(curve) + 1),
-                                      "loss": curve.astype(np.float64)})]
+                                      "loss": curve.astype(np.float64)}),
+                              _importance_table(fc, imp)]
         return self
+
+
+    def get_model_info(self) -> MTable:
+        m = TreeModelDataConverter().load_model(self.get_output_table())
+        return _model_info_table(m)
 
 
 class GbdtRegTrainBatchOp(GbdtTrainBatchOp):
@@ -174,8 +270,8 @@ class RandomForestTrainBatchOp(BatchOperator, _TreeTrainParamsMixin):
 
     def link_from(self, in_op: BatchOperator):
         t = in_op.get_output_table()
-        X, y, w, labels, fc, vc, lt = _extract_xy(t=t, op=self,
-                                                  regression=self.IS_REGRESSION)
+        (X, y, w, labels, fc, vc, lt, cat_mask, cat_cols,
+         vocabs) = _extract_xy(t=t, op=self, regression=self.IS_REGRESSION)
         p = _tree_params(self)
         if self.IS_REGRESSION:
             stats = np.stack([y * w, y * y * w, w], axis=1)
@@ -185,14 +281,23 @@ class RandomForestTrainBatchOp(BatchOperator, _TreeTrainParamsMixin):
             onehot = np.eye(k)[y.astype(int)] * w[:, None]
             stats = np.concatenate([onehot, w[:, None]], axis=1)
             kind = "gini"
-        tf, tb, tv, edges = forest_train(X, stats, p, kind)
+        tf, tb, tm, tv, edges, imp = forest_train(X, stats, p, kind,
+                                                  cat_mask=cat_mask)
         thr = np.stack([bins_to_thresholds(np.asarray(tf[i]), np.asarray(tb[i]),
                                            edges) for i in range(p.num_trees)])
         model = TreeModelData(
             "rf", self.IS_REGRESSION, p.max_depth, np.asarray(tf), thr,
-            np.asarray(tv), 0.0, 1.0, labels, fc, vc, lt)
+            np.asarray(tv), 0.0, 1.0, labels, fc, vc, lt,
+            split_masks=np.asarray(tm), cat_cols=cat_cols, cat_vocabs=vocabs,
+            importances=np.asarray(imp))
         self._output = TreeModelDataConverter().save_model(model)
+        self._side_outputs = [_importance_table(fc, imp)]
         return self
+
+
+    def get_model_info(self) -> MTable:
+        m = TreeModelDataConverter().load_model(self.get_output_table())
+        return _model_info_table(m)
 
 
 class RandomForestRegTrainBatchOp(RandomForestTrainBatchOp):
@@ -224,19 +329,41 @@ class TreeModelMapper(ModelMapper):
 
     def map_table(self, data: MTable) -> MTable:
         m = self.model
-        design = extract_design(data, m.feature_cols, m.vector_col, np.float64)
-        X = design["X"] if design["kind"] == "dense" else None
-        if X is None:
-            from ....common.vector import SparseBatch
-            X = SparseBatch(design["idx"], design["val"], design["dim"]).to_dense(np.float64)
+        if m.cat_cols:
+            n = data.num_rows
+            X = np.empty((n, len(m.feature_cols)), np.float64)
+            for j, c in enumerate(m.feature_cols):
+                col = data.col(c)
+                if c in m.cat_vocabs:
+                    lut = {v: i for i, v in enumerate(m.cat_vocabs[c])}
+                    X[:, j] = [lut.get(str(v), -1) for v in col]  # OOV -> right
+                else:
+                    X[:, j] = np.asarray(col, np.float64)
+        else:
+            design = extract_design(data, m.feature_cols, m.vector_col,
+                                    np.float64)
+            X = design["X"] if design["kind"] == "dense" else None
+            if X is None:
+                from ....common.vector import SparseBatch
+                X = SparseBatch(design["idx"], design["val"],
+                                design["dim"]).to_dense(np.float64)
         T = m.features.shape[0]
         n = X.shape[0]
+        cat_mask = (np.asarray([c in set(m.cat_cols) for c in
+                                (m.feature_cols or [])], bool)
+                    if m.cat_cols else None)
+
+        def apply(t):
+            return tree_apply_values(
+                X, m.features[t], m.thresholds[t], m.max_depth,
+                cat_mask=cat_mask,
+                split_masks=(m.split_masks[t]
+                             if m.split_masks is not None else None))
+
         if m.algo == "gbdt":
             score = np.full(n, m.base_score)
             for t in range(T):
-                leaf = tree_apply_values(X, m.features[t], m.thresholds[t],
-                                         m.max_depth)
-                score += m.learning_rate * m.leaf_values[t][leaf]
+                score += m.learning_rate * m.leaf_values[t][apply(t)]
             if m.is_regression:
                 return self._emit(data, score, None, None)
             p_pos = 1.0 / (1.0 + np.exp(-np.clip(score, -500, 500)))
@@ -246,16 +373,12 @@ class TreeModelMapper(ModelMapper):
         if m.is_regression:
             acc = np.zeros(n)
             for t in range(T):
-                leaf = tree_apply_values(X, m.features[t], m.thresholds[t],
-                                         m.max_depth)
-                acc += m.leaf_values[t][leaf]
+                acc += m.leaf_values[t][apply(t)]
             return self._emit(data, acc / T, None, None)
         k = m.leaf_values.shape[2]
         probs = np.zeros((n, k))
         for t in range(T):
-            leaf = tree_apply_values(X, m.features[t], m.thresholds[t],
-                                     m.max_depth)
-            probs += m.leaf_values[t][leaf]
+            probs += m.leaf_values[t][apply(t)]
         probs /= np.maximum(probs.sum(1, keepdims=True), 1e-12)
         return self._emit(data, None, probs, m.labels)
 

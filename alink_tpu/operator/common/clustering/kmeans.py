@@ -22,7 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ....common.mlenv import MLEnvironment
+from ....common.mlenv import MLEnvironment, MLEnvironmentFactory
 from ....engine import AllReduce, IterativeComQueue
 
 
@@ -52,6 +52,131 @@ def random_init(X: np.ndarray, k: int, seed: int) -> np.ndarray:
     return X[rng.choice(X.shape[0], k, replace=X.shape[0] < k)]
 
 
+def _weighted_kmeans_pp(C: np.ndarray, w: np.ndarray, k: int,
+                        rng: np.random.RandomState,
+                        lloyd_iters: int = 8) -> np.ndarray:
+    """Weighted k-means++ seeding + a few weighted Lloyd sweeps on the
+    (small) candidate set — the K-MEANS|| recluster step (Bahmani et al.
+    algorithm 2 line 7-8; reference KMeansInitCentroids final recluster).
+    Runs on the host: the candidate set is O(rounds * oversample), never
+    the data."""
+    m = C.shape[0]
+    w = np.maximum(np.asarray(w, np.float64), 0.0)
+    if w.sum() <= 0:
+        w = np.ones(m)
+    p = w / w.sum()
+    cents = [C[rng.choice(m, p=p)]]
+    d2 = ((C - cents[0]) ** 2).sum(1)
+    for _ in range(1, k):
+        q = w * d2
+        tot = q.sum()
+        if tot <= 0:
+            cents.append(C[rng.choice(m, p=p)])
+            continue
+        cents.append(C[rng.choice(m, p=q / tot)])
+        d2 = np.minimum(d2, ((C - cents[-1]) ** 2).sum(1))
+    cc = np.stack(cents)
+    for _ in range(lloyd_iters):
+        dist = ((C[:, None, :] - cc[None, :, :]) ** 2).sum(-1)
+        ids = dist.argmin(1)
+        for j in range(k):
+            sel = ids == j
+            if w[sel].sum() > 0:
+                cc[j] = (C[sel] * w[sel, None]).sum(0) / w[sel].sum()
+    return cc
+
+
+def kmeans_parallel_init(X: np.ndarray, k: int, seed: int = 0,
+                         rounds: int = 5, oversample: Optional[int] = None,
+                         env: Optional[MLEnvironment] = None) -> np.ndarray:
+    """K-MEANS|| distributed seeding (reference
+    clustering/kmeans/KMeansInitCentroids.java; Bahmani et al. 2012) as a
+    BSP program — no full-data host pass.
+
+    Each superstep samples ``l = oversample`` new candidates with
+    probability proportional to the current squared distance to the
+    candidate set (the exactly-l Gumbel-top-l variant of the per-point
+    Bernoulli draw), via per-shard ``top_k`` + ``all_gather`` + global
+    ``top_k``; the per-point d2/nearest state updates incrementally
+    against only the l new candidates, so the total work is
+    O(rounds * n * l * d / workers). Candidate weights (cluster sizes)
+    come out of the same program; the final weighted recluster to k runs
+    on the O(rounds*l) candidate set on the host.
+    """
+    X = np.asarray(X)
+    n, d = X.shape
+    dt = X.dtype
+    l = int(oversample) if oversample else max(2 * k, 1)
+    cap = 1 + rounds * l
+    rng = np.random.RandomState(seed)
+    first = X[rng.randint(n)].astype(dt)
+    env_ = env or MLEnvironmentFactory.get_default()
+    nw = env_.num_workers
+    n_loc = -(-n // nw)              # padded shard length (static)
+    l_loc = min(l, n_loc)            # per-shard candidate proposals
+    l_glob = min(l, nw * l_loc)
+
+    mask_col = np.ones(n, dt)
+
+    def sample(ctx):
+        Xb = ctx.get_obj("X")
+        msk = ctx.get_obj("mask")
+        step = ctx.step_no
+        if ctx.is_init_step:
+            cands = jnp.zeros((cap, d), dt).at[0].set(ctx.get_obj("first"))
+            d2 = ((Xb - ctx.get_obj("first")) ** 2).sum(1) * msk
+            nearest = jnp.zeros(Xb.shape[0], jnp.int32)
+            ctx.put_obj("weights", jnp.zeros((cap,), dt))
+        else:
+            cands = ctx.get_obj("cands")
+            d2 = ctx.get_obj("d2")
+            nearest = ctx.get_obj("nearest")
+            # fold in the l candidates written by the previous superstep
+            off = 1 + (step - 2) * l
+            new = jax.lax.dynamic_slice_in_dim(cands, off, l, 0)  # (l, d)
+            Dn = ((Xb[:, None, :] - new[None, :, :]) ** 2).sum(-1)
+            j = jnp.argmin(Dn, axis=1)
+            dn = jnp.take_along_axis(Dn, j[:, None], 1)[:, 0] * msk
+            closer = dn < d2
+            nearest = jnp.where(closer, off + j.astype(jnp.int32), nearest)
+            d2 = jnp.where(closer, dn, d2)
+        # draw this round's l candidates: Gumbel-top-l over p_i ∝ d2_i
+        g = jax.random.gumbel(ctx.rng_key(), d2.shape, dt)
+        keys = jnp.where(d2 > 0, jnp.log(jnp.maximum(d2, 1e-30)) + g, -jnp.inf)
+        kv, ki = jax.lax.top_k(keys, l_loc)
+        pts = Xb[ki]                                        # (l_loc, d)
+        gk = jax.lax.all_gather(kv, ctx.AXIS).reshape(-1)   # (nw*l_loc,)
+        gp = jax.lax.all_gather(pts, ctx.AXIS).reshape(-1, d)
+        gv, gi = jax.lax.top_k(gk, l_glob)
+        sel = gp[gi]
+        valid = jnp.isfinite(gv)
+        sel = jnp.where(valid[:, None], sel, cands[0])
+        if l_glob < l:                                      # static-shape pad
+            sel = jnp.concatenate(
+                [sel, jnp.broadcast_to(cands[0], (l - l_glob, d))], 0)
+        off_w = 1 + (step - 1) * l
+        cands = jax.lax.dynamic_update_slice_in_dim(cands, sel, off_w, 0)
+        # running candidate weights (cluster sizes under current nearest)
+        counts = jnp.zeros((cap,), dt).at[nearest].add(msk)
+        ctx.put_obj("weights", ctx.all_reduce_sum(counts))
+        ctx.put_obj("cands", cands)
+        ctx.put_obj("d2", d2)
+        ctx.put_obj("nearest", nearest)
+
+    res = (IterativeComQueue(env=env_, max_iter=rounds, seed=seed)
+           .init_with_partitioned_data("X", X)
+           .init_with_partitioned_data("mask", mask_col)
+           .init_with_broadcast_data("first", first)
+           .add(sample)
+           .exec())
+    cands = np.asarray(res.get("cands"))
+    weights = np.array(res.get("weights"))
+    # candidates sampled in the final round carry no counted weight yet;
+    # give them each weight 1 so the recluster can still use them
+    weights[weights == 0] = 1.0
+    return _weighted_kmeans_pp(cands, weights, k, rng).astype(dt)
+
+
 def _distances(X, C, distance_type: str):
     """(n, k) distance matrix as one MXU matmul."""
     if distance_type == "COSINE":
@@ -79,8 +204,14 @@ def kmeans_train(X: np.ndarray, k: int, max_iter: int = 50, tol: float = 1e-4,
     X = np.asarray(X)
     n, d = X.shape
     w = np.ones(n, X.dtype) if sample_weight is None else np.asarray(sample_weight, X.dtype)
-    init_c = (kmeans_plus_plus_init(X, k, seed) if init.upper() != "RANDOM"
-              else random_init(X, k, seed)).astype(X.dtype)
+    init_u = init.upper()
+    if init_u == "RANDOM":
+        init_c = random_init(X, k, seed)
+    elif init_u in ("K_MEANS_PARALLEL", "KMEANS_PARALLEL"):
+        init_c = kmeans_parallel_init(X, k, seed=seed, env=env)
+    else:  # K_MEANS_PLUS_PLUS / legacy host seeding
+        init_c = kmeans_plus_plus_init(X, k, seed)
+    init_c = init_c.astype(X.dtype)
     data = np.concatenate([X, w[:, None]], axis=1)
     dt = X.dtype
 

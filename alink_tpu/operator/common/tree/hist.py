@@ -34,11 +34,22 @@ import jax.numpy as jnp
 # host-side quantile binning
 # ---------------------------------------------------------------------------
 
-def make_bin_edges(X: np.ndarray, n_bins: int) -> np.ndarray:
-    """(F, n_bins-1) per-feature quantile cut points (padded with +inf)."""
+def make_bin_edges(X: np.ndarray, n_bins: int,
+                   cat_mask: Optional[np.ndarray] = None) -> np.ndarray:
+    """(F, n_bins-1) per-feature quantile cut points (padded with +inf).
+
+    Categorical features (``cat_mask[f]`` True; values must be integer
+    category codes) get identity edges 0.5, 1.5, ... so every category is
+    its own bin — no quantile artifacts (reference
+    seriestree/CategoricalSplitter.java treats categories as unordered).
+    """
     n, F = X.shape
     edges = np.full((F, n_bins - 1), np.inf)
     for f in range(F):
+        if cat_mask is not None and cat_mask[f]:
+            arity = min(int(X[:, f].max()) + 1, n_bins)
+            edges[f, :max(arity - 1, 0)] = np.arange(max(arity - 1, 0)) + 0.5
+            continue
         qs = np.quantile(X[:, f], np.linspace(0, 1, n_bins + 1)[1:-1])
         uq = np.unique(qs)
         edges[f, :len(uq)] = uq
@@ -141,22 +152,57 @@ def level_hist(binned, stats, node_id, n_nodes: int, n_bins: int,
     hist = hist.at[flat_idx.reshape(-1)].add(jnp.repeat(stats, F, axis=0))
     return hist.reshape(n_nodes, F, n_bins, m)
 
+def _default_cat_order(hist):
+    """Per-(node,feature,bin) ordering score for categorical subset splits:
+    first-stat / count ratio — g/h-style mean response. Exact (Fisher) for
+    regression and binary targets; a standard heuristic for multiclass.
+    Empty bins sort last so unseen categories route right."""
+    cnt = hist[..., -1]
+    r = hist[..., 0] / jnp.maximum(cnt, 1e-12)
+    return jnp.where(cnt > 0, r, jnp.inf)
+
+
 def build_tree(binned, stats, max_depth: int, n_bins: int,
                gain_fn, leaf_fn, min_samples_leaf: float = 1.0,
-               min_gain: float = 1e-9, feature_mask=None, axis_name=None):
-    """Grow one tree; returns (features, split_bins, leaf_values, node_id).
+               min_gain: float = 1e-9, feature_mask=None, axis_name=None,
+               cat_feats=None, cat_order_fn=None):
+    """Grow one tree; returns
+    (features, split_bins, split_masks, leaf_values, node_id, leaf_hist,
+     importance).
 
     binned: (n, F) int32; stats: (n, m) — zero rows are inert (padding /
     bagging handled by zeroing stats); feature_mask: (F,) 1/0 per-tree
-    column subsample; axis_name: psum histograms across this mesh axis.
-    features/split_bins: (2^max_depth - 1,) level-order; leaf_values:
-    (2^max_depth, ...) from leaf_fn; node_id: (n,) final leaf per sample.
+    column subsample; axis_name: psum histograms across this mesh axis;
+    cat_feats: (F,) bool — categorical features split on category
+    *subsets* (bins sorted by ``cat_order_fn`` score, then cut like a
+    threshold — the classical exact reduction, reference
+    seriestree/CategoricalSplitter.java) instead of bin order.
+
+    features/split_bins: (2^max_depth - 1,) level-order;
+    split_masks: (2^max_depth - 1, n_bins) bool — per-node LEFT membership
+    by bin (continuous nodes encode ``bin <= split_bin``), the single
+    descent rule for both feature kinds; leaf_values: (2^max_depth, ...)
+    from leaf_fn; node_id: (n,) final leaf; importance: (F,) summed split
+    gain per feature (psum'd histograms make it identical on every worker).
     """
     n, F = binned.shape
     m = stats.shape[1]
     dt = stats.dtype
     node_id = jnp.zeros(n, jnp.int32)
-    feats_out, bins_out = [], []
+    feats_out, bins_out, masks_out = [], [], []
+    importance = jnp.zeros((F,), dt)
+    cat_order_fn = cat_order_fn or _default_cat_order
+    bins_ar = jnp.arange(n_bins)
+    if cat_feats is not None:
+        cat_np = np.asarray(cat_feats, bool)       # static column selection
+        if not cat_np.any():
+            cat_feats = None
+        else:
+            cat_idx = np.flatnonzero(cat_np)
+            cat_pos = np.zeros(F, np.int32)        # F-index -> cat-slice index
+            cat_pos[cat_idx] = np.arange(len(cat_idx), dtype=np.int32)
+            cat_pos = jnp.asarray(cat_pos)
+            cat_arr = jnp.asarray(cat_np)
 
     use_onehot = jax.default_backend() == "tpu"
     for level in range(max_depth):
@@ -169,6 +215,21 @@ def build_tree(binned, stats, max_depth: int, n_bins: int,
         left = cum[:, :, :-1, :]                      # split "bin <= b"
         right = total - left
         gains = gain_fn(left, right, total, min_samples_leaf)  # (nodes,F,B-1)
+        if cat_feats is not None:
+            # sorted-by-score cumulation over ONLY the categorical columns
+            # (static gather — continuous features skip the second pass):
+            # cut position c sends the first c+1 bins (in score order) left
+            hist_c = hist[:, cat_idx]                          # (nodes,Fc,B,m)
+            total_c = total[:, cat_idx]
+            order = jnp.argsort(cat_order_fn(hist_c), axis=2)  # (nodes,Fc,B)
+            shist = jnp.take_along_axis(hist_c, order[..., None], 2)
+            scum = jnp.cumsum(shist, axis=2)
+            sleft = scum[:, :, :-1, :]
+            sright = total_c - sleft
+            sgains = gain_fn(sleft, sright, total_c, min_samples_leaf)
+            gains = gains.at[:, cat_idx].set(sgains)
+            # rank[bin] = position of bin in score order
+            rank_c = jnp.argsort(order, axis=2)                # (nodes,Fc,B)
         if feature_mask is not None:
             gains = jnp.where(feature_mask[None, :, None] > 0, gains, -jnp.inf)
         flat_g = gains.reshape(n_nodes, F * (n_bins - 1))
@@ -179,11 +240,23 @@ def build_tree(binned, stats, max_depth: int, n_bins: int,
         split = best_gain > min_gain
         feats_out.append(jnp.where(split, best_f, -1))
         bins_out.append(jnp.where(split, best_b, 0))
-        # descend: right iff split and bin > best_b
+        # LEFT-membership mask per node over bins
+        if cat_feats is not None:
+            brank = jnp.take_along_axis(
+                rank_c, cat_pos[best_f][:, None, None], 1)[:, 0, :]  # (nodes,B)
+            is_cat = cat_arr[best_f]
+            pos = jnp.where(is_cat[:, None], brank, bins_ar[None, :])
+        else:
+            pos = jnp.broadcast_to(bins_ar[None, :], (n_nodes, n_bins))
+        mask = pos <= best_b[:, None]                          # (nodes, B)
+        masks_out.append(mask & split[:, None])
+        importance = importance.at[best_f].add(
+            jnp.where(split, best_gain, jnp.zeros_like(best_gain)))
+        # descend: right iff split and sample's bin is not in the left set
         nf = feats_out[-1][node_id]
-        nb = bins_out[-1][node_id]
         sample_bin = jnp.take_along_axis(binned, jnp.maximum(nf, 0)[:, None], 1)[:, 0]
-        go_right = (nf >= 0) & (sample_bin > nb)
+        in_left = masks_out[-1][node_id, sample_bin]
+        go_right = (nf >= 0) & jnp.logical_not(in_left)
         node_id = node_id * 2 + go_right.astype(jnp.int32)
 
     n_leaves = 1 << max_depth
@@ -192,20 +265,30 @@ def build_tree(binned, stats, max_depth: int, n_bins: int,
         leaf_hist = jax.lax.psum(leaf_hist, axis_name)
     features = jnp.concatenate(feats_out)
     split_bins = jnp.concatenate(bins_out)
-    return features, split_bins, leaf_fn(leaf_hist), node_id, leaf_hist
+    split_masks = jnp.concatenate(masks_out, axis=0)
+    return (features, split_bins, split_masks, leaf_fn(leaf_hist), node_id,
+            leaf_hist, importance)
 
 
-def tree_apply_binned(binned, features, split_bins, max_depth: int):
-    """Final leaf index for each row, descending the dense tree (traceable)."""
+def tree_apply_binned(binned, features, split_bins, max_depth: int,
+                      split_masks=None):
+    """Final leaf index for each row, descending the dense tree (traceable).
+
+    With ``split_masks`` (n_internal, n_bins) the descent uses the uniform
+    LEFT-membership rule (required for categorical splits; identical to
+    ``bin <= split_bin`` for continuous nodes)."""
     n = binned.shape[0]
     node = jnp.zeros(n, jnp.int32)
     offset = 0
     for level in range(max_depth):
         gi = offset + node
         f = features[gi]
-        b = split_bins[gi]
         sample_bin = jnp.take_along_axis(binned, jnp.maximum(f, 0)[:, None], 1)[:, 0]
-        go_right = (f >= 0) & (sample_bin > b)
+        if split_masks is not None:
+            in_left = split_masks[gi, sample_bin]
+            go_right = (f >= 0) & jnp.logical_not(in_left)
+        else:
+            go_right = (f >= 0) & (sample_bin > split_bins[gi])
         node = node * 2 + go_right.astype(jnp.int32)
         offset += 1 << level
     return node
@@ -221,17 +304,30 @@ def bins_to_thresholds(features: np.ndarray, split_bins: np.ndarray,
 
 
 def tree_apply_values(X: np.ndarray, features: np.ndarray, thresholds: np.ndarray,
-                      max_depth: int) -> np.ndarray:
-    """Host/numpy descent on raw feature values."""
+                      max_depth: int, cat_mask: Optional[np.ndarray] = None,
+                      split_masks: Optional[np.ndarray] = None) -> np.ndarray:
+    """Host/numpy descent on raw feature values.
+
+    Categorical nodes (``cat_mask[f]``) route by LEFT-membership of the
+    category code in ``split_masks[node]``; out-of-vocabulary codes route
+    right (never in the left set)."""
     n = X.shape[0]
     node = np.zeros(n, np.int64)
     offset = 0
+    n_bins = split_masks.shape[1] if split_masks is not None else 0
     for level in range(max_depth):
         gi = offset + node
         f = features[gi].astype(np.int64)
         thr = thresholds[gi]
         x = X[np.arange(n), np.maximum(f, 0)]
         go_right = (f >= 0) & (x > thr)
+        if cat_mask is not None and split_masks is not None:
+            code = np.round(x).astype(np.int64)
+            in_left = np.where(
+                code >= 0,
+                split_masks[gi, np.clip(code, 0, n_bins - 1)], False)
+            is_cat = cat_mask[np.maximum(f, 0)] & (f >= 0)
+            go_right = np.where(is_cat, (f >= 0) & ~in_left, go_right)
         node = node * 2 + go_right
         offset += 1 << level
     return node

@@ -41,12 +41,17 @@ class TreeTrainParams:
 
 def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
                is_regression: bool, env: Optional[MLEnvironment] = None,
-               sample_weight: Optional[np.ndarray] = None):
-    """Returns (features (T, 2^d-1), split_bins, leaf_values (T, 2^d), edges,
-    base_score, loss_curve)."""
+               sample_weight: Optional[np.ndarray] = None,
+               cat_mask: Optional[np.ndarray] = None):
+    """Returns (features (T, 2^d-1), split_bins, split_masks
+    (T, 2^d-1, n_bins), leaf_values (T, 2^d), edges, base_score,
+    loss_curve, importance (F,)).
+
+    ``cat_mask``: (F,) bool — categorical columns (integer category codes)
+    bin by identity and split on category subsets (hist.build_tree)."""
     n, F = X.shape
     dtype = np.float32
-    edges = make_bin_edges(X, p.n_bins)
+    edges = make_bin_edges(X, p.n_bins, cat_mask)
     binned = bin_data(X, edges)
     w = np.ones(n, dtype) if sample_weight is None else np.asarray(sample_weight, dtype)
     y = np.asarray(y, dtype)
@@ -64,6 +69,8 @@ def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
             ctx.put_obj("trees_f", jnp.zeros((T, n_internal), jnp.int32))
             ctx.put_obj("trees_b", jnp.zeros((T, n_internal), jnp.int32))
             ctx.put_obj("trees_v", jnp.zeros((T, n_leaves), dtype))
+            ctx.put_obj("trees_m", jnp.zeros((T, n_internal, p.n_bins), bool))
+            ctx.put_obj("importance", jnp.zeros((F,), dtype))
             ctx.put_obj("loss_curve", jnp.zeros((T,), dtype))
         binned_l = ctx.get_obj("binned")
         yl = ctx.get_obj("y")
@@ -91,10 +98,13 @@ def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
                  < p.feature_subsample_ratio).astype(dtype) \
             if p.feature_subsample_ratio < 1.0 else None
         stats = jnp.stack([g, h, wb], axis=1)
-        tf, tb, tv, node_id, _ = build_tree(
+        tf, tb, tm, tv, node_id, _, imp = build_tree(
             binned_l, stats, d, p.n_bins, gain_fn, leaf_fn,
             min_samples_leaf=float(p.min_samples_leaf), feature_mask=fmask,
-            axis_name="d")
+            axis_name="d", cat_feats=cat_mask,
+            cat_order_fn=lambda h_: jnp.where(
+                h_[..., 1] > 0, h_[..., 0] / (h_[..., 1] + p.reg_lambda),
+                jnp.inf))
         t = ctx.step_no - 1
         ctx.put_obj("trees_f", jax.lax.dynamic_update_index_in_dim(
             ctx.get_obj("trees_f"), tf, t, 0))
@@ -102,6 +112,9 @@ def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
             ctx.get_obj("trees_b"), tb, t, 0))
         ctx.put_obj("trees_v", jax.lax.dynamic_update_index_in_dim(
             ctx.get_obj("trees_v"), tv.astype(dtype), t, 0))
+        ctx.put_obj("trees_m", jax.lax.dynamic_update_index_in_dim(
+            ctx.get_obj("trees_m"), tm, t, 0))
+        ctx.put_obj("importance", ctx.get_obj("importance") + imp)
         ctx.put_obj("F", Fcur + p.learning_rate * tv[node_id].astype(dtype))
         lw = jax.lax.psum(jnp.stack([loss, wl.sum()]), "d")
         ctx.put_obj("loss_curve", jax.lax.dynamic_update_index_in_dim(
@@ -113,19 +126,21 @@ def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
              .init_with_partitioned_data("w", w)
              .add(grow))
     res = queue.exec()
-    return (res.get("trees_f"), res.get("trees_b"), res.get("trees_v"),
-            edges, base, np.asarray(res.get("loss_curve")))
+    return (res.get("trees_f"), res.get("trees_b"), res.get("trees_m"),
+            res.get("trees_v"), edges, base,
+            np.asarray(res.get("loss_curve")), res.get("importance"))
 
 
 def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
-                 kind: str, env: Optional[MLEnvironment] = None):
+                 kind: str, env: Optional[MLEnvironment] = None,
+                 cat_mask: Optional[np.ndarray] = None):
     """Random forest / decision tree. ``y_stats``: (n, m) per-sample stats —
     (onehot(y), 1) for classification (kind="gini") or (y, y^2, 1) for
     regression (kind="variance"). Returns (features, split_bins,
-    leaf_values (T, 2^d, ...), edges)."""
+    split_masks, leaf_values (T, 2^d, ...), edges, importance (F,))."""
     n, F = X.shape
     dtype = np.float32
-    edges = make_bin_edges(X, p.n_bins)
+    edges = make_bin_edges(X, p.n_bins, cat_mask)
     binned = bin_data(X, edges)
     d = p.max_depth
     T = p.num_trees
@@ -141,6 +156,8 @@ def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
             ctx.put_obj("trees_b", jnp.zeros((T, n_internal), jnp.int32))
             shape = (T, n_leaves, leaf_w) if kind == "gini" else (T, n_leaves)
             ctx.put_obj("trees_v", jnp.zeros(shape, dtype))
+            ctx.put_obj("trees_m", jnp.zeros((T, n_internal, p.n_bins), bool))
+            ctx.put_obj("importance", jnp.zeros((F,), dtype))
         binned_l = ctx.get_obj("binned")
         stats = ctx.get_obj("stats")
         key = ctx.rng_key()
@@ -151,10 +168,10 @@ def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
         fmask = (jax.random.uniform(jax.random.fold_in(key, 1), (F,))
                  < p.feature_subsample_ratio).astype(dtype) \
             if p.feature_subsample_ratio < 1.0 else None
-        tf, tb, tv, _, _ = build_tree(
+        tf, tb, tm, tv, _, _, imp = build_tree(
             binned_l, stats, d, p.n_bins, gain_fn, leaf_fn,
             min_samples_leaf=float(p.min_samples_leaf), feature_mask=fmask,
-            axis_name="d")
+            axis_name="d", cat_feats=cat_mask)
         t = ctx.step_no - 1
         ctx.put_obj("trees_f", jax.lax.dynamic_update_index_in_dim(
             ctx.get_obj("trees_f"), tf, t, 0))
@@ -162,10 +179,14 @@ def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
             ctx.get_obj("trees_b"), tb, t, 0))
         ctx.put_obj("trees_v", jax.lax.dynamic_update_index_in_dim(
             ctx.get_obj("trees_v"), tv.astype(dtype), t, 0))
+        ctx.put_obj("trees_m", jax.lax.dynamic_update_index_in_dim(
+            ctx.get_obj("trees_m"), tm, t, 0))
+        ctx.put_obj("importance", ctx.get_obj("importance") + imp)
 
     queue = (IterativeComQueue(env=env, max_iter=T, seed=p.seed)
              .init_with_partitioned_data("binned", binned)
              .init_with_partitioned_data("stats", y_stats.astype(dtype))
              .add(grow))
     res = queue.exec()
-    return (res.get("trees_f"), res.get("trees_b"), res.get("trees_v"), edges)
+    return (res.get("trees_f"), res.get("trees_b"), res.get("trees_m"),
+            res.get("trees_v"), edges, res.get("importance"))

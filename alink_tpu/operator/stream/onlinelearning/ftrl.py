@@ -240,7 +240,7 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 return ("dense", X, labels(mt, b, batch_size))
             idx0, val0 = design["idx"], design["val"]
             hi = int(idx0.max()) if idx0.size else -1
-            if hi + (1 if has_icpt else 0) >= dim_pad:
+            if hi + (1 if has_icpt else 0) >= dim:
                 raise IndexError(
                     f"sparse feature index {hi} out of range for the "
                     f"warm-start model (dim {dim}); the dense path fails "

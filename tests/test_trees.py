@@ -140,3 +140,94 @@ class TestLevelHist:
                        onehot_dtype=jnp.float32)
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
                                    atol=1e-5)
+
+
+def test_gbdt_categorical_subset_split():
+    """A label driven by membership in a scattered category subset needs
+    ~1 categorical subset split but many ordinal threshold splits: shallow
+    trees with categorical_cols must beat the same trees without
+    (VERDICT round-2 item 6, ref seriestree/CategoricalSplitter.java)."""
+    import numpy as np
+    from alink_tpu.operator.batch.source import MemSourceBatchOp
+    from alink_tpu.operator.batch.classification.tree_ops import (
+        GbdtTrainBatchOp, GbdtPredictBatchOp)
+
+    rng = np.random.RandomState(0)
+    n = 3000
+    cats = np.asarray(list("ABCDEFGHIJKL"))
+    cvals = cats[rng.randint(0, 12, n)]
+    subset = {"B", "F", "K"}          # scattered in ordinal order
+    x0 = rng.randn(n)
+    y = ((np.isin(cvals, list(subset))) ^ (x0 > 1.5)).astype(int)
+    rows = [(str(c), float(v), int(t)) for c, v, t in zip(cvals, x0, y)]
+    src = MemSourceBatchOp(rows, "cat STRING, x0 DOUBLE, label LONG")
+
+    def acc(train_op):
+        pred = GbdtPredictBatchOp(prediction_col="p").link_from(train_op, src)
+        out = pred.collect_mtable()
+        return np.mean(np.asarray(out.col("p")) == y)
+
+    with_cat = GbdtTrainBatchOp(
+        feature_cols=["x0"], categorical_cols=["cat"], label_col="label",
+        num_trees=5, max_depth=2).link_from(src)
+    acc_cat = acc(with_cat)
+    assert acc_cat > 0.97, acc_cat
+
+    # importances present and dominated by the categorical column
+    info = with_cat.get_model_info()
+    items = dict(zip(info.col("item"), info.col("value")))
+    assert float(items["importance[cat]"]) > 0.5
+    ti = with_cat.get_side_output(1).get_output_table()
+    imp = dict(zip(ti.col("feature"), ti.col("importance")))
+    assert abs(sum(imp.values()) - 1.0) < 1e-9
+    assert imp["cat"] > imp["x0"]
+
+
+def test_gbdt_categorical_roundtrip_and_oov():
+    """Split masks and vocabularies survive the model-table round trip;
+    unseen categories at predict time route right (no crash)."""
+    import numpy as np
+    from alink_tpu.common import MTable
+    from alink_tpu.operator.batch.source import MemSourceBatchOp
+    from alink_tpu.operator.batch.classification.tree_ops import (
+        GbdtTrainBatchOp, GbdtPredictBatchOp, TreeModelDataConverter)
+
+    rng = np.random.RandomState(1)
+    n = 800
+    cvals = np.asarray(list("PQRS"))[rng.randint(0, 4, n)]
+    y = (np.isin(cvals, ["Q", "S"])).astype(int)
+    rows = [(str(c), int(t)) for c, t in zip(cvals, y)]
+    src = MemSourceBatchOp(rows, "cat STRING, label LONG")
+    train = GbdtTrainBatchOp(feature_cols=[], categorical_cols=["cat"],
+                             label_col="label", num_trees=3,
+                             max_depth=2).link_from(src)
+    m = TreeModelDataConverter().load_model(train.get_output_table())
+    assert m.split_masks is not None and m.cat_vocabs["cat"] == list("PQRS")
+    # round trip through rows (string serialization)
+    t = train.get_output_table()
+    m2 = TreeModelDataConverter().load_model(MTable(t.to_rows(), t.schema))
+    np.testing.assert_array_equal(m.split_masks, m2.split_masks)
+
+    test_rows = [("P", 0), ("Q", 1), ("ZZZ", 0)]   # ZZZ unseen
+    out = GbdtPredictBatchOp(prediction_col="p").link_from(
+        train, MemSourceBatchOp(test_rows, "cat STRING, label LONG")
+    ).collect_mtable()
+    p = np.asarray(out.col("p"))
+    assert p[0] == 0 and p[1] == 1
+
+    # forests get importances too
+    from alink_tpu.operator.batch.classification.tree_ops import (
+        RandomForestTrainBatchOp)
+    rf = RandomForestTrainBatchOp(feature_cols=[], categorical_cols=["cat"],
+                                  label_col="label", num_trees=4,
+                                  max_depth=3).link_from(src)
+    info = rf.get_model_info()
+    assert any("importance[cat]" in i for i in info.col("item"))
+    # RF *classification* predict must route categorical nodes by subset
+    # membership too (regression + gbdt paths are covered above)
+    from alink_tpu.operator.batch.classification.tree_ops import (
+        RandomForestPredictBatchOp)
+    rf_out = RandomForestPredictBatchOp(prediction_col="p").link_from(
+        rf, src).collect_mtable()
+    rf_acc = np.mean(np.asarray(rf_out.col("p")) == y)
+    assert rf_acc > 0.97, rf_acc
