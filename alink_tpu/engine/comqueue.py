@@ -212,7 +212,17 @@ class IterativeComQueue:
         mapped = shard_map(run, mesh=mesh, in_specs=(P("d"), P()),
                            out_specs=P("d"), check_vma=False)
         stacked = jax.jit(mapped)(parts, bcast)
-        stacked = jax.tree_util.tree_map(np.asarray, stacked)
+        if jax.process_count() > 1:
+            # multi-host session: leaves span non-addressable devices —
+            # gather every worker's shard to every host before fetching
+            # (the reference's result collection back to the client)
+            from jax.experimental import multihost_utils
+            stacked = jax.tree_util.tree_map(
+                lambda x: np.asarray(
+                    multihost_utils.process_allgather(x, tiled=True)),
+                stacked)
+        else:
+            stacked = jax.tree_util.tree_map(np.asarray, stacked)
         result = ComQueueResult(stacked, nw, totals)
         if self._close is not None:
             return self._close(result)

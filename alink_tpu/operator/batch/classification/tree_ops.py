@@ -267,6 +267,9 @@ class RandomForestTrainBatchOp(BatchOperator, _TreeTrainParamsMixin):
     SUBSAMPLING_RATIO = ParamInfo("subsampling_ratio", float, default=0.8)
     FEATURE_SUBSAMPLING_RATIO = ParamInfo("feature_subsampling_ratio", float,
                                           default=0.7)
+    # True ensemble parallelism (whole trees per worker, reference
+    # SeriesTrainFunction); None = auto (on for multi-tree forests)
+    ENSEMBLE_PARALLEL = ParamInfo("ensemble_parallel", bool, default=None)
 
     def link_from(self, in_op: BatchOperator):
         t = in_op.get_output_table()
@@ -281,8 +284,9 @@ class RandomForestTrainBatchOp(BatchOperator, _TreeTrainParamsMixin):
             onehot = np.eye(k)[y.astype(int)] * w[:, None]
             stats = np.concatenate([onehot, w[:, None]], axis=1)
             kind = "gini"
-        tf, tb, tm, tv, edges, imp = forest_train(X, stats, p, kind,
-                                                  cat_mask=cat_mask)
+        tf, tb, tm, tv, edges, imp = forest_train(
+            X, stats, p, kind, cat_mask=cat_mask,
+            ensemble=self.params._m.get("ensemble_parallel"))
         thr = np.stack([bins_to_thresholds(np.asarray(tf[i]), np.asarray(tb[i]),
                                            edges) for i in range(p.num_trees)])
         model = TreeModelData(

@@ -115,12 +115,24 @@ class QuantileDiscretizerTrainBatchOp(BatchOperator, HasSelectedCols):
     def link_from(self, in_op: BatchOperator) -> "QuantileDiscretizerTrainBatchOp":
         t = in_op.get_output_table()
         nb = self.get_num_buckets()
+        cols = self.get_selected_cols()
+        probs = np.linspace(0, 1, nb + 1)[1:-1]
         model = {}
-        for c in self.get_selected_cols():
-            v = np.asarray(t.col(c), np.float64)
-            v = v[~np.isnan(v)]
-            qs = np.quantile(v, np.linspace(0, 1, nb + 1)[1:-1]) if v.size else []
-            model[c] = sorted(set(float(q) for q in np.atleast_1d(qs)))
+        if t.num_rows * len(cols) >= 2_000_000:
+            # large input: one device pass for ALL columns (the reference
+            # distributes this via SortUtils.pSort; dataproc/quantile.py)
+            from ...common.dataproc.quantile import distributed_quantiles
+            X = np.stack([np.asarray(t.col(c), np.float64) for c in cols], 1)
+            qs_all = distributed_quantiles(X, probs)
+            for j, c in enumerate(cols):
+                model[c] = sorted(set(float(q) for q in qs_all[j]
+                                      if np.isfinite(q)))
+        else:
+            for c in cols:
+                v = np.asarray(t.col(c), np.float64)
+                v = v[~np.isnan(v)]
+                qs = np.quantile(v, probs) if v.size else []
+                model[c] = sorted(set(float(q) for q in np.atleast_1d(qs)))
         self._output = QuantileModelConverter().save_model(model)
         return self
 

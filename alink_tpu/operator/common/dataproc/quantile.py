@@ -1,0 +1,99 @@
+"""Distributed quantile computation — device-side, all columns at once.
+
+Re-design of the reference's parallel sort-based quantiles
+(common/dataproc/SortUtils.java:38-47 ``pSort`` + QuantileDiscretizer's
+per-column pass). A distributed full sort is the wrong shape for a TPU;
+instead one BSP superstep builds a fine-grained histogram for EVERY
+column simultaneously:
+
+  1. per-shard masked min/max, ``pmax``/``pmin`` across the mesh;
+  2. per-shard fixed-grid histogram (fine_bins cells per column) via one
+     scatter-add over all (row, column) pairs, ``psum`` across the mesh;
+  3. the tiny (F, fine_bins) table goes to the host once; quantiles come
+     from the cumulative counts with linear interpolation inside cells.
+
+No per-column host loops, no full-data host pass: host work is
+O(F * fine_bins) regardless of row count. With fine_bins=8192 the result
+matches np.quantile to ~1e-3 of the column span (exact at the cell
+boundaries), which is far below what quantile binning consumers (trees,
+discretizers) can distinguish.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ....common.mlenv import MLEnvironment
+from ....engine import IterativeComQueue
+
+
+def distributed_quantiles(X: np.ndarray, probs: np.ndarray,
+                          env: Optional[MLEnvironment] = None,
+                          fine_bins: int = 8192) -> np.ndarray:
+    """(F, len(probs)) per-column quantile values of ``X`` (n, F).
+
+    NaNs are excluded per column (matching np.quantile on the non-NaN
+    subset). Columns that are entirely NaN/empty return NaN (callers drop
+    non-finite cut points).
+    """
+    X = np.asarray(X)
+    n, F = X.shape
+    probs = np.asarray(probs, np.float64)
+
+    def stage(ctx):
+        Xb = ctx.get_obj("X")
+        msk = ctx.get_obj("mask")
+        valid = (msk[:, None] > 0) & ~jnp.isnan(Xb)
+        big = jnp.where(valid, Xb, -jnp.inf).max(0)
+        small = jnp.where(valid, Xb, jnp.inf).min(0)
+        mx = jax.lax.pmax(big, ctx.AXIS)
+        mn = jax.lax.pmin(small, ctx.AXIS)
+        span = jnp.maximum(mx - mn, 1e-300)
+        b = jnp.clip(((Xb - mn) / span * fine_bins).astype(jnp.int32),
+                     0, fine_bins - 1)
+        flat = jnp.arange(F, dtype=jnp.int32)[None, :] * fine_bins + b
+        # int32 accumulation: float32 scatter-add of 1.0 silently saturates
+        # at 2^24 — exactly the large-n regime this path is gated to
+        hist = jnp.zeros((F * fine_bins,), jnp.int32)
+        hist = hist.at[flat.reshape(-1)].add(valid.astype(jnp.int32).reshape(-1))
+        ctx.put_obj("hist", ctx.all_reduce_sum(hist))
+        ctx.put_obj("mn", mn)
+        ctx.put_obj("mx", mx)
+
+    res = (IterativeComQueue(env=env, max_iter=1)
+           .init_with_partitioned_data("X", X)
+           .init_with_partitioned_data("mask", np.ones(n, X.dtype))
+           .add(stage)
+           .exec())
+    hist = np.asarray(res.get("hist"), np.float64).reshape(F, fine_bins)
+    mn = np.asarray(res.get("mn"), np.float64)
+    mx = np.asarray(res.get("mx"), np.float64)
+    span = mx - mn
+
+    cum = np.cumsum(hist, axis=1)                     # (F, K)
+    total = cum[:, -1]                                # non-NaN count per col
+    out = np.full((F, len(probs)), np.nan)
+    ok = (total > 0) & np.isfinite(span)
+    targets = np.outer(total, probs)                  # (F, q)
+    for_cols = np.where(ok)[0]
+    if for_cols.size:
+        # cell index where the cumulative count reaches the target
+        idx = np.stack([np.searchsorted(cum[f], targets[f], side="left")
+                        for f in for_cols])
+        idx = np.clip(idx, 0, fine_bins - 1)
+        csel = cum[for_cols]
+        prev = np.where(idx > 0,
+                        np.take_along_axis(csel, np.maximum(idx - 1, 0), 1), 0.0)
+        cell = np.take_along_axis(hist[for_cols], idx, 1)
+        frac = np.where(cell > 0,
+                        (targets[for_cols] - prev) / np.maximum(cell, 1e-300),
+                        0.0)
+        vals = (mn[for_cols, None]
+                + (idx + np.clip(frac, 0.0, 1.0)) / fine_bins
+                * span[for_cols, None])
+        out[for_cols] = np.clip(vals, mn[for_cols, None], mx[for_cols, None])
+    return out

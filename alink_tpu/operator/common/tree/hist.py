@@ -34,25 +34,46 @@ import jax.numpy as jnp
 # host-side quantile binning
 # ---------------------------------------------------------------------------
 
+_DEVICE_BINNING_MIN_CELLS = 2_000_000  # n*F above this: bin on device
+
+
 def make_bin_edges(X: np.ndarray, n_bins: int,
-                   cat_mask: Optional[np.ndarray] = None) -> np.ndarray:
+                   cat_mask: Optional[np.ndarray] = None,
+                   device: Optional[bool] = None, env=None) -> np.ndarray:
     """(F, n_bins-1) per-feature quantile cut points (padded with +inf).
 
     Categorical features (``cat_mask[f]`` True; values must be integer
     category codes) get identity edges 0.5, 1.5, ... so every category is
     its own bin — no quantile artifacts (reference
     seriestree/CategoricalSplitter.java treats categories as unordered).
+
+    ``device=None`` auto-selects the distributed histogram-quantile pass
+    (dataproc/quantile.py, the SortUtils.pSort analogue) once n*F is large
+    enough that per-column host ``np.quantile`` would dominate; True/False
+    force it.
     """
     n, F = X.shape
     edges = np.full((F, n_bins - 1), np.inf)
-    for f in range(F):
-        if cat_mask is not None and cat_mask[f]:
-            arity = min(int(X[:, f].max()) + 1, n_bins)
-            edges[f, :max(arity - 1, 0)] = np.arange(max(arity - 1, 0)) + 0.5
-            continue
-        qs = np.quantile(X[:, f], np.linspace(0, 1, n_bins + 1)[1:-1])
+    if device is None:
+        device = n * F >= _DEVICE_BINNING_MIN_CELLS
+    cont = ([f for f in range(F) if not cat_mask[f]]
+            if cat_mask is not None else list(range(F)))
+    probs = np.linspace(0, 1, n_bins + 1)[1:-1]
+    if device and cont:
+        from ..dataproc.quantile import distributed_quantiles
+        qs_all = distributed_quantiles(
+            np.ascontiguousarray(X[:, cont]), probs, env=env)
+    for pos, f in enumerate(cont):
+        qs = qs_all[pos] if device else np.quantile(X[:, f], probs)
         uq = np.unique(qs)
+        uq = uq[np.isfinite(uq)]
         edges[f, :len(uq)] = uq
+    if cat_mask is not None:
+        for f in range(F):
+            if cat_mask[f]:
+                arity = min(int(X[:, f].max()) + 1, n_bins)
+                edges[f, :max(arity - 1, 0)] = (
+                    np.arange(max(arity - 1, 0)) + 0.5)
     return edges
 
 

@@ -19,7 +19,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ....common.mlenv import MLEnvironment
+from ....common.mlenv import MLEnvironment, MLEnvironmentFactory
 from ....engine import IterativeComQueue
 from .hist import (bin_data, build_tree, gini_gain, gini_leaf, make_bin_edges,
                    make_xgb_gain, make_xgb_leaf, tree_apply_binned,
@@ -51,7 +51,7 @@ def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
     bin by identity and split on category subsets (hist.build_tree)."""
     n, F = X.shape
     dtype = np.float32
-    edges = make_bin_edges(X, p.n_bins, cat_mask)
+    edges = make_bin_edges(X, p.n_bins, cat_mask, env=env)
     binned = bin_data(X, edges)
     w = np.ones(n, dtype) if sample_weight is None else np.asarray(sample_weight, dtype)
     y = np.asarray(y, dtype)
@@ -133,14 +133,24 @@ def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
 
 def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
                  kind: str, env: Optional[MLEnvironment] = None,
-                 cat_mask: Optional[np.ndarray] = None):
+                 cat_mask: Optional[np.ndarray] = None,
+                 ensemble: Optional[bool] = None):
     """Random forest / decision tree. ``y_stats``: (n, m) per-sample stats —
     (onehot(y), 1) for classification (kind="gini") or (y, y^2, 1) for
     regression (kind="variance"). Returns (features, split_bins,
-    split_masks, leaf_values (T, 2^d, ...), edges, importance (F,))."""
+    split_masks, leaf_values (T, 2^d, ...), edges, importance (F,)).
+
+    ``ensemble`` selects TRUE ensemble parallelism (reference
+    BaseRandomForestTrainBatchOp.java:264 SeriesTrainFunction: every
+    worker grows whole independent trees on its own data partition, no
+    histogram allreduce): W trees materialize per superstep, so T trees
+    cost ceil(T/W) supersteps. False grows one data-parallel tree per
+    superstep with psum'd histograms (better per-tree quality, W-fold
+    more supersteps). Default: ensemble when T > 1.
+    """
     n, F = X.shape
     dtype = np.float32
-    edges = make_bin_edges(X, p.n_bins, cat_mask)
+    edges = make_bin_edges(X, p.n_bins, cat_mask, env=env)
     binned = bin_data(X, edges)
     d = p.max_depth
     T = p.num_trees
@@ -149,18 +159,26 @@ def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
     leaf_fn = gini_leaf if kind == "gini" else variance_leaf
     leaf_w = (m - 1) if kind == "gini" else 1
     n_internal, n_leaves = (1 << d) - 1, 1 << d
+    env_ = env or MLEnvironmentFactory.get_default()
+    W = env_.num_workers
+    if ensemble is None:
+        ensemble = T > 1
+    T_store = -(-T // W) if ensemble else T   # per-worker tree slots
+    axis = None if ensemble else "d"
 
     def grow(ctx):
         if ctx.is_init_step:
-            ctx.put_obj("trees_f", jnp.zeros((T, n_internal), jnp.int32))
-            ctx.put_obj("trees_b", jnp.zeros((T, n_internal), jnp.int32))
-            shape = (T, n_leaves, leaf_w) if kind == "gini" else (T, n_leaves)
+            ctx.put_obj("trees_f", jnp.zeros((T_store, n_internal), jnp.int32))
+            ctx.put_obj("trees_b", jnp.zeros((T_store, n_internal), jnp.int32))
+            shape = ((T_store, n_leaves, leaf_w) if kind == "gini"
+                     else (T_store, n_leaves))
             ctx.put_obj("trees_v", jnp.zeros(shape, dtype))
-            ctx.put_obj("trees_m", jnp.zeros((T, n_internal, p.n_bins), bool))
+            ctx.put_obj("trees_m",
+                        jnp.zeros((T_store, n_internal, p.n_bins), bool))
             ctx.put_obj("importance", jnp.zeros((F,), dtype))
         binned_l = ctx.get_obj("binned")
         stats = ctx.get_obj("stats")
-        key = ctx.rng_key()
+        key = ctx.rng_key()      # per-worker, per-step: trees differ per worker
         if p.subsample_ratio < 1.0:
             bag = jax.random.bernoulli(key, p.subsample_ratio,
                                        (stats.shape[0],)).astype(dtype)
@@ -171,7 +189,7 @@ def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
         tf, tb, tm, tv, _, _, imp = build_tree(
             binned_l, stats, d, p.n_bins, gain_fn, leaf_fn,
             min_samples_leaf=float(p.min_samples_leaf), feature_mask=fmask,
-            axis_name="d", cat_feats=cat_mask)
+            axis_name=axis, cat_feats=cat_mask)
         t = ctx.step_no - 1
         ctx.put_obj("trees_f", jax.lax.dynamic_update_index_in_dim(
             ctx.get_obj("trees_f"), tf, t, 0))
@@ -181,12 +199,27 @@ def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
             ctx.get_obj("trees_v"), tv.astype(dtype), t, 0))
         ctx.put_obj("trees_m", jax.lax.dynamic_update_index_in_dim(
             ctx.get_obj("trees_m"), tm, t, 0))
+        if ensemble:
+            # surplus trees past T (T not a multiple of W) are trimmed from
+            # the returned forest; keep their gains out of the importances
+            kept = (t * W + ctx.task_id) < T
+            imp = jnp.where(kept, imp, jnp.zeros_like(imp))
         ctx.put_obj("importance", ctx.get_obj("importance") + imp)
 
-    queue = (IterativeComQueue(env=env, max_iter=T, seed=p.seed)
+    queue = (IterativeComQueue(env=env_, max_iter=T_store, seed=p.seed)
              .init_with_partitioned_data("binned", binned)
              .init_with_partitioned_data("stats", y_stats.astype(dtype))
              .add(grow))
     res = queue.exec()
-    return (res.get("trees_f"), res.get("trees_b"), res.get("trees_m"),
-            res.get("trees_v"), edges, res.get("importance"))
+    if not ensemble:
+        return (res.get("trees_f"), res.get("trees_b"), res.get("trees_m"),
+                res.get("trees_v"), edges, res.get("importance"))
+    # ensemble: per-worker tree slices -> interleaved (T, ...) global forest
+    # (superstep-major: tree s*W + w grew on worker w at superstep s+1)
+    def gather(name):
+        v = res.shards(name)                       # (W, T_store, ...)
+        v = np.swapaxes(v, 0, 1).reshape((W * T_store,) + v.shape[2:])
+        return v[:T]
+    importance = res.shards("importance").sum(0)   # no psum ran: host-sum
+    return (gather("trees_f"), gather("trees_b"), gather("trees_m"),
+            gather("trees_v"), edges, importance)

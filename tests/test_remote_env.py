@@ -1,0 +1,86 @@
+"""Repeatable multi-process use_remote_env test (VERDICT round-2 item 8).
+
+Round 1 verified the jax.distributed Gloo join by hand (commit d426458);
+this spawns TWO fresh interpreters that both call ``use_remote_env`` with
+the same coordinator, asserts the joined runtime spans both processes'
+devices, and runs a BSP AllReduce program on the resulting session so the
+cross-process collective path is exercised, not just the handshake.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_CHILD = r"""
+import sys
+import numpy as np
+
+coordinator, pid = sys.argv[1], int(sys.argv[2])
+
+from alink_tpu.common.mlenv import use_remote_env
+env = use_remote_env(coordinator_address=coordinator, num_processes=2,
+                     process_id=pid, parallelism=4)
+
+import jax
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, jax.devices()          # 2 local x 2 procs
+assert env.num_workers == 4
+
+# cross-process collective through the engine: psum over the session mesh
+import jax.numpy as jnp
+from alink_tpu.engine import IterativeComQueue
+
+def stage(ctx):
+    ctx.put_obj("total", ctx.all_reduce_sum(ctx.get_obj("x").sum()))
+
+data = np.arange(8, dtype=np.float64)       # same global input on each host
+res = (IterativeComQueue(env=env, max_iter=1)
+       .init_with_partitioned_data("x", data)
+       .add(stage)
+       .exec())
+total = float(res.get("total"))
+assert total == data.sum(), total
+print("CHILD_OK", pid, total)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_gloo_join_and_collective(tmp_path):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_root)
+    from bootenv import cpu_mesh_env
+
+    coordinator = f"127.0.0.1:{_free_port()}"
+    script = tmp_path / "child.py"
+    script.write_text(_CHILD)
+    procs = []
+    for pid in range(2):
+        env = cpu_mesh_env(2)               # 2 virtual CPU devices per proc
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), coordinator, str(pid)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=200)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out.decode())
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"child {pid} failed:\n{out}"
+        assert f"CHILD_OK {pid}" in out, out
