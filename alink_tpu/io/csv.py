@@ -76,7 +76,7 @@ def _csv_bytes(data: bytes, schema: TableSchema, field_delimiter: str,
 
 
 def _load_line_bytes(path: str, ignore_first_line: bool,
-                     shard=None) -> bytes:
+                     shard=None, quote_char: str = '"') -> bytes:
     """Bytes of ``path``'s lines for this reader.
 
     ``shard=(i, n)`` selects the per-host slice (SURVEY §7 sharded sources):
@@ -86,9 +86,27 @@ def _load_line_bytes(path: str, ignore_first_line: bool,
     """
     from .sharding import read_file_shard, shard_paths
 
+    q = quote_char.encode("utf-8") if quote_char else None
+
     def drop_header(b: bytes) -> bytes:
-        nl = b.find(b"\n")
-        return b[nl + 1:] if nl >= 0 else b""
+        # quote-aware: a header record containing a quoted embedded newline
+        # spans physical lines — skip newlines until quotes are balanced.
+        # A stray unbalanced quote must not swallow the file: fall back to
+        # dropping one physical line when parity never balances.
+        first_nl = b.find(b"\n")
+        if first_nl < 0:
+            return b""
+        if q is None:
+            return b[first_nl + 1:]
+        pos, quotes = 0, 0
+        while True:
+            nl = b.find(b"\n", pos)
+            if nl < 0:
+                return b[first_nl + 1:]
+            quotes += b.count(q, pos, nl)
+            if quotes % 2 == 0:
+                return b[nl + 1:]
+            pos = nl + 1
 
     if path.startswith(("http://", "https://")):
         if shard is not None and shard[1] > 1:
@@ -120,7 +138,7 @@ def _load_line_bytes(path: str, ignore_first_line: bool,
 def read_csv(path: str, schema: TableSchema, field_delimiter: str = ",",
              quote_char: str = '"', skip_blank: bool = True,
              ignore_first_line: bool = False, shard=None) -> MTable:
-    data = _load_line_bytes(path, ignore_first_line, shard)
+    data = _load_line_bytes(path, ignore_first_line, shard, quote_char)
     return _csv_bytes(data, schema, field_delimiter, quote_char, skip_blank)
 
 

@@ -118,10 +118,11 @@ class QuantileDiscretizerTrainBatchOp(BatchOperator, HasSelectedCols):
         cols = self.get_selected_cols()
         probs = np.linspace(0, 1, nb + 1)[1:-1]
         model = {}
-        if t.num_rows * len(cols) >= 2_000_000:
+        from ...common.dataproc.quantile import (DEVICE_BINNING_MIN_CELLS,
+                                                 distributed_quantiles)
+        if t.num_rows * len(cols) >= DEVICE_BINNING_MIN_CELLS:
             # large input: one device pass for ALL columns (the reference
             # distributes this via SortUtils.pSort; dataproc/quantile.py)
-            from ...common.dataproc.quantile import distributed_quantiles
             X = np.stack([np.asarray(t.col(c), np.float64) for c in cols], 1)
             qs_all = distributed_quantiles(X, probs)
             for j, c in enumerate(cols):

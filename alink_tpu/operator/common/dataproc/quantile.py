@@ -30,6 +30,11 @@ import jax.numpy as jnp
 from ....common.mlenv import MLEnvironment
 from ....engine import IterativeComQueue
 
+# n*F at or above this: quantile/bin on device (one sharded pass) instead of
+# per-column host numpy — shared by tree binning (tree/hist.py) and
+# QuantileDiscretizerTrainBatchOp so the cutover is tuned in one place
+DEVICE_BINNING_MIN_CELLS = 2_000_000
+
 
 def distributed_quantiles(X: np.ndarray, probs: np.ndarray,
                           env: Optional[MLEnvironment] = None,

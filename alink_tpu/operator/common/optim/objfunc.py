@@ -212,6 +212,35 @@ def rmatvec(data: Dict, c, dim: int, fb_meta=None):
         contrib.reshape(-1))
 
 
+def densify_shard(data: Dict, dim: int, fb_meta=None):
+    """(n, dim) dense design matrix from any shard layout.
+
+    Only for algorithms whose memory is already O(dim^2) — Newton's Hessian
+    (reference common/optim/Newton.java runs on any vector input because its
+    Hessian is a dense dim x dim matrix regardless) — where the O(n*dim)
+    scatter-densify is not the dominant cost. Hot gradient paths must keep
+    using matvec/rmatvec, which never densify.
+    """
+    if "X" in data:
+        return data["X"]
+    if "fb_idx" in data:
+        if fb_meta is None:
+            raise ValueError("shard has 'fb_idx' but no FieldBlockMeta was "
+                             "provided (pass fb_meta= to the objective)")
+        offs = jnp.arange(fb_meta.num_fields, dtype=data["fb_idx"].dtype) \
+            * fb_meta.field_size
+        idx = data["fb_idx"] + offs[None, :]
+        val = data.get("fb_val")
+        if val is None:
+            val = jnp.ones(idx.shape, jnp.float32)
+    else:
+        idx, val = data["idx"], data["val"]
+    n = idx.shape[0]
+    # padding entries carry val == 0, so scatter-add at their (0-)index is a no-op
+    return jnp.zeros((n, dim), val.dtype).at[
+        jnp.arange(n)[:, None], idx].add(val)
+
+
 class OptimObjFunc:
     """Base objective: per-shard grad/loss/hessian + global regularization."""
 
@@ -298,12 +327,11 @@ class UnaryLossObjFunc(OptimObjFunc):
         return jax.vmap(one)(steps)
 
     def hessian_shard(self, data, coef):
-        if "X" not in data:
-            raise NotImplementedError("Newton requires dense features")
-        eta = matvec(data, coef)
+        eta = matvec(data, coef, self.fb_meta)
         y, w = data["y"], data["w"]
         h = w * self.unary_loss.second_derivative(eta, y)
-        H = (data["X"] * h[:, None]).T @ data["X"]
+        Xd = densify_shard(data, self.dim, self.fb_meta)
+        H = (Xd * h[:, None]).T @ Xd
         grad, loss, wsum = self.calc_grad_shard(data, coef)
         return H, grad, loss, wsum
 
@@ -368,3 +396,18 @@ class SoftmaxObjFunc(OptimObjFunc):
             return (w * (lse - jnp.take_along_axis(z, y[:, None], 1)[:, 0])).sum()
 
         return jax.vmap(one)(steps)
+
+    def hessian_shard(self, data, coef):
+        """Full (k-1)d x (k-1)d Hessian (reference SoftmaxObjFunc.java
+        calcHessian): block (a,b) is sum_i w_i (p_ia [a==b] - p_ia p_ib)
+        x_i x_i^T, laid out to match the flattened (k-1, d) coef."""
+        W = coef.reshape(self.k - 1, self.d)
+        w = data["w"]
+        p = jax.nn.softmax(self._logits(data, W), axis=1)[:, :self.k - 1]
+        S = w[:, None, None] * (
+            p[:, :, None] * jnp.eye(self.k - 1, dtype=p.dtype)[None]
+            - p[:, :, None] * p[:, None, :])                      # (n, k-1, k-1)
+        Xd = densify_shard(data, self.d)
+        H = jnp.einsum("nab,nj,nl->ajbl", S, Xd, Xd).reshape(self.dim, self.dim)
+        grad, loss, wsum = self.calc_grad_shard(data, coef)
+        return H, grad, loss, wsum

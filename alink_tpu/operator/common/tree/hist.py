@@ -34,7 +34,7 @@ import jax.numpy as jnp
 # host-side quantile binning
 # ---------------------------------------------------------------------------
 
-_DEVICE_BINNING_MIN_CELLS = 2_000_000  # n*F above this: bin on device
+from ..dataproc.quantile import DEVICE_BINNING_MIN_CELLS as _DEVICE_BINNING_MIN_CELLS
 
 
 def make_bin_edges(X: np.ndarray, n_bins: int,
@@ -64,7 +64,12 @@ def make_bin_edges(X: np.ndarray, n_bins: int,
         qs_all = distributed_quantiles(
             np.ascontiguousarray(X[:, cont]), probs, env=env)
     for pos, f in enumerate(cont):
-        qs = qs_all[pos] if device else np.quantile(X[:, f], probs)
+        if device:
+            qs = qs_all[pos]
+        else:
+            v = X[:, f]
+            v = v[~np.isnan(v)]   # match the device path's per-column NaN
+            qs = np.quantile(v, probs) if v.size else np.array([])
         uq = np.unique(qs)
         uq = uq[np.isfinite(uq)]
         edges[f, :len(uq)] = uq

@@ -163,6 +163,15 @@ def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
     W = env_.num_workers
     if ensemble is None:
         ensemble = T > 1
+    if ensemble and W > 1:
+        # ensemble trees see ONLY their worker's partition; contiguous
+        # splits of an ordered dataset (e.g. sorted by label) would hand
+        # each worker a biased — possibly single-class — slice. Shuffle
+        # rows before partitioning, the analogue of the reference's
+        # AvgPartition re-distribution (BaseRandomForestTrainBatchOp.java:350)
+        perm = np.random.RandomState(p.seed).permutation(n)
+        binned = binned[perm]
+        y_stats = y_stats[perm]
     T_store = -(-T // W) if ensemble else T   # per-worker tree slots
     axis = None if ensemble else "d"
 

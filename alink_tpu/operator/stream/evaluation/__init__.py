@@ -83,9 +83,17 @@ class EvalBinaryClassStreamOp(_BaseEvalStreamOp, HasLabelCol,
         details = table.col(self.get_prediction_detail_col() or "pred_detail")
         pos, p_pos = parse_detail_probs(
             details, self.params._m.get("positive_label_value_string"))
+        m = binary_metrics(labels, p_pos, pos)
         if len(set(str(l) for l in labels)) < 2:
-            return json.dumps({"count": len(labels), "note": "single-class window"})
-        return binary_metrics(labels, p_pos, pos).to_json()
+            # a window that saw one label class still emits the full schema
+            # (reference BaseEvalClassStreamOp windows do) — confusion-matrix
+            # metrics are well-defined; rank metrics are not, so null them
+            d = m.to_dict()
+            for k in ("AUC", "KS", "PRC"):
+                d[k] = None
+            from ...common.evaluation.metrics import BinaryClassMetrics
+            return BinaryClassMetrics(d).to_json()
+        return m.to_json()
 
 
 class EvalMultiClassStreamOp(_BaseEvalStreamOp, HasLabelCol, HasPredictionCol,

@@ -288,3 +288,34 @@ def test_ftrl_improves_on_weak_warm_start():
                        == np.asarray(table.col("label")))
 
     assert batch_acc(final_model) >= batch_acc(weak.get_output_table())
+
+
+def test_stream_eval_single_class_window_full_schema():
+    """A window that saw only one label class still emits the full metric
+    schema (reference BaseEvalClassStreamOp) — rank metrics nulled, confusion
+    metrics real — instead of a {"count", "note"} stub row."""
+    table = _make_lr_fixture(n=80, seed=9)
+    batch_src = MemSourceBatchOp(table)
+    model = LogisticRegressionTrainBatchOp(
+        feature_cols=["f0", "f1", "f2"], label_col="label",
+        max_iter=40).link_from(batch_src)
+
+    # an all-positive slice: every window is single-class
+    mask = np.asarray(table.col("label")) == 1
+    pos_only = MTable({c: np.asarray(table.col(c))[mask] for c in
+                       ("f0", "f1", "f2", "label")})
+    pred = LogisticRegressionPredictStreamOp(
+        model, prediction_col="pred", prediction_detail_col="detail"
+    ).link_from(MemSourceStreamOp(pos_only, batch_size=16))
+    ev = EvalBinaryClassStreamOp(label_col="label",
+                                 prediction_detail_col="detail",
+                                 time_interval=2.0).link_from(pred)
+    rows = _drain(ev)
+    assert rows.num_rows
+    for d in rows.col("Data"):
+        m = json.loads(d)
+        assert "note" not in m
+        assert m["AUC"] is None and m["KS"] is None and m["PRC"] is None
+        assert m["TotalSamples"] > 0
+        assert m["TruePositive"] + m["FalseNegative"] == m["TotalSamples"]
+        assert 0.0 <= m["Accuracy"] <= 1.0

@@ -271,3 +271,35 @@ def test_rf_ensemble_parallelism():
     # (independent bagging/rng per worker): first W trees not all identical
     first_round = [tf_ens[t].tobytes() for t in range(min(W, T))]
     assert len(set(first_round)) > 1
+
+
+def test_random_forest_label_sorted_input():
+    """Ensemble trees see only their worker's partition; a label-sorted
+    dataset must not hand workers single-class slices (rows are shuffled
+    before partitioning, mirroring the reference's AvgPartition)."""
+    src, X, y = _nonlinear_cls(n=800, seed=4)
+    order = np.argsort(y, kind="stable")   # all "neg" rows, then all "pos"
+    rows = [tuple(r) + (t,) for r, t in zip(X[order], y[order])]
+    cols = "a DOUBLE, b DOUBLE, c DOUBLE, d DOUBLE, label STRING"
+    sorted_src = MemSourceBatchOp(rows, cols)
+    train = RandomForestTrainBatchOp(feature_cols=["a", "b", "c", "d"],
+                                     label_col="label", num_trees=16,
+                                     max_depth=5).link_from(sorted_src)
+    out = (RandomForestPredictBatchOp(prediction_col="pred")
+           .link_from(train, sorted_src)).collect_mtable()
+    acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
+    assert acc > 0.9
+
+
+def test_bin_edges_nan_host_device_agree():
+    """Host and device binning must agree on NaN handling: a column with
+    missing values still gets real cut points on both paths."""
+    from alink_tpu.operator.common.tree.hist import make_bin_edges
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 3)
+    X[rng.rand(400) < 0.1, 1] = np.nan
+    e_host = make_bin_edges(X, 8, device=False)
+    e_dev = make_bin_edges(X, 8, device=True)
+    assert np.isfinite(e_host[1]).any(), "NaN column dead on host path"
+    assert np.isfinite(e_dev[1]).any()
+    np.testing.assert_allclose(e_host[0], e_dev[0], atol=0.15)
