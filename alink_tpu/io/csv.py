@@ -91,15 +91,16 @@ def _load_line_bytes(path: str, ignore_first_line: bool,
     def drop_header(b: bytes) -> bytes:
         # quote-aware: a header record containing a quoted embedded newline
         # spans physical lines — skip newlines until quotes are balanced.
-        # A stray unbalanced quote must not swallow the file: fall back to
-        # dropping one physical line when parity never balances.
+        # A stray unbalanced quote must not swallow data: the continuation
+        # scan is capped (a >64-line header is malformation, not a header),
+        # and past the cap exactly one physical line is dropped.
         first_nl = b.find(b"\n")
         if first_nl < 0:
             return b""
         if q is None:
             return b[first_nl + 1:]
         pos, quotes = 0, 0
-        while True:
+        for _ in range(64):
             nl = b.find(b"\n", pos)
             if nl < 0:
                 return b[first_nl + 1:]
@@ -107,6 +108,7 @@ def _load_line_bytes(path: str, ignore_first_line: bool,
             if quotes % 2 == 0:
                 return b[nl + 1:]
             pos = nl + 1
+        return b[first_nl + 1:]
 
     if path.startswith(("http://", "https://")):
         if shard is not None and shard[1] > 1:

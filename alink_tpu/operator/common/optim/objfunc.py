@@ -327,12 +327,11 @@ class UnaryLossObjFunc(OptimObjFunc):
         return jax.vmap(one)(steps)
 
     def hessian_shard(self, data, coef):
-        eta = matvec(data, coef, self.fb_meta)
+        grad, loss, wsum, eta = self.calc_grad_eta_shard(data, coef)
         y, w = data["y"], data["w"]
         h = w * self.unary_loss.second_derivative(eta, y)
         Xd = densify_shard(data, self.dim, self.fb_meta)
         H = (Xd * h[:, None]).T @ Xd
-        grad, loss, wsum = self.calc_grad_shard(data, coef)
         return H, grad, loss, wsum
 
 
@@ -364,10 +363,11 @@ class SoftmaxObjFunc(OptimObjFunc):
             z = (gathered * data["val"][..., None]).sum(1)
         return jnp.concatenate([z, jnp.zeros((z.shape[0], 1), z.dtype)], axis=1)
 
-    def calc_grad_shard(self, data, coef):
-        W = coef.reshape(self.k - 1, self.d)
+    def _grad_loss_from_logits(self, data, logits):
+        """(grad, loss, wsum, softmax probs) at already-computed logits —
+        shared by the gradient and Newton paths so each Newton step runs
+        the design-matrix product once."""
         y, w = data["y"].astype(jnp.int32), data["w"]
-        logits = self._logits(data, W)
         lse = jax.nn.logsumexp(logits, axis=1)
         loss = (w * (lse - jnp.take_along_axis(logits, y[:, None], 1)[:, 0])).sum()
         p = jax.nn.softmax(logits, axis=1)
@@ -381,7 +381,13 @@ class SoftmaxObjFunc(OptimObjFunc):
             g = jnp.zeros((self.d, self.k - 1), contrib.dtype)
             g = g.at[flat_idx].add(contrib.reshape(-1, self.k - 1))
             grad = g.T.reshape(-1)
-        return grad, loss, w.sum()
+        return grad, loss, w.sum(), p
+
+    def calc_grad_shard(self, data, coef):
+        W = coef.reshape(self.k - 1, self.d)
+        grad, loss, wsum, _ = self._grad_loss_from_logits(
+            data, self._logits(data, W))
+        return grad, loss, wsum
 
     def line_losses_shard(self, data, coef, direction, steps, eta0=None):
         W = coef.reshape(self.k - 1, self.d)
@@ -400,14 +406,28 @@ class SoftmaxObjFunc(OptimObjFunc):
     def hessian_shard(self, data, coef):
         """Full (k-1)d x (k-1)d Hessian (reference SoftmaxObjFunc.java
         calcHessian): block (a,b) is sum_i w_i (p_ia [a==b] - p_ia p_ib)
-        x_i x_i^T, laid out to match the flattened (k-1, d) coef."""
+        x_i x_i^T, laid out to match the flattened (k-1, d) coef.
+
+        Blocks are contracted one (a,b) pair at a time under lax.map so
+        peak memory stays O(n*d) — a single three-operand einsum would
+        materialize an O(n*d^2) or O(n*(k-1)^2*d) intermediate."""
         W = coef.reshape(self.k - 1, self.d)
+        logits = self._logits(data, W)
+        grad, loss, wsum, p_full = self._grad_loss_from_logits(data, logits)
         w = data["w"]
-        p = jax.nn.softmax(self._logits(data, W), axis=1)[:, :self.k - 1]
-        S = w[:, None, None] * (
-            p[:, :, None] * jnp.eye(self.k - 1, dtype=p.dtype)[None]
-            - p[:, :, None] * p[:, None, :])                      # (n, k-1, k-1)
+        p = p_full[:, :self.k - 1]
         Xd = densify_shard(data, self.d)
-        H = jnp.einsum("nab,nj,nl->ajbl", S, Xd, Xd).reshape(self.dim, self.dim)
-        grad, loss, wsum = self.calc_grad_shard(data, coef)
+        km1 = self.k - 1
+        pairs = jnp.stack(jnp.meshgrid(jnp.arange(km1), jnp.arange(km1),
+                                       indexing="ij"), -1).reshape(-1, 2)
+
+        def block(pair):
+            a, b = pair[0], pair[1]
+            same = (a == b).astype(p.dtype)
+            s = w * (p[:, a] * same - p[:, a] * p[:, b])
+            return Xd.T @ (s[:, None] * Xd)
+
+        blocks = jax.lax.map(block, pairs)         # ((k-1)^2, d, d)
+        H = (blocks.reshape(km1, km1, self.d, self.d)
+             .transpose(0, 2, 1, 3).reshape(self.dim, self.dim))
         return H, grad, loss, wsum
