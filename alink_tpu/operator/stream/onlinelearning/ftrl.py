@@ -31,7 +31,7 @@ from typing import List, Optional
 import numpy as np
 
 from ....common.mtable import MTable
-from ....common.params import ParamInfo, Params
+from ....common.params import InValidator, ParamInfo, Params
 from ....common.types import AlinkTypes, TableSchema
 from ....params.shared import (HasFeatureCols, HasLabelCol, HasPredictionCol,
                                HasPredictionDetailCol, HasReservedCols,
@@ -139,11 +139,157 @@ def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2):
             n = n.at[li].add(dn)
             return (z, n), margin
 
-        (z, n), margins = jax.lax.scan(body, (z, n), (idx, val, y))
+        # unroll amortizes the per-iteration loop overhead of the strictly
+        # sequential sample scan (~+20% measured on v5e)
+        (z, n), margins = jax.lax.scan(body, (z, n), (idx, val, y),
+                                       unroll=32)
         return z, n, margins
 
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=(P(), P(), P(), P("d"), P("d")),
+                   out_specs=(P("d"), P("d"), P()))
+    return jax.jit(fn)
+
+
+def _ftrl_sparse_batch_step_factory(mesh, alpha, beta, l1, l2):
+    """Batched-update twin of :func:`_ftrl_sparse_step_factory`.
+
+    ``update_mode="batch"``: every row's gradient is computed at the
+    weights from *before* the micro-batch, and the (z, n) updates land in
+    one fused gather/scatter — no sequential scan, so the whole batch is
+    one data-parallel SPMD program and throughput is bound by memory
+    bandwidth instead of per-sample loop latency (~50x the strict scan on
+    v5e at Criteo shape).
+
+    This is a deliberate TPU-first semantics relaxation of the reference's
+    strict per-sample order (FtrlTrainStreamOp.java CalcTask): within one
+    micro-batch, updates from earlier rows are not visible to later rows.
+    When the rows of a batch touch pairwise-disjoint feature sets it is
+    EXACTLY the per-sample program (no state is shared inside the batch);
+    with hashed CTR features collisions inside a 1k-row batch are rare, so
+    the trajectories track closely (pinned by tests). Convergence of
+    delayed/minibatched FTRL-proximal is standard online-learning
+    practice; the strict mode stays the default for reference parity.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def weights(z, n):
+        return _ftrl_weights(z, n, alpha, beta, l1, l2)
+
+    def shard_fn(idx, val, y, z, n):
+        shard = z.shape[0]
+        lo = jax.lax.axis_index("d") * shard
+        local = (idx >= lo) & (idx < lo + shard)       # (B, width)
+        li = jnp.clip(idx - lo, 0, shard - 1)
+        zj = jnp.where(local, z[li], 0.0)
+        nj = jnp.where(local, n[li], 0.0)
+        wj = jnp.where(local, weights(zj, nj), 0.0)
+        margins = jax.lax.psum((val * wj).sum(-1), "d")
+        p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margins, -35.0, 35.0)))
+        g = (p - y)[:, None] * val
+        sigma = (jnp.sqrt(nj + g * g) - jnp.sqrt(nj)) / alpha
+        dz = jnp.where(local, g - sigma * wj, 0.0)
+        dn = jnp.where(local, g * g, 0.0)
+        # duplicate feature slots inside the batch accumulate their rows'
+        # contributions (padding has val == 0 -> dz = dn = 0)
+        z = z.at[li.reshape(-1)].add(dz.reshape(-1))
+        n = n.at[li.reshape(-1)].add(dn.reshape(-1))
+        return z, n, margins
+
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(P(), P(), P(), P("d"), P("d")),
+                   out_specs=(P("d"), P("d"), P()))
+    return jax.jit(fn)
+
+
+def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2):
+    """Field-blocked batched FTRL — the Criteo fast path.
+
+    Both gather/scatter-style modes above are bound by XLA's serialized
+    random gather/scatter on TPU (~5M touched elements/s measured on v5e
+    — the same wall the round-1 L-BFGS hit). When the input is
+    field-aware hashed (exactly one slot per field per row,
+    ops/fieldblock.py), every state access becomes a factored one-hot MXU
+    matmul instead: per-slot (n, w) reads via :func:`fb_gather`, margin
+    via :func:`fb_matvec`, and the update scatter via :func:`fb_rmatvec`.
+    Same batched-update semantics as the COO batch factory (gradients at
+    pre-batch weights; exact for collision-free batches).
+
+    Sharding: devices own contiguous FIELD groups (meta.num_fields must
+    divide by the mesh size — pad with a zero-valued dummy field if not);
+    each device runs the kernels on its own field columns and the margin
+    psums, the field-sharded analogue of the reference's feature ranges.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ....ops.fieldblock import (FieldBlockMeta, fb_gather, fb_matvec,
+                                    fb_rmatvec)
+
+    n_dev = mesh.devices.size
+    if meta.num_fields % n_dev:
+        raise ValueError(f"num_fields {meta.num_fields} must be a multiple "
+                         f"of the mesh size {n_dev} (pad with a dummy field)")
+    local_meta = FieldBlockMeta(meta.num_fields // n_dev, meta.field_size)
+
+    def weights(z, n):
+        return _ftrl_weights(z, n, alpha, beta, l1, l2)
+
+    def shard_fn(fb_idx, val, y, z, n):
+        # fb_idx/val: (B, F) replicated; z/n: local field-group slice
+        F_loc = local_meta.num_fields
+        k0 = jax.lax.axis_index("d") * F_loc
+        idx_l = jax.lax.dynamic_slice_in_dim(fb_idx, k0, F_loc, 1)
+        val_l = jax.lax.dynamic_slice_in_dim(val, k0, F_loc, 1)
+        w = weights(z, n)
+        margins = jax.lax.psum(
+            fb_matvec(idx_l, w, local_meta, val=val_l), "d")
+        p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margins, -35.0, 35.0)))
+        g = (p - y)[:, None] * val_l                        # (B, F_loc)
+        nj = fb_gather(idx_l, n, local_meta)
+        wj = fb_gather(idx_l, w, local_meta)
+        sigma = (jnp.sqrt(nj + g * g) - jnp.sqrt(nj)) / alpha
+        ones = jnp.ones_like(y)
+        dz = fb_rmatvec(idx_l, ones, local_meta, val=g - sigma * wj,
+                        dtype=jnp.float32)
+        dn = fb_rmatvec(idx_l, ones, local_meta, val=g * g,
+                        dtype=jnp.float32)
+        return z + dz.astype(z.dtype), n + dn.astype(n.dtype), margins
+
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(P(), P(), P(), P("d"), P("d")),
+                   out_specs=(P("d"), P("d"), P()))
+    return jax.jit(fn)
+
+
+def _ftrl_dense_batch_step_factory(mesh, alpha, beta, l1, l2):
+    """Batched-update twin of the dense program (see the sparse batch
+    factory's docstring for semantics)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def weights(z, n):
+        return _ftrl_weights(z, n, alpha, beta, l1, l2)
+
+    def shard_fn(X, y, z, n):
+        w = weights(z, n)
+        margins = jax.lax.psum(X @ w, "d")
+        p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margins, -35.0, 35.0)))
+        g = (p - y)[:, None] * X                       # (B, shard)
+        sigma = (jnp.sqrt(n[None, :] + g * g) - jnp.sqrt(n[None, :])) / alpha
+        z = z + (g - sigma * w[None, :]).sum(0)
+        n = n + (g * g).sum(0)
+        return z, n, margins
+
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(P(None, "d"), P(), P("d"), P("d")),
                    out_specs=(P("d"), P("d"), P()))
     return jax.jit(fn)
 
@@ -162,6 +308,11 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
     TIME_INTERVAL = ParamInfo("time_interval", float, default=1.0)
     VECTOR_SIZE = ParamInfo("vector_size", int, default=0)
     WITH_INTERCEPT = ParamInfo("with_intercept", bool, default=True)
+    # "sample" = reference-strict per-sample scan; "batch" = fused
+    # per-micro-batch updates (gradients at pre-batch weights) — the
+    # TPU-first high-throughput mode, exact for collision-free batches
+    UPDATE_MODE = ParamInfo("update_mode", str, default="sample",
+                            validator=InValidator(["sample", "batch"]))
 
     def __init__(self, initial_model: Optional[BatchOperator] = None,
                  params: Optional[Params] = None, **kwargs):
@@ -196,12 +347,25 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
 
         dim = init.coef.shape[0]            # includes intercept slot if any
         dim_pad = -(-dim // n_dev) * n_dev  # feature ranges, one per device
+        batch_mode = (self.params._m.get("update_mode", "sample") == "batch")
+        allow_fb = [True]    # cleared once the state commits to std layout
         sparse_step = [None]                # built lazily (sparse input only)
         _dense, weights_fn = _ftrl_step_factory(mesh, alpha, beta, l1, l2)
+        if batch_mode:
+            _dense = _ftrl_dense_batch_step_factory(mesh, alpha, beta, l1, l2)
         dense_step = [_dense]
 
-        def snapshot(z_host: np.ndarray, n_host: np.ndarray) -> MTable:
-            w = np.asarray(weights_fn(z_host, n_host))[:dim]
+        def snapshot(z_host: np.ndarray, n_host: np.ndarray,
+                     fb_S: Optional[int] = None) -> MTable:
+            w_full = np.asarray(weights_fn(z_host, n_host))
+            if fb_S is None:
+                w = w_full[:dim]
+            elif has_icpt:
+                # fb layout: [intercept field (S slots, only slot 0 used)]
+                # then the original field-major feature space
+                w = np.concatenate([w_full[:1], w_full[fb_S:fb_S + dim - 1]])
+            else:
+                w = w_full[:dim]
             m = LinearModelData(
                 model_name="FTRL", linear_model_type=LinearModelType.LR,
                 has_intercept=init.has_intercept, vector_col=init.vector_col,
@@ -245,6 +409,27 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                     f"sparse feature index {hi} out of range for the "
                     f"warm-start model (dim {dim}); the dense path fails "
                     f"loudly on the same input")
+            if batch_mode and allow_fb[0]:
+                # field-aware-hashed rows route to the one-hot MXU program
+                # (random gather/scatter is the TPU bottleneck of both
+                # element-addressed modes — see _ftrl_fb_batch_step_factory)
+                from ....ops.fieldblock import FieldBlockMeta, detect_fieldblock
+                fbd = detect_fieldblock(idx0, val0,
+                                        dim - (1 if has_icpt else 0))
+                if fbd is not None:
+                    fb_local, fb_val, meta0 = fbd
+                    F_aug = meta0.num_fields + (1 if has_icpt else 0)
+                    if F_aug % n_dev == 0:
+                        fbi = np.zeros((batch_size, F_aug), np.int32)
+                        fbv = np.zeros((batch_size, F_aug), np.float64)
+                        c0 = 1 if has_icpt else 0
+                        if has_icpt:
+                            fbv[:b, 0] = 1.0   # intercept field, local 0
+                        fbi[:b, c0:] = fb_local
+                        fbv[:b, c0:] = (1.0 if fb_val is None else fb_val)
+                        meta = FieldBlockMeta(F_aug, meta0.field_size)
+                        return ("fb", fbi, fbv,
+                                labels(mt, b, batch_size), meta)
             if has_icpt:
                 idx0 = np.concatenate(
                     [np.zeros((b, 1), idx0.dtype), idx0 + 1], axis=1)
@@ -263,13 +448,29 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             feat_shard = NamedSharding(mesh, P("d"))
-            z0 = np.zeros(dim_pad)
-            n0 = np.zeros(dim_pad)
-            # warm start: z encodes the initial weights (w = -z*decay^-1
-            # inverse at n=0: z = -w*(beta/alpha + l2))
-            z0[:dim] = -np.asarray(init.coef) * (beta / alpha + l2)
-            z = jax.device_put(z0, feat_shard)
-            n = jax.device_put(n0, feat_shard)
+            scale = beta / alpha + l2   # z = -w*(beta/alpha + l2) at n=0:
+            # the warm start encodes the initial weights into z
+
+            def alloc(layout, fb_S=None):
+                if layout == "fb":
+                    dim_state = ((dim - 1 if has_icpt else dim) +
+                                 (fb_S if has_icpt else 0))
+                else:
+                    dim_state = dim_pad
+                z0 = np.zeros(dim_state)
+                coef = np.asarray(init.coef)
+                if layout == "fb" and has_icpt:
+                    z0[0] = -coef[0] * scale
+                    z0[fb_S:fb_S + dim - 1] = -coef[1:] * scale
+                else:
+                    z0[:dim] = -coef * scale
+                return (jax.device_put(z0, feat_shard),
+                        jax.device_put(np.zeros(dim_state), feat_shard))
+
+            z = n = None
+            layout = None                # "std" | "fb", fixed by first batch
+            fb_S = None
+            fb_meta = None
             batch_size = None
             next_emit = None
             width = 8
@@ -281,21 +482,65 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 if next_emit is None:
                     next_emit = (np.floor(t / interval) + 1) * interval
                 enc = encode(mt, max(batch_size, mt.num_rows), width)
-                if enc[0] == "dense":
+                if enc[0] == "fb" and layout in (None, "fb"):
+                    _, fbi, fbv, y, meta = enc
+                    if layout is None:
+                        layout, fb_S = "fb", meta.field_size
+                        fb_meta = meta
+                        z, n = alloc(layout, fb_S)
+                        sparse_step[0] = _ftrl_fb_batch_step_factory(
+                            mesh, meta, alpha, beta, l1, l2)
+                    elif (meta.num_fields != fb_meta.num_fields or
+                          meta.field_size != fb_meta.field_size):
+                        # a different row width can re-detect with a
+                        # different (F, S) — feeding it to the step compiled
+                        # for the committed meta would corrupt state slots
+                        raise ValueError(
+                            f"FTRL stream's field-blocked layout changed "
+                            f"mid-stream: committed (F={fb_meta.num_fields}, "
+                            f"S={fb_meta.field_size}), batch detected "
+                            f"(F={meta.num_fields}, S={meta.field_size})")
+                    z, n, _ = sparse_step[0](fbi, fbv, y, z, n)
+                elif enc[0] == "dense":
+                    if layout == "fb":
+                        raise ValueError(
+                            "FTRL stream switched from field-blocked to "
+                            "dense rows mid-stream; state layouts are "
+                            "incompatible")
+                    if layout is None:
+                        layout = "std"
+                        allow_fb[0] = False
+                        z, n = alloc(layout)
                     _, X, y = enc
                     z, n, _ = dense_step[0](X, y, z, n)
                 else:
+                    if layout == "fb":
+                        raise ValueError(
+                            "FTRL stream switched from field-blocked to "
+                            "generic sparse rows mid-stream; state layouts "
+                            "are incompatible")
+                    if layout is None:
+                        layout = "std"
+                        allow_fb[0] = False
+                        z, n = alloc(layout)
                     _, idx, val, y, width = enc
                     if sparse_step[0] is None:
-                        sparse_step[0] = _ftrl_sparse_step_factory(
-                            mesh, alpha, beta, l1, l2)
+                        sparse_step[0] = (
+                            _ftrl_sparse_batch_step_factory if batch_mode
+                            else _ftrl_sparse_step_factory)(
+                                mesh, alpha, beta, l1, l2)
                     z, n, _ = sparse_step[0](idx, val, y, z, n)
                 if t + 1e-12 >= next_emit:
-                    yield (t, snapshot(z, n))
+                    yield (t, snapshot(z, n, fb_S))
                     while next_emit <= t + 1e-12:
                         next_emit += interval
+            if z is None:
+                # empty stream: emit the warm-start model, as the eager
+                # allocation used to
+                layout = "std"
+                z, n = alloc(layout)
             yield (next_emit if next_emit is not None else interval,
-                   snapshot(z, n))
+                   snapshot(z, n, fb_S))
 
         self._stream_fn = gen
         return self

@@ -177,6 +177,24 @@ def fb_matvec(fb_idx, coef, meta: FieldBlockMeta, val=None, dtype=None):
     return jnp.einsum("nfl,nfl->n", rows, Bv)
 
 
+def fb_gather(fb_idx, vec, meta: FieldBlockMeta, dtype=None):
+    """out[i, k] = vec[k*S + fb_idx[i,k]] — per-field value selection as
+    one-hot MXU matmuls (the gather XLA would otherwise serialize).
+
+    Same factored kernel as :func:`fb_matvec` but keeping the field axis
+    instead of dotting it away; batched FTRL uses it to read the per-slot
+    (n, w) state without a random gather. Defaults to f32 operands: a
+    selection must return the value exactly, unlike the matvec whose bf16
+    operand rounding is amortized by f32 accumulation over the contraction."""
+    import jax.numpy as jnp
+    dtype = dtype or jnp.float32
+    A, B = _parts(fb_idx, meta)
+    W = _w3(vec, meta).astype(dtype)
+    rows = jnp.einsum("nfh,fhl->nfl", A.astype(dtype), W,
+                      preferred_element_type=jnp.float32)
+    return jnp.einsum("nfl,nfl->nf", rows, B.astype(jnp.float32))
+
+
 def fb_rmatvec(fb_idx, c, meta: FieldBlockMeta, val=None, dtype=None):
     """grad = X^T c for the field-blocked design matrix — scatter-free.
 

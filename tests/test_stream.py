@@ -319,3 +319,164 @@ def test_stream_eval_single_class_window_full_schema():
         assert m["TotalSamples"] > 0
         assert m["TruePositive"] + m["FalseNegative"] == m["TotalSamples"]
         assert 0.0 <= m["Accuracy"] <= 1.0
+
+
+def _disjoint_sparse_fixture(n, dim, nnz, seed):
+    """Rows with pairwise-disjoint feature sets inside every 8-row batch:
+    row i in a batch uses its own contiguous feature block."""
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim)
+    block = dim // 8
+    vecs, ys = [], []
+    for i in range(n):
+        base = (i % 8) * block
+        idx = np.sort(rng.choice(block, nnz, replace=False)) + base
+        val = rng.randn(nnz)
+        y = int(float(val @ w[idx]) > 0)
+        vecs.append("$%d$" % dim + " ".join(
+            f"{j}:{v:.6f}" for j, v in zip(idx, val)))
+        ys.append(y)
+    return MTable({"vec": np.asarray(vecs, object),
+                   "label": np.asarray(ys, np.int64)})
+
+
+def _ftrl_final_coef(table, warm, batch_size, mode):
+    from alink_tpu.operator.common.linear.base import LinearModelDataConverter
+    ftrl = FtrlTrainStreamOp(
+        warm, label_col="label", vector_col="vec", alpha=0.5,
+        l1=0.001, l2=0.001, time_interval=1e9,
+        update_mode=mode).link_from(MemSourceStreamOp(table,
+                                                      batch_size=batch_size))
+    final = list(ftrl.micro_batches())[-1]
+    lt = final.schema.types[2]
+    return LinearModelDataConverter(lt).load_model(final).coef
+
+
+def test_ftrl_batch_mode_exact_on_disjoint_batches():
+    """update_mode="batch" computes every gradient at pre-batch weights;
+    when the rows of a batch touch pairwise-disjoint features no state is
+    shared inside the batch, so it must EQUAL the strict per-sample scan."""
+    dim = 64
+    table = _disjoint_sparse_fixture(n=128, dim=dim, nnz=3, seed=7)
+    # no intercept: the intercept slot is shared by every row, which would
+    # make every batch colliding by construction
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=3,
+        with_intercept=False).link_from(
+        MemSourceBatchOp(_sparse_lr_fixture(64, dim, 4, 1)))
+    c_sample = _ftrl_final_coef(table, warm, 8, "sample")
+    c_batch = _ftrl_final_coef(table, warm, 8, "batch")
+    np.testing.assert_allclose(c_batch, c_sample, rtol=1e-9, atol=1e-12)
+
+
+def test_ftrl_batch_mode_quality_with_collisions():
+    """On ordinary (colliding) sparse data the batched trajectory is an
+    approximation — it must stay close to the strict one and train a
+    usable model."""
+    dim = 2048          # realistic CTR regime: dim >> batch * nnz, so
+    # intra-batch feature collisions are rare and the batched trajectory
+    # tracks the strict one closely
+    table = _sparse_lr_fixture(n=1024, dim=dim, nnz=5, seed=11)
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=3).link_from(
+        MemSourceBatchOp(table.first_n(64)))
+    c_sample = _ftrl_final_coef(table, warm, 128, "sample")
+    c_batch = _ftrl_final_coef(table, warm, 128, "batch")
+    # same sign structure and magnitude ballpark, not bitwise equality
+    denom = np.abs(c_sample).max()
+    assert denom > 0
+    assert np.abs(c_batch - c_sample).max() / denom < 0.35
+    big = np.abs(c_sample) > 0.2 * denom
+    assert (np.sign(c_batch[big]) == np.sign(c_sample[big])).all()
+
+
+def test_ftrl_batch_mode_dense_path():
+    """update_mode="batch" on dense feature columns trains a usable model
+    through the fused dense program."""
+    table = _make_lr_fixture(n=600, seed=31)
+    weak = LogisticRegressionTrainBatchOp(
+        feature_cols=["f0", "f1", "f2"], label_col="label",
+        max_iter=1).link_from(MemSourceBatchOp(table.first_n(24)))
+    ftrl = FtrlTrainStreamOp(
+        weak, label_col="label", feature_cols=["f0", "f1", "f2"],
+        alpha=1.0, time_interval=1e9, update_mode="batch").link_from(
+        MemSourceStreamOp(table, batch_size=64))
+    final_model = list(ftrl.micro_batches())[-1]
+    scored = LogisticRegressionPredictBatchOp(prediction_col="p").link_from(
+        MemSourceBatchOp(final_model), MemSourceBatchOp(table))
+    acc = np.mean(np.asarray(scored.get_output_table().col("p"))
+                  == np.asarray(table.col("label")))
+    assert acc > 0.85
+
+
+def _field_aware_fixture(n, F, S, seed, unit_vals=False):
+    """Field-aware-hashed sparse rows: exactly one slot per field, field k's
+    global indices in [k*S, (k+1)*S) — the layout FeatureHasher
+    field_aware=True emits."""
+    rng = np.random.RandomState(seed)
+    dim = F * S
+    w = rng.randn(dim)
+    vecs, ys = [], []
+    for _ in range(n):
+        local = rng.randint(0, S, F)
+        idx = local + np.arange(F) * S
+        val = np.ones(F) if unit_vals else rng.randn(F)
+        y = int(float(val @ w[idx]) > 0)
+        vecs.append("$%d$" % dim + " ".join(
+            f"{j}:{v:.6f}" for j, v in zip(idx, val)))
+        ys.append(y)
+    return MTable({"vec": np.asarray(vecs, object),
+                   "label": np.asarray(ys, np.int64)})
+
+
+def test_ftrl_fb_batch_matches_coo_batch(monkeypatch):
+    """Field-aware input in update_mode="batch" routes to the one-hot MXU
+    program; its model must match the element-addressed COO batch program
+    (same math, different kernels — f32 vs f64 tolerance)."""
+    import alink_tpu.ops.fieldblock as fb_mod
+    import alink_tpu.operator.stream.onlinelearning.ftrl as ftrl_mod
+
+    F, S = 7, 16                      # +1 intercept field -> 8 | 8-dev mesh
+    table = _field_aware_fixture(n=512, F=F, S=S, seed=13)
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=3).link_from(
+        MemSourceBatchOp(table.first_n(64)))
+
+    engaged = {"fb": 0}
+    orig = ftrl_mod._ftrl_fb_batch_step_factory
+
+    def spy(*a, **k):
+        engaged["fb"] += 1
+        return orig(*a, **k)
+
+    monkeypatch.setattr(ftrl_mod, "_ftrl_fb_batch_step_factory", spy)
+    c_fb = _ftrl_final_coef(table, warm, 64, "batch")
+    assert engaged["fb"] == 1, "field-blocked fast path did not engage"
+
+    # same data through the COO batch program (detection disabled)
+    monkeypatch.setattr(fb_mod, "detect_fieldblock", lambda *a, **k: None)
+    c_coo = _ftrl_final_coef(table, warm, 64, "batch")
+    np.testing.assert_allclose(c_fb, c_coo, rtol=5e-4, atol=5e-5)
+    assert np.abs(c_fb).max() > 0
+
+
+def test_ftrl_empty_stream_emits_warm_start():
+    """A stream with no rows still emits the warm-start model snapshot
+    (state is lazily allocated, but the final emit must not crash)."""
+    from alink_tpu.operator.common.linear.base import LinearModelDataConverter
+    table = _make_lr_fixture(n=100, seed=2)
+    warm = LogisticRegressionTrainBatchOp(
+        feature_cols=["f0", "f1", "f2"], label_col="label",
+        max_iter=5).link_from(MemSourceBatchOp(table))
+    empty = MTable({c: np.asarray([], float) for c in ("f0", "f1", "f2")}
+                   | {"label": np.asarray([], np.int64)})
+    ftrl = FtrlTrainStreamOp(
+        warm, label_col="label", feature_cols=["f0", "f1", "f2"],
+        time_interval=1e9).link_from(MemSourceStreamOp(empty, batch_size=8))
+    snaps = list(ftrl.micro_batches())
+    assert len(snaps) == 1
+    lt = snaps[0].schema.types[2]
+    coef = LinearModelDataConverter(lt).load_model(snaps[0]).coef
+    warm_coef = LinearModelDataConverter(lt).load_model(
+        warm.get_output_table()).coef
+    np.testing.assert_allclose(coef, warm_coef, rtol=1e-9)
