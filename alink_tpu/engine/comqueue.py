@@ -161,6 +161,18 @@ class IterativeComQueue:
         parts: Dict[str, Any] = {}
         totals: Dict[str, int] = {}
         for k, arr in self._partitioned.items():
+            if isinstance(arr, jax.Array):
+                # already device-resident (e.g. precomputed one-hot design
+                # factors): pad on device — np.asarray would round-trip
+                # GBs through the host
+                totals[k] = int(arr.shape[0])
+                pad = (-arr.shape[0]) % nw
+                if pad:
+                    arr = jnp.concatenate(
+                        [arr, jnp.zeros((pad, *arr.shape[1:]), arr.dtype)],
+                        axis=0)
+                parts[k] = arr
+                continue
             arr = np.asarray(arr)
             totals[k] = int(arr.shape[0])
             pad = (-arr.shape[0]) % nw

@@ -176,6 +176,14 @@ LOSS_REGISTRY = {
 # design-matrix ops over a data shard
 # ---------------------------------------------------------------------------
 
+def _fb_parts(data: Dict):
+    """Precomputed one-hot factors, when the trainer's init superstep
+    materialized them into the shard dict (fb_onehot_parts)."""
+    if "fb_A" in data:
+        return data["fb_A"], data["fb_B"]
+    return None
+
+
 def matvec(data: Dict, coef, fb_meta=None):
     """margins = X @ coef for dense, padded-COO, or field-blocked shard.
 
@@ -189,7 +197,8 @@ def matvec(data: Dict, coef, fb_meta=None):
             raise ValueError("shard has 'fb_idx' but no FieldBlockMeta was "
                              "provided (pass fb_meta= to the objective)")
         from ....ops.fieldblock import fb_matvec
-        return fb_matvec(data["fb_idx"], coef, fb_meta, val=data.get("fb_val"))
+        return fb_matvec(data["fb_idx"], coef, fb_meta, val=data.get("fb_val"),
+                         parts=_fb_parts(data))
     return (data["val"] * coef[data["idx"]]).sum(-1)
 
 
@@ -206,7 +215,8 @@ def rmatvec(data: Dict, c, dim: int, fb_meta=None):
             raise ValueError("shard has 'fb_idx' but no FieldBlockMeta was "
                              "provided (pass fb_meta= to the objective)")
         from ....ops.fieldblock import fb_rmatvec
-        return fb_rmatvec(data["fb_idx"], c, fb_meta, val=data.get("fb_val"))
+        return fb_rmatvec(data["fb_idx"], c, fb_meta, val=data.get("fb_val"),
+                          parts=_fb_parts(data))
     contrib = data["val"] * c[:, None]
     return jnp.zeros(dim, contrib.dtype).at[data["idx"].reshape(-1)].add(
         contrib.reshape(-1))

@@ -214,7 +214,8 @@ def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2):
     field-aware hashed (exactly one slot per field per row,
     ops/fieldblock.py), every state access becomes a factored one-hot MXU
     matmul instead: per-slot (n, w) reads via :func:`fb_gather`, margin
-    via :func:`fb_matvec`, and the update scatter via :func:`fb_rmatvec`.
+    margins from the same gathered slots, and the update scatter via
+    :func:`fb_rmatvec`.
     Same batched-update semantics as the COO batch factory (gradients at
     pre-batch weights; exact for collision-free batches).
 
@@ -228,8 +229,7 @@ def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2):
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    from ....ops.fieldblock import (FieldBlockMeta, fb_gather, fb_matvec,
-                                    fb_rmatvec)
+    from ....ops.fieldblock import FieldBlockMeta, fb_gather, fb_rmatvec
 
     n_dev = mesh.devices.size
     if meta.num_fields % n_dev:
@@ -247,12 +247,13 @@ def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2):
         idx_l = jax.lax.dynamic_slice_in_dim(fb_idx, k0, F_loc, 1)
         val_l = jax.lax.dynamic_slice_in_dim(val, k0, F_loc, 1)
         w = weights(z, n)
-        margins = jax.lax.psum(
-            fb_matvec(idx_l, w, local_meta, val=val_l), "d")
-        p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margins, -35.0, 35.0)))
-        g = (p - y)[:, None] * val_l                        # (B, F_loc)
         nj = fb_gather(idx_l, n, local_meta)
         wj = fb_gather(idx_l, w, local_meta)
+        # margins from the exact f32 per-slot gather — a separate fb_matvec
+        # would redo the same one-hot pass with bf16 operand rounding
+        margins = jax.lax.psum((val_l * wj).sum(-1), "d")
+        p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margins, -35.0, 35.0)))
+        g = (p - y)[:, None] * val_l                        # (B, F_loc)
         sigma = (jnp.sqrt(nj + g * g) - jnp.sqrt(nj)) / alpha
         ones = jnp.ones_like(y)
         dz = fb_rmatvec(idx_l, ones, local_meta, val=g - sigma * wj,
@@ -467,8 +468,26 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 return (jax.device_put(z0, feat_shard),
                         jax.device_put(np.zeros(dim_state), feat_shard))
 
+            def fb_to_std_state(z_fb, n_fb):
+                """Exact fb -> std state translation: the fb layout is
+                [intercept field (slot 0 only)] + the original field-major
+                feature space, so dropping the intercept field's unused
+                slots loses nothing."""
+                zh, nh = np.asarray(z_fb), np.asarray(n_fb)
+                z0 = np.zeros(dim_pad)
+                n0 = np.zeros(dim_pad)
+                if has_icpt:
+                    z0[0], n0[0] = zh[0], nh[0]
+                    z0[1:dim] = zh[fb_S:fb_S + dim - 1]
+                    n0[1:dim] = nh[fb_S:fb_S + dim - 1]
+                else:
+                    z0[:dim] = zh[:dim]
+                    n0[:dim] = nh[:dim]
+                return (jax.device_put(z0, feat_shard),
+                        jax.device_put(n0, feat_shard))
+
             z = n = None
-            layout = None                # "std" | "fb", fixed by first batch
+            layout = None                # "std" | "fb"
             fb_S = None
             fb_meta = None
             batch_size = None
@@ -482,7 +501,19 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 if next_emit is None:
                     next_emit = (np.floor(t / interval) + 1) * interval
                 enc = encode(mt, max(batch_size, mt.num_rows), width)
-                if enc[0] == "fb" and layout in (None, "fb"):
+                if layout == "fb" and (
+                        enc[0] != "fb" or
+                        enc[4].num_fields != fb_meta.num_fields or
+                        enc[4].field_size != fb_meta.field_size):
+                    # the first batch's detection was coincidental (or the
+                    # row shape changed): demote the state to the generic
+                    # layout — an exact translation — and stay there
+                    z, n = fb_to_std_state(z, n)
+                    layout, fb_S, fb_meta = "std", None, None
+                    allow_fb[0] = False
+                    sparse_step[0] = None
+                    enc = encode(mt, max(batch_size, mt.num_rows), width)
+                if enc[0] == "fb":
                     _, fbi, fbv, y, meta = enc
                     if layout is None:
                         layout, fb_S = "fb", meta.field_size
@@ -490,23 +521,8 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                         z, n = alloc(layout, fb_S)
                         sparse_step[0] = _ftrl_fb_batch_step_factory(
                             mesh, meta, alpha, beta, l1, l2)
-                    elif (meta.num_fields != fb_meta.num_fields or
-                          meta.field_size != fb_meta.field_size):
-                        # a different row width can re-detect with a
-                        # different (F, S) — feeding it to the step compiled
-                        # for the committed meta would corrupt state slots
-                        raise ValueError(
-                            f"FTRL stream's field-blocked layout changed "
-                            f"mid-stream: committed (F={fb_meta.num_fields}, "
-                            f"S={fb_meta.field_size}), batch detected "
-                            f"(F={meta.num_fields}, S={meta.field_size})")
                     z, n, _ = sparse_step[0](fbi, fbv, y, z, n)
                 elif enc[0] == "dense":
-                    if layout == "fb":
-                        raise ValueError(
-                            "FTRL stream switched from field-blocked to "
-                            "dense rows mid-stream; state layouts are "
-                            "incompatible")
                     if layout is None:
                         layout = "std"
                         allow_fb[0] = False
@@ -514,11 +530,6 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                     _, X, y = enc
                     z, n, _ = dense_step[0](X, y, z, n)
                 else:
-                    if layout == "fb":
-                        raise ValueError(
-                            "FTRL stream switched from field-blocked to "
-                            "generic sparse rows mid-stream; state layouts "
-                            "are incompatible")
                     if layout is None:
                         layout = "std"
                         allow_fb[0] = False

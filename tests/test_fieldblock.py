@@ -320,3 +320,39 @@ class TestTrainerIntegration:
         preds = [r[-1] for r in pred.collect()]
         acc = np.mean([str(a) == str(b) for a, b in zip(preds, labels)])
         assert acc > 0.9, acc
+
+
+def test_fb_onehot_precompute_parity(monkeypatch):
+    """Coefficients with the precomputed one-hot factors (init-superstep
+    fb_A/fb_B carry) must equal the inline-one-hot run bit-for-bit — the
+    same einsums over the same operand values, built once vs per pass."""
+    import numpy as np
+    from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                         UnaryLossObjFunc)
+    from alink_tpu.operator.common.optim.optimizers import (OptimParams,
+                                                            optimize)
+    from alink_tpu.ops.fieldblock import FieldBlockMeta
+
+    rng = np.random.RandomState(0)
+    F, S = 4, 16
+    meta = FieldBlockMeta(F, S)
+    n = 256
+    fb_idx = rng.randint(0, S, (n, F)).astype(np.int32)
+    w_true = rng.randn(meta.dim)
+    flat = fb_idx + np.arange(F, dtype=np.int32)[None, :] * S
+    y = np.where(w_true[flat].sum(1) > 0, 1.0, -1.0).astype(np.float32)
+    data = {"fb_idx": fb_idx, "y": y, "w": np.ones(n, np.float32)}
+
+    def run():
+        obj = UnaryLossObjFunc(LogLossFunc(), meta.dim, l2=1e-3, fb_meta=meta)
+        coef, _, _ = optimize(obj, data,
+                              OptimParams(method="LBFGS", max_iter=8,
+                                          epsilon=0.0))
+        return np.asarray(coef)
+
+    monkeypatch.setenv("ALINK_TPU_FB_ONEHOT_BYTES", "0")     # disabled
+    c_off = run()
+    monkeypatch.setenv("ALINK_TPU_FB_ONEHOT_BYTES", "6e9")   # enabled
+    c_on = run()
+    np.testing.assert_array_equal(c_on, c_off)
+    assert np.abs(c_on).max() > 0

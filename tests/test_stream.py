@@ -480,3 +480,30 @@ def test_ftrl_empty_stream_emits_warm_start():
     warm_coef = LinearModelDataConverter(lt).load_model(
         warm.get_output_table()).coef
     np.testing.assert_allclose(coef, warm_coef, rtol=1e-9)
+
+
+def test_ftrl_fb_demotes_to_generic_midstream():
+    """A coincidental field-blocked detection on the first batch must not
+    kill the stream when later generic batches arrive: the state demotes
+    to the generic layout (an exact translation) and training continues."""
+    from alink_tpu.operator.common.linear.base import LinearModelDataConverter
+    F, S = 7, 16
+    dim = F * S
+    fb_part = _field_aware_fixture(n=64, F=F, S=S, seed=3, unit_vals=True)
+    generic = _sparse_lr_fixture(n=64, dim=dim, nnz=3, seed=4)
+    mixed = MTable(
+        {"vec": np.concatenate([np.asarray(fb_part.col("vec"), object),
+                                np.asarray(generic.col("vec"), object)]),
+         "label": np.concatenate([np.asarray(fb_part.col("label")),
+                                  np.asarray(generic.col("label"))])})
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=3).link_from(
+        MemSourceBatchOp(generic))
+    ftrl = FtrlTrainStreamOp(
+        warm, label_col="label", vector_col="vec", alpha=0.5,
+        time_interval=1e9, update_mode="batch").link_from(
+        MemSourceStreamOp(mixed, batch_size=32))
+    final = list(ftrl.micro_batches())[-1]    # must not raise
+    lt = final.schema.types[2]
+    coef = LinearModelDataConverter(lt).load_model(final).coef
+    assert np.isfinite(coef).all() and np.abs(coef).max() > 0
