@@ -3,23 +3,24 @@
 The reference dispatches its hot loops to native BLAS through JNI
 (common/linalg/BLAS.java:10-26) and hand-written Java inner loops
 (per-sample gradient loops in common/optim/subfunc/CalcGradient.java:27-54).
-On TPU the equivalents are XLA programs shaped for the MXU plus Pallas
-kernels where XLA's default lowering is wrong for the access pattern —
-most importantly random gather/scatter, which XLA serializes on TPU.
+On TPU the equivalents are XLA programs shaped for the MXU — most
+importantly replacing random gather/scatter, which XLA serializes on TPU,
+with factored one-hot matmuls (a hand-written Pallas variant measured
+slower than the precomputed-operand einsum path and was removed; see the
+design note in fieldblock.py).
 
 `fieldblock` implements the field-blocked sparse format and its
 factored-one-hot matvec/rmatvec — the TPU answer to the reference's
 SparseVector dot/axpy hot loops.
 """
 
-from .fieldblock import (FieldBlockMeta, detect_fieldblock, fb_fused_grad,
-                         fb_fused_grad_pallas, fb_matvec, fb_matvec_pallas,
-                         fb_pallas_ok, fb_rmatvec, fb_to_flat_indices,
-                         flat_to_fb_indices, hash_to_fields)
+from .fieldblock import (FieldBlockMeta, detect_fieldblock, fb_gather,
+                         fb_matvec, fb_onehot_parts, fb_rmatvec,
+                         fb_to_flat_indices, flat_to_fb_indices,
+                         hash_to_fields)
 
 __all__ = [
     "FieldBlockMeta", "detect_fieldblock", "fb_matvec", "fb_rmatvec",
-    "fb_fused_grad", "fb_matvec_pallas", "fb_pallas_ok",
-    "fb_fused_grad_pallas", "fb_to_flat_indices", "flat_to_fb_indices",
-    "hash_to_fields",
+    "fb_gather", "fb_onehot_parts", "fb_to_flat_indices",
+    "flat_to_fb_indices", "hash_to_fields",
 ]

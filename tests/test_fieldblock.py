@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from alink_tpu.ops.fieldblock import (LO, FieldBlockMeta,
-                                      fb_fused_grad_pallas, fb_matvec,
+                                      fb_matvec,
                                       fb_rmatvec, fb_to_flat_indices,
                                       flat_to_fb_indices, hash_to_fields)
 
@@ -117,102 +117,6 @@ class TestFormat:
     def test_meta_validation(self):
         with pytest.raises(ValueError):
             FieldBlockMeta(2, 17)
-
-
-class TestPallasFused:
-    def test_fused_grad_interpret(self):
-        """The fused Pallas kernel in interpreter mode vs numpy."""
-        import jax.numpy as jnp
-        rng = np.random.RandomState(3)
-        meta = FieldBlockMeta(num_fields=2, field_size=32)
-        n, CH = 16, 8
-        fb_idx = rng.randint(0, meta.field_size, (n, meta.num_fields)).astype(np.int32)
-        y = np.where(rng.rand(n) < 0.5, 1.0, -1.0).astype(np.float32)
-        w = np.ones(n, np.float32)
-        coef = rng.randn(meta.dim).astype(np.float32)
-
-        def deriv_and_loss(eta, yv, wv):
-            import jax
-            c = wv * (-yv * jax.nn.sigmoid(-yv * eta))
-            loss = wv * jnp.logaddexp(0.0, -yv * eta)
-            return c, loss
-
-        g, eta, loss = fb_fused_grad_pallas(
-            jnp.asarray(fb_idx.T.copy()), jnp.asarray(y), jnp.asarray(w),
-            jnp.asarray(coef), meta, deriv_and_loss, chunk=CH, interpret=True)
-
-        flat = fb_to_flat_indices(fb_idx, meta)
-        eta_ref = coef[flat].sum(-1)
-        c_ref = w * (-y / (1.0 + np.exp(y * eta_ref)))
-        g_ref = np.zeros(meta.dim, np.float32)
-        np.add.at(g_ref, flat.reshape(-1), np.repeat(c_ref, meta.num_fields))
-        np.testing.assert_allclose(np.asarray(eta), eta_ref, rtol=2e-2, atol=1e-2)
-        np.testing.assert_allclose(np.asarray(g), g_ref, rtol=2e-2, atol=2e-2)
-        assert abs(float(loss) - (w * np.logaddexp(0, -y * eta_ref)).sum()) < 1.0
-
-
-class TestPallasFusedV2:
-    """LANE=128-layout kernels (fb_fused_grad / fb_matvec_pallas) in
-    interpreter mode vs numpy, covering val-weighting, padding (n not a
-    multiple of chunk) and empty shards."""
-
-    def _dal(self, eta, yv, wv):
-        import jax
-        import jax.numpy as jnp
-        c = wv * (-yv * jax.nn.sigmoid(-yv * eta))
-        return c, wv * jnp.logaddexp(0.0, -yv * eta)
-
-    @pytest.mark.parametrize("with_val", [False, True])
-    def test_fused_grad_and_matvec(self, with_val):
-        import jax.numpy as jnp
-        from alink_tpu.ops.fieldblock import fb_fused_grad, fb_matvec_pallas
-        rng = np.random.RandomState(5)
-        meta = FieldBlockMeta(num_fields=3, field_size=256)
-        n = 700  # not a multiple of the 512-row chunk -> exercises padding
-        fb_idx = rng.randint(0, meta.field_size, (n, meta.num_fields)).astype(np.int32)
-        y = np.where(rng.rand(n) < 0.5, 1.0, -1.0).astype(np.float32)
-        w = rng.rand(n).astype(np.float32)
-        coef = rng.randn(meta.dim).astype(np.float32)
-        val = rng.rand(n, meta.num_fields).astype(np.float32) if with_val else None
-
-        g, eta, loss = fb_fused_grad(jnp.asarray(fb_idx), jnp.asarray(y),
-                                     jnp.asarray(w), jnp.asarray(coef), meta,
-                                     self._dal,
-                                     val=None if val is None else jnp.asarray(val),
-                                     chunk=512, interpret=True)
-
-        flat = fb_to_flat_indices(fb_idx, meta)
-        vnp = np.ones((n, meta.num_fields), np.float32) if val is None else val
-        eta_ref = (coef[flat] * vnp).sum(-1)
-        c_ref = w * (-y / (1.0 + np.exp(y * eta_ref)))
-        g_ref = np.zeros(meta.dim, np.float32)
-        np.add.at(g_ref, flat.reshape(-1), (c_ref[:, None] * vnp).reshape(-1))
-        np.testing.assert_allclose(np.asarray(eta), eta_ref, rtol=1e-4, atol=1e-4)
-        np.testing.assert_allclose(np.asarray(g), g_ref, rtol=1e-4, atol=1e-4)
-        loss_ref = (w * np.logaddexp(0, -y * eta_ref)).sum()
-        assert abs(float(loss) - loss_ref) < 1e-2 * max(1.0, abs(loss_ref))
-
-        eta2 = fb_matvec_pallas(jnp.asarray(fb_idx), jnp.asarray(coef), meta,
-                                val=None if val is None else jnp.asarray(val),
-                                chunk=512, interpret=True)
-        np.testing.assert_allclose(np.asarray(eta2), eta_ref, rtol=1e-4, atol=1e-4)
-
-    def test_empty_shard(self):
-        import jax.numpy as jnp
-        from alink_tpu.ops.fieldblock import fb_fused_grad, fb_matvec_pallas
-        meta = FieldBlockMeta(num_fields=2, field_size=128)
-        empty_idx = jnp.zeros((0, 2), jnp.int32)
-        z = jnp.zeros((0,), jnp.float32)
-        coef = jnp.zeros(meta.dim, jnp.float32)
-        g, eta, loss = fb_fused_grad(empty_idx, z, z, coef, meta, self._dal,
-                                     interpret=True)
-        assert g.shape == (meta.dim,) and eta.shape == (0,) and float(loss) == 0.0
-        assert fb_matvec_pallas(empty_idx, coef, meta, interpret=True).shape == (0,)
-
-    def test_pallas_ok_rejects_oversized_tables(self):
-        from alink_tpu.ops.fieldblock import fb_pallas_ok
-        # dim = 32 * 65536 -> 8 MB coef + 8 MB grad: must be rejected
-        assert not fb_pallas_ok(FieldBlockMeta(32, 65536))
 
 
 class TestLbfgsFieldBlocked:
