@@ -169,8 +169,11 @@ def level_hist(binned, stats, node_id, n_nodes: int, n_bins: int,
         s_hi = s32.astype(hdt)
         s_lo = (s32 - s_hi.astype(f32)).astype(hdt)
         s2 = jnp.concatenate([s_hi, s_lo], axis=1)           # (n, 2m)
-        Z = ohB[..., None] * s2[:, None, None, :]
-        h2 = jnp.einsum("in,ifbM->nfbM", ohN, Z,
+        # contract (node-one-hot x stats) FIRST: the (i, n_nodes, 2m)
+        # intermediate is ~KBs/sample, where the old explicit
+        # ohB[..., None] * s2 product materialized an (i, F, bins, 2m)
+        # tensor (~0.5 GB at adult scale) every level
+        h2 = jnp.einsum("in,iM,ifb->nfbM", ohN, s2, ohB,
                         preferred_element_type=f32)
         return (h2[..., :m] + h2[..., m:]).astype(dt)
     flat_idx = (node_id[:, None] * F + jnp.arange(F)[None, :]) * n_bins + binned

@@ -6,7 +6,7 @@ reference's per-sample SparseVector gather/scatter hot loops
 import numpy as np
 import pytest
 
-from alink_tpu.ops.fieldblock import (LO, FieldBlockMeta,
+from alink_tpu.ops.fieldblock import (FieldBlockMeta,
                                       fb_matvec,
                                       fb_rmatvec, fb_to_flat_indices,
                                       flat_to_fb_indices, hash_to_fields)
