@@ -96,6 +96,13 @@ class Harness:
         run(n)
         return time.perf_counter() - t0
 
+    @staticmethod
+    def put(a):
+        """device_put on single-process runs only: host-local committed
+        arrays cannot be resharded by a multi-host mesh jit."""
+        import jax
+        return jax.device_put(a) if jax.process_count() == 1 else a
+
 
 # ---------------------------------------------------------------------------
 # 1. LogReg / Criteo-shape (north star; unchanged methodology from round 1)
@@ -241,9 +248,8 @@ def bench_softmax(h: Harness):
     # the ~188 MB design matrix through the tunnel on every timed call
     # swamps the measured delta. X stays a host array for the CPU
     # baseline below.
-    put = jax.device_put if jax.process_count() == 1 else (lambda a: a)
-    data = {"X": put(X), "y": put(yc.astype(np.float32)),
-            "w": put(np.ones(n, np.float32))}
+    data = {"X": h.put(X), "y": h.put(yc.astype(np.float32)),
+            "w": h.put(np.ones(n, np.float32))}
     iters = 500
     wrng = np.random.RandomState(11)
 
@@ -333,24 +339,37 @@ def bench_ftrl(h: Harness):
                                      l1=1e-5, l2=1e-5)
     shard = NamedSharding(mesh, P("d"))
     zrng = np.random.RandomState(3)
+    sp_idx = h.put(np.stack([p[0] for p in pool]))
+    sp_val = h.put(np.stack([p[1] for p in pool]))
+    sp_y = h.put(np.stack([p[2] for p in pool]))
 
-    def run(n_batches):
+    @jax.jit
+    def strict_pool(sp_idx, sp_val, sp_y, z, nacc):
+        # chain the whole pool in one program: one strict batch is ~35 ms
+        # of device scan; per-batch RPC dispatch would dominate the delta
+        def body(carry, xs):
+            z, nacc = carry
+            z, nacc, m = step(xs[0], xs[1], xs[2], z, nacc)
+            return (z, nacc), m[0]
+        (z, nacc), _ = jax.lax.scan(body, (z, nacc), (sp_idx, sp_val, sp_y))
+        return z, nacc
+
+    def run(n_pools):
         z = jax.device_put(zrng.randn(dim_pad) * 1e-8, shard)
         nacc = jax.device_put(np.zeros(dim_pad), shard)
-        for i in range(n_batches):
-            idx, val, y = pool[i % len(pool)]
-            z, nacc, _ = step(idx, val, y, z, nacc)
+        for _ in range(n_pools):
+            z, nacc = strict_pool(sp_idx, sp_val, sp_y, z, nacc)
         np.asarray(z)
         return z, nacc
 
-    K = 40
+    K = 8                                    # 8 pools = 192 batches
     dt = h.delta(run, K)
-    sps = B * K / dt / h.chips
+    sps = B * len(pool) * K / dt / h.chips
 
     # AUC: train several epochs over the pool, score a held-out batch
     # (one ~98k-sample pass over a 65k-dim model is too little signal to
     # be a meaningful quality number)
-    z, nacc = run(len(pool) * 6)
+    z, nacc = run(6)                         # 6 pool passes = 6 epochs
     w = np.asarray(_ftrl_weights(np.asarray(z), np.asarray(nacc),
                                  0.05, 1.0, 1e-5, 1e-5))[:dim]
     hidx, hval, hy = make_batch(10_001)
@@ -385,9 +404,9 @@ def bench_ftrl(h: Harness):
                                         l1=1e-5, l2=1e-5)
     # pool inputs live on device once — re-shipping ~50 MB of host arrays
     # per call would measure the tunnel, not the program
-    pidx = jax.device_put(np.stack([p[0] for p in fb_pool]))
-    pval = jax.device_put(np.stack([p[1] for p in fb_pool]))
-    py = jax.device_put(np.stack([p[2] for p in fb_pool]))
+    pidx = h.put(np.stack([p[0] for p in fb_pool]))
+    pval = h.put(np.stack([p[1] for p in fb_pool]))
+    py = h.put(np.stack([p[2] for p in fb_pool]))
     fb_shard = NamedSharding(mesh, P("d"))
 
     @jax.jit
@@ -585,10 +604,15 @@ def main():
                      ("ftrl_criteo", bench_ftrl),
                      ("gbdt_adult", bench_gbdt),
                      ("als_movielens", bench_als)):
-        try:
-            r = fn(h)
-        except Exception as e:  # pragma: no cover - keep the bench robust
-            r = {"error": f"{type(e).__name__}: {e}"}
+        r = None
+        for attempt in (1, 2):
+            try:
+                r = fn(h)
+                break
+            except Exception as e:  # pragma: no cover - keep the bench robust
+                # the tunneled device service occasionally drops a request
+                # (e.g. "response body closed") — one retry absorbs it
+                r = {"error": f"{type(e).__name__}: {e}"}
         workloads[name] = r
         print(json.dumps({"workload": name, **r}), flush=True)
 
