@@ -1,0 +1,10 @@
+"""Make the repo root importable when an example runs as a script
+(``python examples/foo.py`` puts examples/, not the repo root, on
+sys.path). Import this before any ``alink_tpu`` import."""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

@@ -3,8 +3,10 @@
 low-rank ratings matrix (MovieLens stand-in; no egress).
 
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-     PYTHONPATH=. python examples/als_example.py
+     python examples/als_example.py
 """
+
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 
 import numpy as np
 
@@ -28,7 +30,7 @@ def synthetic_ratings(n_users=60, n_items=40, rank=4, density=0.3, seed=5):
 
 
 def main():
-    use_local_env(parallelism=8)
+    use_local_env()   # all available devices (8 on the CPU test mesh)
     rows = synthetic_ratings()
     src = MemSourceBatchOp(rows, "user LONG, item LONG, rating DOUBLE")
 

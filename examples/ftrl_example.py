@@ -6,8 +6,10 @@ with hot model reload -> windowed + cumulative streaming eval.
 Synthetic Criteo/avazu-style CTR data (no egress).
 
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-     PYTHONPATH=. python examples/ftrl_example.py
+     python examples/ftrl_example.py
 """
+
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 
 import json
 
@@ -49,7 +51,7 @@ SCHEMA = "site STRING, device STRING, c1 DOUBLE, c2 DOUBLE, click LONG"
 
 
 def main():
-    use_local_env(parallelism=8)
+    use_local_env()   # all available devices (8 on the CPU test mesh)
     batch_data = MemSourceBatchOp(ctr_rows(1500, 1), SCHEMA)
 
     # 1. feature engineering pipeline (fit on the batch data)

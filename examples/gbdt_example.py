@@ -3,8 +3,10 @@
 style mixed numeric features, synthetic — no egress).
 
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-     PYTHONPATH=. python examples/gbdt_example.py
+     python examples/gbdt_example.py
 """
+
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 
 import numpy as np
 
@@ -28,7 +30,7 @@ def adult_like(n=1200, seed=11):
 
 
 def main():
-    use_local_env(parallelism=8)
+    use_local_env()   # all available devices (8 on the CPU test mesh)
     rows = adult_like()
     cut = int(0.8 * len(rows))
     schema = ("age DOUBLE, education_num DOUBLE, hours_per_week DOUBLE, "

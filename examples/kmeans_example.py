@@ -3,8 +3,10 @@
 with a synthetic iris-like fixture instead of the hosted CSV (no egress).
 
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-     PYTHONPATH=. python examples/kmeans_example.py
+     python examples/kmeans_example.py
 """
+
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 
 import numpy as np
 
@@ -30,7 +32,7 @@ def iris_like(n_per: int = 50, seed: int = 7):
 
 
 def main():
-    use_local_env(parallelism=8)
+    use_local_env()   # all available devices (8 on the CPU test mesh)
     data = MemSourceBatchOp(
         iris_like(),
         "sepal_length DOUBLE, sepal_width DOUBLE, petal_length DOUBLE, "

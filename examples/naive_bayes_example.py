@@ -4,8 +4,10 @@ count vectorize -> NaiveBayesText over review text), with a synthetic
 review fixture instead of the hosted CSV (no egress).
 
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-     PYTHONPATH=. python examples/naive_bayes_example.py
+     python examples/naive_bayes_example.py
 """
+
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 
 import numpy as np
 
@@ -38,7 +40,7 @@ def reviews(n: int = 800, seed: int = 11):
 
 
 def main():
-    use_local_env(parallelism=8)
+    use_local_env()   # all available devices (8 on the CPU test mesh)
     rows = reviews()
     split = int(len(rows) * 0.8)
     train = MemSourceBatchOp(rows[:split], "review STRING, label INT")

@@ -3,8 +3,10 @@
 with a synthetic digit-like fixture instead of the hosted CSV (no egress).
 
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
-     PYTHONPATH=. python examples/softmax_mnist_example.py
+     python examples/softmax_mnist_example.py
 """
+
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 
 import numpy as np
 
@@ -32,7 +34,7 @@ def mnist_like(n: int = 1500, d: int = 784, k: int = 10, seed: int = 3):
 
 
 def main():
-    use_local_env(parallelism=8)
+    use_local_env()   # all available devices (8 on the CPU test mesh)
     rows = mnist_like()
     split = int(len(rows) * 0.8)
     train = MemSourceBatchOp(rows[:split], "vec STRING, label INT")
