@@ -91,9 +91,9 @@ def _load_line_bytes(path: str, ignore_first_line: bool,
     def drop_header(b: bytes) -> bytes:
         # quote-aware: a header record containing a quoted embedded newline
         # spans physical lines — skip newlines until quotes are balanced.
-        # A stray unbalanced quote must not swallow data: the continuation
-        # scan is capped (a >64-line header is malformation, not a header),
-        # and past the cap exactly one physical line is dropped.
+        # A stray unbalanced quote must not silently swallow data: the
+        # continuation scan is capped, and past the cap the input is
+        # rejected (a >64-line header is malformation, not a header).
         first_nl = b.find(b"\n")
         if first_nl < 0:
             return b""
@@ -108,7 +108,9 @@ def _load_line_bytes(path: str, ignore_first_line: bool,
             if quotes % 2 == 0:
                 return b[nl + 1:]
             pos = nl + 1
-        return b[first_nl + 1:]
+        raise ValueError(
+            "header record spans >64 physical lines (unbalanced quote?); "
+            "refusing to guess where the header ends")
 
     if path.startswith(("http://", "https://")):
         if shard is not None and shard[1] > 1:

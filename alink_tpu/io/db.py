@@ -132,9 +132,19 @@ class JdbcDB(BaseDB):
         return (sql if self.PARAM_STYLE == "?"
                 else sql.replace("?", self.PARAM_STYLE))
 
+    def _execute(self, cur, sql: str, params: Sequence):
+        """Parameterless statements run VERBATIM: the '?'->PARAM_STYLE
+        rewrite and the driver's %-formatting path must never touch
+        free-form user SQL (a literal '?' or '%' in it would corrupt the
+        statement or raise in the driver's formatter)."""
+        if params:
+            cur.execute(self._sql(sql), tuple(params))
+        else:
+            cur.execute(sql)
+
     def execute(self, sql: str, params: Sequence = ()):
         cur = self.conn.cursor()
-        cur.execute(self._sql(sql), tuple(params))
+        self._execute(cur, sql, params)
         self.conn.commit()
         return cur
 
@@ -145,7 +155,7 @@ class JdbcDB(BaseDB):
 
     def query(self, sql: str, params: Sequence = ()) -> MTable:
         cur = self.conn.cursor()
-        cur.execute(self._sql(sql), tuple(params))
+        self._execute(cur, sql, params)
         names = [d[0] for d in cur.description]
         rows = cur.fetchall()
         cols = {n: [r[i] for r in rows] for i, n in enumerate(names)}

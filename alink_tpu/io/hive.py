@@ -79,6 +79,10 @@ class _HasHiveDB:
     def _warehouse_read(self):
         wh = self._warehouse()
         p = self.params._m
+        if p.get("query"):
+            raise ValueError("query needs the live-server path (host=); the "
+                             "warehouse_dir path reads whole tables — use "
+                             "partitions= to prune, or a downstream Select")
         schema = (TableSchema.parse(p["schema_str"])
                   if p.get("schema_str") else None)
         return wh.read_table(p["input_table_name"],
@@ -86,22 +90,33 @@ class _HasHiveDB:
                              partitions=p.get("partitions"))
 
     def _server_read(self):
-        """Live-server read honoring ``partitions`` as a pushed-down WHERE
-        (comma = OR of alternatives, slash = AND of levels). ``schema_str``
-        is rejected here — the server's schema is authoritative."""
+        """Live-server read honoring ``query`` (free-form SELECT, like
+        DBSourceBatchOp) or ``partitions`` as a pushed-down WHERE (comma =
+        OR of alternatives, slash = AND of levels). ``schema_str`` is
+        rejected here — the server's schema is authoritative."""
         from .hive_warehouse import parse_partitions_param
         p = self.params._m
         if p.get("schema_str"):
             raise ValueError("schema_str only applies to the warehouse_dir "
                              "path; the live server defines the schema")
         db = self._make_db()
+        if p.get("query"):
+            if p.get("partitions"):
+                raise ValueError("query and partitions are mutually "
+                                 "exclusive on the live-server path")
+            return db.query(p["query"])
         alts = parse_partitions_param(p.get("partitions"))
         if not alts:
             return db.read_table(p["input_table_name"])
+        for alt in alts:
+            for k in alt:
+                if not k.replace("_", "").isalnum():
+                    raise ValueError(f"bad partition column name: {k!r}")
         ors = " OR ".join(
-            "(" + " AND ".join(f"{k}='{v}'" for k, v in alt.items()) + ")"
-            for alt in alts)
-        return db.query(f"SELECT * FROM {p['input_table_name']} WHERE {ors}")
+            "(" + " AND ".join(f"{k}=?" for k in alt) + ")" for alt in alts)
+        vals = [v for alt in alts for v in alt.values()]
+        return db.query(
+            f"SELECT * FROM {p['input_table_name']} WHERE {ors}", vals)
 
 
 class HiveSourceBatchOp(_HasHiveDB, DBSourceBatchOp):

@@ -7,26 +7,39 @@ blocks, exchanges factor request/response messages over Flink coGroups
 Cholesky (NormalEquation, :493) inside a Flink loop of
 numIters*numMiniBatches*2 supersteps.
 
-TPU-first shape: factors live as device arrays sharded over the data axis;
-the request/response gather becomes ONE ``lax.all_gather`` of the opposing
-factor block per half-step (the "factor all-gather" north star), and all
-per-row normal equations are solved with ``jnp.linalg.solve`` batched over
-rows — MXU-batched Cholesky solves instead of per-block Java loops.
+TPU-first shape: each worker holds its rating shard device-resident; the
+per-row normal-equation sums are ``lax.psum``'d across the mesh, which
+leaves every worker holding the COMPLETE updated factor matrix — so the
+reference's request/response gather ("factor all-gather") costs nothing
+extra here: the psum of the (A, b) systems is itself the all-gather, and
+the factors ride the carry fully replicated. All per-row normal equations
+are solved with a batched dense solve — MXU-batched instead of per-block
+Java loops.
 
 Accumulating the per-row (A, b) sums is the hot spot: a scatter-add of
 nnz x rank^2 outer products serializes on TPU (~120 ms per side at
 MovieLens-1M scale). Instead each worker's rating rows are pre-sorted by
 the side's id (host-side, once — the ids never change), so every id owns a
 CONTIGUOUS run and its sum is a difference of two prefix sums. The prefix
-is two-level: f32 cumsums WITHIN 512-row blocks (error bounded by the
-block length, ~512*eps, independent of the global magnitude) plus an f64
-cumsum over only the ~nnz/512 block sums — a single global f32 prefix
-would lose ~nnz*eps of every short run, and a full f64 cumsum is slow
-(f64 is emulated on TPU; measured slower than the scatter it replaces).
-Two tiny per-id gathers then replace the million-row scatter.
+is two-level (f32 cumsums WITHIN 512-row blocks + a cumsum over only the
+~nnz/512 block sums) and MEAN-CENTERED: subtracting the per-column mean
+before the scan turns the prefix from a linearly-growing sum (whose f32
+differencing loses ~nnz*eps of every short run — round 2 paid an
+emulated-f64 inter level for this, 33 ms/side) into a zero-drift random
+walk of magnitude ~sqrt(nnz), so all-f32 keeps ~1e-6 relative accuracy
+(tools/profile_als3.py) and the exact ``mean * run_length`` is added
+back per run. Two tiny per-id gathers then replace the million-row
+scatter.
 
-Ratings rows carry weight-0 padding. Implicit feedback (implicitprefs)
-follows the reference's confidence weighting c = 1 + alpha*|r|.
+Ids ride in their own int32 columns (never cast through the float32
+rating block — f32 is exact only to 2^24, so large ids would silently
+collide; ADVICE r2). Ratings rows carry weight-0 padding. Implicit
+feedback (implicitprefs) follows the reference's confidence weighting
+c = 1 + alpha*|r|.
+
+Convergence mirrors KMeansIterTermination (KMeansTrainBatchOp.java:72-83):
+``tol`` > 0 stops the superstep loop when the train-RMSE delta falls
+below it, and the returned curve length is the MEASURED iteration count.
 """
 
 from __future__ import annotations
@@ -40,6 +53,7 @@ import jax.numpy as jnp
 
 from ....common.mlenv import MLEnvironment, MLEnvironmentFactory
 from ....engine import IterativeComQueue
+from ....ops.smallsolve import batched_spd_solve
 
 
 def batched_nnls(A, b, x0=None, num_iter: int = 80):
@@ -80,23 +94,27 @@ class AlsTrainParams:
     alpha: float = 40.0
     nonnegative: bool = False
     seed: int = 0
+    tol: float = 0.0          # train-RMSE delta early stop; 0 = run num_iter
 
 
-def _sorted_side(block: np.ndarray, col: int):
+def _sorted_side(ids: np.ndarray, rw: np.ndarray, col: int):
     """Sort one worker's rating rows by the side's id column and emit the
-    per-id run boundaries. Returns (sorted_block, (ids, starts, ends))."""
-    order = np.argsort(block[:, col], kind="stable")
-    sb = block[order]
-    ids, starts, counts = np.unique(sb[:, col].astype(np.int64),
-                                    return_index=True, return_counts=True)
-    return sb, np.stack([ids, starts, starts + counts], 1).astype(np.int32)
+    per-id run boundaries. ``ids`` (L, 2) int32, ``rw`` (L, 2) float32
+    [rating, weight]. Returns (sorted_ids, sorted_rw, (id, start, end))."""
+    order = np.argsort(ids[:, col], kind="stable")
+    si, sr = ids[order], rw[order]
+    uniq, starts, counts = np.unique(si[:, col], return_index=True,
+                                     return_counts=True)
+    plan = np.stack([uniq, starts, starts + counts], 1).astype(np.int32)
+    return si, sr, plan
 
 
 def als_train(users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
               p: AlsTrainParams, env: Optional[MLEnvironment] = None,
               num_users: Optional[int] = None, num_items: Optional[int] = None
-              ) -> Tuple[np.ndarray, np.ndarray]:
-    """Returns (user_factors (U, rank), item_factors (I, rank))."""
+              ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Returns (user_factors (U, rank), item_factors (I, rank), rmse_curve);
+    ``len(rmse_curve)`` is the measured number of iterations run."""
     env = env or MLEnvironmentFactory.get_default()
     users = np.asarray(users, np.int32)
     items = np.asarray(items, np.int32)
@@ -108,29 +126,27 @@ def als_train(users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
     uf0 = (rng.rand(U, rank).astype(np.float32) / np.sqrt(rank))
     if0 = (rng.rand(I, rank).astype(np.float32) / np.sqrt(rank))
     nw = env.num_workers
-    # ratings partitioned by row over workers; factor matrices sharded by
-    # padding U/I to a multiple of the worker count
-    Upad = -(-U // nw) * nw
-    Ipad = -(-I // nw) * nw
-    uf0 = np.concatenate([uf0, np.zeros((Upad - U, rank), np.float32)])
-    if0 = np.concatenate([if0, np.zeros((Ipad - I, rank), np.float32)])
     nnz = len(ratings)
     L = -(-max(nnz, 1) // nw)
-    data = np.zeros((nw * L, 4), np.float32)      # weight-0 padding rows
-    data[:nnz] = np.stack([users.astype(np.float32),
-                           items.astype(np.float32),
-                           ratings, np.ones(nnz, np.float32)], axis=1)
+    ids = np.zeros((nw * L, 2), np.int32)          # id-0 padding rows
+    rw = np.zeros((nw * L, 2), np.float32)         # weight-0 padding rows
+    ids[:nnz, 0] = users
+    ids[:nnz, 1] = items
+    rw[:nnz, 0] = ratings
+    rw[:nnz, 1] = 1.0
     # per-worker side-sorted copies + run boundaries (the ids are static,
     # so this host pass happens once per training, not per iteration)
-    blkU, blkI, planU, planI = [], [], [], []
+    idsU, rwU, idsI, rwI, planU, planI = [], [], [], [], [], []
     for wkr in range(nw):
-        chunk = data[wkr * L:(wkr + 1) * L]
-        sbU, plU = _sorted_side(chunk, 0)
-        sbI, plI = _sorted_side(chunk, 1)
-        blkU.append(sbU)
-        blkI.append(sbI)
-        planU.append(plU)
-        planI.append(plI)
+        ci, cr = ids[wkr * L:(wkr + 1) * L], rw[wkr * L:(wkr + 1) * L]
+        si, sr, pl = _sorted_side(ci, cr, 0)
+        idsU.append(si)
+        rwU.append(sr)
+        planU.append(pl)
+        si, sr, pl = _sorted_side(ci, cr, 1)
+        idsI.append(si)
+        rwI.append(sr)
+        planI.append(pl)
     Nu = max(pl.shape[0] for pl in planU)
     Ni = max(pl.shape[0] for pl in planI)
     # zero-length (id=0, start=end=0) slots pad to a uniform worker shape
@@ -141,18 +157,19 @@ def als_train(users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
     lam = p.lambda_reg
     eye = np.eye(rank, dtype=np.float32)
 
-    def solve_side(block, plan, other_col, other_factors, n_rows):
+    def solve_side(bids, brw, plan, other_col, other_factors, n_rows):
         """Per-id normal equations from this worker's rows, which are
         pre-sorted by the side's id: contribution sums are prefix-sum
         differences over the contiguous runs (see module docstring), then
         psum across workers (the reference's request/response
-        accumulation) and one batched Cholesky-style solve."""
-        ids = plan[:, 0]
+        accumulation) and one batched solve. The psum replicates the
+        result, so the return value is the FULL factor matrix."""
+        ids_ = plan[:, 0]
         starts = plan[:, 1]
         ends = plan[:, 2]
-        r = block[:, 2]
-        w = block[:, 3]
-        x = other_factors[block[:, other_col].astype(jnp.int32)]  # (L, rank)
+        r = brw[:, 0]
+        w = brw[:, 1]
+        x = other_factors[bids[:, other_col]]                 # (L, rank)
         if p.implicit_prefs:
             c = 1.0 + p.alpha * jnp.abs(r)
             pref = (r > 0).astype(x.dtype)
@@ -164,12 +181,10 @@ def als_train(users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
         contrib = jnp.concatenate(
             [ww[:, None] * (x[:, :, None] * x[:, None, :]).reshape(-1, rank * rank),
              bval[:, None] * x, w[:, None]], axis=1)          # (L, r^2+r+1)
-        # Two-level prefix: a single global f32 prefix grows to O(nnz)
-        # magnitude and differencing it loses ~nnz*eps of every short run,
-        # while a full f64 cumsum is slow (f64 is emulated on TPU). So:
-        # f32 prefixes WITHIN 512-row blocks (error bounded by the block
-        # length, not the global magnitude) and an f64 cumsum over only
-        # the ~L/512 block sums (x64 stays off globally).
+        # Mean-centered two-level all-f32 prefix (see module docstring):
+        # in-block f32 cumsums + an f32 cumsum over block sums, both over
+        # CENTERED values so the prefix is a zero-drift random walk; the
+        # removed mean re-enters exactly as mean * run_length.
         K = contrib.shape[1]
         Lr = contrib.shape[0]
         C = 512
@@ -177,81 +192,88 @@ def als_train(users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
         pad = Lb * C - Lr
         cpad = jnp.concatenate(
             [contrib, jnp.zeros((pad, K), contrib.dtype)], axis=0)
-        intra = jnp.cumsum(cpad.reshape(Lb, C, K), axis=1)    # f32, in-block
-        with jax.enable_x64(True):
-            bsums = intra[:, -1, :].astype(jnp.float64)
-            inter = jnp.concatenate(
-                [jnp.zeros((1, K), jnp.float64),
-                 jnp.cumsum(bsums, axis=0)], axis=0)          # exclusive
+        blk = cpad.reshape(Lb, C, K)
+        mean = blk.sum(axis=1).sum(axis=0) / (Lb * C)         # per-column
+        intra = jnp.cumsum(blk - mean, axis=1)                # f32, in-block
+        inter = jnp.concatenate(
+            [jnp.zeros((1, K), contrib.dtype),
+             jnp.cumsum(intra[:, -1, :], axis=0)], axis=0)    # exclusive
 
-            def prefix(t):                                    # t: (N,) positions
-                bi = t // C
-                ri = t % C
-                part = jnp.where((ri > 0)[:, None],
-                                 intra[bi, ri - 1], 0.0)
-                return inter[bi] + part.astype(jnp.float64)
+        def prefix(t):                                        # t: (N,) positions
+            bi = t // C
+            ri = t % C
+            part = jnp.where((ri > 0)[:, None], intra[bi, ri - 1], 0.0)
+            return inter[bi] + part
 
-            slot = (prefix(ends) - prefix(starts)).astype(x.dtype)
-        A = jnp.zeros((n_rows, rank * rank), x.dtype).at[ids].add(
+        span = (ends - starts).astype(contrib.dtype)[:, None]
+        slot = (prefix(ends) - prefix(starts)) + mean * span
+        A = jnp.zeros((n_rows, rank * rank), x.dtype).at[ids_].add(
             slot[:, :rank * rank])
-        b = jnp.zeros((n_rows, rank), x.dtype).at[ids].add(
+        b = jnp.zeros((n_rows, rank), x.dtype).at[ids_].add(
             slot[:, rank * rank:rank * rank + rank])
-        cnt = jnp.zeros((n_rows,), x.dtype).at[ids].add(slot[:, -1])
+        cnt = jnp.zeros((n_rows,), x.dtype).at[ids_].add(slot[:, -1])
         A = jax.lax.psum(A, "d").reshape(n_rows, rank, rank)
         b = jax.lax.psum(b, "d")
         cnt = jax.lax.psum(cnt, "d")
         A = A + lam * jnp.maximum(cnt, 1.0)[:, None, None] * eye
-        sol = jnp.linalg.solve(A, b[..., None])[..., 0]
+        # batched unrolled Gauss-Jordan: jnp.linalg.solve's batched LU
+        # leaves the MXU idle (21 ms vs ~0 ms here, tools/profile_als3.py)
+        sol = batched_spd_solve(A, b)
         if p.nonnegative:
             sol = batched_nnls(A, b, x0=jnp.maximum(sol, 0.0))
         return jnp.where(cnt[:, None] > 0, sol, 0.0)
 
     def step(ctx):
         if ctx.is_init_step:
-            tid0 = ctx.task_id
-            ctx.put_obj("uf", ctx.get_obj("uf0")[tid0])   # (Upad/nw, rank)
-            ctx.put_obj("if_", ctx.get_obj("if0")[tid0])
+            # factors ride the carry FULLY REPLICATED: solve_side's psum
+            # already leaves every worker with the complete matrix, so the
+            # reference's per-half-step factor exchange needs no collective
+            # at all here (round 2 spent 3 all_gathers per superstep on it)
+            ctx.put_obj("uf", ctx.get_obj("uf0"))
+            ctx.put_obj("if_", ctx.get_obj("if0"))
             ctx.put_obj("rmse_curve", jnp.zeros((p.num_iter,), jnp.float32))
-        bU = ctx.get_obj("blkU")
-        bI = ctx.get_obj("blkI")
+            ctx.put_obj("prev_rmse", jnp.asarray(jnp.inf, jnp.float32))
+            ctx.put_obj("rmse_delta", jnp.asarray(jnp.inf, jnp.float32))
+        bidsU = ctx.get_obj("idsU")
+        brwU = ctx.get_obj("rwU")
+        bidsI = ctx.get_obj("idsI")
+        brwI = ctx.get_obj("rwI")
         plU = ctx.get_obj("planU")
         plI = ctx.get_obj("planI")
-        # ---- update user factors: gather ALL item factors (all_gather) ----
-        item_full = jax.lax.all_gather(ctx.get_obj("if_"), "d", axis=0,
-                                       tiled=True)
-        uf_full = solve_side(bU, plU, 1, item_full, Upad)
-        tid = ctx.task_id
-        shard = Upad // nw
-        ctx.put_obj("uf", jax.lax.dynamic_slice_in_dim(uf_full, tid * shard,
-                                                       shard, 0))
-        # ---- update item factors ----
-        user_full = jax.lax.all_gather(ctx.get_obj("uf"), "d", axis=0, tiled=True)
-        if_full = solve_side(bI, plI, 0, user_full, Ipad)
-        ishard = Ipad // nw
-        ctx.put_obj("if_", jax.lax.dynamic_slice_in_dim(if_full, tid * ishard,
-                                                        ishard, 0))
-        # rmse for the curve (over the user-sorted copy; order is irrelevant)
-        uid = bU[:, 0].astype(jnp.int32)
-        iid = bU[:, 1].astype(jnp.int32)
-        r = bU[:, 2]
-        w = bU[:, 3]
-        uf_now = jax.lax.all_gather(ctx.get_obj("uf"), "d", axis=0, tiled=True)
-        pred = (uf_now[uid] * if_full[iid]).sum(-1)
+        # ---- the two half-sweeps, fused in one compiled superstep ----
+        uf = solve_side(bidsU, brwU, plU, 1, ctx.get_obj("if_"), U)
+        if_ = solve_side(bidsI, brwI, plI, 0, uf, I)
+        ctx.put_obj("uf", uf)
+        ctx.put_obj("if_", if_)
+        # rmse for the curve + stop criterion (user-sorted copy; order is
+        # irrelevant for a sum)
+        pred = (uf[bidsU[:, 0]] * if_[bidsU[:, 1]]).sum(-1)
+        r = brwU[:, 0]
+        w = brwU[:, 1]
         se = jax.lax.psum(jnp.stack([(w * (pred - r) ** 2).sum(), w.sum()]), "d")
+        rmse = jnp.sqrt(se[0] / jnp.maximum(se[1], 1e-12)).astype(jnp.float32)
         ctx.put_obj("rmse_curve", jax.lax.dynamic_update_index_in_dim(
-            ctx.get_obj("rmse_curve"),
-            jnp.sqrt(se[0] / jnp.maximum(se[1], 1e-12)).astype(jnp.float32),
-            ctx.step_no - 1, 0))
+            ctx.get_obj("rmse_curve"), rmse, ctx.step_no - 1, 0))
+        ctx.put_obj("rmse_delta", jnp.abs(ctx.get_obj("prev_rmse") - rmse))
+        ctx.put_obj("prev_rmse", rmse)
 
     queue = (IterativeComQueue(env=env, max_iter=p.num_iter, seed=p.seed)
-             .init_with_partitioned_data("blkU", np.concatenate(blkU))
-             .init_with_partitioned_data("blkI", np.concatenate(blkI))
+             .init_with_partitioned_data("idsU", np.concatenate(idsU))
+             .init_with_partitioned_data("rwU", np.concatenate(rwU))
+             .init_with_partitioned_data("idsI", np.concatenate(idsI))
+             .init_with_partitioned_data("rwI", np.concatenate(rwI))
              .init_with_partitioned_data("planU", planU.reshape(-1, 3))
              .init_with_partitioned_data("planI", planI.reshape(-1, 3))
-             .init_with_broadcast_data("uf0", uf0.reshape(nw, -1, rank))
-             .init_with_broadcast_data("if0", if0.reshape(nw, -1, rank))
+             .init_with_broadcast_data("uf0", uf0)
+             .init_with_broadcast_data("if0", if0)
              .add(step))
+    if p.tol > 0:
+        # KMeansIterTermination analogue: stop when the train-RMSE moves
+        # less than tol between supersteps (replicated state only)
+        queue.set_compare_criterion(
+            lambda ctx: ctx.get_obj("rmse_delta") < p.tol)
     res = queue.exec()
-    uf = res.concat("uf", total=Upad)[:U]
-    if_ = res.concat("if_", total=Ipad)[:I]
-    return uf, if_, np.asarray(res.get("rmse_curve"))
+    uf = res.get("uf")
+    if_ = res.get("if_")
+    curve = np.asarray(res.get("rmse_curve"))[:res.step_count]
+    return uf, if_, curve

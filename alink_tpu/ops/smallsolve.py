@@ -1,0 +1,37 @@
+"""Batched small dense solves, TPU-shaped.
+
+XLA's ``jnp.linalg.solve`` lowers batched LU through loops of
+dynamic-update-slices that leave the MXU idle — measured 21 ms for
+(6040, 10, 10) on v5e vs ~0 ms for the elementwise Gauss-Jordan below
+(tools/profile_als3.py). For the rank-sized SPD normal equations ALS /
+Newton-style trainers solve (reference: NormalEquation.java's dense
+Cholesky, common/linalg/NormalEquation.java), rank is a small static
+Python int, so the elimination unrolls completely into ~rank fused
+elementwise passes — no pivoting (valid for SPD: the running pivot is a
+Schur complement diagonal, positive by definiteness; the reference's
+Cholesky makes the same assumption).
+
+Accuracy: ~1e-6 relative on ridge-regularized SPD batches (vs 4e-8 for
+f32 LAPACK) — below the f32 accumulation error already in the normal
+equations themselves.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def batched_spd_solve(A, b):
+    """Solve ``A x = b`` for a batch of small SPD systems.
+
+    ``A``: (..., n, n) SPD (e.g. Gram + ridge), ``b``: (..., n), with n a
+    static small int (unrolls n elimination steps). Returns (..., n).
+    """
+    n = A.shape[-1]
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=A.dtype), A.shape)
+    M = jnp.concatenate([A, eye], axis=-1)
+    for i in range(n):
+        piv = M[..., i, :] / M[..., i, i:i + 1]
+        M = M - M[..., :, i:i + 1] * piv[..., None, :]
+        M = M.at[..., i, :].set(piv)
+    return jnp.einsum("...ij,...j->...i", M[..., :, n:], b)

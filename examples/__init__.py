@@ -1,0 +1,2 @@
+"""Examples package — lets ``python -m examples.<name>`` work in addition
+to plain-script ``python examples/<name>.py`` runs."""

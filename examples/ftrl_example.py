@@ -9,7 +9,10 @@ Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
      python examples/ftrl_example.py
 """
 
-import _bootstrap  # noqa: F401  (repo root onto sys.path)
+try:
+    import _bootstrap  # noqa: F401  (repo root onto sys.path)
+except ImportError:  # running as a module: python -m examples.foo
+    from . import _bootstrap  # noqa: F401
 
 import json
 

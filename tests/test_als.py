@@ -163,3 +163,25 @@ def test_als_nonnegative():
     S = m.user_factors @ m.item_factors.T
     errs = [abs(S[u, i] - r) for u, i, r in rows]
     assert np.mean(errs) < 0.8, np.mean(errs)
+
+
+def test_als_tol_early_stop():
+    """tol > 0 stops the superstep loop when the train-RMSE delta falls
+    under it (KMeansIterTermination analogue), and the returned curve
+    length is the MEASURED iteration count — VERDICT r2 #5."""
+    from alink_tpu.operator.common.recommendation.als import (AlsTrainParams,
+                                                              als_train)
+    rng = np.random.RandomState(0)
+    U, I, r = 40, 30, 3
+    uf = rng.rand(U, r).astype(np.float32)
+    if_ = rng.rand(I, r).astype(np.float32)
+    users, items = np.meshgrid(np.arange(U), np.arange(I), indexing="ij")
+    users, items = users.ravel(), items.ravel()
+    ratings = (uf[users] * if_[items]).sum(1)      # exact low rank, no noise
+    p = AlsTrainParams(rank=r, num_iter=50, lambda_reg=1e-3, tol=1e-4)
+    uf_hat, if_hat, curve = als_train(users, items, ratings, p)
+    assert 1 < len(curve) < 50, len(curve)         # stopped early, measured
+    assert curve[-1] < 0.1                          # and actually converged
+    p0 = AlsTrainParams(rank=r, num_iter=7, lambda_reg=1e-3, tol=0.0)
+    _, _, curve0 = als_train(users, items, ratings, p0)
+    assert len(curve0) == 7                         # tol=0 runs the budget

@@ -348,7 +348,8 @@ def test_hive_escaping_roundtrip(tmp_path):
 
 def test_hive_server_partition_pushdown(monkeypatch):
     """On the live-server path the partitions spec pushes down as a WHERE
-    clause (it must not be silently ignored), and schema_str is rejected."""
+    clause with DB-API parameter binding (values never interpolated into
+    the SQL text), and schema_str is rejected."""
     from alink_tpu.common import MTable
     from alink_tpu.common.types import TableSchema
     from alink_tpu.io.hive import HiveSourceBatchOp
@@ -361,8 +362,9 @@ def test_hive_server_partition_pushdown(monkeypatch):
             captured["q"] = f"TABLE:{t}"
             return mt
 
-        def query(self, q):
+        def query(self, q, params=()):
             captured["q"] = q
+            captured["params"] = list(params)
             return mt
 
     op = HiveSourceBatchOp(host="hs2", input_table_name="t",
@@ -370,10 +372,72 @@ def test_hive_server_partition_pushdown(monkeypatch):
     monkeypatch.setattr(op, "_make_db", lambda: FakeDB())
     op.link_from()
     assert captured["q"] == ("SELECT * FROM t WHERE "
-                             "(ds='20190729' AND dt='12') OR (ds='20190730')")
+                             "(ds=? AND dt=?) OR (ds=?)")
+    assert captured["params"] == ["20190729", "12", "20190730"]
+
+    # a value with a quote rides as a bound parameter, not SQL text
+    op_q = HiveSourceBatchOp(host="hs2", input_table_name="t",
+                             partitions="ds=x' OR '1'='1")
+    monkeypatch.setattr(op_q, "_make_db", lambda: FakeDB())
+    op_q.link_from()
+    assert "'" not in captured["q"]
+    assert captured["params"] == ["x' OR '1'='1"]
+
+    # a partition COLUMN is an identifier; a hostile one is rejected
+    op_k = HiveSourceBatchOp(host="hs2", input_table_name="t",
+                             partitions="ds;drop=1")
+    monkeypatch.setattr(op_k, "_make_db", lambda: FakeDB())
+    with _pytest.raises(ValueError, match="partition column"):
+        op_k.link_from()
 
     op2 = HiveSourceBatchOp(host="hs2", input_table_name="t",
                             schema_str="a LONG")
     monkeypatch.setattr(op2, "_make_db", lambda: FakeDB())
     with _pytest.raises(ValueError, match="warehouse_dir"):
         op2.link_from()
+
+
+def test_hive_server_query_param(monkeypatch):
+    """A configured free-form ``query`` runs on the live-server path
+    (ADVICE r2: it used to be silently dropped) and is rejected with a
+    clear error on the warehouse_dir path."""
+    from alink_tpu.common import MTable
+    from alink_tpu.common.types import TableSchema
+    from alink_tpu.io.hive import HiveSourceBatchOp
+    import pytest as _pytest
+    captured = {}
+    mt = MTable([(1,)], TableSchema.parse("a LONG"))
+
+    class FakeDB:
+        def query(self, q, params=()):
+            captured["q"] = q
+            return mt
+
+    op = HiveSourceBatchOp(host="hs2", query="SELECT a FROM t WHERE a > 1")
+    monkeypatch.setattr(op, "_make_db", lambda: FakeDB())
+    op.link_from()
+    assert captured["q"] == "SELECT a FROM t WHERE a > 1"
+
+    op_both = HiveSourceBatchOp(host="hs2", query="SELECT 1",
+                                partitions="ds=1", input_table_name="t")
+    monkeypatch.setattr(op_both, "_make_db", lambda: FakeDB())
+    with _pytest.raises(ValueError, match="mutually exclusive"):
+        op_both.link_from()
+
+    op_wh = HiveSourceBatchOp(warehouse_dir="/nonexistent", query="SELECT 1")
+    with _pytest.raises(ValueError, match="live-server"):
+        op_wh.link_from()
+
+
+def test_csv_oversized_quoted_header_rejected(tmp_path):
+    """A header whose unbalanced quote would swallow >64 lines raises
+    instead of silently degrading to a one-line drop (ADVICE r2)."""
+    import pytest as _pytest
+    from alink_tpu.common.types import TableSchema
+    from alink_tpu.io.csv import read_csv
+    p = tmp_path / "bad.csv"
+    lines = ['col_a,"unterminated'] + [f"{i},x" for i in range(80)]
+    p.write_text("\n".join(lines) + "\n")
+    schema = TableSchema.parse("a LONG, s STRING")
+    with _pytest.raises(ValueError, match="header"):
+        read_csv(str(p), schema, ignore_first_line=True)

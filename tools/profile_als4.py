@@ -1,0 +1,124 @@
+"""Profiler round 4: the 1M-row factor gathers + a faithful full-side
+reconstruction, to find the ~330 ms/iter not explained by the prefix."""
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+nnz, U, I, rank = 1_000_000, 6040, 3706, 10
+K = rank * rank + rank + 1
+k0 = jax.random.PRNGKey(0)
+ids2 = jax.random.randint(k0, (nnz, 2), 0, 3000).astype(jnp.int32)
+rw = jax.random.uniform(k0, (nnz, 2), jnp.float32)
+uf = jax.random.uniform(k0, (U, rank), jnp.float32)
+if_ = jax.random.uniform(k0, (I, rank), jnp.float32)
+plan = jnp.stack([jnp.arange(U, dtype=jnp.int32),
+                  jnp.arange(U, dtype=jnp.int32) * (nnz // U),
+                  jnp.arange(U, dtype=jnp.int32) * (nnz // U) + nnz // U], 1)
+C = 512
+Lb = -(-nnz // C)
+pad = Lb * C - nnz
+
+
+def kernel_delta(name, body, arg, iters=8, reps=3):
+    def many(n):
+        def f(a, i):
+            return jnp.asarray(body(a + i)).sum()
+        return jax.jit(lambda a: jax.lax.fori_loop(
+            0, n, lambda i, s: s + f(a, i), jnp.asarray(0.0)))
+
+    g1, gn = many(1), many(1 + iters)
+    np.asarray(g1(arg)); np.asarray(gn(arg))
+    t1, tn = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter(); np.asarray(g1(arg))
+        t1.append(time.perf_counter() - t0)
+        t0 = time.perf_counter(); np.asarray(gn(arg))
+        tn.append(time.perf_counter() - t0)
+    print(f"{name:44s} {(min(tn)-min(t1))/iters*1e3:8.2f} ms", flush=True)
+
+
+def gather_1m(shift):
+    idx = (ids2[:, 0] + shift.astype(jnp.int32)) % U
+    return uf[idx]
+
+
+def gather_1m_onehot_chunked(shift):
+    # alternative: per-512-chunk one-hot matmul on the MXU
+    idx = ((ids2[:1000448, 0] if False else jnp.pad(ids2[:, 0], (0, 448)) + shift.astype(jnp.int32)) % U).reshape(-1, 512)
+    oh = jax.nn.one_hot(idx, U, dtype=jnp.bfloat16)       # (chunks, 512, U)
+    return jnp.einsum("csu,uk->csk", oh, uf.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+
+
+def gather_take(shift):
+    idx = (ids2[:, 0] + shift.astype(jnp.int32)) % U
+    return jnp.take(uf, idx, axis=0, indices_are_sorted=True)
+
+
+def full_side(shift):
+    """Faithful copy of als.solve_side (explicit-feedback branch)."""
+    bids = ids2
+    r = rw[:, 0] + shift * 1e-7
+    w = rw[:, 1]
+    x = if_[bids[:, 1]]
+    ww = w
+    bval = r * w
+    contrib = jnp.concatenate(
+        [ww[:, None] * (x[:, :, None] * x[:, None, :]).reshape(-1, rank * rank),
+         bval[:, None] * x, w[:, None]], axis=1)
+    cpad = jnp.concatenate([contrib, jnp.zeros((pad, K), contrib.dtype)])
+    blk = cpad.reshape(Lb, C, K)
+    mean = blk.sum(axis=1).sum(axis=0) / (Lb * C)
+    intra = jnp.cumsum(blk - mean, axis=1)
+    inter = jnp.concatenate(
+        [jnp.zeros((1, K), jnp.float32), jnp.cumsum(intra[:, -1, :], axis=0)])
+    starts, ends = plan[:, 1], plan[:, 2]
+
+    def prefix(t):
+        bi, ri = t // C, t % C
+        return inter[bi] + jnp.where((ri > 0)[:, None], intra[bi, ri - 1], 0.0)
+
+    span = (ends - starts).astype(jnp.float32)[:, None]
+    slot = (prefix(ends) - prefix(starts)) + mean * span
+    ids_ = plan[:, 0]
+    A = jnp.zeros((U, rank * rank), jnp.float32).at[ids_].add(slot[:, :rank * rank])
+    b = jnp.zeros((U, rank), jnp.float32).at[ids_].add(
+        slot[:, rank * rank:rank * rank + rank])
+    cnt = jnp.zeros((U,), jnp.float32).at[ids_].add(slot[:, -1])
+    A = A.reshape(U, rank, rank) + 0.1 * jnp.maximum(cnt, 1.0)[:, None, None] * jnp.eye(rank)
+    M = jnp.concatenate([A, jnp.broadcast_to(jnp.eye(rank), A.shape)], -1)
+    for i in range(rank):
+        piv = M[:, i, :] / M[:, i, i:i + 1]
+        M = M - M[:, :, i:i + 1] * piv[:, None, :]
+        M = M.at[:, i, :].set(piv)
+    sol = jnp.einsum("nij,nj->ni", M[:, :, rank:], b)
+    return jnp.where(cnt[:, None] > 0, sol, 0.0)
+
+
+def rmse_block(shift):
+    pred = (uf[ids2[:, 0]] * if_[ids2[:, 1] % I]).sum(-1)
+    r = rw[:, 0] + shift * 1e-7
+    w = rw[:, 1]
+    return jnp.stack([(w * (pred - r) ** 2).sum(), w.sum()])
+
+
+def contrib_cumsum_only(shift):
+    x = if_[ids2[:, 1]]
+    r = rw[:, 0] + shift * 1e-7
+    contrib = jnp.concatenate(
+        [(x[:, :, None] * x[:, None, :]).reshape(-1, rank * rank),
+         r[:, None] * x, jnp.ones((nnz, 1), jnp.float32)], axis=1)
+    cpad = jnp.concatenate([contrib, jnp.zeros((pad, K), contrib.dtype)])
+    return jnp.cumsum(cpad.reshape(Lb, C, K), axis=1)
+
+
+z = jnp.asarray(0.0)
+kernel_delta("plain gather (1M,10)", gather_1m, z)
+kernel_delta("take sorted-hint (1M,10)", gather_take, z)
+kernel_delta("one-hot-matmul gather (1M,10)", gather_1m_onehot_chunked, z)
+kernel_delta("rmse block (2 gathers + reduce)", rmse_block, z)
+kernel_delta("contrib build + cumsum", contrib_cumsum_only, z)
+kernel_delta("FULL side (faithful solve_side)", full_side, z)
+print("done", flush=True)
