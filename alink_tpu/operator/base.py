@@ -310,10 +310,14 @@ class StreamOperator(AlgoOperator):
     @staticmethod
     def execute():
         """Drain all registered stream DAGs to completion (reference
-        StreamOperator.execute launching the stream job)."""
+        StreamOperator.execute launching the stream job). The DAG runs
+        ``prefetch``ed in a background thread so upstream parse/encode
+        overlaps the sink's blocking device fetches (Flink's pipelined
+        operator exchange; see stream/prefetch.py)."""
+        from .stream.prefetch import prefetch
         streams = StreamOperator._session_streams
         StreamOperator._session_streams = []
         for s in streams:
-            for mt in s.micro_batches():
+            for mt in prefetch(s.micro_batches()):
                 for sink in s._sinks:
                     sink(mt)

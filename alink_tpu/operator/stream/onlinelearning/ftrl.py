@@ -486,33 +486,54 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 return (jax.device_put(z0, feat_shard),
                         jax.device_put(n0, feat_shard))
 
+            def encoded_stream():
+                """(t, mt, enc) with encode running IN the prefetch
+                thread: hashing/padding of batch t+1 overlaps the device
+                running batch t (VERDICT r2 #4; Flink's pipelined
+                operators, FtrlTrainStreamOp.java:120-135)."""
+                batch_size = None
+                width = 8
+                for t, mt in data_op.timed_batches():
+                    if mt.num_rows == 0:
+                        continue
+                    if batch_size is None:
+                        batch_size = max(1, mt.num_rows)
+                    enc = encode(mt, max(batch_size, mt.num_rows), width)
+                    if enc[0] == "sparse":
+                        width = enc[4]
+                    yield (t, mt, enc, batch_size)
+
+            from ..prefetch import prefetch
+
             z = n = None
             layout = None                # "std" | "fb"
             fb_S = None
             fb_meta = None
-            batch_size = None
             next_emit = None
-            width = 8
-            for t, mt in data_op.timed_batches():
-                if mt.num_rows == 0:
-                    continue
-                if batch_size is None:
-                    batch_size = max(1, mt.num_rows)
+            for t, mt, enc, batch_size in prefetch(encoded_stream()):
                 if next_emit is None:
                     next_emit = (np.floor(t / interval) + 1) * interval
-                enc = encode(mt, max(batch_size, mt.num_rows), width)
-                if layout == "fb" and (
+                if (layout == "fb" and (
                         enc[0] != "fb" or
                         enc[4].num_fields != fb_meta.num_fields or
-                        enc[4].field_size != fb_meta.field_size):
+                        enc[4].field_size != fb_meta.field_size)) or (
+                        layout == "std" and enc[0] == "fb"):
                     # the first batch's detection was coincidental (or the
                     # row shape changed): demote the state to the generic
-                    # layout — an exact translation — and stay there
-                    z, n = fb_to_std_state(z, n)
+                    # layout — an exact translation — and stay there.
+                    # (Also covers up-to-`depth` in-flight batches the
+                    # prefetch thread encoded as fb before seeing the
+                    # demotion flag flip.)
+                    if layout == "fb":
+                        z, n = fb_to_std_state(z, n)
+                        # only an fb-layout step factory is invalidated;
+                        # once layout is std, queued fb-encoded batches
+                        # must NOT null the (std) factory again — that
+                        # re-traced the step once per in-flight batch
+                        sparse_step[0] = None
                     layout, fb_S, fb_meta = "std", None, None
                     allow_fb[0] = False
-                    sparse_step[0] = None
-                    enc = encode(mt, max(batch_size, mt.num_rows), width)
+                    enc = encode(mt, max(batch_size, mt.num_rows), 8)
                 if enc[0] == "fb":
                     _, fbi, fbv, y, meta = enc
                     if layout is None:

@@ -65,6 +65,34 @@ def _auc(y, s):
     return float((ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0))
 
 
+# v5e per-chip peak: 197 TFLOP/s bf16 (MXU-native; f32 einsums run below
+# this, so f32-dominated workloads understate their achievable ceiling).
+# HBM: ~819 GB/s. Used to turn samples/sec into "% of chip" so a reader
+# can tell compute-bound from memory/gather-bound (VERDICT r2 #2).
+PEAK_TFLOPS = 197.0
+PEAK_HBM_GBPS = 819.0
+
+
+def mfu(sps_per_chip, flops_per_sample, bytes_per_sample=None):
+    """FLOP/MFU accounting row fragment.
+
+    ``flops_per_sample`` counts the FLOPs the kernels actually ISSUE per
+    sample per iteration (one-hot MXU formulations issue more than the
+    nominal sparse math — that is the design tradeoff being measured).
+    ``bytes_per_sample`` (optional) is nominal HBM traffic for
+    memory-bound workloads, reported as % of HBM peak."""
+    ach = sps_per_chip * flops_per_sample
+    row = {"flops_per_sample": int(flops_per_sample),
+           "achieved_tflops_per_chip": round(ach / 1e12, 3),
+           "pct_chip_peak_flops": round(100.0 * ach / (PEAK_TFLOPS * 1e12), 2)}
+    if bytes_per_sample is not None:
+        bw = sps_per_chip * bytes_per_sample
+        row["hbm_bytes_per_sample"] = int(bytes_per_sample)
+        row["pct_chip_peak_hbm"] = round(
+            100.0 * bw / (PEAK_HBM_GBPS * 1e9), 2)
+    return row
+
+
 class Harness:
     def __init__(self):
         import tempfile
@@ -78,7 +106,7 @@ class Harness:
         self.chips = max(self.env.num_workers, 1)
 
     def delta(self, run, iters, reps: int = 3):
-        """min-of-reps of [time(run(1+2*iters)) - time(run(1+iters))].
+        """min-of-reps of [time(run(1+iters)) - time(run(2))] * iters/(iters-1).
 
         min, not median: the device service is shared, so each timing is
         (true cost + nonnegative contention noise); the minimum is the
@@ -183,9 +211,13 @@ def bench_logreg(h: Harness):
                   for s in steps]
         coef = coef - steps[int(np.argmin(losses))] * g
     cpu_sps = n_rows * base_iters / (time.perf_counter() - t0)
+    # issued FLOPs/sample/iter: the L-BFGS superstep is 3 field-block
+    # einsum passes (eta, grad, eta_d), each 2 * DIM MACs-as-flops per
+    # sample (ops/fieldblock.py "nfh,fhl->nfl": F*H*LO = DIM MACs)
     return {"samples_per_sec_per_chip": round(sps, 1),
             "vs_baseline": round(sps / cpu_sps, 3),
-            "iters_to_converge": int(n_conv), "dt_s": round(dt, 3)}
+            "iters_to_converge": int(n_conv), "dt_s": round(dt, 3),
+            **mfu(sps, 3 * 2 * DIM)}
 
 
 # ---------------------------------------------------------------------------
@@ -231,9 +263,12 @@ def bench_kmeans(h: Harness):
         cnts = np.bincount(ids, minlength=3).astype(np.float32)
         C = np.where(cnts[:, None] > 0, sums / np.maximum(cnts[:, None], 1e-12), C)
     cpu_sps = n * base_iters / (time.perf_counter() - t0)
+    # per sample per iter: distance matmul 2*k*d + one-hot scatter-add of
+    # (d+1) sums over k centroids 2*k*(d+1) (common/clustering/kmeans.py)
     return {"samples_per_sec_per_chip": round(sps, 1),
             "vs_baseline": round(sps / cpu_sps, 3),
-            "iters_to_converge": int(n_conv), "dt_s": round(dt, 3)}
+            "iters_to_converge": int(n_conv), "dt_s": round(dt, 3),
+            **mfu(sps, 2 * 3 * 4 + 2 * 3 * 5)}
 
 
 # ---------------------------------------------------------------------------
@@ -306,10 +341,21 @@ def bench_softmax(h: Harness):
             np.log(np.exp(Zsf - m[:, None]).sum(1))
         Wc = Wc - steps[1] * G
     cpu_sps = n * base_iters / (time.perf_counter() - t0)
+    # quality anchor (VERDICT r2 #8): sklearn multinomial LR on the
+    # IDENTICAL matrix (saga tolerates the n=60k x d=785 size; the
+    # blob data is linearly separable so both should sit near 1.0)
+    from sklearn.linear_model import LogisticRegression
+    sk = LogisticRegression(max_iter=30, C=1e4, tol=1e-3)
+    sk.fit(X[:, 1:], yc)
+    sk_acc = float((sk.predict(X[:, 1:]) == yc).mean())
+    # L-BFGS superstep = 3 dense (n,785)@(785,10)-class passes (logits,
+    # grad, direction-logits): 3 * 2*(d+1)*k flops/sample/iter, f32
     return {"samples_per_sec_per_chip": round(sps, 1),
             "vs_baseline": round(sps / cpu_sps, 3),
             "iters_to_converge": int(n_conv), "accuracy": round(acc, 4),
-            "dt_s": round(dt, 3)}
+            "sklearn_accuracy": round(sk_acc, 4),
+            "dt_s": round(dt, 3),
+            **mfu(sps, 3 * 2 * (d + 1) * k)}
 
 
 # ---------------------------------------------------------------------------
@@ -380,12 +426,34 @@ def bench_ftrl(h: Harness):
     # AUC: train several epochs over the pool, score a held-out batch
     # (one ~98k-sample pass over a 65k-dim model is too little signal to
     # be a meaningful quality number)
-    z, nacc = run(6)                         # 6 pool passes = 6 epochs
+    z, nacc = run(12)                        # 12 pool passes = 12 epochs
     w = np.asarray(_ftrl_weights(np.asarray(z), np.asarray(nacc),
                                  0.05, 1.0, 1e-5, 1e-5))[:dim]
     hidx, hval, hy = make_batch(10_001)
     margins = (w[hidx] * hval).sum(1)
     auc = _auc(hy, margins)
+
+    # Quality anchors (VERDICT r2 #3): the north star says "identical
+    # AUC" vs a converged batch model on the SAME data. (a) batch L-BFGS
+    # LR trained to convergence on the identical stream corpus; (b) the
+    # oracle — scoring with the generating w_true — which is the ceiling
+    # the label noise (y ~ Bernoulli(sigmoid(margin))) allows at all.
+    from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                         UnaryLossObjFunc)
+    from alink_tpu.operator.common.optim.optimizers import (OptimParams,
+                                                            optimize)
+    all_idx = np.concatenate([p[0] for p in pool])
+    all_val = np.concatenate([p[1] for p in pool]).astype(np.float32)
+    all_y = np.concatenate([p[2] for p in pool])
+    lr_data = {"idx": all_idx, "val": all_val,
+               "y": np.where(all_y > 0, 1.0, -1.0).astype(np.float32),
+               "w": np.ones(len(all_y), np.float32)}
+    obj = UnaryLossObjFunc(LogLossFunc(), dim_pad, l2=1e-6)
+    coef, _, _ = optimize(obj, lr_data, OptimParams(
+        method="LBFGS", max_iter=300, epsilon=1e-8), h.env)
+    wb = np.asarray(coef)[:dim]
+    batch_lr_auc = _auc(hy, (wb[hidx] * hval).sum(1))
+    oracle_auc = _auc(hy, w_true[hidx[:, 1:nnz + 1]].sum(1))
 
     # update_mode="batch" on field-aware-hashed rows (ftrl_demo hashes CTR
     # fields, so the stream op auto-detects the layout and routes to the
@@ -441,29 +509,200 @@ def bench_ftrl(h: Harness):
     Kb = 900                                 # 900 pools = 21,600 batches
     sps_batch = B * len(fb_pool) * Kb / h.delta(run_batchmode, Kb) / h.chips
 
-    # CPU baseline: per-sample O(nnz) FTRL loop in numpy (one task slot)
-    zc = np.zeros(dim)
-    nc = np.zeros(dim)
+    # End-to-end STREAM rate including hashing/encode (VERDICT r2 #4):
+    # raw string rows -> FeatureHasherStreamOp(field_aware) ->
+    # FtrlTrainStreamOp, drained through the prefetched stream runtime
+    # (host hash/pad of batch t+1 overlaps the device running batch t).
+    from alink_tpu.operator.batch.classification.linear import (
+        LogisticRegressionTrainBatchOp)
+    from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+    from alink_tpu.operator.stream.batch_twins import FeatureHasherStreamOp
+    from alink_tpu.operator.stream.onlinelearning.ftrl import (
+        FtrlTrainStreamOp)
+    from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+
+    n_stream = 49_152                        # 12 x 4096-row micro-batches
+    srng = np.random.RandomState(17)
+    sites = np.char.add("s", srng.randint(0, 4000, n_stream).astype("U6"))
+    devs = np.char.add("d", srng.randint(0, 4000, n_stream).astype("U6"))
+    apps = np.char.add("a", srng.randint(0, 4000, n_stream).astype("U6"))
+    ys = srng.randint(0, 2, n_stream).astype(np.int64)
+    from alink_tpu.common.mtable import MTable
+    cols = {"site": sites.astype(object), "dev": devs.astype(object),
+            "app": apps.astype(object), "click": ys}
+    stream_schema = "site STRING, dev STRING, app STRING, click LONG"
+    hash_cols = ["site", "dev", "app"]
+    hasher_kw = dict(selected_cols=hash_cols, categorical_cols=hash_cols,
+                     output_col="vec", num_features=3 * 1648,
+                     field_aware=True)
+    warm_src = MemSourceBatchOp(MTable(cols, stream_schema).first_n(4096))
+    from alink_tpu.operator.batch.feature.feature_ops import (
+        FeatureHasherBatchOp)
+    warm_feat = FeatureHasherBatchOp(**hasher_kw).link_from(warm_src)
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="click", max_iter=3).link_from(warm_feat)
+
+    def drain_stream():
+        src = MemSourceStreamOp(MTable(cols, stream_schema), batch_size=4096)
+        feat = FeatureHasherStreamOp(**hasher_kw).link_from(src)
+        ftrl = FtrlTrainStreamOp(warm, vector_col="vec", label_col="click",
+                                 alpha=0.05, beta=1.0, l1=1e-5, l2=1e-5,
+                                 update_mode="batch",
+                                 time_interval=1e9).link_from(feat)
+        last = None
+        for mt in ftrl.micro_batches():
+            last = mt
+        return last
+
+    drain_stream()                           # warm compiles
+    t0 = time.perf_counter()
+    drain_stream()
+    stream_e2e_sps = n_stream / (time.perf_counter() - t0) / h.chips
+
+    # CPU baseline: per-sample O(nnz) FTRL loop in numpy (one task slot).
+    # Best-of-3: a single timing of a 4096-sample Python loop swings
+    # 30-50% with host load, which alone moved vs_baseline across the
+    # 10x bar between otherwise identical runs (r3 trial: 6.8 vs 10.2).
     bidx, bval, by = pool[0]
     n_base = 4096
-    t0 = time.perf_counter()
-    for i in range(n_base):
-        ii, vv, yy = bidx[i], bval[i], by[i]
-        zi, ni = zc[ii], nc[ii]
-        decay = (1.0 + np.sqrt(ni)) / 0.05 + 1e-5
-        wi = np.where(np.abs(zi) <= 1e-5, 0.0,
-                      -(zi - np.sign(zi) * 1e-5) / decay)
-        p = 1.0 / (1.0 + np.exp(-np.clip(wi @ vv, -35, 35)))
-        g = (p - yy) * vv
-        sigma = (np.sqrt(ni + g * g) - np.sqrt(ni)) / 0.05
-        zc[ii] = zi + g - sigma * wi
-        nc[ii] = ni + g * g
-    cpu_sps = n_base / (time.perf_counter() - t0)
+
+    def cpu_pass():
+        zc = np.zeros(dim)
+        nc = np.zeros(dim)
+        t0 = time.perf_counter()
+        for i in range(n_base):
+            ii, vv, yy = bidx[i], bval[i], by[i]
+            zi, ni = zc[ii], nc[ii]
+            decay = (1.0 + np.sqrt(ni)) / 0.05 + 1e-5
+            wi = np.where(np.abs(zi) <= 1e-5, 0.0,
+                          -(zi - np.sign(zi) * 1e-5) / decay)
+            p = 1.0 / (1.0 + np.exp(-np.clip(wi @ vv, -35, 35)))
+            g = (p - yy) * vv
+            sigma = (np.sqrt(ni + g * g) - np.sqrt(ni)) / 0.05
+            zc[ii] = zi + g - sigma * wi
+            nc[ii] = ni + g * g
+        return time.perf_counter() - t0
+
+    cpu_sps = n_base / min(cpu_pass() for _ in range(3))
+    # strict FTRL is elementwise over width=40 slots (~15 flops each) —
+    # gather/state-bound, not MXU work; its honest peak metric is HBM
+    # traffic (~width * 3 state vectors * 2 dirs * 8B). The batch-mode row
+    # issues field-block one-hot matmuls instead: 2 passes * 2*dim_fb.
+    strict = mfu(sps, width * 15, bytes_per_sample=width * 3 * 2 * 8)
+    batch = mfu(sps_batch, 2 * 2 * dim_fb)
     return {"samples_per_sec_per_chip": round(sps, 1),
             "vs_baseline": round(sps / cpu_sps, 3),
-            "auc": round(auc, 4), "dt_s": round(dt, 3),
+            "auc": round(auc, 4),
+            "batch_lr_auc": round(batch_lr_auc, 4),
+            "oracle_auc": round(oracle_auc, 4),
+            "dt_s": round(dt, 3),
+            **strict,
             "batch_mode_samples_per_sec_per_chip": round(sps_batch, 1),
-            "batch_mode_vs_baseline": round(sps_batch / cpu_sps, 3)}
+            "batch_mode_vs_baseline": round(sps_batch / cpu_sps, 3),
+            "batch_mode_pct_chip_peak_flops": batch["pct_chip_peak_flops"],
+            "stream_e2e_samples_per_sec_per_chip": round(stream_e2e_sps, 1)}
+
+
+# ---------------------------------------------------------------------------
+# 4b. LogReg from DISK — the input pipeline at rate (VERDICT r2 #3)
+# ---------------------------------------------------------------------------
+
+def bench_logreg_from_disk(h: Harness):
+    """Source -> device throughput: a LibSVM fixture on disk, read through
+    the sharded byte-range sources (io/sharding.py via read_file_shard)
+    and the native C++ LibSVM parser, feeding the field-blocked L-BFGS.
+
+    This is the "Criteo-1TB must shard at the source" plumbing (SURVEY §7)
+    made measurable: sustained samples/sec INCLUDING read+parse+encode+
+    device_put, next to the same train step fed from RAM, with the
+    component split so the bottleneck is identified in the artifact.
+    Fixture size scales with ALINK_TPU_DISKBENCH_ROWS (default 1M rows,
+    ~360 MB — the multi-GB shape at a bench-budget size)."""
+    import os
+    import tempfile
+
+    from alink_tpu.io.csv import _load_line_bytes
+    from alink_tpu.native import parse_libsvm_bytes
+    from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                         UnaryLossObjFunc)
+    from alink_tpu.operator.common.optim.optimizers import OptimParams, optimize
+    from alink_tpu.ops.fieldblock import FieldBlockMeta
+
+    n_rows = int(os.environ.get("ALINK_TPU_DISKBENCH_ROWS", "1000000"))
+    path = os.path.join(tempfile.gettempdir(),
+                        f"alink_diskbench_{n_rows}_{N_FIELDS}.libsvm")
+    fb_idx_true, y_true = make_ctr_fieldblock(n_rows, seed=42)
+    if not os.path.exists(path):
+        # vectorized LibSVM formatting: per-field "global_idx:1" tokens
+        # via np.char ops (a Python join over 32M tokens would dominate)
+        flat = (fb_idx_true
+                + (np.arange(N_FIELDS, dtype=np.int32) * FIELD_SIZE)[None, :]
+                + 1)                                    # 1-based indices
+        row = np.where(y_true > 0, "1", "-1").astype("U8")
+        for k in range(N_FIELDS):
+            tok = np.char.add(np.char.add(" ", flat[:, k].astype("U7")), ":1")
+            row = np.char.add(row, tok)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write("\n".join(row))
+            f.write("\n")
+        os.replace(tmp, path)
+
+    n_shards = 8                 # per-host sharded readers, drained serially
+    meta = FieldBlockMeta(N_FIELDS, FIELD_SIZE)
+    offs = (np.arange(N_FIELDS, dtype=np.int64) * FIELD_SIZE)[None, :]
+
+    def load_from_disk():
+        t0 = time.perf_counter()
+        blobs = [_load_line_bytes(path, False, (i, n_shards))
+                 for i in range(n_shards)]
+        t_read = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        parts = [parse_libsvm_bytes(b, 1) for b in blobs]
+        t_parse = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        labels = np.concatenate([p[0] for p in parts]).astype(np.float32)
+        idx = np.concatenate([p[2] for p in parts]).reshape(-1, N_FIELDS)
+        fb = (idx - offs).astype(np.int32)              # field-local encode
+        t_enc = time.perf_counter() - t0
+        return fb, labels, {"read_s": round(t_read, 3),
+                            "parse_s": round(t_parse, 3),
+                            "encode_s": round(t_enc, 3)}
+
+    def train(fb, labels):
+        data = {"fb_idx": fb, "y": labels,
+                "w": np.ones(len(labels), np.float32)}
+        obj = UnaryLossObjFunc(LogLossFunc(), DIM, l2=1e-4, fb_meta=meta)
+        coef, _, _ = optimize(obj, data, OptimParams(
+            method="LBFGS", max_iter=3, epsilon=0.0), h.env)
+        np.asarray(coef)
+
+    # warm the compile cache so neither timing includes compilation
+    fb0, y0, _ = load_from_disk()
+    train(fb0, y0)
+    assert (fb0 == fb_idx_true).all() and len(y0) == n_rows
+
+    t0 = time.perf_counter()
+    fb, labels, split = load_from_disk()
+    train(fb, labels)
+    t_total = time.perf_counter() - t0
+    pipeline_sps = n_rows / t_total / h.chips
+
+    t0 = time.perf_counter()
+    train(fb_idx_true, y_true)
+    t_mem = time.perf_counter() - t0
+    mem_sps = n_rows / t_mem / h.chips
+
+    bytes_read = os.path.getsize(path)
+    return {"samples_per_sec_per_chip": round(pipeline_sps, 1),
+            "in_memory_samples_per_sec_per_chip": round(mem_sps, 1),
+            "pipeline_vs_memory": round(pipeline_sps / mem_sps, 3),
+            "fixture_mb": round(bytes_read / 1e6, 1),
+            "source_mb_per_sec": round(
+                bytes_read / 1e6 / (split["read_s"] + split["parse_s"]), 1),
+            **split, "train_s": round(t_total - split["read_s"]
+                                      - split["parse_s"] - split["encode_s"], 3),
+            "dt_s": round(t_total, 3)}
 
 
 # ---------------------------------------------------------------------------
@@ -500,8 +739,13 @@ def bench_gbdt(h: Harness):
         np.asarray(curve)
         return tf, tb, tm, tv, edges, base
 
-    dt = h.delta(run, trees)
-    sps = n * trees / dt / h.chips
+    # span must be ~3x the 50-bench trees: the true marginal cost of 49
+    # trees (~0.3 s) sits inside the tunnel's ±0.5 s contention noise and
+    # the r3-trial delta came out NEGATIVE (clamped), recording a
+    # nonsense 2.4e15 samples/s
+    span = 150
+    dt = h.delta(run, span)
+    sps = n * span / dt / h.chips
 
     tf, tb, tm, tv, edges, base, curve, _ = gbdt_train(
         X, y, TreeTrainParams(num_trees=trees, max_depth=depth,
@@ -517,8 +761,10 @@ def bench_gbdt(h: Harness):
     base_iters = 2
     edges_np = np.asarray(edges)
     b_np = np.asarray(binned)
-    t0 = time.perf_counter()
-    for _ in range(base_iters):
+    cpu_times = []
+    for _rep in range(3):
+      t0 = time.perf_counter()
+      for _ in range(base_iters):
         node = np.zeros(n, np.int64)
         Fcur = np.zeros(n, np.float32)
         prob = 1.0 / (1.0 + np.exp(-Fcur))
@@ -541,11 +787,27 @@ def bench_gbdt(h: Harness):
             bf = best // (n_bins - 1)
             bb = best % (n_bins - 1)
             node = node * 2 + (b_np[np.arange(n), bf[node]] > bb[node])
-    cpu_sps = n * base_iters / (time.perf_counter() - t0)
+      cpu_times.append(time.perf_counter() - t0)
+    cpu_sps = n * base_iters / min(cpu_times)
+    # quality anchor (VERDICT r2 #8): sklearn HistGradientBoosting on the
+    # IDENTICAL matrix — proves the trainer extracts the planted signal
+    # as well as a reference implementation does, not just "learns"
+    from sklearn.ensemble import HistGradientBoostingClassifier
+    hgb = HistGradientBoostingClassifier(
+        max_iter=trees, max_depth=depth, learning_rate=0.3,
+        max_bins=n_bins, early_stopping=False)
+    hgb.fit(X, y)
+    sk_auc = _auc(y, hgb.decision_function(X))
+
+    # per sample per TREE: depth levels of one-hot histogram einsums over
+    # (F features x n_bins) x 3 stats channels (tree/hist.py): issued
+    # flops = depth * F * 2*n_bins*3 (samples/sec already counts trees)
     return {"samples_per_sec_per_chip": round(sps, 1),
             "vs_baseline": round(sps / cpu_sps, 3),
             "iters_trees_x_depth": f"{trees}x{depth}", "auc": round(auc, 4),
-            "dt_s": round(dt, 3)}
+            "sklearn_auc": round(sk_auc, 4),
+            "dt_s": round(dt, 3),
+            **mfu(sps, depth * F * 2 * n_bins * 3)}
 
 
 # ---------------------------------------------------------------------------
@@ -603,10 +865,16 @@ def bench_als(h: Harness):
             np.add.at(b, ids, ratings[:, None] * x)
             fac[:] = np.linalg.solve(A + 0.1 * eye, b[:, :, None])[:, :, 0]
     cpu_sps = nnz * base_iters / (time.perf_counter() - t0)
+    # per sample per iter: 2 half-sweeps x (r^2+r+1)-col contribution rows
+    # (outer product + prefix) ~ 2 * 2*(r^2+r+1) flops; the (U+I) batched
+    # r^3 GJ solves amortize to ~(U+I)*2*r^3/nnz. The prefix pipeline is
+    # HBM-bound: ~6 passes over the (nnz, r^2+r+1) f32 contrib per side.
+    fps = 2 * 2 * (rank * rank + rank + 1) + (U + I) * 2 * rank ** 3 // nnz
+    bps = 2 * 6 * (rank * rank + rank + 1) * 4
     return {"samples_per_sec_per_chip": round(sps, 1),
             "vs_baseline": round(sps / cpu_sps, 3),
             "iters_to_converge": int(n_conv), "rmse": round(rmse, 4),
-            "dt_s": round(dt, 3)}
+            "dt_s": round(dt, 3), **mfu(sps, fps, bytes_per_sample=bps)}
 
 
 # ---------------------------------------------------------------------------
@@ -618,6 +886,7 @@ def main():
                      ("kmeans_iris", bench_kmeans),
                      ("softmax_mnist", bench_softmax),
                      ("ftrl_criteo", bench_ftrl),
+                     ("logreg_from_disk", bench_logreg_from_disk),
                      ("gbdt_adult", bench_gbdt),
                      ("als_movielens", bench_als)):
         r = None

@@ -507,3 +507,76 @@ def test_ftrl_fb_demotes_to_generic_midstream():
     lt = final.schema.types[2]
     coef = LinearModelDataConverter(lt).load_model(final).coef
     assert np.isfinite(coef).all() and np.abs(coef).max() > 0
+
+
+def test_prefetch_preserves_order_and_propagates_errors():
+    """The stream prefetcher (VERDICT r2 #4) must be order-transparent:
+    a FIFO hand-off, identical sequence, upstream exceptions re-raised
+    at the consumption point, bounded queue giving backpressure."""
+    import time as _time
+
+    from alink_tpu.operator.stream.prefetch import prefetch
+
+    # order over a non-trivial length with a slow consumer
+    out = []
+    for v in prefetch(iter(range(500)), depth=3):
+        out.append(v)
+    assert out == list(range(500))
+
+    # exception propagation
+    def boom():
+        yield 1
+        yield 2
+        raise RuntimeError("upstream failed")
+
+    got = []
+    try:
+        for v in prefetch(boom(), depth=2):
+            got.append(v)
+        raise AssertionError("should have raised")
+    except RuntimeError as e:
+        assert "upstream failed" in str(e)
+    assert got == [1, 2]
+
+    # backpressure: producer cannot run more than depth ahead
+    produced = []
+
+    def tracked():
+        for i in range(10):
+            produced.append(i)
+            yield i
+
+    it = prefetch(tracked(), depth=2)
+    next(it)
+    _time.sleep(0.05)
+    # 1 yielded + ≤depth in queue + ≤1 in-flight put
+    assert len(produced) <= 1 + 2 + 1, produced
+
+    # depth=0 disables (pure inline iteration)
+    assert list(prefetch(iter([1, 2, 3]), depth=0)) == [1, 2, 3]
+
+
+def test_ftrl_prefetch_identical_model(monkeypatch):
+    """Prefetching overlaps encode with device compute but must not
+    change a single bit of the trained model (no batch reordering)."""
+    from alink_tpu.operator.common.linear.base import LinearModelDataConverter
+
+    table = _sparse_lr_fixture(n=256, dim=24, nnz=5, seed=3)
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=3).link_from(
+        MemSourceBatchOp(table.first_n(64)))
+
+    def run():
+        ftrl = FtrlTrainStreamOp(
+            warm, label_col="label", vector_col="vec", alpha=0.5,
+            l1=0.001, l2=0.001, time_interval=1e9).link_from(
+            MemSourceStreamOp(table, batch_size=64))
+        final = list(ftrl.micro_batches())[-1]
+        lt = final.schema.types[2]
+        return LinearModelDataConverter(lt).load_model(final).coef
+
+    monkeypatch.setenv("ALINK_TPU_STREAM_PREFETCH", "0")
+    coef_off = run()
+    monkeypatch.setenv("ALINK_TPU_STREAM_PREFETCH", "3")
+    coef_on = run()
+    np.testing.assert_array_equal(coef_off, coef_on)

@@ -1,0 +1,321 @@
+# -*- coding: utf-8 -*-
+"""Generate alink_tpu's Mandarin frequency dictionary (zh_dict.txt).
+
+The reference bundles jieba's ~350k-entry dictionary plus a 676K HMM
+emission table (jiebasegment/WordDictionary.java, viterbi/FinalSeg.java).
+This repo may not copy those resources, and the build has no network
+egress — so the dictionary is COMPILED here, deterministically, from:
+
+  1. a hand-authored core vocabulary (common words across POS classes,
+     written for this project);
+  2. compositional expansion over real components:
+     - numerals (一百, 三千五, 第十二, 百分之三十 ...),
+     - dates/times (三月, 十五日, 星期四, 二零二四年 ...),
+     - full person names = real surname inventory x common given-name
+       characters (王伟, 李秀英 ... — the reference dictionary likewise
+       carries bulk name entries),
+     - place names = province/city stems x administrative suffixes
+       (北京市, 广东省, 朝阳区 ...),
+     - measure-word phrases (一个, 两张, 几次 ...),
+     - verb reduplication and V一V (看看, 想一想 ...),
+     - common affixed forms (老师们, 科学家, 现代化 ...).
+
+Frequencies are band-based: hand-authored core words carry corpus-scale
+bands by class; generated items carry low bands (they exist so the DAG
+*can* take them, and so OOV Viterbi sees realistic B/E char statistics —
+exact counts matter far less than relative magnitude).
+
+Run:  python tools/gen_zh_dict.py   (rewrites
+      alink_tpu/operator/common/nlp/zh_dict.txt deterministically)
+"""
+
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from tools.zh_core_vocab import CORE_VOCAB  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "alink_tpu",
+                   "operator", "common", "nlp", "zh_dict.txt")
+
+# ---------------------------------------------------------------------------
+# component inventories (real items, hand-authored)
+# ---------------------------------------------------------------------------
+
+DIGITS = "一二三四五六七八九"
+SMALL_UNITS = ["十", "百", "千"]
+BIG_UNITS = ["万", "亿"]
+
+SURNAMES = (
+    "王李张刘陈杨黄赵吴周徐孙马朱胡郭何高林罗郑梁谢宋唐许韩冯邓曹彭曾肖田董袁潘于蒋蔡余杜叶程苏魏吕丁任沈姚卢姜崔钟谭陆汪范金石廖贾夏韦付方白邹孟熊秦邱江尹薛闫段雷侯龙史陶黎贺顾毛郝龚邵万钱严覃武戴莫孔向汤"
+)
+DOUBLE_SURNAMES = ["欧阳", "司马", "上官", "诸葛", "东方", "皇甫", "尉迟",
+                   "司徒", "长孙", "慕容"]
+GIVEN_CHARS = (
+    "伟芳娜敏静丽强磊军洋勇艳杰娟涛明超秀霞平刚桂英华玉萍红娥玲芬燕彬鹏浩凯秀兰珍莉斌宇浩然博文昊轩子涵雨欣怡梓晨思宇佳琪志国建军建华国强国华志强志明海燕海燕春梅春花秋月冬梅雪梅丹凤霞云龙凤鑫淼森晶磊鑫焱垚嘉琪欣怡雅婷婷玥璐瑶倩颖莹洁慧巧美惠珠翠雅芝妍茜秋珊莎锦黛青倩婷姣婉娴瑾颖露瑶怡婵雁蓓纨仪荷丹蓉眉君琴蕊薇菁梦岚苑婕馨瑗琰韵融园艺咏卿聪澜纯毓悦昭冰爽琬茗羽希宁欣飘育滢馥筠柔竹霭凝晓欢霄枫芸菲寒伊亚宜可姬舒影荔枝思丽"
+)
+
+PROVINCES = ["北京", "天津", "上海", "重庆", "河北", "山西", "辽宁", "吉林",
+             "黑龙江", "江苏", "浙江", "安徽", "福建", "江西", "山东", "河南",
+             "湖北", "湖南", "广东", "海南", "四川", "贵州", "云南", "陕西",
+             "甘肃", "青海", "台湾", "内蒙古", "广西", "西藏", "宁夏", "新疆",
+             "香港", "澳门"]
+CITIES = ["广州", "深圳", "杭州", "南京", "苏州", "成都", "武汉", "西安",
+          "郑州", "长沙", "沈阳", "青岛", "大连", "厦门", "宁波", "无锡",
+          "佛山", "东莞", "泉州", "南通", "合肥", "福州", "济南", "昆明",
+          "哈尔滨", "长春", "石家庄", "太原", "南昌", "贵阳", "南宁", "兰州",
+          "乌鲁木齐", "呼和浩特", "银川", "西宁", "拉萨", "海口", "三亚",
+          "珠海", "中山", "惠州", "嘉兴", "温州", "绍兴", "台州", "金华",
+          "徐州", "常州", "扬州", "烟台", "潍坊", "临沂", "洛阳", "开封",
+          "襄阳", "宜昌", "岳阳", "衡阳", "桂林", "柳州", "遵义", "绵阳",
+          "唐山", "保定", "邯郸", "秦皇岛", "包头", "鞍山", "抚顺", "吉林",
+          "齐齐哈尔", "大庆", "牡丹江", "镇江", "泰州", "盐城", "淮安",
+          "连云港", "湖州", "芜湖", "蚌埠", "安庆", "漳州", "莆田", "九江",
+          "赣州", "淄博", "济宁", "威海", "日照", "新乡", "安阳", "焦作",
+          "黄石", "十堰", "荆州", "株洲", "湘潭", "常德", "汕头", "湛江",
+          "茂名", "肇庆", "江门", "北海", "攀枝花", "泸州", "德阳", "南充",
+          "宜宾", "曲靖", "大理", "宝鸡", "咸阳", "延安", "天水", "克拉玛依"]
+DISTRICTS = ["朝阳", "海淀", "东城", "西城", "丰台", "石景山", "浦东",
+             "黄浦", "徐汇", "长宁", "静安", "虹口", "杨浦", "闵行", "宝山",
+             "天河", "越秀", "荔湾", "白云", "番禺", "南山", "福田", "罗湖",
+             "宝安", "龙岗", "西湖", "滨江", "余杭", "萧山", "鼓楼", "玄武",
+             "秦淮", "武侯", "锦江", "青羊", "金牛", "洪山", "武昌", "汉阳",
+             "雁塔", "碑林", "未央", "岳麓", "芙蓉", "天心"]
+COUNTRIES = ["中国", "美国", "日本", "韩国", "英国", "法国", "德国", "俄罗斯",
+             "印度", "巴西", "加拿大", "澳大利亚", "意大利", "西班牙",
+             "葡萄牙", "荷兰", "瑞士", "瑞典", "挪威", "丹麦", "芬兰",
+             "波兰", "希腊", "土耳其", "埃及", "南非", "墨西哥", "阿根廷",
+             "智利", "泰国", "越南", "新加坡", "马来西亚", "印度尼西亚",
+             "菲律宾", "缅甸", "柬埔寨", "老挝", "蒙古", "朝鲜", "巴基斯坦",
+             "孟加拉", "伊朗", "伊拉克", "沙特", "以色列", "乌克兰",
+             "比利时", "奥地利", "爱尔兰", "新西兰", "捷克", "匈牙利"]
+
+MEASURES = "个只条张件套名位本台辆艘间家场次回顿番趟遍层排行组队双对副幅座栋棵株朵粒颗滴块段节届期封笔门科岁年月日天周"
+MEASURE_NUMS = ["一", "两", "三", "四", "五", "六", "七", "八", "九", "十",
+                "几", "每", "半", "数", "这", "那", "上", "下", "首", "同"]
+
+REDUP_VERBS = ["看", "听", "想", "说", "走", "坐", "玩", "试", "问", "读",
+               "写", "聊", "歇", "逛", "查", "算", "等", "找", "摸", "尝",
+               "谈", "转", "动", "笑", "练", "比", "猜", "数", "擦", "洗"]
+
+PERSON_SUFFIX = ["们", "家", "者", "员", "长", "手", "师", "士", "生", "工"]
+ABSTRACT_SUFFIX = ["化", "性", "度", "率", "力", "感", "观", "界", "论",
+                   "学", "法", "式", "型", "类", "版", "期", "区", "部",
+                   "所", "站", "厅", "馆", "院", "局", "处", "科"]
+STEMS_FOR_SUFFIX = ["现代", "全球", "信息", "工业", "城市", "市场", "科学",
+                    "自动", "数字", "智能", "网络", "标准", "规范", "多样",
+                    "合理", "可能", "重要", "安全", "稳定", "可靠", "敏感",
+                    "责任", "荣誉", "幸福", "满意", "成功", "效率", "增长",
+                    "利用", "覆盖", "就业", "入学", "合格", "优秀", "道德",
+                    "价值", "人生", "世界", "历史", "艺术", "文学", "哲学",
+                    "经济", "社会", "自然", "语言", "心理", "物理", "化学",
+                    "生物", "地理", "教育", "管理", "金融", "法律", "医学",
+                    "工程", "环境", "能源", "材料", "生活", "工作", "学习",
+                    "研究", "发展", "建设", "服务", "生产", "消费", "投资"]
+
+
+def number_words():
+    """Real numeral words: 十五, 三百, 五千二, 第十二, 百分之三十 ..."""
+    words = set()
+    # 11..99 (十一..九十九)
+    for t in [""] + list(DIGITS):
+        for o in [""] + list(DIGITS):
+            if t == "" and o == "":
+                continue
+            w = (t + "十" + o) if (t or o != "") else ""
+            if t == "" and o:
+                w = "十" + o          # 十一..十九
+            elif t and o == "":
+                w = t + "十"          # 二十..九十
+            elif t and o:
+                w = t + "十" + o      # 二十一..
+            if w:
+                words.add(w)
+    # D百 / D千 / D万 / D亿 (+一位 tail: 三百五, 两千八)
+    for d in list(DIGITS) + ["两", "几", "数"]:
+        for u in SMALL_UNITS + BIG_UNITS:
+            words.add(d + u)
+            for tail in DIGITS:
+                words.add(d + u + tail)
+    # 第N (ordinals)
+    for d in list(DIGITS) + ["十", "百"]:
+        words.add("第" + d)
+    for t in DIGITS:
+        words.add("第十" + t)
+        words.add("第" + t + "十")
+    # percent 百分之N
+    for d in list(DIGITS) + ["十", "百"]:
+        words.add("百分之" + d)
+    for t in DIGITS:
+        words.add("百分之十" + t)
+        words.add("百分之" + t + "十")
+    return sorted(words)
+
+
+def date_words():
+    words = set()
+    months = ["一", "二", "三", "四", "五", "六", "七", "八", "九", "十",
+              "十一", "十二"]
+    for m in months:
+        words.add(m + "月")
+        words.add(m + "月份")
+    days = months + ["十三", "十四", "十五", "十六", "十七", "十八", "十九",
+                     "二十", "二十一", "二十二", "二十三", "二十四", "二十五",
+                     "二十六", "二十七", "二十八", "二十九", "三十", "三十一"]
+    for d in days:
+        words.add(d + "日")
+        words.add(d + "号")
+    for w in ["一", "二", "三", "四", "五", "六", "日", "天"]:
+        words.add("星期" + w)
+        words.add("周" + w)
+        words.add("礼拜" + w)
+    for h in days[:24]:
+        words.add(h + "点")
+        words.add(h + "点钟")
+    for d in DIGITS + "零":
+        words.add(d + "年")
+    return sorted(words)
+
+
+def person_names():
+    """Full names: top surname inventory x given-name characters.
+
+    Two-char names (王伟) from every (surname, given) pair; three-char
+    names (王秀英) from a deterministic subsample of given-char pairs —
+    the full cross product would be ~900k entries, far beyond need."""
+    names = []
+    gc = sorted(set(GIVEN_CHARS))
+    for s in SURNAMES:
+        for g in gc:
+            names.append(s + g)
+    # deterministic 3-char subsample: per-surname cross product of two
+    # disjoint-stride slices of the given-char inventory (~325/surname —
+    # the full cross product would be ~2.9M entries; this matches the
+    # name density a corpus-derived dictionary would carry)
+    for si, s in enumerate(SURNAMES):
+        aset = gc[si % 13::13]
+        bset = gc[(si * 3) % 7::7]
+        for a in aset:
+            for b in bset:
+                names.append(s + a + b)
+    n = len(gc)
+    for s in DOUBLE_SURNAMES:
+        for k in range(40):
+            names.append(s + gc[(k * 17) % n])
+        for a in gc[3::23]:
+            for b in gc[5::11]:
+                names.append(s + a + b)
+    return names
+
+
+def place_names():
+    words = set()
+    for p in PROVINCES:
+        words.add(p)
+        words.add(p + ("市" if p in ("北京", "天津", "上海", "重庆") else "省"))
+        words.add(p + "人")
+    for c in CITIES:
+        words.add(c)
+        words.add(c + "市")
+        words.add(c + "人")
+    for d in DISTRICTS:
+        words.add(d)
+        words.add(d + "区")
+    for c in COUNTRIES:
+        words.add(c)
+        words.add(c + "人")
+        words.add(c + "语")
+    return sorted(words)
+
+
+def measure_phrases():
+    words = set()
+    for n in MEASURE_NUMS:
+        for m in MEASURES:
+            words.add(n + m)
+    return sorted(words)
+
+
+def redup_words():
+    words = set()
+    for v in REDUP_VERBS:
+        words.add(v + v)
+        words.add(v + "一" + v)
+        words.add(v + "了" + v)
+    return sorted(words)
+
+
+def affixed_words():
+    words = set()
+    for s in STEMS_FOR_SUFFIX:
+        for suf in ABSTRACT_SUFFIX:
+            words.add(s + suf)
+    people = ["工人", "农民", "学生", "老师", "医生", "护士", "司机",
+              "记者", "作家", "画家", "歌手", "演员", "律师", "法官",
+              "警察", "士兵", "科学家", "工程师", "设计师", "教授",
+              "专家", "学者", "读者", "观众", "听众", "用户", "客户",
+              "选手", "球员", "教练", "裁判", "厨师", "服务员", "经理",
+              "职员", "会计", "秘书", "助理", "主任", "主席", "部长",
+              "市长", "省长", "校长", "院长", "馆长", "团长", "队长",
+              "班长", "组长", "社长", "店长", "厂长", "船长", "机长"]
+    for p in people:
+        words.add(p)
+        words.add(p + "们")
+    return sorted(words)
+
+
+# frequency bands (log-ish spacing; core classes set in zh_core_vocab)
+BANDS = {
+    "number": 800, "date": 900, "measure": 1500, "redup": 300,
+    "affix": 400, "place": 600, "country": 1200, "name3": 25, "name2": 60,
+}
+
+
+def main():
+    entries = {}
+
+    def put(w, f):
+        if len(w) < 1 or " " in w:
+            return
+        entries[w] = max(entries.get(w, 0), f)
+
+    for w, f in CORE_VOCAB:
+        put(w, f)
+    # round-2's hand-tuned 1.1k list rides along as a base layer (it is
+    # equally original and already covers the segmenter's fixture set)
+    base = os.path.join(os.path.dirname(__file__), "zh_base_vocab.txt")
+    with open(base, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                w, _, c = line.partition(" ")
+                put(w, int(c))
+    for w in number_words():
+        put(w, BANDS["number"])
+    for w in date_words():
+        put(w, BANDS["date"])
+    for w in measure_phrases():
+        put(w, BANDS["measure"])
+    for w in redup_words():
+        put(w, BANDS["redup"])
+    for w in affixed_words():
+        put(w, BANDS["affix"])
+    for w in place_names():
+        put(w, BANDS["place"])
+    for w in person_names():
+        put(w, BANDS["name2"] if len(w) == 2 else BANDS["name3"])
+
+    out = os.path.abspath(OUT)
+    with open(out, "w", encoding="utf-8") as f:
+        f.write("# Mandarin frequency dictionary for alink_tpu — GENERATED\n"
+                "# by tools/gen_zh_dict.py (deterministic). Original\n"
+                "# compilation: hand-authored core vocabulary + composed\n"
+                "# real items (numerals, dates, full names, places,\n"
+                "# measures). NOT derived from the reference's resources.\n")
+        for w in sorted(entries, key=lambda w: (-entries[w], w)):
+            f.write(f"{w} {entries[w]}\n")
+    print(f"{len(entries)} entries -> {out}")
+
+
+if __name__ == "__main__":
+    main()
