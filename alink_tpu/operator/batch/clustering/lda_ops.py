@@ -29,7 +29,8 @@ from ....model.converters import (SimpleModelDataConverter, decode_array,
 from ....params.shared import (HasPredictionCol, HasPredictionDetailCol,
                                HasReservedCols, HasSeed, HasSelectedCol)
 from ...base import BatchOperator
-from ...common.clustering.lda import (em_lda_train, encode_corpus, lda_infer,
+from ...common.clustering.lda import (em_lda_train, encode_corpus,
+                                      gibbs_lda_train, lda_infer,
                                       online_lda_train)
 from ...common.nlp.vectorizer import (DocCountVectorizerModelConverter,
                                       train_doc_count_vectorizer)
@@ -91,7 +92,7 @@ class _LdaTrainParams(HasSelectedCol, HasSeed):
                       default=-1.0)
     BETA = ParamInfo("beta", float, "topic-word Dirichlet prior (-1=auto)",
                      default=-1.0)
-    METHOD = ParamInfo("method", str, "optimizer: em | online", default="em",
+    METHOD = ParamInfo("method", str, "optimizer: em | em_gibbs | online", default="em",
                        aliases=("optimizer",))
     VOCAB_SIZE = ParamInfo("vocab_size", int, "max vocabulary size",
                            default=1 << 18)
@@ -147,8 +148,21 @@ class LdaTrainBatchOp(BatchOperator, _LdaTrainParams):
             gamma = np.concatenate([wt, tot[None, :]], axis=0)
             model = LdaModelData(k, dcv.vocab, gamma, np.full((k,), a),
                                  b, "em", ll, perp)
+        elif method in ("gibbs", "em_gibbs"):
+            # the reference EM path IS collapsed Gibbs (EmCorpusStep.java);
+            # this is its AD-LDA device-resident sampler twin. Priors get
+            # the reference's +1 shift for the collapsed predictive rule
+            # (LdaTrainBatchOp.java:118-124) inside gibbs_lda_train's
+            # defaults when unset.
+            wt, tot, a, b, ll, perp = gibbs_lda_train(
+                ids, cnts, k, V, num_iter=self.get_num_iter(),
+                alpha=alpha, beta=beta, seed=seed)
+            gamma = np.concatenate([wt, tot[None, :]], axis=0)
+            model = LdaModelData(k, dcv.vocab, gamma, np.full((k,), a),
+                                 b, "em", ll, perp)
         else:
-            raise ValueError(f"LDA method must be em|online, got {method}")
+            raise ValueError(
+                f"LDA method must be em|em_gibbs|online, got {method}")
         self.set_output_table(LdaModelDataConverter().save_model(model))
         return self
 

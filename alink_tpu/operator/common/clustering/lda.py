@@ -264,6 +264,117 @@ def em_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
     return wt.T, wt.sum(1), alpha, beta, score, log_perp
 
 
+def expand_tokens(ids: np.ndarray, cnts: np.ndarray
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Bag-of-words (ids, counts) -> per-OCCURRENCE token arrays.
+
+    Collapsed Gibbs assigns a topic per token occurrence, not per bag
+    entry; a count-c entry expands to c slots. Returns (tok (n, T) int32,
+    mask (n, T) {0,1} f32) with zero padding, T = longest doc — never
+    truncated, so counts are conserved exactly (the Gibbs invariant)."""
+    n = ids.shape[0]
+    docs = []
+    for r in range(n):
+        row = np.repeat(ids[r], cnts[r].astype(np.int64))
+        docs.append(row)
+    T = max(max((len(d) for d in docs), default=1), 1)
+    tok = np.zeros((n, T), np.int32)
+    mask = np.zeros((n, T), np.float32)
+    for r, d in enumerate(docs):
+        tok[r, :len(d)] = d
+        mask[r, :len(d)] = 1.0
+    return tok, mask
+
+
+def gibbs_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
+                    num_iter: int = 50, alpha: float = -1.0,
+                    beta: float = -1.0, seed: int = 0, env=None):
+    """Distributed collapsed-Gibbs LDA — the TPU shape of the reference's
+    EmCorpusStep (LdaTrainBatchOp.java:135; VERDICT r2 #7).
+
+    The reference's sampler walks tokens sequentially, updating global
+    counts token by token — hostile to a systolic array. The TPU-native
+    equivalent is the standard distributed approximation (AD-LDA,
+    Newman et al. JMLR'09) with Jacobi-style within-worker updates:
+
+    * per-token topic assignments ``z`` live DEVICE-RESIDENT in the
+      superstep carry, sharded with the doc partition (the analogue of
+      the reference's per-task topic arrays in SessionSharedObjs);
+    * each superstep rebuilds doc-topic counts ``nd`` (one-hot einsum),
+      word-topic counts ``nw`` (scatter-add, ``lax.psum`` across
+      workers — the reference's AllReduce of wordTopicStat), subtracts
+      each token's OWN contribution, and samples every token in
+      parallel with ``jax.random.categorical`` over the collapsed
+      posterior (nd-z+alpha)*(nw-z+beta)/(nt-z+V*beta);
+    * counts re-psum next superstep, so cross-worker staleness is one
+      superstep — exactly AD-LDA's approximation.
+
+    Defaults mirror the reference Gibbs path (alpha=50/k+1, beta=0.01+1
+    shifted priors, LdaTrainBatchOp.java:118-124 — the +1 shift is
+    applied by the CALLER as in the reference; here plain alpha/beta are
+    used directly in the collapsed rule). Returns (wordTopicCounts
+    (V, k), topicCounts (k,), alpha, beta, loglik, log_perplexity).
+    """
+    if alpha <= 0:
+        alpha = 50.0 / k + 1.0
+    if beta <= 0:
+        beta = 0.01 + 1.0
+    tok, mask = expand_tokens(ids, cnts)
+    n, T = tok.shape
+    total_words = float(mask.sum())
+    rng = np.random.RandomState(seed)
+    z0 = rng.randint(0, k, size=(n, T)).astype(np.int32)
+
+    def stage(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("z", ctx.get_obj("z_init"))
+            ctx.put_obj("score", jnp.zeros(()))
+        tok_b = ctx.get_obj("tok")
+        mask_b = ctx.get_obj("mask")
+        z = ctx.get_obj("z")
+        oh = jax.nn.one_hot(z, k, dtype=jnp.float32) * mask_b[..., None]
+        nd = oh.sum(1)                                         # (n, k)
+        # word-topic counts: scatter over flat (topic, word) cells
+        flat = (z.astype(jnp.int32) * V + tok_b).reshape(-1)
+        nw = jnp.zeros((k * V,), jnp.float32).at[flat].add(
+            mask_b.reshape(-1)).reshape(k, V)
+        nw = ctx.all_reduce_sum(nw)                            # psum
+        nt = nw.sum(1)                                         # (k,)
+        # per-token posterior with own contribution removed (collapsed rule)
+        nd_m = nd[:, None, :] - oh                             # (n, T, k)
+        nw_tok = jnp.take(nw.T, tok_b, axis=0) - oh            # (n, T, k)
+        nt_m = nt[None, None, :] - oh                          # (n, T, k)
+        logp = (jnp.log(nd_m + alpha) + jnp.log(nw_tok + beta)
+                - jnp.log(nt_m + V * beta))
+        key = jax.random.fold_in(jax.random.PRNGKey(seed), ctx.step_no)
+        key = jax.random.fold_in(key, ctx.task_id)
+        z_new = jax.random.categorical(key, logp, axis=-1).astype(jnp.int32)
+        z_new = jnp.where(mask_b > 0, z_new, 0)
+        ctx.put_obj("z", z_new)
+        # corpus log-likelihood proxy from the current counts
+        theta = (nd + alpha) / (nd.sum(1, keepdims=True) + k * alpha)
+        beta_hat = (nw + beta) / (nw.sum(1, keepdims=True) + V * beta)
+        bw = jnp.take(beta_hat.T, tok_b, axis=0)               # (n, T, k)
+        pw = jnp.einsum("nk,ntk->nt", theta, bw)
+        ctx.put_obj("score", ctx.all_reduce_sum(
+            (mask_b * jnp.log(jnp.maximum(pw, 1e-100))).sum()))
+
+    q = (IterativeComQueue(env=env, max_iter=max(num_iter, 1), seed=seed)
+         .init_with_partitioned_data("tok", tok)
+         .init_with_partitioned_data("mask", mask)
+         .init_with_partitioned_data("z_init", z0)
+         .add(stage))
+    res = q.exec()
+    # final global counts from the final assignments (all shards)
+    z_fin = res.concat("z", total=n)
+    nw = np.zeros((k, V), np.float64)
+    np.add.at(nw.reshape(-1), (z_fin.astype(np.int64) * V
+                               + tok).reshape(-1)[mask.reshape(-1) > 0], 1.0)
+    score = float(res.get("score"))
+    log_perp = -score / max(total_words, 1.0)
+    return nw.T, nw.sum(1), alpha, beta, score, log_perp
+
+
 def lda_infer(ids: np.ndarray, cnts: np.ndarray, word_topic: np.ndarray,
               alpha, n_inner: int = 50, seed: int = 0) -> np.ndarray:
     """Doc-topic inference at predict time (reference LdaUtil /

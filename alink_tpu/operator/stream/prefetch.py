@@ -65,13 +65,16 @@ def prefetch(it: Iterable[T], depth: int = None) -> Iterator[T]:
             for item in it:
                 if not put(item):
                     return
+            # run the upstream generator's finally BEFORE the sentinel so
+            # a failing flush-on-close propagates instead of dying on the
+            # daemon thread after the consumer already saw a clean end
+            close = getattr(it, "close", None)
+            if close is not None:
+                close()
         except BaseException as e:  # propagate to the consumer
             err.append(e)
         finally:
             put(_SENTINEL)
-            close = getattr(it, "close", None)
-            if close is not None:   # run the upstream generator's finally
-                close()
 
     th = threading.Thread(target=worker, daemon=True,
                           name="alink-stream-prefetch")

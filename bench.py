@@ -694,8 +694,16 @@ def bench_logreg_from_disk(h: Harness):
     mem_sps = n_rows / t_mem / h.chips
 
     bytes_read = os.path.getsize(path)
+    # train_s is dominated by the per-call fixed cost of building a fresh
+    # ComQueue program (trace + compile-cache lookup, ~8-10 s — the same
+    # fixed cost delta() subtracts out for the per-iteration rows); it is
+    # identical in both timings, so pipeline_vs_memory isolates the disk
+    # path's cost, and read_s/parse_s/encode_s attribute it.
     return {"samples_per_sec_per_chip": round(pipeline_sps, 1),
             "in_memory_samples_per_sec_per_chip": round(mem_sps, 1),
+            "source_samples_per_sec": round(
+                n_rows / (split["read_s"] + split["parse_s"]
+                          + split["encode_s"]), 1),
             "pipeline_vs_memory": round(pipeline_sps / mem_sps, 3),
             "fixture_mb": round(bytes_read / 1e6, 1),
             "source_mb_per_sec": round(

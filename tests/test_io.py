@@ -441,3 +441,97 @@ def test_csv_oversized_quoted_header_rejected(tmp_path):
     schema = TableSchema.parse("a LONG, s STRING")
     with _pytest.raises(ValueError, match="header"):
         read_csv(str(p), schema, ignore_first_line=True)
+
+
+def test_kafka_real_client_adapter_path(monkeypatch):
+    """VERDICT r2 #9: exercise the REAL kafka-python adapter
+    (_KafkaPythonClient) and _default_client, not only FakeKafka.
+
+    kafka-python is not installed in this image, so an API-faithful
+    double of the kafka module (KafkaConsumer(topic, bootstrap_servers=,
+    ...) with poll(timeout_ms=) -> {TopicPartition: [records]}, lazy
+    KafkaProducer with send(topic, value)) is installed in sys.modules,
+    backed by an in-process broker with per-consumer offsets. Everything
+    from the op layer down through _KafkaPythonClient's consumer
+    caching, batch flattening, and lazy producer init is the production
+    code path."""
+    import sys
+    import types
+    from collections import namedtuple
+
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.common.types import TableSchema
+    from alink_tpu.io.kafka import (KafkaSinkStreamOp, KafkaSourceStreamOp,
+                                    _KafkaPythonClient, _default_client)
+    from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+
+    Record = namedtuple("ConsumerRecord", "topic partition offset value")
+    TopicPartition = namedtuple("TopicPartition", "topic partition")
+    broker = {"topics": {}, "consumer_count": 0, "producer_count": 0}
+
+    class KafkaConsumer:
+        def __init__(self, *topics, bootstrap_servers=None,
+                     consumer_timeout_ms=None, auto_offset_reset="latest"):
+            assert bootstrap_servers == "fakehost:9092"
+            assert auto_offset_reset == "earliest"
+            self._topics = topics
+            self._offsets = {t: 0 for t in topics}
+            broker["consumer_count"] += 1
+
+        def poll(self, timeout_ms=0):
+            out = {}
+            for t in self._topics:
+                log = broker["topics"].setdefault(t, [])
+                start = self._offsets[t]
+                if start < len(log):
+                    out[TopicPartition(t, 0)] = [
+                        Record(t, 0, i, v)
+                        for i, v in enumerate(log[start:], start)]
+                    self._offsets[t] = len(log)
+            return out
+
+    class KafkaProducer:
+        def __init__(self, bootstrap_servers=None):
+            assert bootstrap_servers == "fakehost:9092"
+            broker["producer_count"] += 1
+
+        def send(self, topic, value):
+            broker["topics"].setdefault(topic, []).append(value)
+
+    fake_mod = types.ModuleType("kafka")
+    fake_mod.KafkaConsumer = KafkaConsumer
+    fake_mod.KafkaProducer = KafkaProducer
+    monkeypatch.setitem(sys.modules, "kafka", fake_mod)
+
+    # _default_client builds the real adapter when bootstrap_servers set,
+    # and raises without it
+    client = _default_client("fakehost:9092")
+    assert isinstance(client, _KafkaPythonClient)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="bootstrap_servers"):
+        _default_client(None)
+
+    # sink -> broker through the real producer path
+    rows = [(1, "a"), (2, "b"), (3, "c")]
+    src = MemSourceStreamOp(rows, "x LONG, s STRING", batch_size=2)
+    sink = KafkaSinkStreamOp(topic="t1", format="json",
+                             bootstrap_servers="fakehost:9092").link_from(src)
+    from alink_tpu.operator.base import StreamOperator
+    StreamOperator.execute()
+    assert len(broker["topics"]["t1"]) == 3
+    assert broker["producer_count"] == 1        # lazy init, one producer
+
+    # broker -> source through the real consumer path (poll+flatten)
+    src2 = KafkaSourceStreamOp(topic="t1", format="json",
+                               schema_str="x LONG, s STRING",
+                               bootstrap_servers="fakehost:9092",
+                               max_batches=2)
+    got = [r for _, mt in src2.timed_batches() for r in mt.to_rows()]
+    assert sorted(got) == rows, got
+    assert broker["consumer_count"] == 1        # cached per topic
+
+    # adapter caches the consumer across polls: a second poll sees only
+    # NEW messages (offset tracking — the semantics FakeKafka also has)
+    client.send("t2", b'{"x": 9}')
+    assert client.poll("t2") == [b'{"x": 9}']
+    assert client.poll("t2") == []
