@@ -141,20 +141,16 @@ class LdaTrainBatchOp(BatchOperator, _LdaTrainParams):
             beta_out = beta if beta > 0 else 1.0 / k
             model = LdaModelData(k, dcv.vocab, gamma, avec, beta_out,
                                  "online", ll, perp)
-        elif method == "em":
-            wt, tot, a, b, ll, perp = em_lda_train(
-                ids, cnts, k, V, num_iter=self.get_num_iter(),
-                alpha=alpha, beta=beta, seed=seed)
-            gamma = np.concatenate([wt, tot[None, :]], axis=0)
-            model = LdaModelData(k, dcv.vocab, gamma, np.full((k,), a),
-                                 b, "em", ll, perp)
-        elif method in ("gibbs", "em_gibbs"):
-            # the reference EM path IS collapsed Gibbs (EmCorpusStep.java);
-            # this is its AD-LDA device-resident sampler twin. Priors get
-            # the reference's +1 shift for the collapsed predictive rule
-            # (LdaTrainBatchOp.java:118-124) inside gibbs_lda_train's
-            # defaults when unset.
-            wt, tot, a, b, ll, perp = gibbs_lda_train(
+        elif method in ("em", "gibbs", "em_gibbs"):
+            # em = batched variational EM; em_gibbs = the AD-LDA sampler
+            # twin of the reference's collapsed Gibbs (EmCorpusStep.java).
+            # Both produce the same (V, k)+totals count-matrix model, so
+            # they share the model construction. gibbs_lda_train's
+            # DEFAULTS already include the reference's +1 prior shift for
+            # the collapsed predictive rule (LdaTrainBatchOp.java:118-124);
+            # explicitly-set alpha/beta are used as given.
+            train_fn = em_lda_train if method == "em" else gibbs_lda_train
+            wt, tot, a, b, ll, perp = train_fn(
                 ids, cnts, k, V, num_iter=self.get_num_iter(),
                 alpha=alpha, beta=beta, seed=seed)
             gamma = np.concatenate([wt, tot[None, :]], axis=0)

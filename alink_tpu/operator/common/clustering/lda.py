@@ -309,10 +309,10 @@ def gibbs_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
     * counts re-psum next superstep, so cross-worker staleness is one
       superstep — exactly AD-LDA's approximation.
 
-    Defaults mirror the reference Gibbs path (alpha=50/k+1, beta=0.01+1
-    shifted priors, LdaTrainBatchOp.java:118-124 — the +1 shift is
-    applied by the CALLER as in the reference; here plain alpha/beta are
-    used directly in the collapsed rule). Returns (wordTopicCounts
+    Default priors mirror the reference Gibbs path INCLUDING its +1
+    shift (alpha=50/k+1, beta=0.01+1, LdaTrainBatchOp.java:118-124);
+    explicitly-passed alpha/beta are used as given in the collapsed
+    rule. Returns (wordTopicCounts
     (V, k), topicCounts (k,), alpha, beta, loglik, log_perplexity).
     """
     if alpha <= 0:

@@ -64,16 +64,20 @@ def prefetch(it: Iterable[T], depth: int = None) -> Iterator[T]:
         try:
             for item in it:
                 if not put(item):
-                    return
-            # run the upstream generator's finally BEFORE the sentinel so
-            # a failing flush-on-close propagates instead of dying on the
-            # daemon thread after the consumer already saw a clean end
-            close = getattr(it, "close", None)
-            if close is not None:
-                close()
+                    break
         except BaseException as e:  # propagate to the consumer
             err.append(e)
         finally:
+            # close the upstream generator on EVERY exit path (normal end,
+            # upstream error, consumer abandonment) and BEFORE the
+            # sentinel, so a failing flush-on-close still reaches the
+            # consumer instead of dying on the daemon thread
+            try:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
+            except BaseException as e:
+                err.append(e)
             put(_SENTINEL)
 
     th = threading.Thread(target=worker, daemon=True,
