@@ -733,15 +733,25 @@ def bench_logreg_from_disk(h: Harness):
     train(fb0, y0)
     assert (fb0 == fb_idx_true).all() and len(y0) == n_rows
 
-    t0 = time.perf_counter()
-    fb, labels, split = load_from_disk()
-    train(fb, labels)
-    t_total = time.perf_counter() - t0
+    # median-of-3: the train leg carries the ~8-10 s fixed trace cost
+    # whose variance swung the single-shot row 34k-79k samples/s
+    tot_ts, splits = [], []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        fb, labels, split = load_from_disk()
+        train(fb, labels)
+        tot_ts.append(time.perf_counter() - t0)
+        splits.append(split)
+    t_total = sorted(tot_ts)[1]
+    split = splits[tot_ts.index(t_total)]
     pipeline_sps = n_rows / t_total / h.chips
 
-    t0 = time.perf_counter()
-    train(fb_idx_true, y_true)
-    t_mem = time.perf_counter() - t0
+    mem_ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        train(fb_idx_true, y_true)
+        mem_ts.append(time.perf_counter() - t0)
+    t_mem = sorted(mem_ts)[1]
     mem_sps = n_rows / t_mem / h.chips
 
     bytes_read = os.path.getsize(path)
@@ -899,7 +909,9 @@ def bench_als(h: Harness):
         np.asarray(out[0])
         return out
 
-    dt = h.delta(run, iters)
+    # 5 paired reps: the ~11 s fixed per-call cost leaves the 40-iter
+    # signal noisy at 3 (the recorded row swung 14-25 M samples/s)
+    dt = h.delta(run, iters, reps=5)
     sps = nnz * iters / dt / h.chips
 
     # quality + iters-to-converge: one run with the production RMSE-delta
