@@ -129,3 +129,54 @@ def test_broadcast_data_and_close_with():
            .close_with(lambda res: float(res.get("acc")))
            .exec())
     assert out == 15.0
+
+
+def test_engine_mesh_size_generality():
+    """BASELINE's scaling claim needs mesh-size generality, not just the
+    8-device default: the same ComQueue program (PI + allreduce) must
+    compile and run on 16 and 32 virtual devices. Runs in a subprocess
+    because XLA's host-device count latches at backend init."""
+    import os
+    import subprocess
+    import sys
+
+    from bootenv import cpu_mesh_env
+
+    code = """
+import numpy as np
+import jax
+from alink_tpu.common.mlenv import MLEnvironment, MLEnvironmentFactory
+from alink_tpu.engine import IterativeComQueue
+
+n = len(jax.devices())
+assert n == int(__import__("os").environ["WANT"]), (n,)
+env = MLEnvironment(parallelism=n)
+MLEnvironmentFactory.set_default(env)
+
+def stage(ctx):
+    import jax.numpy as jnp
+    if ctx.is_init_step:
+        ctx.put_obj("inside", jnp.zeros(()))
+        ctx.put_obj("total", jnp.zeros(()))
+    key = jax.random.fold_in(jax.random.fold_in(
+        jax.random.PRNGKey(0), ctx.step_no), ctx.task_id)
+    pts = jax.random.uniform(key, (256, 2))
+    hit = ((pts ** 2).sum(1) <= 1.0).sum() * 1.0
+    ctx.put_obj("inside", ctx.get_obj("inside") + ctx.all_reduce_sum(hit))
+    ctx.put_obj("total", ctx.get_obj("total") + 256.0 * n)
+
+res = (IterativeComQueue(env=env, max_iter=40)
+       .add(stage).exec())
+pi = 4.0 * float(res.get("inside")) / float(res.get("total"))
+assert abs(pi - 3.14159) < 0.1, pi
+print("pi ok", pi)
+"""
+    for want in (16, 32):
+        env = cpu_mesh_env(want)
+        env["WANT"] = str(want)
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           cwd=os.path.dirname(os.path.dirname(
+                               os.path.abspath(__file__))),
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, (want, r.stdout[-2000:], r.stderr[-2000:])
+        assert "pi ok" in r.stdout, r.stdout
