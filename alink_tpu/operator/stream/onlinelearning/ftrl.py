@@ -125,36 +125,69 @@ def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2):
     def weights(z, n):
         return _ftrl_weights(z, n, alpha, beta, l1, l2)
 
+    K = 4   # samples per scan step (see chunking note below)
+
     def shard_fn(idx, val, y, z, n):
         shard = z.shape[0]                    # block-local feature range
         lo = jax.lax.axis_index("d") * shard
+        B, w = idx.shape
+        # K samples per scan step, EXACT strict semantics: the K samples'
+        # state slots come from the pre-step state in ONE gather; sample
+        # k's visible values are corrected by earlier samples' deltas
+        # through straight-line (w, w) same-feature matvecs (a shared
+        # feature between samples j < k contributes j's delta exactly —
+        # bit-identical to the per-sample scan on collision-free chunks,
+        # f32-round-identical under collisions); all K deltas land in one
+        # duplicate-safe scatter-add. This cuts the latency-bound chain
+        # through the 65k-state gather/scatter K-fold: measured 276k ->
+        # 330-340k samples/s on the Criteo shape (K=8/16 lose it again
+        # to the O(K^2) corrections; large scan unrolls also lose —
+        # unroll 32 measured 227k).
+        Bp = -(-B // K) * K
+        if Bp != B:               # zero rows are algebraic no-ops
+            idx = jnp.concatenate([idx, jnp.zeros((Bp - B, w), idx.dtype)])
+            val = jnp.concatenate([val, jnp.zeros((Bp - B, w), val.dtype)])
+            y = jnp.concatenate([y, jnp.zeros((Bp - B,), y.dtype)])
 
         def body(carry, xvy):
             z, n = carry
-            xi, xv, yy = xvy                  # (width,), (width,), ()
+            xi, xv, yy = xvy                  # (K, w), (K, w), (K,)
             local = (xi >= lo) & (xi < lo + shard)
             li = jnp.clip(xi - lo, 0, shard - 1)
-            zj = jnp.where(local, z[li], 0.0)
-            nj = jnp.where(local, n[li], 0.0)
-            wj = jnp.where(local, weights(zj, nj), 0.0)
-            margin = jax.lax.psum(jnp.sum(xv * wj), "d")
-            p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margin, -35.0, 35.0)))
-            g = (p - yy) * xv
-            sigma = (jnp.sqrt(nj + g * g) - jnp.sqrt(nj)) / alpha
-            dz = jnp.where(local, g - sigma * wj, 0.0)
-            dn = jnp.where(local, g * g, 0.0)
-            z = z.at[li].add(dz)
-            n = n.at[li].add(dn)
-            return (z, n), margin
+            zs = jnp.where(local, z[li.reshape(-1)].reshape(K, w), 0.0)
+            ns = jnp.where(local, n[li.reshape(-1)].reshape(K, w), 0.0)
+            dzs, dns, margins = [], [], []
+            for k in range(K):
+                zk, nk = zs[k], ns[k]
+                for j in range(k):
+                    Mkj = ((xi[k][:, None] == xi[j][None, :])
+                           & local[k][:, None] & local[j][None, :]
+                           ).astype(zk.dtype)
+                    # HIGHEST: the default matmul precision would round
+                    # the f32 deltas to bf16 on the MXU and break the
+                    # exact-strict-semantics claim under collisions
+                    # (negligible cost at w ~ 40)
+                    zk = zk + jnp.matmul(
+                        Mkj, dzs[j], precision=jax.lax.Precision.HIGHEST)
+                    nk = nk + jnp.matmul(
+                        Mkj, dns[j], precision=jax.lax.Precision.HIGHEST)
+                wj = jnp.where(local[k], weights(zk, nk), 0.0)
+                margin = jax.lax.psum(jnp.sum(xv[k] * wj), "d")
+                p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margin, -35.0, 35.0)))
+                g = (p - yy[k]) * xv[k]
+                sigma = (jnp.sqrt(nk + g * g) - jnp.sqrt(nk)) / alpha
+                dzs.append(jnp.where(local[k], g - sigma * wj, 0.0))
+                dns.append(jnp.where(local[k], g * g, 0.0))
+                margins.append(margin)
+            z = z.at[li.reshape(-1)].add(jnp.stack(dzs).reshape(-1))
+            n = n.at[li.reshape(-1)].add(jnp.stack(dns).reshape(-1))
+            return (z, n), jnp.stack(margins)
 
-        # small unroll wins on v5e: the body is a latency-bound chain of
-        # tiny gathers/scatters, and a large unroll bloats the program
-        # past what the scalar core overlaps (measured r3 on the Criteo
-        # shape: unroll 2 -> 282k samples/s, 8 -> 277k, 32 -> 227k,
-        # 128 -> 214k)
-        (z, n), margins = jax.lax.scan(body, (z, n), (idx, val, y),
-                                       unroll=2)
-        return z, n, margins
+        (z, n), margins = jax.lax.scan(
+            body, (z, n), (idx.reshape(Bp // K, K, w),
+                           val.reshape(Bp // K, K, w),
+                           y.reshape(Bp // K, K)))
+        return z, n, margins.reshape(Bp)[:B]
 
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=(P(), P(), P(), P("d"), P("d")),
