@@ -20,13 +20,14 @@ Workloads (reference entry points in parentheses):
                       histogram-psum boosting.
   6. als_movielens  — ALS on MovieLens-1M-shape ratings (ALSExample.java).
 
-Measurement method (see git 477897a): every timed call gets distinct
-inputs (defeats execution-result memoization in the runtime), the
-measured span covers many supersteps (well above the ~0.5 s dispatch
-noise floor), wall time is taken as the delta between a 1-iteration and
-a (1+iters)-iteration program — both precompiled into the persistent
-cache — and the final value is the median of 3 runs. A device->host
-fetch ends every run (block_until_ready is not reliable here).
+Measurement method: every timed call gets distinct inputs (defeats
+execution-result memoization in the runtime), the measured span covers
+many supersteps (well above the ~0.5 s dispatch noise floor), wall time
+is the MEDIAN of adjacent-pair deltas between a 2-iteration and a
+(1+iters)-iteration program — both contain the superstep while-loop and
+are precompiled, see Harness.delta for why pairing and median. A
+device->host fetch ends every run (block_until_ready is not reliable
+here).
 
 ``vs_baseline`` compares against a numpy/BLAS implementation of the same
 superstep on the host CPU — the stand-in for one Flink task-slot worker
