@@ -163,3 +163,79 @@ class TestMurmurBatch:
         monkeypatch.setenv("ALINK_NO_NATIVE", "1")
         pure = run()
         assert native == pure
+
+
+class TestNativeVsPythonDifferential:
+    """Differential harness: every native parser must agree with the
+    pure-Python fallback on the same bytes (ALINK_NO_NATIVE=1 forces the
+    fallback at call time — no cache to clear). Randomized inputs cover
+    negatives, exponent notation, blank lines, and CRLF."""
+
+    def _tables(self):
+        rng = np.random.RandomState(0)
+        for trial in range(6):
+            n = rng.randint(1, 40)
+            c = rng.randint(1, 6)
+            m = rng.randn(n, c) * 10 ** rng.randint(-3, 4)
+            if trial % 2:
+                m = np.round(m)         # integer-looking values
+            yield m
+
+    def test_numeric_csv_differential(self, tmp_path, monkeypatch):
+        from alink_tpu.common.types import TableSchema
+        from alink_tpu.io.csv import read_csv
+        for k, m in enumerate(self._tables()):
+            nl = "\r\n" if k % 3 == 0 else "\n"
+            txt = nl.join(",".join(f"{v:.10g}" for v in row) for row in m)
+            if k % 2 == 0:
+                txt += nl               # trailing newline variant
+            p = tmp_path / f"t{k}.csv"
+            p.write_text(txt)
+            schema = TableSchema.parse(
+                ", ".join(f"c{j} DOUBLE" for j in range(m.shape[1])))
+            fast = read_csv(str(p), schema)
+            monkeypatch.setenv("ALINK_NO_NATIVE", "1")
+            slow = read_csv(str(p), schema)
+            monkeypatch.delenv("ALINK_NO_NATIVE")
+            assert fast.num_rows == slow.num_rows == m.shape[0]
+            for j in range(m.shape[1]):
+                np.testing.assert_allclose(
+                    np.asarray(fast.col(f"c{j}"), float),
+                    np.asarray(slow.col(f"c{j}"), float), rtol=1e-12)
+
+    def test_libsvm_differential(self, tmp_path, monkeypatch):
+        from alink_tpu.io.csv import read_libsvm
+        rng = np.random.RandomState(1)
+        lines = []
+        for i in range(60):
+            nnz = rng.randint(0, 6)
+            idx = sorted(rng.choice(50, nnz, replace=False) + 1)
+            vals = rng.randn(nnz) * 10 ** rng.randint(-2, 3)
+            lines.append(" ".join(
+                [f"{rng.choice([-1, 1, 0, 2]):g}"]
+                + [f"{a}:{v:.8g}" for a, v in zip(idx, vals)]))
+        p = tmp_path / "d.svm"
+        p.write_text("\n".join(lines) + "\n")
+        fast = read_libsvm(str(p), vector_size=64)
+        monkeypatch.setenv("ALINK_NO_NATIVE", "1")
+        slow = read_libsvm(str(p), vector_size=64)
+        monkeypatch.delenv("ALINK_NO_NATIVE")
+        assert fast.num_rows == slow.num_rows == 60
+        np.testing.assert_allclose(np.asarray(fast.col("label"), float),
+                                   np.asarray(slow.col("label"), float))
+        for a, b in zip(fast.col("features"), slow.col("features")):
+            assert a.size() == b.size()
+            np.testing.assert_array_equal(np.asarray(a.indices),
+                                          np.asarray(b.indices))
+            np.testing.assert_allclose(np.asarray(a.values),
+                                       np.asarray(b.values), rtol=1e-12)
+
+    def test_murmur_differential(self, monkeypatch):
+        from alink_tpu.operator.batch.feature.feature_ops import murmur32_cells
+        toks = [f"field_{i}={chr(65 + i % 26) * (i % 7 + 1)}".encode()
+                for i in range(300)] + ["".encode(), "北京".encode() * 3]
+        fast = murmur32_cells(toks, seed=17, mod=1024)
+        monkeypatch.setenv("ALINK_NO_NATIVE", "1")
+        slow = murmur32_cells(toks, seed=17, mod=1024)
+        monkeypatch.delenv("ALINK_NO_NATIVE")
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
