@@ -92,7 +92,9 @@ class _LdaTrainParams(HasSelectedCol, HasSeed):
                       default=-1.0)
     BETA = ParamInfo("beta", float, "topic-word Dirichlet prior (-1=auto)",
                      default=-1.0)
-    METHOD = ParamInfo("method", str, "optimizer: em | em_gibbs | online", default="em",
+    METHOD = ParamInfo("method", str,
+                       "optimizer: em | em_gibbs (alias: gibbs) | online",
+                       default="em",
                        aliases=("optimizer",))
     VOCAB_SIZE = ParamInfo("vocab_size", int, "max vocabulary size",
                            default=1 << 18)

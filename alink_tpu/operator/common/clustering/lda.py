@@ -328,7 +328,6 @@ def gibbs_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
     def stage(ctx):
         if ctx.is_init_step:
             ctx.put_obj("z", ctx.get_obj("z_init"))
-            ctx.put_obj("score", jnp.zeros(()))
         tok_b = ctx.get_obj("tok")
         mask_b = ctx.get_obj("mask")
         z = ctx.get_obj("z")
@@ -351,13 +350,6 @@ def gibbs_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
         z_new = jax.random.categorical(key, logp, axis=-1).astype(jnp.int32)
         z_new = jnp.where(mask_b > 0, z_new, 0)
         ctx.put_obj("z", z_new)
-        # corpus log-likelihood proxy from the current counts
-        theta = (nd + alpha) / (nd.sum(1, keepdims=True) + k * alpha)
-        beta_hat = (nw + beta) / (nw.sum(1, keepdims=True) + V * beta)
-        bw = jnp.take(beta_hat.T, tok_b, axis=0)               # (n, T, k)
-        pw = jnp.einsum("nk,ntk->nt", theta, bw)
-        ctx.put_obj("score", ctx.all_reduce_sum(
-            (mask_b * jnp.log(jnp.maximum(pw, 1e-100))).sum()))
 
     q = (IterativeComQueue(env=env, max_iter=max(num_iter, 1), seed=seed)
          .init_with_partitioned_data("tok", tok)
@@ -370,7 +362,22 @@ def gibbs_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
     nw = np.zeros((k, V), np.float64)
     np.add.at(nw.reshape(-1), (z_fin.astype(np.int64) * V
                                + tok).reshape(-1)[mask.reshape(-1) > 0], 1.0)
-    score = float(res.get("score"))
+    # score recomputed from the FINAL assignments so the reported
+    # perplexity matches the returned counts (the in-carry score is one
+    # superstep stale: it is computed from the counts before the last
+    # resample)
+    nd = np.zeros((n, k), np.float64)
+    np.add.at(nd.reshape(-1), (np.arange(n)[:, None] * k
+                               + z_fin).reshape(-1)[mask.reshape(-1) > 0], 1.0)
+    theta = (nd + alpha) / (nd.sum(1, keepdims=True) + k * alpha)
+    beta_hat = (nw + beta) / (nw.sum(1, keepdims=True) + V * beta)
+    # chunk over docs: beta_hat.T[tok] for the whole corpus would be an
+    # (n, T, k) float64 allocation
+    score = 0.0
+    for s0 in range(0, n, 2048):
+        sl = slice(s0, min(s0 + 2048, n))
+        pw = np.einsum("nk,ntk->nt", theta[sl], beta_hat.T[tok[sl]])
+        score += float((mask[sl] * np.log(np.maximum(pw, 1e-100))).sum())
     log_perp = -score / max(total_words, 1.0)
     return nw.T, nw.sum(1), alpha, beta, score, log_perp
 

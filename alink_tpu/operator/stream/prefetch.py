@@ -102,3 +102,12 @@ def prefetch(it: Iterable[T], depth: int = None) -> Iterator[T]:
         except queue.Empty:
             pass
         th.join(timeout=5.0)
+        if th.is_alive():
+            # the producer is stuck inside the upstream iterator itself
+            # (e.g. a blocking poll) — it cannot see the stop flag until
+            # that call returns, so the daemon thread outlives us still
+            # holding the iterator. Make that diagnosable, not silent.
+            import logging
+            logging.getLogger(__name__).warning(
+                "prefetch worker did not exit within 5s of consumer "
+                "abandonment; the upstream source appears blocked")
