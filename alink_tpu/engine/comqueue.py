@@ -32,6 +32,7 @@ Contract notes:
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -39,6 +40,85 @@ import numpy as np
 from ..common.mlenv import MLEnvironment, MLEnvironmentFactory
 from .context import ComContext
 from .communication import CommunicateFunction
+
+# Compiled-program cache across exec() calls. Every exec() used to build
+# a fresh ``run`` closure, so jax.jit could never hit its own cache and
+# every fit paid the full trace+compile (~10-18 s for the optimizer
+# programs) even when an identical program had just run. The reference
+# pays plan-construction per exec too, but its plan build is cheap
+# (BaseComQueue.java:154-308); execution cost is per run. Here the
+# expensive artifact is the compiled XLA program, so it is cached keyed
+# on (caller program_key, mesh, worker count, max_iter, seed,
+# criterion-presence, input-name sets). Shape/dtype polymorphism is
+# handled by jax.jit itself underneath each entry.
+#
+# Caller contract for ``program_key``: the key must determine the stage
+# list's STRUCTURE and every Python-level constant the stage closures
+# bake into the trace (hyperparameters, dims, loss config). Training
+# DATA always flows through partitioned/broadcast inputs, never through
+# the key — a cached program re-runs correctly on fresh data.
+_PROGRAM_CACHE: "OrderedDict[tuple, Callable]" = OrderedDict()
+_PROGRAM_CACHE_MAX = 32
+_PROGRAM_CACHE_STATS = {"hits": 0, "misses": 0}
+
+
+def program_cache_stats() -> Dict[str, int]:
+    """Cumulative hit/miss counters (observability + tests)."""
+    return dict(_PROGRAM_CACHE_STATS)
+
+
+def clear_program_cache() -> None:
+    _PROGRAM_CACHE.clear()
+
+
+def freeze_config(v):
+    """Hashable token of a config object for ``set_program_key``. Captures
+    every Python constant stage closures bake into a trace (loss type,
+    dims, regularization, field metadata). Arrays hash by content; objects
+    by public attrs, recursively."""
+    import dataclasses
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if isinstance(v, (tuple, list)):
+        return tuple(freeze_config(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, freeze_config(x)) for k, x in v.items()))
+    if isinstance(v, np.ndarray) or (hasattr(v, "shape") and hasattr(v, "dtype")):
+        a = np.asarray(v)
+        return ("nd", a.shape, str(a.dtype), a.tobytes())
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return (type(v).__name__, freeze_config(dataclasses.asdict(v)))
+    if hasattr(v, "__dict__"):
+        # PUBLIC attrs only: a config object must not hide trace-relevant
+        # state in underscore attrs (the set_program_key contract)
+        return (type(v).__name__,
+                tuple(sorted((k, freeze_config(x)) for k, x in vars(v).items()
+                             if not k.startswith("_"))))
+    # no safe generic fallback: default repr() embeds the memory address,
+    # which would make the key never match (a silent permanent cache miss
+    # churning the LRU) — force the caller to pass something freezable
+    raise TypeError(f"freeze_config: cannot build a stable key from "
+                    f"{type(v).__name__!r}; pass scalars, arrays, "
+                    f"dataclasses, or objects with public __dict__ attrs")
+
+
+def lazy_jit(fn, static_argnums=()):
+    """Persistent jit wrapper for a module-level function. Calling
+    ``jax.jit(fn)(...)`` inline creates a fresh wrapper — and a fresh
+    trace — on every call; this memoizes the wrapper per (fn, statics)."""
+    return _lazy_jit_cached(fn, tuple(static_argnums))
+
+
+def _lazy_jit_cached(fn, static_argnums):
+    key = (fn, static_argnums)
+    got = _LAZY_JIT.get(key)
+    if got is None:
+        import jax
+        got = _LAZY_JIT[key] = jax.jit(fn, static_argnums=static_argnums)
+    return got
+
+
+_LAZY_JIT: Dict[tuple, Callable] = {}
 
 
 class ComputeFunction:
@@ -111,6 +191,7 @@ class IterativeComQueue:
         self._broadcast: Dict[str, Any] = {}
         self._criterion: Optional[Callable[[ComContext], Any]] = None
         self._close: Optional[Callable[[ComQueueResult], Any]] = None
+        self._program_key: Optional[tuple] = None
 
     # -- builder API (mirrors BaseComQueue.java:75-148) -------------------
     def init_with_partitioned_data(self, name: str, data) -> "IterativeComQueue":
@@ -141,6 +222,16 @@ class IterativeComQueue:
 
     def close_with(self, fn: Callable[[ComQueueResult], Any]) -> "IterativeComQueue":
         self._close = fn
+        return self
+
+    def set_program_key(self, key) -> "IterativeComQueue":
+        """Opt into the compiled-program cache (see _PROGRAM_CACHE).
+
+        ``key`` must be hashable and must determine the stage structure
+        and every Python constant the stages close over; data must flow
+        through partitioned/broadcast inputs only.
+        """
+        self._program_key = key
         return self
 
     # -- execution --------------------------------------------------------
@@ -221,9 +312,27 @@ class IterativeComQueue:
             # uniform out_spec: every leaf gains a leading worker axis
             return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), final)
 
-        mapped = shard_map(run, mesh=mesh, in_specs=(P("d"), P()),
-                           out_specs=P("d"), check_vma=False)
-        stacked = jax.jit(mapped)(parts, bcast)
+        compiled = None
+        ckey = None
+        if self._program_key is not None:
+            from ..common.profiling import step_log_enabled
+            ckey = (self._program_key, mesh, nw, max_iter, seed,
+                    criterion is not None, step_log_enabled(),
+                    tuple(sorted(parts)), tuple(sorted(bcast)))
+            compiled = _PROGRAM_CACHE.get(ckey)
+        if compiled is None:
+            mapped = shard_map(run, mesh=mesh, in_specs=(P("d"), P()),
+                               out_specs=P("d"), check_vma=False)
+            compiled = jax.jit(mapped)
+            if ckey is not None:
+                _PROGRAM_CACHE_STATS["misses"] += 1
+                _PROGRAM_CACHE[ckey] = compiled
+                while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+                    _PROGRAM_CACHE.popitem(last=False)
+        elif ckey is not None:
+            _PROGRAM_CACHE_STATS["hits"] += 1
+            _PROGRAM_CACHE.move_to_end(ckey)
+        stacked = compiled(parts, bcast)
         if jax.process_count() > 1:
             # multi-host session: leaves span non-addressable devices —
             # gather every worker's shard to every host before fetching

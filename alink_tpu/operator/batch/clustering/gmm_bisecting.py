@@ -125,12 +125,16 @@ def gmm_train(X: np.ndarray, k: int, max_iter: int = 100, tol: float = 1e-4,
         ctx.put_obj("delta", jnp.abs(ll - ctx.get_obj("loglik")))
         ctx.put_obj("loglik", ll)
 
+    from ....engine.comqueue import freeze_config
     res = (IterativeComQueue(max_iter=max_iter, seed=seed)
            .init_with_partitioned_data("data", data)
            .add(estep_mstep)
            .add(AllReduce("stats"))
            .add(update)
            .set_compare_criterion(lambda ctx: ctx.get_obj("delta") < tol)
+           # init_means is data-derived and baked into the trace — hash it
+           .set_program_key(("gmm", k, d, float(tol), float(reg),
+                             freeze_config(init_means)))
            .exec())
     return (res.get("weights"), res.get("means"), res.get("covs"),
             float(res.get("loglik")), res.step_count)

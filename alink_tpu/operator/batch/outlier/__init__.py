@@ -78,7 +78,8 @@ class SosBatchOp(BatchOperator, HasVectorCol, HasPredictionCol):
             from ....common.vector import SparseBatch
             X = SparseBatch(design["idx"], design["val"],
                             design["dim"]).to_dense(np.float64)
-        probs = np.asarray(jax.jit(_sos_kernel, static_argnums=(1,))(
+        from ....engine.comqueue import lazy_jit
+        probs = np.asarray(lazy_jit(_sos_kernel, static_argnums=(1,))(
             jnp.asarray(X), float(self.get_perplexity())))
         cols = {c: t.col(c) for c in t.col_names}
         cols[self.get_prediction_col()] = probs

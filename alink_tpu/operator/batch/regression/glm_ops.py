@@ -204,12 +204,14 @@ def glm_irls(X: np.ndarray, y: np.ndarray, w: np.ndarray, family: _Family,
     """Distributed IRLS; X already has the intercept column. Returns
     (beta, deviance-ish curve, steps)."""
     n, d = X.shape
+    dt = X.dtype  # hoisted: a closure over X itself would pin the whole
+    # design matrix in the program cache for the cache's lifetime
     data = np.concatenate([X, y[:, None], w[:, None]], 1)
 
     def partials(ctx):
         if ctx.is_init_step:
-            ctx.put_obj("beta", jnp.zeros(d, X.dtype))
-            ctx.put_obj("delta", jnp.asarray(jnp.inf, X.dtype))
+            ctx.put_obj("beta", jnp.zeros(d, dt))
+            ctx.put_obj("delta", jnp.asarray(jnp.inf, dt))
         block = ctx.get_obj("data")
         Xb, yb, wb = block[:, :d], block[:, d], block[:, d + 1]
         beta = ctx.get_obj("beta")
@@ -231,12 +233,15 @@ def glm_irls(X: np.ndarray, y: np.ndarray, w: np.ndarray, family: _Family,
                     jnp.maximum(1.0, jnp.linalg.norm(beta_new)))
         ctx.put_obj("beta", beta_new)
 
+    from ....engine.comqueue import freeze_config
     res = (IterativeComQueue(max_iter=max_iter)
            .init_with_partitioned_data("data", data)
            .add(partials)
            .add(AllReduce("normal"))
            .add(solve)
            .set_compare_criterion(lambda ctx: ctx.get_obj("delta") < tol)
+           .set_program_key(("glm_irls", d, str(dt), float(tol), float(reg),
+                             freeze_config(family), freeze_config(link)))
            .exec())
     return res.get("beta"), res.step_count
 

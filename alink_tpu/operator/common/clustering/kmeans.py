@@ -168,6 +168,8 @@ def kmeans_parallel_init(X: np.ndarray, k: int, seed: int = 0,
            .init_with_partitioned_data("mask", mask_col)
            .init_with_broadcast_data("first", first)
            .add(sample)
+           .set_program_key(("kmeans_par_init", cap, d, l, l_loc, l_glob,
+                             str(dt)))
            .exec())
     cands = np.asarray(res.get("cands"))
     weights = np.array(res.get("weights"))
@@ -244,6 +246,8 @@ def kmeans_train(X: np.ndarray, k: int, max_iter: int = 50, tol: float = 1e-4,
               .add(AllReduce("buf"))
               .add(update)
               .set_compare_criterion(lambda ctx: ctx.get_obj("movement") < tol)
+              .set_program_key(("kmeans", k, d, distance_type, float(tol),
+                                str(dt)))
               .exec())
     return (result.get("centroids"), result.get("cluster_weights"),
             result.step_count)

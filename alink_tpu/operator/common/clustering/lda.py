@@ -193,7 +193,12 @@ def online_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
     q = (IterativeComQueue(env=env, max_iter=max(num_iter, 1), seed=seed)
          .init_with_partitioned_data("ids", ids)
          .init_with_partitioned_data("cnts", cnts)
-         .add(stage))
+         .add(stage)
+         # total_words is a data-derived constant baked into the trace;
+         # lam0 derives from (seed, k, V) and seed rides the engine key
+         .set_program_key(("lda_online", k, V, float(alpha), float(beta),
+                           float(tau0), float(kappa), float(subsample),
+                           bool(optimize_alpha), int(n_inner), total_words)))
     res = q.exec()
     lam = res.get("lambda")
     avec = res.get("alpha_vec")
@@ -256,7 +261,9 @@ def em_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
     q = (IterativeComQueue(env=env, max_iter=max(num_iter, 1), seed=seed)
          .init_with_partitioned_data("ids", ids)
          .init_with_partitioned_data("cnts", cnts)
-         .add(stage))
+         .add(stage)
+         .set_program_key(("lda_em", k, V, float(alpha), float(beta),
+                           int(n_inner))))
     res = q.exec()
     wt = np.asarray(res.get("wt"))                                # (k, V)
     score = float(res.get("score"))
@@ -355,7 +362,8 @@ def gibbs_lda_train(ids: np.ndarray, cnts: np.ndarray, k: int, V: int,
          .init_with_partitioned_data("tok", tok)
          .init_with_partitioned_data("mask", mask)
          .init_with_partitioned_data("z_init", z0)
-         .add(stage))
+         .add(stage)
+         .set_program_key(("lda_gibbs", k, V, float(alpha), float(beta))))
     res = q.exec()
     # final global counts from the final assignments (all shards)
     z_fin = res.concat("z", total=n)
@@ -387,10 +395,11 @@ def lda_infer(ids: np.ndarray, cnts: np.ndarray, word_topic: np.ndarray,
     """Doc-topic inference at predict time (reference LdaUtil /
     LdaModelMapper.predictResultDetail). word_topic: (V, k) p(w|z) columns
     (already normalized). Returns theta (n, k)."""
+    from ....engine.comqueue import lazy_jit
     eEb = jnp.asarray(word_topic.T)                               # (k, V)
     alpha = jnp.asarray(alpha)
     key = jax.random.PRNGKey(seed)
-    gamma, _ = jax.jit(_e_step, static_argnums=(5,))(
+    gamma, _ = lazy_jit(_e_step, static_argnums=(5,))(
         jnp.asarray(ids), jnp.asarray(cnts), eEb,
         alpha[None, :] if alpha.ndim == 1 else alpha, key, n_inner)
     gamma = np.asarray(gamma)

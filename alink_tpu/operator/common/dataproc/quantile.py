@@ -73,6 +73,7 @@ def distributed_quantiles(X: np.ndarray, probs: np.ndarray,
            .init_with_partitioned_data("X", X)
            .init_with_partitioned_data("mask", np.ones(n, X.dtype))
            .add(stage)
+           .set_program_key(("quantile_hist", F, fine_bins))
            .exec())
     hist = np.asarray(res.get("hist"), np.float64).reshape(F, fine_bins)
     mn = np.asarray(res.get("mn"), np.float64)

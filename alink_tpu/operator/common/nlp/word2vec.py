@@ -171,7 +171,9 @@ def word2vec_train(table: MTable, selected_col: str, p: Word2VecParams,
          .init_with_broadcast_data("hs_codes", codes)
          .init_with_broadcast_data("hs_mask", mask)
          .add(epoch)
-         .add(AllReduce("emb", mean=True)))
+         .add(AllReduce("emb", mean=True))
+         # in0 is derived from (p.seed, V, D) — seed rides the engine key
+         .set_program_key(("w2v", V, D, mb, lr0, num_iter)))
     result = q.exec()
     vectors = np.asarray(result.get("emb")["in"], np.float64)
     return vocab, vectors

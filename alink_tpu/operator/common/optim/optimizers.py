@@ -39,6 +39,9 @@ _NUM_SEARCH_STEP = 10  # line-search ladder size (reference numSearchStep=4, wid
 _HISTORY = 10          # L-BFGS memory (reference m=10, Lbfgs.java)
 
 
+from ....engine.comqueue import freeze_config as _freeze
+
+
 @dataclass
 class OptimParams:
     method: str = "LBFGS"
@@ -139,7 +142,8 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
         # carrying GB-scale arrays through the while_loop made XLA's layout
         # assignment explode; as closed-over operands they are free)
         from ....ops.fieldblock import fb_onehot_parts
-        A, B = jax.jit(fb_onehot_parts, static_argnums=(1,))(
+        from ....engine.comqueue import lazy_jit
+        A, B = lazy_jit(fb_onehot_parts, static_argnums=(1,))(
             jnp.asarray(data["fb_idx"]), obj.fb_meta)
         data = dict(data)
         data["fb_A"], data["fb_B"] = A, B
@@ -245,7 +249,10 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
              .add(direction_and_losses)
              .add(AllReduce("line_losses"))
              .add(update_model)
-             .set_compare_criterion(lambda ctx: ctx.get_obj("conv")))
+             .set_compare_criterion(lambda ctx: ctx.get_obj("conv"))
+             .set_program_key(("qn", owlqn, m, params.learning_rate,
+                               params.epsilon, str(dtype), data_keys,
+                               _freeze(obj))))
     for k, v in data.items():
         queue.init_with_partitioned_data(k, v)
     res = queue.exec()
@@ -305,7 +312,10 @@ def _sgd(obj, data, params, env, warm_start):
              .add(calc_grad)
              .add(AllReduce("glw"))
              .add(update)
-             .set_compare_criterion(lambda ctx: ctx.get_obj("conv")))
+             .set_compare_criterion(lambda ctx: ctx.get_obj("conv"))
+             .set_program_key(("sgd", params.learning_rate, params.epsilon,
+                               params.mini_batch_fraction, str(dtype),
+                               data_keys, _freeze(obj))))
     for k, v in data.items():
         queue.init_with_partitioned_data(k, v)
     res = queue.exec()
@@ -358,7 +368,9 @@ def _newton(obj, data, params, env, warm_start):
              .add(AllReduce("H"))
              .add(AllReduce("glw"))
              .add(update)
-             .set_compare_criterion(lambda ctx: ctx.get_obj("conv")))
+             .set_compare_criterion(lambda ctx: ctx.get_obj("conv"))
+             .set_program_key(("newton", params.epsilon, str(dtype),
+                               data_keys, _freeze(obj))))
     for k, v in data.items():
         queue.init_with_partitioned_data(k, v)
     res = queue.exec()

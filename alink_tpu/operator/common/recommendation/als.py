@@ -279,6 +279,8 @@ def als_train(users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
              .init_with_broadcast_data("uf0", uf0)
              .init_with_broadcast_data("if0", if0)
              .add(step))
+    from ....engine.comqueue import freeze_config
+    queue.set_program_key(("als", U, I, freeze_config(p)))
     if p.tol > 0:
         # KMeansIterTermination analogue: stop when the train-RMSE moves
         # less than tol between supersteps (replicated state only). The

@@ -120,11 +120,15 @@ def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
         ctx.put_obj("loss_curve", jax.lax.dynamic_update_index_in_dim(
             ctx.get_obj("loss_curve"), lw[0] / jnp.maximum(lw[1], 1e-12), t, 0))
 
+    from ....engine.comqueue import freeze_config
     queue = (IterativeComQueue(env=env, max_iter=T, seed=p.seed)
              .init_with_partitioned_data("binned", binned)
              .init_with_partitioned_data("y", y)
              .init_with_partitioned_data("w", w)
-             .add(grow))
+             .add(grow)
+             # base is a data-derived Python float baked into the trace
+             .set_program_key(("gbdt", is_regression, F, base,
+                               freeze_config(p), freeze_config(cat_mask))))
     res = queue.exec()
     return (res.get("trees_f"), res.get("trees_b"), res.get("trees_m"),
             res.get("trees_v"), edges, base,
@@ -215,10 +219,13 @@ def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
             imp = jnp.where(kept, imp, jnp.zeros_like(imp))
         ctx.put_obj("importance", ctx.get_obj("importance") + imp)
 
+    from ....engine.comqueue import freeze_config
     queue = (IterativeComQueue(env=env_, max_iter=T_store, seed=p.seed)
              .init_with_partitioned_data("binned", binned)
              .init_with_partitioned_data("stats", y_stats.astype(dtype))
-             .add(grow))
+             .add(grow)
+             .set_program_key(("forest", kind, F, m, bool(ensemble), T,
+                               freeze_config(p), freeze_config(cat_mask))))
     res = queue.exec()
     if not ensemble:
         return (res.get("trees_f"), res.get("trees_b"), res.get("trees_m"),

@@ -761,11 +761,11 @@ def bench_logreg_from_disk(h: Harness):
     mem_sps = n_rows / t_mem / h.chips
 
     bytes_read = os.path.getsize(path)
-    # train_s is dominated by the per-call fixed cost of building a fresh
-    # ComQueue program (trace + compile-cache lookup, ~8-10 s — the same
-    # fixed cost delta() subtracts out for the per-iteration rows); it is
-    # identical in both timings, so pipeline_vs_memory isolates the disk
-    # path's cost, and read_s/parse_s/encode_s attribute it.
+    # the engine's compiled-program cache (comqueue._PROGRAM_CACHE) makes
+    # every post-warmup fit reuse one XLA program, so train_s is actual
+    # device time, not the former ~8-10 s per-fit retrace;
+    # pipeline_vs_memory therefore isolates the disk path's cost, with
+    # read_s/parse_s/encode_s attributing it.
     return {"samples_per_sec_per_chip": round(pipeline_sps, 1),
             "in_memory_samples_per_sec_per_chip": round(mem_sps, 1),
             "source_samples_per_sec": round(

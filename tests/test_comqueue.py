@@ -180,3 +180,92 @@ print("pi ok", pi)
                            capture_output=True, text=True, timeout=300)
         assert r.returncode == 0, (want, r.stdout[-2000:], r.stderr[-2000:])
         assert "pi ok" in r.stdout, r.stdout
+
+
+def test_program_cache_reuse_and_correctness():
+    """A cached program re-runs correctly on FRESH data (the cache key
+    must never bake data in), hits the cache on identical structure, and
+    misses when the program key differs."""
+    from alink_tpu.engine.comqueue import (clear_program_cache,
+                                           program_cache_stats)
+
+    def make_queue(scale):
+        def stage(ctx):
+            if ctx.is_init_step:
+                ctx.put_obj("acc", jnp.zeros(()))
+            x = ctx.get_obj("x")
+            ctx.put_obj("acc", ctx.get_obj("acc")
+                        + ctx.all_reduce_sum((scale * x).sum()))
+        return stage
+
+    clear_program_cache()
+    base = program_cache_stats()
+    x1 = np.arange(16, dtype=np.float32)
+    q1 = (IterativeComQueue(max_iter=3)
+          .init_with_partitioned_data("x", x1)
+          .add(make_queue(1.0))
+          .set_program_key(("cache_test", 1.0)))
+    r1 = q1.exec()
+    assert float(r1.get("acc")) == pytest.approx(3 * x1.sum())
+    s = program_cache_stats()
+    assert s["misses"] == base["misses"] + 1
+
+    # same key, different data -> cache hit, result reflects NEW data
+    x2 = np.arange(16, dtype=np.float32) * 10
+    q2 = (IterativeComQueue(max_iter=3)
+          .init_with_partitioned_data("x", x2)
+          .add(make_queue(1.0))
+          .set_program_key(("cache_test", 1.0)))
+    r2 = q2.exec()
+    assert float(r2.get("acc")) == pytest.approx(3 * x2.sum())
+    s = program_cache_stats()
+    assert s["hits"] == base["hits"] + 1
+
+    # different key (different baked constant) -> miss, different program
+    q3 = (IterativeComQueue(max_iter=3)
+          .init_with_partitioned_data("x", x1)
+          .add(make_queue(2.0))
+          .set_program_key(("cache_test", 2.0)))
+    r3 = q3.exec()
+    assert float(r3.get("acc")) == pytest.approx(3 * 2.0 * x1.sum())
+    s = program_cache_stats()
+    assert s["misses"] == base["misses"] + 2
+
+    # different max_iter with the same key -> engine must not reuse
+    q4 = (IterativeComQueue(max_iter=5)
+          .init_with_partitioned_data("x", x1)
+          .add(make_queue(1.0))
+          .set_program_key(("cache_test", 1.0)))
+    r4 = q4.exec()
+    assert float(r4.get("acc")) == pytest.approx(5 * x1.sum())
+
+
+def test_program_cache_optimizer_fits():
+    """Two same-shape optimizer fits share one compiled program; the
+    second fit must return the correct result for ITS data."""
+    from alink_tpu.engine.comqueue import program_cache_stats
+    from alink_tpu.operator.common.optim.optimizers import (OptimParams,
+                                                            optimize)
+    from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                         UnaryLossObjFunc)
+
+    d = 8
+
+    def make_data(seed):
+        r = np.random.RandomState(seed)
+        X = r.randn(512, d).astype(np.float32)
+        y = (X @ r.randn(d) > 0).astype(np.float32) * 2 - 1
+        return {"X": X, "y": y, "w": np.ones(512, np.float32)}
+
+    obj = UnaryLossObjFunc(LogLossFunc(), dim=d)
+    params = OptimParams(method="LBFGS", max_iter=25)
+    before = program_cache_stats()
+    c1, _, _ = optimize(obj, make_data(1), params)
+    c2, _, _ = optimize(obj, make_data(2), params)
+    after = program_cache_stats()
+    assert after["hits"] >= before["hits"] + 1
+    assert not np.allclose(c1, c2)
+    for seed, coef in ((1, c1), (2, c2)):
+        data = make_data(seed)
+        acc = ((data["X"] @ coef > 0) == (data["y"] > 0)).mean()
+        assert acc > 0.9, (seed, acc)
