@@ -85,7 +85,13 @@ def freeze_config(v):
         return tuple(sorted((k, freeze_config(x)) for k, x in v.items()))
     if isinstance(v, np.ndarray) or (hasattr(v, "shape") and hasattr(v, "dtype")):
         a = np.asarray(v)
-        return ("nd", a.shape, str(a.dtype), a.tobytes())
+        raw = a.tobytes()
+        if len(raw) > 512:
+            # digest large arrays: raw bytes in the key would copy MBs per
+            # fit and pin them in the LRU
+            import hashlib
+            raw = hashlib.blake2b(raw, digest_size=16).digest()
+        return ("nd", a.shape, str(a.dtype), raw)
     if dataclasses.is_dataclass(v) and not isinstance(v, type):
         return (type(v).__name__, freeze_config(dataclasses.asdict(v)))
     if hasattr(v, "__dict__"):

@@ -212,11 +212,12 @@ def read_libsvm(path: str, start_index: int = 1, shard=None,
     the same dataset.
     """
     from ..common.vector import SparseVector
-    from ..native import get_lib, parse_libsvm_bytes
+    from ..native import get_lib, parse_libsvm_bytes_parallel
     data = _load_line_bytes(path, ignore_first_line=False, shard=shard)
     if get_lib() is not None:
-        labels_a, indptr, indices, values = parse_libsvm_bytes(data,
-                                                               start_index)
+        # chunked multi-core parse (the C calls release the GIL)
+        labels_a, indptr, indices, values = parse_libsvm_bytes_parallel(
+            data, start_index)
         max_idx = (int(vector_size) if vector_size is not None else
                    (int(indices.max()) + 1 if indices.size else 0))
         if vector_size is not None and max_idx <= 0:

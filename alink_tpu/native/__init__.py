@@ -68,10 +68,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
         pi32 = ctypes.POINTER(ctypes.c_int32)
         lib.svm_count.argtypes = [c, i64, pi64, pi64, pi64]
         lib.svm_fill.argtypes = [c, i64, i64, pd, pi64, pi32, pd]
+        lib.svm_bounds.argtypes = [c, i64, pi64, pi64]
+        lib.svm_fill2.argtypes = [c, i64, i64, pd, pi64, pi32, pd,
+                                  pi64, pi64, pi64]
         lib.csv_dims.argtypes = [c, i64, ctypes.c_char, pi64, pi64]
         lib.csv_fill.argtypes = [c, i64, ctypes.c_char, i64, pd]
         lib.vec_count.argtypes = [c, i64, pi64, pi64, pi64]
         lib.vec_fill.argtypes = [c, i64, pi64, pi32, pd]
+        lib.vec_bounds.argtypes = [c, i64, pi64, pi64]
+        lib.vec_fill2.argtypes = [c, i64, pi64, pi32, pd, pi64, pi64, pi64]
         lib.murmur_batch.argtypes = [c, pi64, i64, ctypes.c_uint32, i64, pi64]
         _lib = lib
         return _lib
@@ -84,22 +89,87 @@ def _p(arr, typ):
 def parse_libsvm_bytes(data: bytes, start_index: int = 1
                        ) -> Optional[Tuple[np.ndarray, np.ndarray,
                                            np.ndarray, np.ndarray]]:
-    """(labels, indptr, indices, values) CSR arrays, or None w/o native."""
+    """(labels, indptr, indices, values) CSR arrays, or None w/o native.
+
+    One-pass protocol: cheap memchr bounds size the buffers (rows <=
+    newline count, nnz <= ':' count), one real parse fills them and
+    reports actual counts, then views are trimmed. The former two-pass
+    svm_count/svm_fill parsed every token twice.
+    """
     lib = get_lib()
     if lib is None:
         return None
+    rows_ub = ctypes.c_int64()
+    nnz_ub = ctypes.c_int64()
+    lib.svm_bounds(data, len(data), ctypes.byref(rows_ub),
+                   ctypes.byref(nnz_ub))
+    labels = np.empty(rows_ub.value, np.float64)
+    indptr = np.empty(rows_ub.value + 1, np.int64)
+    indices = np.empty(nnz_ub.value, np.int32)
+    values = np.empty(nnz_ub.value, np.float64)
     rows = ctypes.c_int64()
     nnz = ctypes.c_int64()
     mx = ctypes.c_int64()
-    lib.svm_count(data, len(data), ctypes.byref(rows), ctypes.byref(nnz),
-                  ctypes.byref(mx))
-    labels = np.empty(rows.value, np.float64)
-    indptr = np.empty(rows.value + 1, np.int64)
-    indices = np.empty(nnz.value, np.int32)
-    values = np.empty(nnz.value, np.float64)
-    lib.svm_fill(data, len(data), start_index, _p(labels, ctypes.c_double),
-                 _p(indptr, ctypes.c_int64), _p(indices, ctypes.c_int32),
-                 _p(values, ctypes.c_double))
+    lib.svm_fill2(data, len(data), start_index, _p(labels, ctypes.c_double),
+                  _p(indptr, ctypes.c_int64), _p(indices, ctypes.c_int32),
+                  _p(values, ctypes.c_double), ctypes.byref(rows),
+                  ctypes.byref(nnz), ctypes.byref(mx))
+    return (labels[:rows.value], indptr[:rows.value + 1],
+            indices[:nnz.value], values[:nnz.value])
+
+
+def split_newline_chunks(data: bytes, k: int) -> list:
+    """Split ``data`` into <=k newline-aligned chunks (no line is split).
+    Chunk i starts at the first line whose first byte lies at or after
+    len*i//k — the same ownership rule as io/sharding.read_file_shard."""
+    n = len(data)
+    if k <= 1 or n == 0:
+        return [data] if n else []
+    starts = [0]
+    for i in range(1, k):
+        pos = n * i // k
+        if pos == 0 or data[pos - 1:pos] == b"\n":
+            start = pos  # pos itself starts a line — it belongs to chunk i
+        else:
+            nl = data.find(b"\n", pos)
+            start = n if nl < 0 else nl + 1
+        if start > starts[-1]:
+            starts.append(start)
+    starts.append(n)
+    return [data[starts[i]:starts[i + 1]]
+            for i in range(len(starts) - 1)
+            if starts[i + 1] > starts[i]]
+
+
+def parse_libsvm_bytes_parallel(data: bytes, start_index: int = 1,
+                                max_workers: Optional[int] = None
+                                ) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                                    np.ndarray, np.ndarray]]:
+    """parse_libsvm_bytes over newline-aligned chunks on a thread pool.
+
+    The ctypes calls release the GIL, so chunks parse on all cores; the
+    per-chunk CSR results merge with one concatenate each (indptr gets
+    cumulative nnz offsets). Falls back to the single-call parse for
+    small inputs; None without the native library.
+    """
+    if get_lib() is None:
+        return None
+    import os as _os
+    k = min(_os.cpu_count() or 1, max(1, len(data) >> 22))  # ~4 MB/chunk
+    if max_workers is not None:
+        k = min(k, max_workers)
+    if k <= 1:
+        return parse_libsvm_bytes(data, start_index)
+    chunks = split_newline_chunks(data, k)
+    from ..io.sharding import parallel_shard_map
+    parts = parallel_shard_map(
+        lambda i: parse_libsvm_bytes(chunks[i], start_index), len(chunks))
+    labels = np.concatenate([p[0] for p in parts])
+    indices = np.concatenate([p[2] for p in parts])
+    values = np.concatenate([p[3] for p in parts])
+    nnz_offs = np.cumsum([0] + [len(p[2]) for p in parts[:-1]])
+    indptr = np.concatenate(
+        [parts[0][1][:1]] + [p[1][1:] + off for p, off in zip(parts, nnz_offs)])
     return labels, indptr, indices, values
 
 
@@ -142,18 +212,26 @@ def murmur32_batch(tokens, seed: int = 0, mod: int = 0) -> Optional[np.ndarray]:
 def parse_vector_lines(data: bytes) -> Optional[Tuple[np.ndarray, np.ndarray,
                                                       np.ndarray, int]]:
     """Batch-parse newline-separated sparse-vector literals into
-    (indptr, indices, values, dim) CSR arrays, or None w/o native."""
+    (indptr, indices, values, dim) CSR arrays, or None w/o native.
+
+    One-pass protocol (vec_bounds upper-bounds the buffers, vec_fill2
+    parses once and reports actual counts) — same as parse_libsvm_bytes.
+    """
     lib = get_lib()
     if lib is None:
         return None
+    rows_ub = ctypes.c_int64()
+    nnz_ub = ctypes.c_int64()
+    lib.vec_bounds(data, len(data), ctypes.byref(rows_ub),
+                   ctypes.byref(nnz_ub))
+    indptr = np.empty(rows_ub.value + 1, np.int64)
+    indices = np.empty(nnz_ub.value, np.int32)
+    values = np.empty(nnz_ub.value, np.float64)
     rows = ctypes.c_int64()
     nnz = ctypes.c_int64()
     mx = ctypes.c_int64()
-    lib.vec_count(data, len(data), ctypes.byref(rows), ctypes.byref(nnz),
-                  ctypes.byref(mx))
-    indptr = np.empty(rows.value + 1, np.int64)
-    indices = np.empty(nnz.value, np.int32)
-    values = np.empty(nnz.value, np.float64)
-    lib.vec_fill(data, len(data), _p(indptr, ctypes.c_int64),
-                 _p(indices, ctypes.c_int32), _p(values, ctypes.c_double))
-    return indptr, indices, values, int(mx.value)
+    lib.vec_fill2(data, len(data), _p(indptr, ctypes.c_int64),
+                  _p(indices, ctypes.c_int32), _p(values, ctypes.c_double),
+                  ctypes.byref(rows), ctypes.byref(nnz), ctypes.byref(mx))
+    return (indptr[:rows.value + 1], indices[:nnz.value],
+            values[:nnz.value], int(mx.value))

@@ -42,6 +42,47 @@ inline long parse_int(const char*& p, const char* end) {
   return neg ? -v : v;
 }
 
+const double kPow10[23] = {1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+                           1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
+                           1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
+// Fast exact float parse: when the token is [+-]digits[.digits] with at
+// most 15 mantissa digits, the mantissa fits a double exactly and one
+// division by an exactly-representable power of ten is correctly rounded
+// — bit-identical to strtod (the standard strtod fast path). Everything
+// else (exponents, inf/nan, long mantissas) falls back to strtod.
+inline double parse_num_fast(const char*& p, const char* end) {
+  const char* s = p;
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) neg = (*p++ == '-');
+  uint64_t mant = 0;
+  int idig = 0, fdig = 0;
+  while (p < end && *p >= '0' && *p <= '9' && idig < 16) {
+    mant = mant * 10 + (uint64_t)(*p++ - '0');
+    idig++;
+  }
+  if (p < end && *p == '.') {
+    p++;
+    while (p < end && *p >= '0' && *p <= '9' && idig + fdig < 16) {
+      mant = mant * 10 + (uint64_t)(*p++ - '0');
+      fdig++;
+    }
+  }
+  // fall back to strtod whenever the fast scan did not stop at a clean
+  // token boundary (more digits than the 15-digit exact window, an
+  // exponent, hex/inf/nan spellings, no digits at all) — strtod would
+  // consume those bytes, so the fast result would disagree
+  bool dirty_stop = (p < end && !is_space(*p) && *p != ':' && *p != ',' &&
+                     *p != '\n');
+  if (dirty_stop || idig + fdig == 0 || idig + fdig > 15) {
+    p = s;
+    return parse_num(p, end);
+  }
+  double v = (double)mant;
+  if (fdig > 0) v /= kPow10[fdig];
+  return neg ? -v : v;
+}
+
 }  // namespace
 
 extern "C" {
@@ -121,6 +162,80 @@ int svm_fill(const char* buf, int64_t len, int64_t start_index,
     row++;
     indptr[row] = k;
   }
+  return 0;
+}
+
+// Fast one-pass protocol (the two-pass svm_count above parses every
+// token twice — 2x the work for data that is parsed once and discarded):
+// svm_bounds returns cheap memchr-counted UPPER bounds for allocation
+// (rows <= #newlines+1, nnz <= #':'), svm_fill2 does the single real
+// parse and reports the ACTUAL rows/nnz/max_idx so the caller trims.
+int svm_bounds(const char* buf, int64_t len, int64_t* out_rows_ub,
+               int64_t* out_nnz_ub) {
+  // one auto-vectorized sweep counting both bytes at once — memchr per
+  // hit was as slow as the real parse at one ':' every ~8 bytes
+  int64_t nl = 0, colons = 0;
+  for (int64_t i = 0; i < len; i++) {
+    nl += (buf[i] == '\n');
+    colons += (buf[i] == ':');
+  }
+  if (len > 0 && buf[len - 1] != '\n') nl++;
+  *out_rows_ub = nl;
+  *out_nnz_ub = colons;
+  return 0;
+}
+
+int svm_fill2(const char* buf, int64_t len, int64_t start_index,
+              double* labels, int64_t* indptr, int32_t* indices,
+              double* values, int64_t* out_rows, int64_t* out_nnz,
+              int64_t* out_max_idx) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t row = 0, k = 0, max_idx = 0;
+  indptr[0] = 0;
+  while (p < end) {
+    while (p < end && (is_space(*p) || *p == '\n')) p++;
+    if (p >= end) break;
+    // label = the ENTIRE first token (same rule as svm_count)
+    {
+      const char* tok = p;
+      double v = parse_num_fast(p, end);
+      // the token may extend past the parsed number (e.g. "1.5x"): the
+      // label is strtod's prefix parse of the whole token, so re-parse
+      // only if unconsumed non-separator bytes remain
+      if (p < end && !is_space(*p) && *p != '\n') {
+        char lb[64];
+        int n = 0;
+        const char* q = tok;
+        while (q < end && !is_space(*q) && *q != '\n' && n < 63)
+          lb[n++] = *q++;
+        while (q < end && !is_space(*q) && *q != '\n') q++;
+        lb[n] = '\0';
+        v = std::strtod(lb, nullptr);
+        p = q;
+      }
+      labels[row] = v;
+    }
+    while (p < end && *p != '\n') {
+      while (p < end && is_space(*p)) p++;
+      if (p >= end || *p == '\n') break;
+      long idx = parse_int(p, end);
+      if (p < end && *p == ':') {
+        p++;
+        values[k] = parse_num_fast(p, end);
+        indices[k] = (int32_t)(idx - start_index);
+        if (idx > max_idx) max_idx = idx;
+        k++;
+      } else {
+        while (p < end && !is_space(*p) && *p != '\n') p++;
+      }
+    }
+    row++;
+    indptr[row] = k;
+  }
+  *out_rows = row;
+  *out_nnz = k;
+  *out_max_idx = max_idx;
   return 0;
 }
 
@@ -230,6 +345,55 @@ int vec_count(const char* buf, int64_t len, int64_t* out_rows,
   }
   *out_rows = rows;
   *out_nnz = nnz;
+  *out_max_idx = max_idx;
+  return 0;
+}
+
+// one-pass protocol for vector literals, mirroring svm_bounds/svm_fill2
+int vec_bounds(const char* buf, int64_t len, int64_t* out_rows_ub,
+               int64_t* out_nnz_ub) {
+  return svm_bounds(buf, len, out_rows_ub, out_nnz_ub);
+}
+
+int vec_fill2(const char* buf, int64_t len, int64_t* indptr, int32_t* indices,
+              double* values, int64_t* out_rows, int64_t* out_nnz,
+              int64_t* out_max_idx) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t row = 0, k = 0, max_idx = 0;
+  indptr[0] = 0;
+  while (p < end) {
+    const char* line_end = (const char*)memchr(p, '\n', end - p);
+    if (!line_end) line_end = end;
+    if (line_end > p) {
+      const char* q = p;
+      if (*q == '$') {  // "$size$"
+        q++;
+        long sz = parse_int(q, line_end);
+        if (sz > max_idx) max_idx = sz;
+        if (q < line_end && *q == '$') q++;
+      }
+      while (q < line_end) {
+        while (q < line_end && is_sep(*q)) q++;
+        if (q >= line_end) break;
+        long idx = parse_int(q, line_end);
+        if (q < line_end && *q == ':') {
+          q++;
+          values[k] = parse_num_fast(q, line_end);
+          indices[k] = (int32_t)idx;
+          if (idx + 1 > max_idx) max_idx = idx + 1;
+          k++;
+        } else {
+          while (q < line_end && !is_sep(*q)) q++;
+        }
+      }
+      row++;
+      indptr[row] = k;
+    }
+    p = line_end + 1;
+  }
+  *out_rows = row;
+  *out_nnz = k;
   *out_max_idx = max_idx;
   return 0;
 }

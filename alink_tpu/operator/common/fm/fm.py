@@ -163,10 +163,10 @@ def fm_train(data: Dict[str, np.ndarray], dim: int, p: FmTrainParams,
     for kk, v in data.items():
         queue.init_with_partitioned_data(kk, v)
     from ....engine.comqueue import freeze_config
-    # V0 is baked into the trace as a constant, so it must be part of the
-    # key (same seed/dim -> same V0 -> cache hit)
-    queue.set_program_key(("fm", dim, str(dtype), freeze_config(p),
-                           freeze_config(V0)))
+    # V0 is baked into the trace as a constant, but it is a pure function
+    # of (p.seed, dim, p.num_factors, p.init_stdev, dtype) — all already
+    # in the key — so it needs no hashing of its own
+    queue.set_program_key(("fm", dim, str(dtype), freeze_config(p)))
     res = queue.exec()
     model = res.get("model")
     curve = np.asarray(res.get("loss_curve"))

@@ -705,25 +705,44 @@ def bench_logreg_from_disk(h: Harness):
             f.write("\n")
         os.replace(tmp, path)
 
-    n_shards = 8                 # per-host sharded readers, drained serially
+    n_shards = 8                 # per-host sharded readers, drained in parallel
     meta = FieldBlockMeta(N_FIELDS, FIELD_SIZE)
     offs = (np.arange(N_FIELDS, dtype=np.int64) * FIELD_SIZE)[None, :]
 
     def load_from_disk():
+        # each shard reads AND parses in one pooled task (ctypes C calls
+        # release the GIL — io/sharding.parallel_shard_map), so shard i's
+        # disk read overlaps shard j's parse; read_s/parse_s are per-shard
+        # attribution SUMS (they exceed the wall time when overlapped),
+        # rp_wall_s is the wall clock for the whole read+parse phase
+        from alink_tpu.io.sharding import parallel_shard_map
+
+        def load_shard(i):
+            t0 = time.perf_counter()
+            b = _load_line_bytes(path, False, (i, n_shards))
+            t1 = time.perf_counter()
+            p = parse_libsvm_bytes(b, 1)
+            t2 = time.perf_counter()
+            return p, t1 - t0, t2 - t1
+
         t0 = time.perf_counter()
-        blobs = [_load_line_bytes(path, False, (i, n_shards))
-                 for i in range(n_shards)]
-        t_read = time.perf_counter() - t0
+        res = parallel_shard_map(load_shard, n_shards)
+        rp_wall = time.perf_counter() - t0
+        parts = [r[0] for r in res]
         t0 = time.perf_counter()
-        parts = [parse_libsvm_bytes(b, 1) for b in blobs]
-        t_parse = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        labels = np.concatenate([p[0] for p in parts]).astype(np.float32)
-        idx = np.concatenate([p[2] for p in parts]).reshape(-1, N_FIELDS)
-        fb = (idx - offs).astype(np.int32)              # field-local encode
+
+        def encode(i):
+            p = parts[i]
+            fb_i = (p[2].reshape(-1, N_FIELDS) - offs).astype(np.int32)
+            return fb_i, p[0].astype(np.float32)
+
+        enc = parallel_shard_map(encode, n_shards)
+        fb = np.concatenate([e[0] for e in enc])
+        labels = np.concatenate([e[1] for e in enc])
         t_enc = time.perf_counter() - t0
-        return fb, labels, {"read_s": round(t_read, 3),
-                            "parse_s": round(t_parse, 3),
+        return fb, labels, {"read_s": round(sum(r[1] for r in res), 3),
+                            "parse_s": round(sum(r[2] for r in res), 3),
+                            "rp_wall_s": round(rp_wall, 3),
                             "encode_s": round(t_enc, 3)}
 
     def train(fb, labels):
@@ -769,14 +788,13 @@ def bench_logreg_from_disk(h: Harness):
     return {"samples_per_sec_per_chip": round(pipeline_sps, 1),
             "in_memory_samples_per_sec_per_chip": round(mem_sps, 1),
             "source_samples_per_sec": round(
-                n_rows / (split["read_s"] + split["parse_s"]
-                          + split["encode_s"]), 1),
+                n_rows / (split["rp_wall_s"] + split["encode_s"]), 1),
             "pipeline_vs_memory": round(pipeline_sps / mem_sps, 3),
             "fixture_mb": round(bytes_read / 1e6, 1),
             "source_mb_per_sec": round(
-                bytes_read / 1e6 / (split["read_s"] + split["parse_s"]), 1),
-            **split, "train_s": round(t_total - split["read_s"]
-                                      - split["parse_s"] - split["encode_s"], 3),
+                bytes_read / 1e6 / split["rp_wall_s"], 1),
+            **split, "train_s": round(t_total - split["rp_wall_s"]
+                                      - split["encode_s"], 3),
             "dt_s": round(t_total, 3)}
 
 

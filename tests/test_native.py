@@ -239,3 +239,85 @@ class TestNativeVsPythonDifferential:
         slow = murmur32_cells(toks, seed=17, mod=1024)
         monkeypatch.delenv("ALINK_NO_NATIVE")
         np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+
+def test_parallel_libsvm_parse_matches_serial():
+    """Chunked multi-core parse must be byte-identical to the single-call
+    parse, for chunk boundaries landing anywhere in a line."""
+    from alink_tpu.native import (get_lib, parse_libsvm_bytes,
+                                  parse_libsvm_bytes_parallel,
+                                  split_newline_chunks)
+    if get_lib() is None:
+        import pytest
+        pytest.skip("native library unavailable")
+    rng = np.random.RandomState(0)
+    lines = []
+    for i in range(5000):
+        nnz = rng.randint(1, 8)
+        idx = np.sort(rng.choice(200, nnz, replace=False)) + 1
+        toks = " ".join(f"{j}:{rng.randn():.4f}" for j in idx)
+        lines.append(f"{rng.choice([-1.0, 1.0])} {toks}")
+    data = ("\n".join(lines) + "\n").encode()
+
+    ser = parse_libsvm_bytes(data, 1)
+    par = parse_libsvm_bytes_parallel(data, 1, max_workers=7)
+    # force chunking even though the fixture is <4MB
+    chunks = split_newline_chunks(data, 7)
+    assert b"".join(chunks) == data
+    assert len(chunks) > 1
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(len(chunks)) as ex:
+        parts = list(ex.map(lambda c: parse_libsvm_bytes(c, 1), chunks))
+    labels = np.concatenate([p[0] for p in parts])
+    indices = np.concatenate([p[2] for p in parts])
+    values = np.concatenate([p[3] for p in parts])
+    nnz_offs = np.cumsum([0] + [len(p[2]) for p in parts[:-1]])
+    indptr = np.concatenate(
+        [parts[0][1][:1]] + [p[1][1:] + off for p, off in zip(parts, nnz_offs)])
+    for got in (par, (labels, indptr, indices, values)):
+        assert np.array_equal(ser[0], got[0])
+        assert np.array_equal(ser[1], got[1])
+        assert np.array_equal(ser[2], got[2])
+        assert np.array_equal(ser[3], got[3])
+
+
+def test_split_newline_chunks_edges():
+    from alink_tpu.native import split_newline_chunks
+    assert split_newline_chunks(b"", 4) == []
+    assert split_newline_chunks(b"abc\n", 1) == [b"abc\n"]
+    # no trailing newline: last partial line stays in one chunk
+    data = b"a\nbb\nccc\ndddd"
+    for k in range(1, 8):
+        chunks = split_newline_chunks(data, k)
+        assert b"".join(chunks) == data
+        for c in chunks[:-1]:
+            assert c.endswith(b"\n")
+    # single long line, many chunks
+    one = b"x" * 1000
+    assert split_newline_chunks(one, 8) == [one]
+
+
+def test_fast_float_path_exactness():
+    """The one-pass parser's fast float path must be bit-identical to
+    strtod/Python float across exponents, long mantissas, and boundary
+    spellings (it falls back to strtod for anything not exactly
+    representable via one division)."""
+    from alink_tpu.native import get_lib, parse_libsvm_bytes
+    if get_lib() is None:
+        import pytest
+        pytest.skip("native library unavailable")
+    vals = ["1", "-1", "0", "0.5", "-0.5", "3.", ".5", "-.25",
+            "1e-4", "2.5E3", "-1e10", "123456789012345678901234567890",
+            "0.1234567890123456789", "9007199254740993",  # > 2^53
+            "1.7976931348623157e308", "5e-324", "+2.5",
+            "0.30000000000000004", "1.0000000000000002"]
+    lines = []
+    for i, v in enumerate(vals):
+        lines.append(f"{v} {i + 1}:{v}")
+    data = ("\n".join(lines) + "\n").encode()
+    labels, indptr, indices, values = parse_libsvm_bytes(data, 1)
+    expect = np.array([float(v) for v in vals])
+    assert labels.shape == (len(vals),)
+    np.testing.assert_array_equal(labels, expect)
+    np.testing.assert_array_equal(values, expect)
+    assert np.array_equal(indices, np.arange(len(vals), dtype=np.int32))
