@@ -231,6 +231,9 @@ class MTable:
 
 
 def _as_column(v) -> np.ndarray:
+    from .vector import SparseVectorColumn
+    if isinstance(v, SparseVectorColumn):
+        return v  # columnar vector column duck-types the ndarray surface
     if isinstance(v, np.ndarray) and v.ndim == 1:
         return v
     v = list(v)
@@ -261,6 +264,15 @@ def _infer_type(col: np.ndarray) -> str:
 
 
 def _concat(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    from .vector import SparseVectorColumn
+    if isinstance(a, SparseVectorColumn) and isinstance(b, SparseVectorColumn):
+        if (a.dim == b.dim and a.idx.shape[1] == b.idx.shape[1]):
+            return SparseVectorColumn(np.vstack([a.idx, b.idx]),
+                                      np.vstack([a.val, b.val]), a.dim)
+    if isinstance(a, SparseVectorColumn):
+        a = a.materialize()
+    if isinstance(b, SparseVectorColumn):
+        b = b.materialize()
     if a.dtype == object or b.dtype == object:
         out = np.empty(a.shape[0] + b.shape[0], dtype=object)
         out[:a.shape[0]] = a

@@ -347,6 +347,55 @@ class SparseBatch:
         return SparseBatch(idx, val, self.n_cols)
 
 
+class SparseVectorColumn:
+    """Columnar stand-in for an object column of same-width SparseVectors.
+
+    The FeatureHasher -> trainer path used to materialize one SparseVector
+    per row only for extract_design to tear them straight back into
+    (idx, val) arrays — the dominant host cost of the streaming drain.
+    This class keeps the batch columnar end-to-end: it duck-types the
+    ndarray surface MTable uses (shape/dtype/len/indexing — int indexing
+    materializes ONE SparseVector copy; slice/fancy/bool indexing returns
+    a column view), while extract_design consumes ``idx``/``val``
+    zero-copy.
+    """
+
+    __slots__ = ("idx", "val", "dim")
+    dtype = np.dtype(object)
+
+    def __init__(self, idx: np.ndarray, val: np.ndarray, dim: int):
+        assert idx.ndim == 2 and idx.shape == val.shape
+        self.idx = idx
+        self.val = val
+        self.dim = int(dim)
+
+    @property
+    def shape(self):
+        return (self.idx.shape[0],)
+
+    def __len__(self):
+        return self.idx.shape[0]
+
+    def __getitem__(self, i):
+        if isinstance(i, (int, np.integer)):
+            # per-row copies: a retained vector must not pin the batch
+            return SparseVector.trusted(self.dim, self.idx[i].copy(),
+                                        self.val[i].copy())
+        return SparseVectorColumn(self.idx[i], self.val[i], self.dim)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def copy(self) -> "SparseVectorColumn":
+        return SparseVectorColumn(self.idx.copy(), self.val.copy(), self.dim)
+
+    def materialize(self) -> np.ndarray:
+        out = np.empty(len(self), object)
+        out[:] = list(self)
+        return out
+
+
 class DenseMatrix:
     """Column-major double matrix facade (reference common/linalg/DenseMatrix.java).
 

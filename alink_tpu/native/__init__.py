@@ -199,10 +199,21 @@ def murmur32_batch(tokens, seed: int = 0, mod: int = 0) -> Optional[np.ndarray]:
     lib = get_lib()
     if lib is None:
         return None
-    lens = np.fromiter((len(t) for t in tokens), np.int64, len(tokens))
+    if isinstance(tokens, np.ndarray) and tokens.dtype.kind == "S":
+        # fixed-width bytes column: pack WITHOUT a per-token Python loop
+        # (np.char.str_len counts embedded NULs correctly; a token with
+        # TRAILING NULs is indistinguishable from its stripped form in a
+        # fixed-width array — callers hashing text never produce those)
+        n = len(tokens)
+        w = tokens.dtype.itemsize
+        lens = np.char.str_len(tokens).astype(np.int64)
+        bytes2d = np.frombuffer(tokens.tobytes(), np.uint8).reshape(n, w)
+        buf = bytes2d[np.arange(w) < lens[:, None]].tobytes()
+    else:
+        lens = np.fromiter((len(t) for t in tokens), np.int64, len(tokens))
+        buf = b"".join(tokens)
     offsets = np.zeros(len(tokens) + 1, np.int64)
     np.cumsum(lens, out=offsets[1:])
-    buf = b"".join(tokens)
     out = np.empty(len(tokens), np.int64)
     lib.murmur_batch(buf, _p(offsets, ctypes.c_int64), len(tokens),
                      seed & 0xFFFFFFFF, mod, _p(out, ctypes.c_int64))

@@ -234,6 +234,22 @@ def murmur32(data: bytes, seed: int = 0) -> int:
     return h
 
 
+def _format_tokens(col_name: str, a) -> np.ndarray:
+    """Vectorized ``f"{col_name}={v}".encode()`` per cell -> fixed-width
+    "S" array (np str() formatting matches the f-string for every numpy
+    scalar and for None -> "None")."""
+    arr = np.asarray(a)
+    if arr.dtype.kind == "S":
+        # bytes cells format as their repr under the f-string contract
+        # ("c=b'y'"); astype("U") would DECODE them and change the hash
+        return np.array([f"{col_name}={v}".encode() for v in arr])
+    ua = np.char.add(f"{col_name}=", arr.astype("U"))
+    try:
+        return ua.astype("S")  # ASCII cast: ~3x faster than element encode
+    except UnicodeEncodeError:
+        return np.char.encode(ua, "utf-8")
+
+
 def murmur32_cells(tokens, seed: int = 0, mod: int = 0) -> np.ndarray:
     """Batch murmur3_32 over byte-string tokens (int64 array).
 
@@ -281,7 +297,6 @@ class FeatureHasherBatchOp(BatchOperator, HasSelectedCols, HasOutputCol,
         cat = {c: (c in declared_cat or
                    not AlinkTypes.is_numeric(t.schema.type_of(c))) for c in cols}
         arrays = {c: t.col(c) for c in cols}
-        vecs = np.empty(t.num_rows, object)
         n = t.num_rows
         if self.get_field_aware():
             # field size = num_features/n_cols ceiled to a multiple of 16,
@@ -297,19 +312,27 @@ class FeatureHasherBatchOp(BatchOperator, HasSelectedCols, HasOutputCol,
             for k, c in enumerate(cols):
                 a = arrays[c]
                 if cat[c]:
-                    tokens = [f"{c}={v}".encode() for v in a]
-                    fb[:, k] = k * S + murmur32_cells(tokens, mod=S)
+                    fb[:, k] = k * S + murmur32_cells(
+                        _format_tokens(c, a), mod=S)
                     wv[:, k] = 1.0
                 else:
                     fb[:, k] = k * S + murmur32(c.encode()) % S
-                    wv[:, k] = [float(v) if v is not None else 0.0 for v in a]
+                    if a.dtype == object:
+                        # np.asarray would turn None into nan; the contract
+                        # is None -> weight 0.0 (real nans stay nan)
+                        wv[:, k] = np.fromiter(
+                            (float(v) if v is not None else 0.0 for v in a),
+                            np.float64, n)
+                    else:
+                        wv[:, k] = np.asarray(a, np.float64)
             fb32 = fb.astype(np.int32)  # indices sorted by construction
-            for i in range(n):
-                # per-row copies: a retained vector must not pin the whole
-                # (n, n_cols) batch via a view
-                vecs[i] = SparseVector.trusted(dim, fb32[i].copy(),
-                                               wv[i].copy())
+            # columnar output: no per-row SparseVector objects on the hot
+            # path (extract_design consumes idx/val zero-copy; per-row
+            # access materializes copies on demand)
+            from ....common.vector import SparseVectorColumn
+            vecs = SparseVectorColumn(fb32, wv, dim)
         else:
+            vecs = np.empty(t.num_rows, object)
             # per-column vectorized hashing; slot -1 marks missing cells
             slots = np.empty((len(cols), n), np.int64)
             weights = np.empty((len(cols), n), np.float64)
@@ -317,8 +340,8 @@ class FeatureHasherBatchOp(BatchOperator, HasSelectedCols, HasOutputCol,
                 a = arrays[c]
                 miss = np.fromiter((v is None for v in a), bool, n)
                 if cat[c]:
-                    tokens = [b"" if m else f"{c}={v}".encode()
-                              for m, v in zip(miss, a)]
+                    tokens = _format_tokens(c, a)
+                    tokens[miss] = b""  # hashed then overwritten by -1
                     slots[k] = murmur32_cells(tokens, mod=dim)
                     weights[k] = 1.0
                 else:

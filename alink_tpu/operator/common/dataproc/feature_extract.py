@@ -25,8 +25,15 @@ def extract_design(table: MTable, feature_cols: Optional[Sequence[str]],
     {"kind": "sparse", "idx": (n,nnz), "val": (n,nnz)}, plus "dim".
     """
     if vector_col:
-        fast = _native_sparse_fast_path(table.col(vector_col), vector_size,
-                                        dtype)
+        from ....common.vector import SparseVectorColumn
+        col = table.col(vector_col)
+        if isinstance(col, SparseVectorColumn):
+            # columnar hasher output: zero-copy into the padded design
+            return {"kind": "sparse",
+                    "idx": col.idx.astype(np.int32, copy=False),
+                    "val": col.val.astype(dtype, copy=False),
+                    "dim": max(int(vector_size or 0), col.dim)}
+        fast = _native_sparse_fast_path(col, vector_size, dtype)
         if fast is not None:
             return fast
         vecs = [VectorUtil.parse(v) for v in table.col(vector_col)]

@@ -251,7 +251,8 @@ def _ftrl_sparse_batch_step_factory(mesh, alpha, beta, l1, l2):
 
 
 @functools.lru_cache(maxsize=64)
-def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2):
+def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2,
+                                with_val: bool = True):
     """Field-blocked batched FTRL — the Criteo fast path.
 
     Both gather/scatter-style modes above are bound by XLA's serialized
@@ -287,11 +288,17 @@ def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2):
         return _ftrl_weights(z, n, alpha, beta, l1, l2)
 
     def shard_fn(fb_idx, val, y, z, n):
-        # fb_idx/val: (B, F) replicated; z/n: local field-group slice
+        # fb_idx/val: (B, F) replicated; z/n: local field-group slice.
+        # fb_idx may arrive int16 (the tunnel ships half the bytes when
+        # field_size fits); widen before gathering. When with_val=False
+        # (full batch of pure one-hot rows) val is None and the implicit
+        # value is 1.0 — no val tensor crosses the host->device link.
         F_loc = local_meta.num_fields
         k0 = jax.lax.axis_index("d") * F_loc
         idx_l = jax.lax.dynamic_slice_in_dim(fb_idx, k0, F_loc, 1)
-        val_l = jax.lax.dynamic_slice_in_dim(val, k0, F_loc, 1)
+        idx_l = idx_l.astype(jnp.int32)
+        val_l = (jnp.ones(idx_l.shape, jnp.float32) if val is None else
+                 jax.lax.dynamic_slice_in_dim(val, k0, F_loc, 1))
         w = weights(z, n)
         nj = fb_gather(idx_l, n, local_meta)
         wj = fb_gather(idx_l, w, local_meta)
@@ -308,8 +315,13 @@ def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2):
                         dtype=jnp.float32)
         return z + dz.astype(z.dtype), n + dn.astype(n.dtype), margins
 
-    fn = shard_map(shard_fn, mesh=mesh,
-                   in_specs=(P(), P(), P(), P("d"), P("d")),
+    if with_val:
+        fn = shard_map(shard_fn, mesh=mesh,
+                       in_specs=(P(), P(), P(), P("d"), P("d")),
+                       out_specs=(P("d"), P("d"), P()))
+        return jax.jit(fn)
+    fn = shard_map(lambda fbi, y, z, n: shard_fn(fbi, None, y, z, n),
+                   mesh=mesh, in_specs=(P(), P(), P("d"), P("d")),
                    out_specs=(P("d"), P("d"), P()))
     return jax.jit(fn)
 
@@ -422,11 +434,24 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 label_type=init.label_type)
             return LinearModelDataConverter(init.label_type).save_model(m)
 
+        # ship batches in the dtype the device will execute in: with x64
+        # off, jax casts f64 inputs to f32 at the boundary anyway, so f64
+        # payloads just double the host->device transfer bytes
+        import jax as _jax
+        ship_dt = np.float64 if _jax.config.jax_enable_x64 else np.float32
+
         def labels(mt: MTable, b: int, batch_size: int) -> np.ndarray:
             raw = mt.col(label_col)
-            pos = init.label_values[0]
-            y = np.zeros(batch_size, np.float64)
-            y[:b] = [1.0 if str(v) == str(pos) else 0.0 for v in raw[:b]]
+            pos = str(init.label_values[0])
+            y = np.zeros(batch_size, ship_dt)
+            r = np.asarray(raw[:b])
+            if r.dtype != object and r.dtype.kind != "S":
+                # numpy str() formatting matches str(v) per scalar
+                # (bytes do NOT: astype("U") decodes b'1' to '1' while
+                # str(b'1') is "b'1'" — keep bytes on the exact path)
+                y[:b] = (r.astype("U") == pos)
+            else:
+                y[:b] = [1.0 if str(v) == pos else 0.0 for v in r]
             return y
 
         def encode(mt: MTable, batch_size: int, width: int):
@@ -438,12 +463,12 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
             explicit (0, 1.0) entry per real row.
             """
             design = extract_design(mt, feature_cols, vector_col,
-                                    np.float64,
+                                    ship_dt,
                                     vector_size=init.vector_size or None)
             b = mt.num_rows
             if design["kind"] == "dense":
                 Xf = design["X"]
-                X = np.zeros((batch_size, dim_pad), np.float64)
+                X = np.zeros((batch_size, dim_pad), ship_dt)
                 if has_icpt:
                     X[:b, 0] = 1.0
                     X[:b, 1:1 + Xf.shape[1]] = Xf
@@ -468,14 +493,25 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                     fb_local, fb_val, meta0 = fbd
                     F_aug = meta0.num_fields + (1 if has_icpt else 0)
                     if F_aug % n_dev == 0:
-                        fbi = np.zeros((batch_size, F_aug), np.int32)
-                        fbv = np.zeros((batch_size, F_aug), np.float64)
+                        # int16 indices when the field-local range fits:
+                        # half the host->device bytes (widened on device)
+                        idt = (np.int16 if meta0.field_size
+                               <= np.iinfo(np.int16).max else np.int32)
+                        fbi = np.zeros((batch_size, F_aug), idt)
                         c0 = 1 if has_icpt else 0
+                        fbi[:b, c0:] = fb_local
+                        meta = FieldBlockMeta(F_aug, meta0.field_size)
+                        if fb_val is None and b == batch_size:
+                            # full batch of pure one-hot rows: value is
+                            # implicitly 1.0 — ship NO value tensor (the
+                            # full-batch condition matters: padding rows
+                            # rely on val == 0 to be no-ops)
+                            return ("fb", fbi, None,
+                                    labels(mt, b, batch_size), meta)
+                        fbv = np.zeros((batch_size, F_aug), ship_dt)
                         if has_icpt:
                             fbv[:b, 0] = 1.0   # intercept field, local 0
-                        fbi[:b, c0:] = fb_local
                         fbv[:b, c0:] = (1.0 if fb_val is None else fb_val)
-                        meta = FieldBlockMeta(F_aug, meta0.field_size)
                         return ("fb", fbi, fbv,
                                 labels(mt, b, batch_size), meta)
             if has_icpt:
@@ -486,7 +522,7 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
             w0 = idx0.shape[1]
             width = max(width, -(-w0 // 8) * 8)   # grow in steps of 8
             idx = np.zeros((batch_size, width), np.int32)
-            val = np.zeros((batch_size, width), np.float64)
+            val = np.zeros((batch_size, width), ship_dt)
             idx[:b, :w0] = idx0
             val[:b, :w0] = val0
             return ("sparse", idx, val, labels(mt, b, batch_size), width)
@@ -533,11 +569,36 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 return (jax.device_put(z0, feat_shard),
                         jax.device_put(n0, feat_shard))
 
+            rep_shard = NamedSharding(mesh, P())
+
+            def put_replicated(enc):
+                """Move the encoded batch onto the device FROM the
+                prefetch thread: the transfer (a GIL-releasing socket
+                write on tunneled backends) overlaps the consumer's step
+                dispatches instead of serializing with them."""
+                if jax.process_count() > 1:
+                    return enc     # multihost: let the jit place inputs
+                if enc[0] == "fb":
+                    _, fbi, fbv, y, meta = enc
+                    return ("fb", jax.device_put(fbi, rep_shard),
+                            None if fbv is None else
+                            jax.device_put(fbv, rep_shard),
+                            jax.device_put(y, rep_shard), meta)
+                if enc[0] == "dense":
+                    _, X, y = enc
+                    return ("dense", jax.device_put(X, rep_shard),
+                            jax.device_put(y, rep_shard))
+                _, idx, val, y, width = enc
+                return ("sparse", jax.device_put(idx, rep_shard),
+                        jax.device_put(val, rep_shard),
+                        jax.device_put(y, rep_shard), width)
+
             def encoded_stream():
-                """(t, mt, enc) with encode running IN the prefetch
-                thread: hashing/padding of batch t+1 overlaps the device
-                running batch t (VERDICT r2 #4; Flink's pipelined
-                operators, FtrlTrainStreamOp.java:120-135)."""
+                """(t, mt, enc) with encode AND the host->device transfer
+                running IN the prefetch thread: hashing/padding/shipping
+                of batch t+1 overlaps the device running batch t
+                (VERDICT r2 #4; Flink's pipelined operators,
+                FtrlTrainStreamOp.java:120-135)."""
                 batch_size = None
                 width = 8
                 for t, mt in data_op.timed_batches():
@@ -548,71 +609,86 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                     enc = encode(mt, max(batch_size, mt.num_rows), width)
                     if enc[0] == "sparse":
                         width = enc[4]
-                    yield (t, mt, enc, batch_size)
+                    yield (t, mt, put_replicated(enc), batch_size)
 
             from ..prefetch import prefetch
 
+            # NOTE on deferred backends (the tunneled device service):
+            # transfers+execution flush at the first host fetch, so the
+            # device leg of a drain largely materializes at the final
+            # snapshot fetch. Forcing a fetch per batch was measured
+            # STRICTLY WORSE (each fetch pays the link's ~100 ms round
+            # trip: 380k -> 147k samples/s on the Criteo-shape drain);
+            # the single end-of-stream flush pipelines all batches
+            # through the link at full bandwidth.
             z = n = None
             layout = None                # "std" | "fb"
             fb_S = None
             fb_meta = None
             next_emit = None
             for t, mt, enc, batch_size in prefetch(encoded_stream()):
-                if next_emit is None:
-                    next_emit = (np.floor(t / interval) + 1) * interval
-                if (layout == "fb" and (
-                        enc[0] != "fb" or
-                        enc[4].num_fields != fb_meta.num_fields or
-                        enc[4].field_size != fb_meta.field_size)) or (
-                        layout == "std" and enc[0] == "fb"):
-                    # the first batch's detection was coincidental (or the
-                    # row shape changed): demote the state to the generic
-                    # layout — an exact translation — and stay there.
-                    # (Also covers up-to-`depth` in-flight batches the
-                    # prefetch thread encoded as fb before seeing the
-                    # demotion flag flip.)
-                    if layout == "fb":
-                        z, n = fb_to_std_state(z, n)
-                        # only an fb-layout step factory is invalidated;
-                        # once layout is std, queued fb-encoded batches
-                        # must NOT null the (std) factory again — that
-                        # re-traced the step once per in-flight batch
-                        sparse_step[0] = None
-                    layout, fb_S, fb_meta = "std", None, None
-                    allow_fb[0] = False
-                    enc = encode(mt, max(batch_size, mt.num_rows), 8)
-                if enc[0] == "fb":
-                    _, fbi, fbv, y, meta = enc
-                    if layout is None:
-                        layout, fb_S = "fb", meta.field_size
-                        fb_meta = meta
-                        z, n = alloc(layout, fb_S)
-                        sparse_step[0] = _ftrl_fb_batch_step_factory(
-                            mesh, meta, alpha, beta, l1, l2)
-                    z, n, _ = sparse_step[0](fbi, fbv, y, z, n)
-                elif enc[0] == "dense":
-                    if layout is None:
-                        layout = "std"
-                        allow_fb[0] = False
-                        z, n = alloc(layout)
-                    _, X, y = enc
-                    z, n, _ = dense_step[0](X, y, z, n)
-                else:
-                    if layout is None:
-                        layout = "std"
-                        allow_fb[0] = False
-                        z, n = alloc(layout)
-                    _, idx, val, y, width = enc
-                    if sparse_step[0] is None:
-                        sparse_step[0] = (
-                            _ftrl_sparse_batch_step_factory if batch_mode
-                            else _ftrl_sparse_step_factory)(
-                                mesh, alpha, beta, l1, l2)
-                    z, n, _ = sparse_step[0](idx, val, y, z, n)
-                if t + 1e-12 >= next_emit:
-                    yield (t, snapshot(z, n, fb_S))
-                    while next_emit <= t + 1e-12:
-                        next_emit += interval
+              if next_emit is None:
+                  next_emit = (np.floor(t / interval) + 1) * interval
+              if (layout == "fb" and (
+                      enc[0] != "fb" or
+                      enc[4].num_fields != fb_meta.num_fields or
+                      enc[4].field_size != fb_meta.field_size)) or (
+                      layout == "std" and enc[0] == "fb"):
+                  # the first batch's detection was coincidental (or the
+                  # row shape changed): demote the state to the generic
+                  # layout — an exact translation — and stay there.
+                  # (Also covers up-to-`depth` in-flight batches the
+                  # prefetch thread encoded as fb before seeing the
+                  # demotion flag flip.)
+                  if layout == "fb":
+                      z, n = fb_to_std_state(z, n)
+                      # only an fb-layout step factory is invalidated;
+                      # once layout is std, queued fb-encoded batches
+                      # must NOT null the (std) factory again — that
+                      # re-traced the step once per in-flight batch
+                      sparse_step[0] = None
+                  layout, fb_S, fb_meta = "std", None, None
+                  allow_fb[0] = False
+                  enc = encode(mt, max(batch_size, mt.num_rows), 8)
+              if enc[0] == "fb":
+                  _, fbi, fbv, y, meta = enc
+                  if layout is None:
+                      layout, fb_S = "fb", meta.field_size
+                      fb_meta = meta
+                      z, n = alloc(layout, fb_S)
+                  # the lru-cached factory is re-looked-up per batch:
+                  # full one-hot batches run the val-less program (no
+                  # value tensor shipped), partial/weighted ones the
+                  # val-carrying twin
+                  step = _ftrl_fb_batch_step_factory(
+                      mesh, meta, alpha, beta, l1, l2, fbv is not None)
+                  if fbv is None:
+                      z, n, _ = step(fbi, y, z, n)
+                  else:
+                      z, n, _ = step(fbi, fbv, y, z, n)
+              elif enc[0] == "dense":
+                  if layout is None:
+                      layout = "std"
+                      allow_fb[0] = False
+                      z, n = alloc(layout)
+                  _, X, y = enc
+                  z, n, _ = dense_step[0](X, y, z, n)
+              else:
+                  if layout is None:
+                      layout = "std"
+                      allow_fb[0] = False
+                      z, n = alloc(layout)
+                  _, idx, val, y, width = enc
+                  if sparse_step[0] is None:
+                      sparse_step[0] = (
+                          _ftrl_sparse_batch_step_factory if batch_mode
+                          else _ftrl_sparse_step_factory)(
+                              mesh, alpha, beta, l1, l2)
+                  z, n, _ = sparse_step[0](idx, val, y, z, n)
+              if t + 1e-12 >= next_emit:
+                  yield (t, snapshot(z, n, fb_S))
+                  while next_emit <= t + 1e-12:
+                      next_emit += interval
             if z is None:
                 # empty stream: emit the warm-start model, as the eager
                 # allocation used to

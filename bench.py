@@ -573,7 +573,8 @@ def bench_ftrl(h: Harness):
         FtrlTrainStreamOp)
     from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
 
-    n_stream = 49_152                        # 12 x 4096-row micro-batches
+    n_stream = 262_144                       # 16 x 16384-row micro-batches
+    stream_bs = 16_384                       # amortizes per-batch dispatch
     srng = np.random.RandomState(17)
     sites = np.char.add("s", srng.randint(0, 4000, n_stream).astype("U6"))
     devs = np.char.add("d", srng.randint(0, 4000, n_stream).astype("U6"))
@@ -595,7 +596,8 @@ def bench_ftrl(h: Harness):
         vector_col="vec", label_col="click", max_iter=3).link_from(warm_feat)
 
     def drain_stream():
-        src = MemSourceStreamOp(MTable(cols, stream_schema), batch_size=4096)
+        src = MemSourceStreamOp(MTable(cols, stream_schema),
+                                batch_size=stream_bs)
         feat = FeatureHasherStreamOp(**hasher_kw).link_from(src)
         ftrl = FtrlTrainStreamOp(warm, vector_col="vec", label_col="click",
                                  alpha=0.05, beta=1.0, l1=1e-5, l2=1e-5,
@@ -606,10 +608,28 @@ def bench_ftrl(h: Harness):
             last = mt
         return last
 
+    def drain_host_only():
+        # the same source -> hasher chain WITHOUT the device leg: its rate
+        # is the host ceiling, and e2e vs host attributes the gap
+        src = MemSourceStreamOp(MTable(cols, stream_schema),
+                                batch_size=stream_bs)
+        feat = FeatureHasherStreamOp(**hasher_kw).link_from(src)
+        rows = 0
+        for _, mt in feat.timed_batches():
+            rows += mt.num_rows
+        return rows
+
     drain_stream()                           # warm compiles
     t0 = time.perf_counter()
     drain_stream()
-    stream_e2e_sps = n_stream / (time.perf_counter() - t0) / h.chips
+    stream_e2e_s = time.perf_counter() - t0
+    stream_e2e_sps = n_stream / stream_e2e_s / h.chips
+    t0 = time.perf_counter()
+    assert drain_host_only() == n_stream
+    stream_host_s = time.perf_counter() - t0
+    # per-HOST rate (the chain does not scale with chips — dividing by
+    # h.chips would under-report the host ceiling on multi-chip rigs)
+    stream_host_sps = n_stream / stream_host_s
 
     # CPU baseline: per-sample O(nnz) FTRL loop in numpy (one task slot).
     # Best-of-3: a single timing of a 4096-sample Python loop swings
@@ -657,7 +677,12 @@ def bench_ftrl(h: Harness):
             "batch_mode_samples_per_sec_per_chip": round(sps_batch, 1),
             "batch_mode_vs_baseline": round(sps_batch / cpu_sps, 3),
             "batch_mode_pct_chip_peak_flops": batch["pct_chip_peak_flops"],
-            "stream_e2e_samples_per_sec_per_chip": round(stream_e2e_sps, 1)}
+            "stream_e2e_samples_per_sec_per_chip": round(stream_e2e_sps, 1),
+            "stream_e2e_host_samples_per_sec": round(stream_host_sps, 1),
+            "stream_e2e_s": round(stream_e2e_s, 3),
+            "stream_e2e_host_s": round(stream_host_s, 3),
+            "stream_e2e_device_share": round(
+                max(0.0, 1.0 - stream_host_s / max(stream_e2e_s, 1e-9)), 3)}
 
 
 # ---------------------------------------------------------------------------

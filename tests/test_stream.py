@@ -451,7 +451,9 @@ def test_ftrl_fb_batch_matches_coo_batch(monkeypatch):
 
     monkeypatch.setattr(ftrl_mod, "_ftrl_fb_batch_step_factory", spy)
     c_fb = _ftrl_final_coef(table, warm, 64, "batch")
-    assert engaged["fb"] == 1, "field-blocked fast path did not engage"
+    # the lru-cached factory is looked up per batch now (val-less vs
+    # val-carrying variant is a per-batch choice) — engagement, not count
+    assert engaged["fb"] >= 1, "field-blocked fast path did not engage"
 
     # same data through the COO batch program (detection disabled)
     monkeypatch.setattr(fb_mod, "detect_fieldblock", lambda *a, **k: None)
