@@ -231,9 +231,8 @@ class MTable:
 
 
 def _as_column(v) -> np.ndarray:
-    from .vector import SparseVectorColumn
-    if isinstance(v, SparseVectorColumn):
-        return v  # columnar vector column duck-types the ndarray surface
+    if getattr(v, "__mtable_column__", False):
+        return v  # columnar column classes duck-type the ndarray surface
     if isinstance(v, np.ndarray) and v.ndim == 1:
         return v
     v = list(v)
@@ -264,14 +263,12 @@ def _infer_type(col: np.ndarray) -> str:
 
 
 def _concat(a: np.ndarray, b: np.ndarray) -> np.ndarray:
-    from .vector import SparseVectorColumn
-    if isinstance(a, SparseVectorColumn) and isinstance(b, SparseVectorColumn):
-        if (a.dim == b.dim and a.idx.shape[1] == b.idx.shape[1]):
-            return SparseVectorColumn(np.vstack([a.idx, b.idx]),
-                                      np.vstack([a.val, b.val]), a.dim)
-    if isinstance(a, SparseVectorColumn):
+    if getattr(a, "__mtable_column__", False):
+        same = a.concat_same(b)
+        if same is not None:
+            return same
         a = a.materialize()
-    if isinstance(b, SparseVectorColumn):
+    if getattr(b, "__mtable_column__", False):
         b = b.materialize()
     if a.dtype == object or b.dtype == object:
         out = np.empty(a.shape[0] + b.shape[0], dtype=object)

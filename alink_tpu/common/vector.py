@@ -16,6 +16,8 @@ from typing import Iterable, List, Optional, Sequence, Union
 
 import numpy as np
 
+from .columnar import ColumnarColumn
+
 
 class DenseVector:
     """Dense double vector (reference common/linalg/DenseVector.java)."""
@@ -347,21 +349,18 @@ class SparseBatch:
         return SparseBatch(idx, val, self.n_cols)
 
 
-class SparseVectorColumn:
+class SparseVectorColumn(ColumnarColumn):
     """Columnar stand-in for an object column of same-width SparseVectors.
 
     The FeatureHasher -> trainer path used to materialize one SparseVector
     per row only for extract_design to tear them straight back into
     (idx, val) arrays — the dominant host cost of the streaming drain.
-    This class keeps the batch columnar end-to-end: it duck-types the
-    ndarray surface MTable uses (shape/dtype/len/indexing — int indexing
-    materializes ONE SparseVector copy; slice/fancy/bool indexing returns
-    a column view), while extract_design consumes ``idx``/``val``
+    This class keeps the batch columnar end-to-end (protocol:
+    common/columnar.py); extract_design consumes ``idx``/``val``
     zero-copy.
     """
 
     __slots__ = ("idx", "val", "dim")
-    dtype = np.dtype(object)
 
     def __init__(self, idx: np.ndarray, val: np.ndarray, dim: int):
         assert idx.ndim == 2 and idx.shape == val.shape
@@ -369,31 +368,27 @@ class SparseVectorColumn:
         self.val = val
         self.dim = int(dim)
 
-    @property
-    def shape(self):
-        return (self.idx.shape[0],)
-
     def __len__(self):
         return self.idx.shape[0]
 
-    def __getitem__(self, i):
-        if isinstance(i, (int, np.integer)):
-            # per-row copies: a retained vector must not pin the batch
-            return SparseVector.trusted(self.dim, self.idx[i].copy(),
-                                        self.val[i].copy())
-        return SparseVectorColumn(self.idx[i], self.val[i], self.dim)
+    def _render_row(self, i: int):
+        # per-row copies: a retained vector must not pin the batch
+        return SparseVector.trusted(self.dim, self.idx[i].copy(),
+                                    self.val[i].copy())
 
-    def __iter__(self):
-        for i in range(len(self)):
-            yield self[i]
+    def _subset(self, sel):
+        return SparseVectorColumn(self.idx[sel], self.val[sel], self.dim)
 
     def copy(self) -> "SparseVectorColumn":
         return SparseVectorColumn(self.idx.copy(), self.val.copy(), self.dim)
 
-    def materialize(self) -> np.ndarray:
-        out = np.empty(len(self), object)
-        out[:] = list(self)
-        return out
+    def concat_same(self, other):
+        if (isinstance(other, SparseVectorColumn) and other.dim == self.dim
+                and other.idx.shape[1] == self.idx.shape[1]):
+            return SparseVectorColumn(np.vstack([self.idx, other.idx]),
+                                      np.vstack([self.val, other.val]),
+                                      self.dim)
+        return None
 
 
 class DenseMatrix:

@@ -35,6 +35,18 @@ def parse_detail_probs(details, pos_value: Optional[str] = None):
     Default positive label matches the trainer's choice (largest numeric
     first, else reverse lexicographic — see base.encode_labels).
     """
+    from ...common.evaluation.detail import PredictionDetailColumn
+    if isinstance(details, PredictionDetailColumn):
+        # columnar predict output: read the probability matrix zero-parse
+        keys = sorted(details.labels, key=_num_sort_key, reverse=True)
+        if pos_value is None:
+            pos_value = keys[0]
+        try:
+            col = details.labels.index(str(pos_value))
+            p_pos = np.asarray(details.probs[:, col], np.float64)
+        except ValueError:
+            p_pos = np.zeros(len(details))
+        return pos_value, p_pos
     probs = [json.loads(d) for d in details]
     keys = sorted({k for p in probs for k in p}, key=_num_sort_key, reverse=True)
     if pos_value is None:

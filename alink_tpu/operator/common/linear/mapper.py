@@ -87,27 +87,33 @@ class LinearModelMapper(ModelMapper):
             e = np.exp(scores - scores.max(1, keepdims=True))
             probs = e / e.sum(1, keepdims=True)
             pick = probs.argmax(1)
-            preds = _label_array([m.label_values[i] for i in pick])
+            label_arr = np.empty(len(m.label_values), object)
+            label_arr[:] = list(m.label_values)
+            preds = _label_array(label_arr[pick])
             if detail_col:
-                details = [json.dumps({str(l): float(p)
-                                       for l, p in zip(m.label_values, row)})
-                           for row in probs]
+                from ..evaluation.detail import PredictionDetailColumn
+                details = PredictionDetailColumn(
+                    [str(l) for l in m.label_values], probs)
             out_types = [m.label_type]
         else:
-            preds = _label_array([m.label_values[0] if s > 0 else m.label_values[1]
-                                  for s in scores])
+            label_arr = np.empty(2, object)
+            label_arr[:] = [m.label_values[0], m.label_values[1]]
+            # ~(s > 0), not (s <= 0): a NaN score must keep mapping to the
+            # negative label as the per-row 'if s > 0' did
+            preds = _label_array(label_arr[(~(scores > 0)).astype(np.intp)])
             if detail_col:
+                from ..evaluation.detail import PredictionDetailColumn
                 p_pos = _sigmoid(scores)
-                details = [json.dumps({str(m.label_values[0]): float(p),
-                                       str(m.label_values[1]): float(1 - p)})
-                           for p in p_pos]
+                details = PredictionDetailColumn(
+                    [str(m.label_values[0]), str(m.label_values[1])],
+                    np.stack([p_pos, 1.0 - p_pos], axis=1))
             out_types = [m.label_type]
         cols = [pred_col]
         values = [preds]
         if detail_col:
             cols.append(detail_col)
             out_types.append(AlinkTypes.STRING)
-            values.append(np.asarray(details, object) if details is not None
+            values.append(details if details is not None
                           else np.asarray([None] * len(preds), object))
         helper = OutputColsHelper(data.schema, cols, out_types, reserved)
         return helper.build_output(data, values)
@@ -124,7 +130,7 @@ def _matmul(design, w, dim):
 
 
 def _label_array(values: List) -> np.ndarray:
-    first = values[0] if values else ""
+    first = values[0] if len(values) else ""
     if isinstance(first, (int, np.integer)):
         return np.asarray(values, np.int64)
     if isinstance(first, (float, np.floating)):

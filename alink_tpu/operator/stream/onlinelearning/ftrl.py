@@ -641,12 +641,9 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                   # prefetch thread encoded as fb before seeing the
                   # demotion flag flip.)
                   if layout == "fb":
+                      # (the fb step is looked up from its lru cache per
+                      # batch, so nothing to invalidate here)
                       z, n = fb_to_std_state(z, n)
-                      # only an fb-layout step factory is invalidated;
-                      # once layout is std, queued fb-encoded batches
-                      # must NOT null the (std) factory again — that
-                      # re-traced the step once per in-flight batch
-                      sparse_step[0] = None
                   layout, fb_S, fb_meta = "std", None, None
                   allow_fb[0] = False
                   enc = encode(mt, max(batch_size, mt.num_rows), 8)

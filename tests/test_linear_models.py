@@ -251,3 +251,38 @@ def test_newton_softmax():
            .link_from(train, src).collect_mtable())
     acc = np.mean([p == l for p, l in zip(out.col("pred"), out.col("label"))])
     assert acc > 0.95
+
+
+def test_prediction_detail_column_render_parity():
+    """The columnar detail column must render the EXACT json strings the
+    per-row json.dumps used to produce, and parse_detail_probs must read
+    it zero-parse with identical results."""
+    import json
+    from alink_tpu.operator.common.evaluation.detail import (
+        PredictionDetailColumn)
+    from alink_tpu.operator.batch.evaluation.eval_ops import (
+        parse_detail_probs)
+
+    p_pos = np.array([0.25, 0.5, 0.999])
+    col = PredictionDetailColumn(["1", "0"],
+                                 np.stack([p_pos, 1 - p_pos], axis=1))
+    old = [json.dumps({"1": float(p), "0": float(1 - p)}) for p in p_pos]
+    assert list(col) == old
+    assert col[1] == old[1]
+    # slicing keeps the column columnar
+    sub = col[np.array([0, 2])]
+    assert isinstance(sub, PredictionDetailColumn)
+    assert list(sub) == [old[0], old[2]]
+    # zero-parse fast path == json path
+    pos_a, pa = parse_detail_probs(col)
+    pos_b, pb = parse_detail_probs(np.asarray(old, object))
+    assert str(pos_a) == str(pos_b)
+    np.testing.assert_allclose(pa, pb)
+    # explicit positive label selects the other column
+    pos_c, pc = parse_detail_probs(col, pos_value="0")
+    np.testing.assert_allclose(pc, 1 - p_pos)
+    # concat through MTable machinery stays columnar
+    from alink_tpu.common.mtable import _concat
+    cat = _concat(col, sub)
+    assert isinstance(cat, PredictionDetailColumn)
+    assert len(cat) == 5
