@@ -160,9 +160,13 @@ class ComQueueResult:
         return jax.tree_util.tree_map(np.asarray, self._stacked[name])
 
     def get(self, name: str):
-        """Worker 0's copy — use for replicated (post-allreduce) state."""
+        """Worker 0's copy — use for replicated (post-allreduce) state.
+
+        Slices BEFORE fetching (x[0] on device): fetching the full
+        (num_workers, ...) stack and discarding all but shard 0 on host
+        would pay num_workers x the bytes over the device link."""
         import jax
-        return jax.tree_util.tree_map(lambda x: np.asarray(x)[0], self._stacked[name])
+        return jax.tree_util.tree_map(lambda x: np.asarray(x[0]), self._stacked[name])
 
     def concat(self, name: str, total: Optional[int] = None):
         """Concatenate per-worker shards along axis 0 (departitioning).
@@ -348,8 +352,10 @@ class IterativeComQueue:
                 lambda x: np.asarray(
                     multihost_utils.process_allgather(x, tiled=True)),
                 stacked)
-        else:
-            stacked = jax.tree_util.tree_map(np.asarray, stacked)
+        # single-process: leave leaves ON DEVICE — ComQueueResult fetches
+        # per access, so a fit that only reads coef + loss_curve does not
+        # pull the whole carry (L-BFGS sk/yk ring buffers, per-row
+        # margins, ...) through a slow host<->device link
         result = ComQueueResult(stacked, nw, totals)
         if self._close is not None:
             return self._close(result)

@@ -269,23 +269,36 @@ def bench_kmeans(h: Harness):
                                init="RANDOM", seed=0, env=h.env)
         np.asarray(C)
 
-    dt = h.delta(run, iters)
+    # 5 paired reps (the ALS treatment, VERDICT r3 #10): the 3-rep median
+    # still swung this row >2x between captures
+    dt = h.delta(run, iters, reps=5)
     sps = n * iters / dt / h.chips
     _, _, n_conv = kmeans_train(X, k=3, max_iter=500, tol=1e-4, seed=0,
                                 env=h.env)
 
-    # CPU baseline: one assignment+update iteration in numpy
+    # CPU baseline: one assignment+update iteration in numpy —
+    # median-of-5 (a single timing carried the row's host-load noise
+    # straight into vs_baseline)
     base_iters = 3
-    C = X[rng.choice(n, 3, replace=False)]
-    t0 = time.perf_counter()
-    for _ in range(base_iters):
-        d2 = (X ** 2).sum(1, keepdims=True) - 2 * X @ C.T + (C ** 2).sum(1)
-        ids = np.argmin(d2, axis=1)
-        sums = np.zeros_like(C)
-        np.add.at(sums, ids, X)
-        cnts = np.bincount(ids, minlength=3).astype(np.float32)
-        C = np.where(cnts[:, None] > 0, sums / np.maximum(cnts[:, None], 1e-12), C)
-    cpu_sps = n * base_iters / (time.perf_counter() - t0)
+
+    def cpu_pass():
+        C = X[rng.choice(n, 3, replace=False)]
+        t0 = time.perf_counter()
+        for _ in range(base_iters):
+            d2 = (X ** 2).sum(1, keepdims=True) - 2 * X @ C.T + (C ** 2).sum(1)
+            ids = np.argmin(d2, axis=1)
+            sums = np.zeros_like(C)
+            np.add.at(sums, ids, X)
+            cnts = np.bincount(ids, minlength=3).astype(np.float32)
+            C = np.where(cnts[:, None] > 0,
+                         sums / np.maximum(cnts[:, None], 1e-12), C)
+        return time.perf_counter() - t0
+
+    # min-of-5: endpoint timings carry one-sided contention noise (the
+    # delta() docstring's estimator rule) — median would bias cpu_sps low
+    # and OVER-claim vs_baseline under host load
+    cpu_ts = sorted(cpu_pass() for _ in range(5))
+    cpu_sps = n * base_iters / cpu_ts[0]
     # per sample per iter: distance matmul 2*k*d + one-hot scatter-add of
     # (d+1) sums over k centroids 2*k*(d+1) (common/clustering/kmeans.py)
     return {"samples_per_sec_per_chip": round(sps, 1),
@@ -755,6 +768,10 @@ def bench_ftrl(h: Harness):
             nc[ii] = ni + g * g
         return time.perf_counter() - t0
 
+    # median per the r3 verdict's explicit ask for THIS row ("report the
+    # CPU baseline as a median with an error bar"); the min/max spread is
+    # in the artifact, so a reader preferring the suite's min-estimator
+    # rule can recompute the ratio from cpu_baseline_sps_max
     cpu_ts = sorted(cpu_pass() for _ in range(7))
     cpu_sps = n_base / cpu_ts[len(cpu_ts) // 2]
     cpu_spread = {"cpu_baseline_sps_min": round(n_base / cpu_ts[-1], 1),
@@ -865,8 +882,11 @@ def bench_logreg_from_disk(h: Harness):
         t0 = time.perf_counter()
 
         def encode(i):
+            # int16 field-local ids (FIELD_SIZE=2048 fits): halves the
+            # host->device payload, the dominant cost of the train leg on
+            # a tunneled link (the fb kernels widen on device)
             p = parts[i]
-            fb_i = (p[2].reshape(-1, N_FIELDS) - offs).astype(np.int32)
+            fb_i = (p[2].reshape(-1, N_FIELDS) - offs).astype(np.int16)
             return fb_i, p[0].astype(np.float32)
 
         enc = parallel_shard_map(encode, n_shards)
@@ -904,10 +924,12 @@ def bench_logreg_from_disk(h: Harness):
     split = splits[tot_ts.index(t_total)]
     pipeline_sps = n_rows / t_total / h.chips
 
+    fb16_true = fb_idx_true.astype(np.int16)   # same encode as the disk leg
+    y32_true = y_true.astype(np.float32)
     mem_ts = []
     for _ in range(3):
         t0 = time.perf_counter()
-        train(fb_idx_true, y_true)
+        train(fb16_true, y32_true)
         mem_ts.append(time.perf_counter() - t0)
     t_mem = sorted(mem_ts)[1]
     mem_sps = n_rows / t_mem / h.chips
@@ -970,7 +992,9 @@ def bench_gbdt(h: Harness):
     # the r3-trial delta came out NEGATIVE (clamped), recording a
     # nonsense 2.4e15 samples/s
     span = 150
-    dt = h.delta(run, span)
+    # 5 paired reps (ALS treatment): this row swung 15.0x driver vs
+    # 27.8x local in r03
+    dt = h.delta(run, span, reps=5)
     sps = n * span / dt / h.chips
 
     tf, tb, tm, tv, edges, base, curve, _ = gbdt_train(
@@ -988,7 +1012,7 @@ def bench_gbdt(h: Harness):
     edges_np = np.asarray(edges)
     b_np = np.asarray(binned)
     cpu_times = []
-    for _rep in range(3):
+    for _rep in range(5):
       t0 = time.perf_counter()
       for _ in range(base_iters):
         node = np.zeros(n, np.int64)
@@ -1014,6 +1038,7 @@ def bench_gbdt(h: Harness):
             bb = best % (n_bins - 1)
             node = node * 2 + (b_np[np.arange(n), bf[node]] > bb[node])
       cpu_times.append(time.perf_counter() - t0)
+    # min-of-5 per the suite's estimator rule (one-sided endpoint noise)
     cpu_sps = n * base_iters / min(cpu_times)
     # quality anchor (VERDICT r2 #8): sklearn HistGradientBoosting on the
     # IDENTICAL matrix — proves the trainer extracts the planted signal

@@ -307,3 +307,82 @@ def test_segment_oov_names_glued():
         assert name not in freq, f"{name} accidentally in dict"
         toks = d.cut(sent)
         assert name in toks, (sent, toks)
+
+
+def test_segment_open_domain_gold():
+    """Open-domain sentences over the EXTENDED general vocabulary
+    (VERDICT r3 #6): domains the r3 dictionary's ~1.1k hand words did not
+    cover — commerce, medicine, law, sports, technology, chengyu. These
+    exercise dictionary words, not the OOV path."""
+    from alink_tpu.operator.common.nlp.segment import SegmentDict
+    d = SegmentDict()
+    gold = [
+        ("医生建议患者按时吃药",
+         ["医生", "建议", "患者", "按时", "吃药"]),
+        ("公司宣布裁员引发员工抗议",
+         ["公司", "宣布", "裁员", "引发", "员工", "抗议"]),
+        ("法院判决被告赔偿原告损失",
+         ["法院", "判决", "被告", "赔偿", "原告", "损失"]),
+        ("运动员在决赛中夺得冠军",
+         ["运动员", "在", "决赛", "中", "夺得", "冠军"]),
+        ("程序员熬夜修复系统漏洞",
+         ["程序员", "熬夜", "修复", "系统", "漏洞"]),
+        ("股市暴跌投资者损失惨重",
+         ["股市", "暴跌", "投资者", "损失", "惨重"]),
+        ("厨师用新鲜蔬菜烹饪晚餐",
+         ["厨师", "用", "新鲜", "蔬菜", "烹饪", "晚餐"]),
+        ("台风登陆沿海城市停课停工",
+         ["台风", "登陆", "沿海", "城市", "停课", "停工"]),
+        ("他千方百计寻找失散的亲人",
+         ["他", "千方百计", "寻找", "失散", "的", "亲人"]),
+        ("游客参观博物馆欣赏文物",
+         ["游客", "参观", "博物馆", "欣赏", "文物"]),
+    ]
+
+    def spans(toks):
+        out, i = set(), 0
+        for t in toks:
+            out.add((i, i + len(t)))
+            i += len(t)
+        return out
+
+    tp = fp = fn = 0
+    for sent, ref in gold:
+        assert "".join(ref) == sent, f"bad gold: {sent}"
+        hyp = d.cut(sent)
+        assert "".join(hyp) == sent
+        hs, rs = spans(hyp), spans(ref)
+        tp += len(hs & rs)
+        fp += len(hs - rs)
+        fn += len(rs - hs)
+    prec = tp / max(tp + fp, 1)
+    rec = tp / max(tp + fn, 1)
+    f1 = 2 * prec * rec / max(prec + rec, 1e-9)
+    print(f"\nopen-domain gold F1 = {f1:.3f} (P={prec:.3f}, R={rec:.3f})")
+    assert f1 >= 0.85, f1
+
+
+def test_dict_general_vocabulary_scale():
+    """The dictionary's category composition (VERDICT r3 #6): the
+    general-vocabulary band must be real words at scale, not enumerated
+    names/numerals. The generator writes a category-stats header; this
+    pins the floor so a regression (or a generator change that silently
+    drops the hand-authored layers) fails loudly."""
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "alink_tpu",
+                        "operator", "common", "nlp", "zh_dict.txt")
+    stats = None
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            if line.startswith("# category-stats:"):
+                stats = dict(kv.split("=") for kv in line.split(":")[1].split())
+                break
+            if not line.startswith("#"):
+                break
+    assert stats is not None, "zh_dict.txt lacks the category-stats header"
+    stats = {k: int(v) for k, v in stats.items()}
+    assert stats["general"] >= 9_000, stats
+    # general + derived (affix/redup/measure) must be a substantial share
+    # of non-name entries, and names must not be the only mass
+    non_name = sum(v for k, v in stats.items() if k != "name")
+    assert non_name >= 13_000, stats

@@ -34,6 +34,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from tools.zh_core_vocab import CORE_VOCAB  # noqa: E402
+from tools.zh_vocab_extended import EXTENDED_VOCAB  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(__file__), "..", "alink_tpu",
                    "operator", "common", "nlp", "zh_dict.txt")
@@ -273,13 +274,18 @@ BANDS = {
 
 def main():
     entries = {}
+    category = {}   # word -> first-assigned category (stats only)
 
-    def put(w, f):
+    def put(w, f, cat="general"):
         if len(w) < 1 or " " in w:
             return
+        if w not in entries:
+            category[w] = cat
         entries[w] = max(entries.get(w, 0), f)
 
     for w, f in CORE_VOCAB:
+        put(w, f)
+    for w, f in EXTENDED_VOCAB:
         put(w, f)
     # round-2's hand-tuned 1.1k list rides along as a base layer (it is
     # equally original and already covers the segmenter's fixture set)
@@ -291,20 +297,22 @@ def main():
                 w, _, c = line.partition(" ")
                 put(w, int(c))
     for w in number_words():
-        put(w, BANDS["number"])
+        put(w, BANDS["number"], "number")
     for w in date_words():
-        put(w, BANDS["date"])
+        put(w, BANDS["date"], "date")
     for w in measure_phrases():
-        put(w, BANDS["measure"])
+        put(w, BANDS["measure"], "measure")
     for w in redup_words():
-        put(w, BANDS["redup"])
+        put(w, BANDS["redup"], "redup")
     for w in affixed_words():
-        put(w, BANDS["affix"])
+        put(w, BANDS["affix"], "affix")
     for w in place_names():
-        put(w, BANDS["place"])
+        put(w, BANDS["place"], "place")
     for w in person_names():
-        put(w, BANDS["name2"] if len(w) == 2 else BANDS["name3"])
+        put(w, BANDS["name2"] if len(w) == 2 else BANDS["name3"], "name")
 
+    from collections import Counter
+    stats = Counter(category.values())
     out = os.path.abspath(OUT)
     with open(out, "w", encoding="utf-8") as f:
         f.write("# Mandarin frequency dictionary for alink_tpu — GENERATED\n"
@@ -312,9 +320,12 @@ def main():
                 "# compilation: hand-authored core vocabulary + composed\n"
                 "# real items (numerals, dates, full names, places,\n"
                 "# measures). NOT derived from the reference's resources.\n")
+        f.write("# category-stats: " + " ".join(
+            f"{k}={v}" for k, v in sorted(stats.items())) + "\n")
         for w in sorted(entries, key=lambda w: (-entries[w], w)):
             f.write(f"{w} {entries[w]}\n")
     print(f"{len(entries)} entries -> {out}")
+    print("category stats:", dict(sorted(stats.items())))
 
 
 if __name__ == "__main__":
