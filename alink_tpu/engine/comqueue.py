@@ -245,7 +245,17 @@ class IterativeComQueue:
         return self
 
     # -- execution --------------------------------------------------------
+    def lowered(self):
+        """Lower (but do not run) the whole-superstep SPMD program;
+        returns the jax.stages.Lowered for HLO inspection — the scaling
+        evidence tool reads the compiled collectives and their payload
+        shapes from it (tools/scaling_evidence.py)."""
+        return self._run(lower_only=True)
+
     def exec(self):
+        return self._run(lower_only=False)
+
+    def _run(self, lower_only: bool = False):
         import jax
         import jax.numpy as jnp
         from jax import shard_map
@@ -322,6 +332,14 @@ class IterativeComQueue:
             # uniform out_spec: every leaf gains a leading worker axis
             return jax.tree_util.tree_map(lambda x: jnp.expand_dims(x, 0), final)
 
+        def build_mapped():
+            # ONE construction shared by lowered() and exec(): the HLO
+            # audit must inspect exactly the program exec runs
+            return shard_map(run, mesh=mesh, in_specs=(P("d"), P()),
+                             out_specs=P("d"), check_vma=False)
+
+        if lower_only:
+            return jax.jit(build_mapped()).lower(parts, bcast)
         compiled = None
         ckey = None
         if self._program_key is not None:
@@ -331,9 +349,7 @@ class IterativeComQueue:
                     tuple(sorted(parts)), tuple(sorted(bcast)))
             compiled = _PROGRAM_CACHE.get(ckey)
         if compiled is None:
-            mapped = shard_map(run, mesh=mesh, in_specs=(P("d"), P()),
-                               out_specs=P("d"), check_vma=False)
-            compiled = jax.jit(mapped)
+            compiled = jax.jit(build_mapped())
             if ckey is not None:
                 _PROGRAM_CACHE_STATS["misses"] += 1
                 _PROGRAM_CACHE[ckey] = compiled

@@ -1,0 +1,301 @@
+# -*- coding: utf-8 -*-
+"""Scaling-evidence artifact for the 8->128-chip half of the BASELINE
+metric (VERDICT r3 #8), produced within the 1-chip constraint.
+
+Three independent pieces of evidence, written to SCALING_r04.json and
+summarized in docs/parallelism.md:
+
+1. **Compiled-collective audit.** Each ComQueue workload's FULL
+   multi-chip training program is lowered on an 8-virtual-device mesh
+   and its optimized HLO is scanned for collective ops
+   (all-reduce/all-gather/collective-permute/all-to-all). The payload
+   bytes come from the collectives' OWN result shapes in the compiled
+   module — not from hand accounting — so "one small psum per
+   superstep" is checked against what XLA actually emits.
+   NOTE: collectives are counted per compiled MODULE. The engine runs
+   the first superstep OUTSIDE the while_loop (the init pass), so every
+   per-superstep collective appears TWICE in the module (init copy +
+   loop-body copy): collectives per superstep = num_collectives / 2.
+
+2. **Analytic scaling model.** Ring all-reduce of M bytes over p chips
+   moves 2M(p-1)/p bytes per link: t_comm ~ 2M/BW_ici + hop latency *
+   (p-1 within a ring). With the per-superstep compute time measured on
+   the real v5e chip (BENCH capture) and the public v5e ICI spec
+   (1600 Gbps/chip bidirectional), projected weak-scaling efficiency at
+   p chips = t_compute / (t_compute + t_comm(p)). The collective
+   payloads here are model-sized (KB..MB) while supersteps are
+   millisecond-scale, so the model's headroom is large; the table makes
+   that statement quantitative and falsifiable.
+
+3. **Virtual-mesh weak scaling.** The engine executes the same programs
+   at 8/16/32 virtual CPU devices (per-device data held constant).
+   This cannot measure ICI (all "chips" share one host core) — the
+   recorded walltimes are CORRECTNESS/overhead evidence: the program
+   compiles, runs, and its host-side orchestration cost does not grow
+   with the mesh (total walltime tracks total data, i.e. the single
+   core emulating p devices).
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=32 \
+     python tools/scaling_evidence.py
+"""
+
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+# v5e public specs
+ICI_GBPS = 1600.0 / 8            # 1600 Gbps/chip -> GB/s
+HOP_LATENCY_S = 1e-6             # ~1 us per ICI hop (order of magnitude)
+
+_SHAPE = re.compile(
+    r"=\s*\(?((?:[a-z0-9]+\[[0-9,]*\][,{}0-9\s]*)+)\)?\s*"
+    r"(all-reduce|all-gather|collective-permute|all-to-all)(?:-start)?\(")
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
+                "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
+                "pred": 1}
+
+
+def collective_payloads(hlo_text: str):
+    """[(op, bytes)] for every collective in an optimized HLO module,
+    payload = the op's result shape(s)."""
+    out = []
+    for m in _SHAPE.finditer(hlo_text):
+        shapes, op = m.group(1), m.group(2)
+        total = 0
+        for sm in re.finditer(r"([a-z0-9]+)\[([0-9,]*)\]", shapes):
+            dt, dims = sm.group(1), sm.group(2)
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * _DTYPE_BYTES.get(dt, 4)
+        out.append((op, total))
+    return out
+
+
+def build_workloads(env):
+    """name -> (queue builder, rows per device, superstep label)."""
+    from alink_tpu.engine import AllReduce, IterativeComQueue
+    from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                         UnaryLossObjFunc)
+    from alink_tpu.ops.fieldblock import FieldBlockMeta
+
+    nw = env.num_workers
+    per_dev = 256                       # weak scaling: rows PER DEVICE
+
+    def logreg_queue():
+        # the bench's Criteo-shape L-BFGS program at its real dim
+        import alink_tpu.operator.common.optim.optimizers as O
+        meta = FieldBlockMeta(32, 2048)
+        n = per_dev * nw
+        r = np.random.RandomState(0)
+        data = {"fb_idx": r.randint(0, 2048, (n, 32)).astype(np.int16),
+                "y": r.choice([-1.0, 1.0], n).astype(np.float32),
+                "w": np.ones(n, np.float32)}
+        obj = UnaryLossObjFunc(LogLossFunc(), meta.dim, l2=1e-4, fb_meta=meta)
+        params = O.OptimParams(method="LBFGS", max_iter=3, epsilon=0.0)
+        # rebuild the exact queue _quasi_newton builds, via its internals
+        return _optimizer_queue(O, obj, data, params, env)
+
+    def kmeans_queue():
+        from alink_tpu.operator.common.clustering import kmeans as K
+        n = per_dev * nw
+        r = np.random.RandomState(0)
+        X = r.randn(n, 4).astype(np.float32)
+        data = np.concatenate([X, np.ones((n, 1), np.float32)], 1)
+        k, d = 3, 4
+
+        def assign(ctx):
+            import jax
+            import jax.numpy as jnp
+            if ctx.is_init_step:
+                ctx.put_obj("centroids", ctx.get_obj("init_centroids"))
+                ctx.put_obj("movement", jnp.asarray(jnp.inf, jnp.float32))
+            block = ctx.get_obj("data")
+            Xb, wb = block[:, :d], block[:, d]
+            C = ctx.get_obj("centroids")
+            ids, _ = K.assign_clusters(Xb, C, "EUCLIDEAN")
+            onehot = jax.nn.one_hot(ids, k, dtype=jnp.float32) * wb[:, None]
+            sums = onehot.T @ Xb
+            cnts = onehot.sum(0)
+            ctx.put_obj("buf", jnp.concatenate([sums, cnts[:, None]], 1))
+
+        def update(ctx):
+            import jax.numpy as jnp
+            buf = ctx.get_obj("buf")
+            C = ctx.get_obj("centroids")
+            sums, cnts = buf[:, :d], buf[:, d]
+            newC = jnp.where(cnts[:, None] > 0,
+                             sums / jnp.maximum(cnts[:, None], 1e-12), C)
+            ctx.put_obj("movement", jnp.sqrt(((newC - C) ** 2).sum(1)).max())
+            ctx.put_obj("centroids", newC)
+
+        return (IterativeComQueue(env=env, max_iter=10)
+                .init_with_partitioned_data("data", data)
+                .init_with_broadcast_data(
+                    "init_centroids", np.eye(k, d, dtype=np.float32))
+                .add(assign).add(AllReduce("buf")).add(update)
+                .set_program_key(("scaling_ev_kmeans", k, d, nw)))
+
+    def als_queue():
+        from alink_tpu.operator.common.recommendation import als as A
+        n = per_dev * nw
+        r = np.random.RandomState(0)
+        users = r.randint(0, 512, n)
+        items = r.randint(0, 256, n)
+        ratings = r.rand(n).astype(np.float32) * 5
+
+        class Q:
+            def lowered(self):
+                return _capture_als_lowered(A, users, items, ratings, env)
+        return Q()
+
+    return {"logreg_criteo": logreg_queue, "kmeans": kmeans_queue,
+            "als_movielens_shape": als_queue}
+
+
+def _optimizer_queue(O, obj, data, params, env):
+    """Replicate optim.optimizers._quasi_newton's queue WITHOUT running it
+    (the optimizer module builds and execs in one function)."""
+    class Q:
+        def lowered(self):
+            captured = {}
+            orig = O.IterativeComQueue.exec
+
+            def spy(queue_self):
+                captured["lowered"] = queue_self.lowered()
+                # short-circuit execution: raise to unwind
+                raise _Captured()
+
+            O.IterativeComQueue.exec = spy
+            try:
+                O.optimize(obj, data, params, env)
+            except _Captured:
+                pass
+            finally:
+                O.IterativeComQueue.exec = orig
+            return captured["lowered"]
+    return Q()
+
+
+class _Captured(Exception):
+    pass
+
+
+def _capture_als_lowered(A, users, items, ratings, env):
+    captured = {}
+    import alink_tpu.engine.comqueue as cq
+    orig = cq.IterativeComQueue.exec
+
+    def spy(queue_self):
+        captured["lowered"] = queue_self.lowered()
+        raise _Captured()
+
+    cq.IterativeComQueue.exec = spy
+    try:
+        A.als_train(users, items, ratings, A.AlsTrainParams(
+            rank=10, num_iter=5, lambda_reg=0.1), env=env)
+    except _Captured:
+        pass
+    finally:
+        cq.IterativeComQueue.exec = orig
+    return captured["lowered"]
+
+
+def audit(env):
+    rows = {}
+    for name, build in build_workloads(env).items():
+        q = build()
+        low = q.lowered()
+        hlo = low.compile().as_text()
+        colls = collective_payloads(hlo)
+        total = sum(b for _, b in colls)
+        rows[name] = {
+            "collective_ops": [f"{op}:{b}B" for op, b in colls],
+            "num_collectives_in_module": len(colls),
+            # the module holds init-pass + while_loop-body copies of every
+            # per-superstep collective -> per-superstep = module total / 2
+            "payload_bytes_in_module": total,
+            "payload_bytes_per_superstep": total // 2,
+        }
+    return rows
+
+
+def model_efficiency(payload_bytes, superstep_ms, chips):
+    """Ring all-reduce projection (see module docstring)."""
+    t_comm = (2.0 * payload_bytes * (chips - 1) / chips / (ICI_GBPS * 1e9)
+              + HOP_LATENCY_S * (chips - 1))
+    t_comp = superstep_ms / 1e3
+    return round(t_comp / (t_comp + t_comm), 4)
+
+
+def weak_scaling(env_sizes):
+    """Same ComQueue program at 8/16/32 virtual devices, constant rows
+    per device; records walltime per superstep."""
+    from alink_tpu.common.mlenv import MLEnvironment
+    out = {}
+    for nw in env_sizes:
+        env = MLEnvironment(parallelism=nw)
+        build = build_workloads(env)["kmeans"]
+        build().exec()                       # warm compile (program cache)
+        q = build()
+        t0 = time.perf_counter()
+        res = q.exec()
+        np.asarray(res.get("centroids")).sum()   # results fetch lazily:
+        dt = time.perf_counter() - t0            # force execution+fetch
+        out[str(nw)] = round(dt, 3)
+    return out
+
+
+def main():
+    import jax
+    assert jax.default_backend() == "cpu", "run with JAX_PLATFORMS=cpu"
+    from alink_tpu.common.mlenv import MLEnvironment
+    env8 = MLEnvironment(parallelism=8)
+
+    audit_rows = audit(env8)
+
+    # measured per-superstep compute times on the real chip, taken from
+    # the r04 bench capture (samples/sec/chip at the bench row's n)
+    measured_ms = {
+        "logreg_criteo": 1_000_000 / 21.4e6 * 1e3,   # ~46.7 ms/iter
+        "kmeans": 1_500_000 / 5.0e9 * 1e3,           # ~0.3 ms/iter
+        "als_movielens_shape": 1_000_209 / 22.6e6 * 1e3,
+    }
+    for name, row in audit_rows.items():
+        M = row["payload_bytes_per_superstep"]   # module total / 2
+        ms = measured_ms[name]
+        row["measured_superstep_ms_1chip"] = round(ms, 3)
+        row["projected_efficiency"] = {
+            str(p): model_efficiency(M, ms, p) for p in (8, 32, 128)}
+
+    ws = weak_scaling([8, 16, 32])
+
+    artifact = {
+        "method": "compiled-HLO collective audit + ring-allreduce model "
+                  "+ virtual-mesh weak scaling (see tools/scaling_evidence.py)",
+        "ici_gbytes_per_s": ICI_GBPS,
+        "hop_latency_s": HOP_LATENCY_S,
+        "workloads": audit_rows,
+        "weak_scaling_walltime_s_kmeans_10iters": ws,
+        "note": "virtual-mesh walltimes share ONE host core: they are "
+                "correctness/overhead evidence, not speedup. Each "
+                "per-superstep collective appears twice in the module "
+                "(init pass + while_loop body): per-superstep count = "
+                "num_collectives/2, payload/2.",
+    }
+    out = os.path.join(os.path.dirname(__file__), "..", "SCALING_r04.json")
+    with open(os.path.abspath(out), "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps(artifact, indent=1))
+
+
+if __name__ == "__main__":
+    main()
