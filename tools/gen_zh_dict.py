@@ -262,6 +262,29 @@ def affixed_words():
     for p in people:
         words.add(p)
         words.add(p + "们")
+    # demonyms and language names — real derived lexemes over the real
+    # place/country inventories (北京人, 美国人, 法语, 德文 ...)
+    for place in PROVINCES + CITIES + COUNTRIES:
+        words.add(place + "人")
+    for c in ["英", "法", "德", "俄", "日", "韩", "西班牙", "葡萄牙",
+              "意大利", "阿拉伯", "希腊", "越南", "泰", "缅甸", "印地",
+              "蒙古", "朝鲜", "马来", "荷兰", "瑞典", "芬兰", "波兰",
+              "土耳其", "波斯", "拉丁"]:
+        words.add(c + "语")
+    for c in ["英", "法", "德", "俄", "日", "韩", "中", "外"]:
+        words.add(c + "文")
+    # AABB adjective reduplications from real AB bases
+    aabb = ["高兴", "快乐", "干净", "整齐", "认真", "仔细", "清楚",
+            "明白", "漂亮", "大方", "老实", "规矩", "安静", "热闹",
+            "辛苦", "快活", "舒服", "松散", "零碎", "琐碎", "叮当",
+            "吞吐", "来往", "进出", "上下", "反复", "日夜", "风雨",
+            "躲闪", "摇晃", "哭啼", "吵闹", "拉扯", "敲打", "修补",
+            "缝补", "洗刷", "收拾", "打扫", "挑选", "说笑", "蹦跳",
+            "指点", "评说", "商量", "思念", "痛快", "和气", "客气",
+            "仓促", "匆忙", "勤恳", "踏实", "结实", "地道", "利落",
+            "爽快", "直爽", "活泼", "斯文", "文静", "秀气", "实在"]
+    for ab in aabb:
+        words.add(ab[0] * 2 + ab[1] * 2)
     return sorted(words)
 
 

@@ -55,7 +55,7 @@ HOP_LATENCY_S = 1e-6             # ~1 us per ICI hop (order of magnitude)
 
 _SHAPE = re.compile(
     r"=\s*\(?((?:[a-z0-9]+\[[0-9,]*\][,{}0-9\s]*)+)\)?\s*"
-    r"(all-reduce|all-gather|collective-permute|all-to-all)(?:-start)?\(")
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)(?:-start)?\(")
 
 _DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
                 "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1,
@@ -161,52 +161,46 @@ def build_workloads(env):
             "als_movielens_shape": als_queue}
 
 
-def _optimizer_queue(O, obj, data, params, env):
-    """Replicate optim.optimizers._quasi_newton's queue WITHOUT running it
-    (the optimizer module builds and execs in one function)."""
-    class Q:
-        def lowered(self):
-            captured = {}
-            orig = O.IterativeComQueue.exec
-
-            def spy(queue_self):
-                captured["lowered"] = queue_self.lowered()
-                # short-circuit execution: raise to unwind
-                raise _Captured()
-
-            O.IterativeComQueue.exec = spy
-            try:
-                O.optimize(obj, data, params, env)
-            except _Captured:
-                pass
-            finally:
-                O.IterativeComQueue.exec = orig
-            return captured["lowered"]
-    return Q()
-
-
 class _Captured(Exception):
     pass
 
 
-def _capture_als_lowered(A, users, items, ratings, env):
-    captured = {}
+def capture_lowered(fn):
+    """Run ``fn`` (which internally builds and execs an IterativeComQueue)
+    with exec() patched to capture the LOWERED program instead of running
+    it. Re-raises the underlying error if fn never reached exec()."""
     import alink_tpu.engine.comqueue as cq
+    captured = {}
     orig = cq.IterativeComQueue.exec
 
     def spy(queue_self):
         captured["lowered"] = queue_self.lowered()
-        raise _Captured()
+        raise _Captured()    # short-circuit: unwind out of fn
 
     cq.IterativeComQueue.exec = spy
     try:
-        A.als_train(users, items, ratings, A.AlsTrainParams(
-            rank=10, num_iter=5, lambda_reg=0.1), env=env)
+        fn()
     except _Captured:
         pass
     finally:
         cq.IterativeComQueue.exec = orig
+    if "lowered" not in captured:
+        raise RuntimeError("fn returned without building a ComQueue program")
     return captured["lowered"]
+
+
+def _optimizer_queue(O, obj, data, params, env):
+    class Q:
+        def lowered(self):
+            return capture_lowered(
+                lambda: O.optimize(obj, data, params, env))
+    return Q()
+
+
+def _capture_als_lowered(A, users, items, ratings, env):
+    return capture_lowered(lambda: A.als_train(
+        users, items, ratings,
+        A.AlsTrainParams(rank=10, num_iter=5, lambda_reg=0.1), env=env))
 
 
 def audit(env):
@@ -217,11 +211,16 @@ def audit(env):
         hlo = low.compile().as_text()
         colls = collective_payloads(hlo)
         total = sum(b for _, b in colls)
+        # the module holds init-pass + while_loop-body copies of every
+        # per-superstep collective (engine runs superstep 1 outside the
+        # loop); guard the /2 against queues where that pairing does not
+        # hold (max_iter == 1, or CSE/duplication by XLA)
+        from collections import Counter
+        counts = Counter(colls)
+        assert all(v % 2 == 0 for v in counts.values()), (name, colls)
         rows[name] = {
             "collective_ops": [f"{op}:{b}B" for op, b in colls],
             "num_collectives_in_module": len(colls),
-            # the module holds init-pass + while_loop-body copies of every
-            # per-superstep collective -> per-superstep = module total / 2
             "payload_bytes_in_module": total,
             "payload_bytes_per_superstep": total // 2,
         }
