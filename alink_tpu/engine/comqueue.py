@@ -60,6 +60,8 @@ from .communication import CommunicateFunction
 _PROGRAM_CACHE: "OrderedDict[tuple, Callable]" = OrderedDict()
 _PROGRAM_CACHE_MAX = 32
 _PROGRAM_CACHE_STATS = {"hits": 0, "misses": 0}
+# jaxpr text per cached key, populated only under ALINK_VERIFY_PROGRAM_CACHE
+_PROGRAM_CACHE_JAXPRS: Dict[tuple, str] = {}
 
 
 def program_cache_stats() -> Dict[str, int]:
@@ -69,6 +71,7 @@ def program_cache_stats() -> Dict[str, int]:
 
 def clear_program_cache() -> None:
     _PROGRAM_CACHE.clear()
+    _PROGRAM_CACHE_JAXPRS.clear()
 
 
 def freeze_config(v):
@@ -82,7 +85,10 @@ def freeze_config(v):
     if isinstance(v, (tuple, list)):
         return tuple(freeze_config(x) for x in v)
     if isinstance(v, dict):
-        return tuple(sorted((k, freeze_config(x)) for k, x in v.items()))
+        # sort by (type, repr) so mixed-type keys (int and str) still
+        # produce a stable key instead of raising from sorted()
+        return tuple(sorted(((k, freeze_config(x)) for k, x in v.items()),
+                            key=lambda kv: (type(kv[0]).__name__, repr(kv[0]))))
     if isinstance(v, np.ndarray) or (hasattr(v, "shape") and hasattr(v, "dtype")):
         a = np.asarray(v)
         raw = a.tobytes()
@@ -106,6 +112,93 @@ def freeze_config(v):
     raise TypeError(f"freeze_config: cannot build a stable key from "
                     f"{type(v).__name__!r}; pass scalars, arrays, "
                     f"dataclasses, or objects with public __dict__ attrs")
+
+
+def _freeze_closure_value(v, depth):
+    """Best-effort hashable token of one closure-cell value for the
+    program-cache structural guard. Unlike freeze_config this must be
+    TOTAL (never raise) and must NOT fetch device arrays to host — so it
+    recurses itself instead of delegating containers to freeze_config."""
+    import dataclasses
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if isinstance(v, np.ndarray):  # host memory: content hash is cheap
+        raw = v.tobytes()
+        if len(raw) > 512:
+            import hashlib
+            raw = hashlib.blake2b(raw, digest_size=16).digest()
+        return ("nd", v.shape, str(v.dtype), raw)
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        # jax.Array: data belongs in partitioned/broadcast inputs by
+        # contract; hashing its CONTENT would round-trip device memory.
+        # Shape/dtype suffices to catch structural drift.
+        return ("devarray", tuple(v.shape), str(v.dtype))
+    if isinstance(v, (tuple, list)):
+        return tuple(_freeze_closure_value(x, depth) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted(
+            ((repr(k), _freeze_closure_value(x, depth)) for k, x in v.items())))
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return (type(v).__name__, tuple(
+            (f.name, _freeze_closure_value(getattr(v, f.name), depth))
+            for f in dataclasses.fields(v)))
+    if callable(v) and depth > 0:
+        return _callable_digest(v, depth - 1)
+    if hasattr(v, "__dict__") and depth > 0:  # depth bounds cyclic graphs
+        return (type(v).__name__, tuple(sorted(
+            (k, _freeze_closure_value(x, depth - 1))
+            for k, x in vars(v).items() if not k.startswith("_"))))
+    return ("opaque", type(v).__module__, type(v).__qualname__)
+
+
+def _callable_digest(fn, depth=2):
+    """Structural token of a stage callable: bytecode + constants + frozen
+    closure cells (+ bound-object public attrs for methods). Appended to
+    the program-cache key so a caller whose ``program_key`` under-specifies
+    a baked constant gets a cache MISS instead of a silently stale
+    program (advisor r4, comqueue.py:57)."""
+    import functools
+    if isinstance(fn, functools.partial):
+        return ("partial", _callable_digest(fn.func, depth),
+                _freeze_closure_value(fn.args, depth),
+                _freeze_closure_value(fn.keywords, depth))
+    if hasattr(fn, "__wrapped__"):  # functools.wraps / jit-style wrappers
+        return ("wrapped", _callable_digest(fn.__wrapped__, depth))
+    if hasattr(fn, "__func__"):  # bound method: include the receiver's config
+        self_tok = _freeze_closure_value(getattr(fn, "__self__", None), depth)
+        return ("bound", _callable_digest(fn.__func__, depth), self_tok)
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        call = getattr(type(fn), "__call__", None)
+        inner = getattr(call, "__code__", None)
+        if inner is None:
+            return ("opaque_callable", type(fn).__module__, type(fn).__qualname__)
+        return ("callable_obj", _callable_digest(call.__get__(fn), depth))
+    import hashlib
+    h = hashlib.blake2b(code.co_code, digest_size=12)
+    for c in code.co_consts:
+        if isinstance(c, (bool, int, float, str, bytes, type(None))):
+            h.update(repr(c).encode())
+        elif hasattr(c, "co_code"):  # nested lambda/comprehension bodies
+            h.update(c.co_code)
+        else:
+            h.update(type(c).__name__.encode())
+    cells = ()
+    if fn.__closure__:
+        cells = tuple(
+            (name, _freeze_closure_value(cell.cell_contents, depth))
+            for name, cell in zip(code.co_freevars, fn.__closure__))
+    return (code.co_name, h.hexdigest(), cells)
+
+
+def _stages_digest(stages, criterion) -> tuple:
+    items = []
+    for s in stages:
+        fn = s.fn if isinstance(s, _FnStage) else s.calc
+        items.append(_callable_digest(fn))
+    if criterion is not None:
+        items.append(_callable_digest(criterion))
+    return tuple(items)
 
 
 def lazy_jit(fn, static_argnums=()):
@@ -151,22 +244,53 @@ class ComQueueResult:
         self._stacked = stacked
         self.num_workers = num_workers
         self.totals = totals
+        self._fetched: Dict[tuple, Any] = {}
 
     def shards(self, name: str):
         """(num_workers, ...) stacked per-worker values."""
         import jax
         if name not in self._stacked:
             raise KeyError(f"no carry object '{name}'; have {sorted(self._stacked)}")
-        return jax.tree_util.tree_map(np.asarray, self._stacked[name])
+        got = self._fetched.get(("shards", name))
+        if got is None:
+            got = self._fetched[("shards", name)] = jax.tree_util.tree_map(
+                np.asarray, self._stacked[name])
+        return got
 
     def get(self, name: str):
         """Worker 0's copy — use for replicated (post-allreduce) state.
 
         Slices BEFORE fetching (x[0] on device): fetching the full
         (num_workers, ...) stack and discarding all but shard 0 on host
-        would pay num_workers x the bytes over the device link."""
+        would pay num_workers x the bytes over the device link. Fetched
+        leaves are memoized per name, so repeated get() calls pay the
+        link once (advisor r4)."""
         import jax
-        return jax.tree_util.tree_map(lambda x: np.asarray(x[0]), self._stacked[name])
+        got = self._fetched.get(("get", name))
+        if got is None:
+            full = self._fetched.get(("shards", name))
+            if full is not None:  # already on host: slice locally
+                got = jax.tree_util.tree_map(lambda x: x[0], full)
+            else:
+                got = jax.tree_util.tree_map(lambda x: np.asarray(x[0]),
+                                             self._stacked[name])
+            self._fetched[("get", name)] = got
+        return got
+
+    def release(self, keep: Sequence[str] = ()) -> "ComQueueResult":
+        """Detach to host: fetch the named carries (default: those already
+        fetched), then drop every device reference so the superstep carry
+        (sk/yk ring buffers, per-row margins, ...) stops pinning HBM.
+        Callers that retain results across many cached fits should call
+        this once they are done reading device state (advisor r4)."""
+        for name in keep:
+            self.shards(name)
+        # names never fetched are dropped; fetched ones now back _stacked
+        # as host arrays, so shards()/get() keep working after release
+        self._stacked = {k: self._fetched[("shards", k)]
+                         for k in self._stacked
+                         if ("shards", k) in self._fetched}
+        return self
 
     def concat(self, name: str, total: Optional[int] = None):
         """Concatenate per-worker shards along axis 0 (departitioning).
@@ -344,20 +468,44 @@ class IterativeComQueue:
         ckey = None
         if self._program_key is not None:
             from ..common.profiling import step_log_enabled
-            ckey = (self._program_key, mesh, nw, max_iter, seed,
+            # structural guard (advisor r4): the stage bytecode + frozen
+            # closure cells ride in the key, so a program_key that
+            # under-specifies a baked constant misses instead of silently
+            # re-running a stale program
+            ckey = (self._program_key, _stages_digest(stages, criterion),
+                    mesh, nw, max_iter, seed,
                     criterion is not None, step_log_enabled(),
                     tuple(sorted(parts)), tuple(sorted(bcast)))
             compiled = _PROGRAM_CACHE.get(ckey)
+        import os as _os
+        verify = bool(_os.environ.get("ALINK_VERIFY_PROGRAM_CACHE"))
         if compiled is None:
             compiled = jax.jit(build_mapped())
             if ckey is not None:
                 _PROGRAM_CACHE_STATS["misses"] += 1
                 _PROGRAM_CACHE[ckey] = compiled
+                if verify:
+                    # baseline jaxpr recorded AT COMPILE TIME, so the very
+                    # first post-compile drift is caught on the next hit
+                    _PROGRAM_CACHE_JAXPRS[ckey] = str(
+                        jax.make_jaxpr(build_mapped())(parts, bcast))
                 while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
-                    _PROGRAM_CACHE.popitem(last=False)
+                    old_key, _ = _PROGRAM_CACHE.popitem(last=False)
+                    _PROGRAM_CACHE_JAXPRS.pop(old_key, None)
         elif ckey is not None:
             _PROGRAM_CACHE_STATS["hits"] += 1
             _PROGRAM_CACHE.move_to_end(ckey)
+            if verify:
+                # debug mode: re-trace on every hit and compare jaxprs —
+                # catches any constant the structural guard cannot see
+                fresh = str(jax.make_jaxpr(build_mapped())(parts, bcast))
+                seen = _PROGRAM_CACHE_JAXPRS.setdefault(ckey, fresh)
+                if fresh != seen:
+                    raise RuntimeError(
+                        "ALINK_VERIFY_PROGRAM_CACHE: cached program for key "
+                        f"{self._program_key!r} no longer matches a fresh "
+                        "trace — a stage closure baked state the program_key "
+                        "does not cover")
         stacked = compiled(parts, bcast)
         if jax.process_count() > 1:
             # multi-host session: leaves span non-addressable devices —

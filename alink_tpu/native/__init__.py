@@ -114,8 +114,13 @@ def parse_libsvm_bytes(data: bytes, start_index: int = 1
                   _p(indptr, ctypes.c_int64), _p(indices, ctypes.c_int32),
                   _p(values, ctypes.c_double), ctypes.byref(rows),
                   ctypes.byref(nnz), ctypes.byref(mx))
-    return (labels[:rows.value], indptr[:rows.value + 1],
-            indices[:nnz.value], values[:nnz.value])
+    out = (labels[:rows.value], indptr[:rows.value + 1],
+           indices[:nnz.value], values[:nnz.value])
+    # trimmed views pin the full upper-bound buffers; when the memchr
+    # bounds were loose (blank lines, colon-less tokens) copy so the
+    # oversized allocations are freed (advisor r4)
+    return tuple(a.copy() if a.base is not None and
+                 a.nbytes < 0.5 * a.base.nbytes else a for a in out)
 
 
 def split_newline_chunks(data: bytes, k: int) -> list:
@@ -244,5 +249,7 @@ def parse_vector_lines(data: bytes) -> Optional[Tuple[np.ndarray, np.ndarray,
     lib.vec_fill2(data, len(data), _p(indptr, ctypes.c_int64),
                   _p(indices, ctypes.c_int32), _p(values, ctypes.c_double),
                   ctypes.byref(rows), ctypes.byref(nnz), ctypes.byref(mx))
-    return (indptr[:rows.value + 1], indices[:nnz.value],
-            values[:nnz.value], int(mx.value))
+    arrs = (indptr[:rows.value + 1], indices[:nnz.value], values[:nnz.value])
+    arrs = tuple(a.copy() if a.base is not None and
+                 a.nbytes < 0.5 * a.base.nbytes else a for a in arrs)
+    return (*arrs, int(mx.value))

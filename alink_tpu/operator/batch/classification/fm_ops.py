@@ -121,6 +121,10 @@ class BaseFmTrainBatchOp(BatchOperator, _FmTrainParamsMixin):
                                       "loss": curve.astype(np.float64)})]
         return self
 
+    def get_model_info(self) -> MTable:
+        m = FmModelDataConverter().load_model(self.get_output_table())
+        return FmModelInfo(m).to_table()
+
 
 class FmClassifierTrainBatchOp(BaseFmTrainBatchOp):
     IS_REGRESSION = False
@@ -128,6 +132,71 @@ class FmClassifierTrainBatchOp(BaseFmTrainBatchOp):
 
 class FmRegressorTrainBatchOp(BaseFmTrainBatchOp):
     IS_REGRESSION = True
+
+
+class FmModelInfo:
+    """FM model summary (reference common/fm/FmModelInfo.java:18-58): task,
+    latent dimension, vector size, factor matrix, feature columns."""
+
+    def __init__(self, m: FmModelData):
+        self._m = m
+
+    def get_task(self) -> str:
+        return "REGRESSION" if self._m.is_regression else "BINARY_CLASSIFICATION"
+
+    def get_num_factor(self) -> int:
+        return int(self._m.V.shape[1])
+
+    def get_vector_size(self) -> int:
+        return int(self._m.w.shape[0])
+
+    def get_factors(self) -> np.ndarray:
+        return np.asarray(self._m.V)
+
+    def get_col_names(self):
+        return self._m.feature_cols
+
+    def to_table(self) -> MTable:
+        m = self._m
+        V = np.asarray(m.V)
+        return MTable({
+            "task": [self.get_task()],
+            "vector_size": [self.get_vector_size()],
+            "num_factor": [self.get_num_factor()],
+            "intercept": [float(m.w0)],
+            "linear_norm": [float(np.linalg.norm(np.asarray(m.w)))],
+            "factor_norm": [float(np.linalg.norm(V))],
+            "feature_cols": [",".join(m.feature_cols or [])
+                             if m.feature_cols else (m.vector_col or "")],
+        })
+
+    def __repr__(self):
+        return (f"FmModelInfo(task={self.get_task()}, "
+                f"vector_size={self.get_vector_size()}, "
+                f"num_factor={self.get_num_factor()})")
+
+
+class FmModelInfoBatchOp(BatchOperator):
+    """Link to the output of an FM trainer to summarize the model
+    (reference operator/common/fm/FmModelInfoBatchOp.java:15-40, built on
+    ExtractModelInfoBatchOp). ``collect_model_info()`` returns the
+    FmModelInfo; the op's output table is the one-row summary."""
+
+    def link_from(self, in_op: BatchOperator) -> "FmModelInfoBatchOp":
+        model = FmModelDataConverter().load_model(in_op.get_output_table())
+        self._info = FmModelInfo(model)
+        self._output = self._info.to_table()
+        return self
+
+    def collect_model_info(self) -> FmModelInfo:
+        return self._info
+
+    def lazy_print_model_info(self, title=None) -> "FmModelInfoBatchOp":
+        def show(t: MTable):
+            if title:
+                print(title)
+            print(t.to_display_string())
+        return self._lazy("model_info", self.get_output_table(), show)
 
 
 class FmModelMapper(ModelMapper):

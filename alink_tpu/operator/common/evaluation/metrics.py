@@ -94,6 +94,18 @@ def binary_metrics(labels: np.ndarray, p_pos: np.ndarray, pos_value,
     dy = np.diff(np.concatenate([[0.0], tpr]))
     prc = float((precision_curve * dy).sum())
 
+    # LiftChart per reference BinaryMetricsSummary.java:179,224: points
+    # ((TP+FP)/total, TP) over descending-score thresholds, prepended (0,0).
+    total = max(len(y), 1)
+    depth = (tp + fp) / total
+    lift_stride = max(1, len(depth) // 500)
+    lift_x = np.concatenate([[0.0], depth[::lift_stride]])
+    lift_y = np.concatenate([[0.0], tp[::lift_stride].astype(np.float64)])
+    if len(depth) and (len(depth) - 1) % lift_stride:
+        # striding dropped the terminal (depth=1, TP=n_pos) point
+        lift_x = np.append(lift_x, depth[-1])
+        lift_y = np.append(lift_y, float(tp[-1]))
+
     pred_pos = p >= threshold
     tp_ = int(((y == 1) & pred_pos).sum())
     fp_ = int(((y == 0) & pred_pos).sum())
@@ -116,6 +128,7 @@ def binary_metrics(labels: np.ndarray, p_pos: np.ndarray, pos_value,
         "PositiveValue": str(pos_value), "TotalSamples": len(y),
         "RocCurveTpr": tpr[:: max(1, len(tpr) // 500)].tolist(),
         "RocCurveFpr": fpr[:: max(1, len(fpr) // 500)].tolist(),
+        "LiftChart": [lift_x.tolist(), lift_y.tolist()],
     })
 
 

@@ -269,3 +269,71 @@ def test_program_cache_optimizer_fits():
         data = make_data(seed)
         acc = ((data["X"] @ coef > 0) == (data["y"] > 0)).mean()
         assert acc > 0.9, (seed, acc)
+
+
+def test_program_cache_structural_guard():
+    """An UNDER-SPECIFIED program_key (same key, different baked constant)
+    must still miss: the stage bytecode/closure digest rides in the cache
+    key (advisor r4). The old behavior silently re-ran the stale program."""
+    from alink_tpu.engine.comqueue import (clear_program_cache,
+                                           program_cache_stats)
+
+    def make_stage(scale):
+        def stage(ctx):
+            if ctx.is_init_step:
+                ctx.put_obj("acc", jnp.zeros(()))
+            ctx.put_obj("acc", ctx.get_obj("acc")
+                        + ctx.all_reduce_sum((scale * ctx.get_obj("x")).sum()))
+        return stage
+
+    clear_program_cache()
+    x = np.arange(8, dtype=np.float32)
+
+    def run(scale):
+        return float((IterativeComQueue(max_iter=2)
+                      .init_with_partitioned_data("x", x)
+                      .add(make_stage(scale))
+                      .set_program_key(("underspecified",))  # scale NOT in key
+                      .exec()).get("acc"))
+
+    assert run(1.0) == pytest.approx(2 * x.sum())
+    before = program_cache_stats()
+    # same (bad) key, different closure constant: guard forces a miss and
+    # the CORRECT result comes back
+    assert run(3.0) == pytest.approx(2 * 3.0 * x.sum())
+    after = program_cache_stats()
+    assert after["misses"] == before["misses"] + 1
+    # identical closure constant still hits
+    assert run(3.0) == pytest.approx(2 * 3.0 * x.sum())
+    assert program_cache_stats()["hits"] == after["hits"] + 1
+
+
+def test_freeze_config_mixed_type_dict_keys():
+    from alink_tpu.engine.comqueue import freeze_config
+    k1 = freeze_config({1: "a", "b": 2.0})
+    k2 = freeze_config({"b": 2.0, 1: "a"})
+    assert k1 == k2
+    hash(k1)  # must be hashable
+    assert freeze_config({1: "a"}) != freeze_config({"1": "a"})
+
+
+def test_result_memoize_and_release():
+    def stage(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("s", jnp.zeros(()))
+            ctx.put_obj("big", jnp.zeros(64))
+        ctx.put_obj("s", ctx.get_obj("s") + ctx.all_reduce_sum(
+            ctx.get_obj("x").sum()))
+
+    x = np.ones(8, dtype=np.float32)
+    res = (IterativeComQueue(max_iter=2)
+           .init_with_partitioned_data("x", x).add(stage).exec())
+    g1 = res.get("s")
+    assert res.get("s") is g1          # repeated get() served from host
+    sh = res.shards("big")
+    assert res.shards("big") is sh
+    res.release()                       # drop device refs
+    assert float(res.get("s")) == pytest.approx(2 * 8.0)
+    np.testing.assert_array_equal(res.shards("big"), sh)
+    with pytest.raises(KeyError):
+        res.shards("x")                 # never fetched -> dropped
