@@ -123,24 +123,38 @@ def _freeze_closure_value(v, depth):
     if v is None or isinstance(v, (bool, int, float, str, bytes)):
         return v
     if isinstance(v, np.ndarray):  # host memory: content hash is cheap
-        raw = v.tobytes()
-        if len(raw) > 512:
+        if v.nbytes > 512:
             import hashlib
-            raw = hashlib.blake2b(raw, digest_size=16).digest()
+            # hash the buffer in place — tobytes() would copy the whole
+            # array on every exec() including cache hits
+            buf = v.data if v.flags.c_contiguous else \
+                np.ascontiguousarray(v).data
+            raw = hashlib.blake2b(buf, digest_size=16).digest()
+        else:
+            raw = v.tobytes()
         return ("nd", v.shape, str(v.dtype), raw)
     if hasattr(v, "shape") and hasattr(v, "dtype"):
         # jax.Array: data belongs in partitioned/broadcast inputs by
         # contract; hashing its CONTENT would round-trip device memory.
         # Shape/dtype suffices to catch structural drift.
         return ("devarray", tuple(v.shape), str(v.dtype))
+    # containers decrement depth too: a cyclic container (cfg['self'] =
+    # cfg) must degrade to an opaque token, not overflow the stack
     if isinstance(v, (tuple, list)):
-        return tuple(_freeze_closure_value(x, depth) for x in v)
+        if depth <= 0:
+            return ("opaque", type(v).__name__, len(v))
+        return tuple(_freeze_closure_value(x, depth - 1) for x in v)
     if isinstance(v, dict):
+        if depth <= 0:
+            return ("opaque", "dict", len(v))
         return tuple(sorted(
-            ((repr(k), _freeze_closure_value(x, depth)) for k, x in v.items())))
+            ((repr(k), _freeze_closure_value(x, depth - 1))
+             for k, x in v.items())))
     if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        if depth <= 0:
+            return ("opaque", type(v).__name__)
         return (type(v).__name__, tuple(
-            (f.name, _freeze_closure_value(getattr(v, f.name), depth))
+            (f.name, _freeze_closure_value(getattr(v, f.name), depth - 1))
             for f in dataclasses.fields(v)))
     if callable(v) and depth > 0:
         return _callable_digest(v, depth - 1)
@@ -151,7 +165,7 @@ def _freeze_closure_value(v, depth):
     return ("opaque", type(v).__module__, type(v).__qualname__)
 
 
-def _callable_digest(fn, depth=2):
+def _callable_digest(fn, depth=4):
     """Structural token of a stage callable: bytecode + constants + frozen
     closure cells (+ bound-object public attrs for methods). Appended to
     the program-cache key so a caller whose ``program_key`` under-specifies
@@ -278,11 +292,14 @@ class ComQueueResult:
         return got
 
     def release(self, keep: Sequence[str] = ()) -> "ComQueueResult":
-        """Detach to host: fetch the named carries (default: those already
-        fetched), then drop every device reference so the superstep carry
-        (sk/yk ring buffers, per-row margins, ...) stops pinning HBM.
-        Callers that retain results across many cached fits should call
-        this once they are done reading device state (advisor r4)."""
+        """Detach to host and drop every device reference so the superstep
+        carry (sk/yk ring buffers, per-row margins, ...) stops pinning
+        HBM. Carries named in ``keep`` or previously read via ``shards()``
+        stay fully readable; carries read only via ``get()`` keep serving
+        ``get()`` from the memo (their per-worker stacks are gone); all
+        other device state is discarded. Callers that retain results
+        across many cached fits should call this once they are done
+        reading device state (advisor r4)."""
         for name in keep:
             self.shards(name)
         # names never fetched are dropped; fetched ones now back _stacked

@@ -35,6 +35,11 @@ from .db import BaseDB
 
 
 class TableBucketingSink:
+    """Row sink that rolls output into numbered bucket tables
+    ``<prefix>_<id>`` — in a BaseDB or as a partitioned directory of CSV
+    files — by explicit bucket-id columns (ruler mode) or by
+    size/time rollover (reference common/io/TableBucketingSink.java)."""
+
     def __init__(self, table_name_prefix: str, schema: TableSchema,
                  db: Optional[BaseDB] = None, base_dir: Optional[str] = None,
                  batch_size: int = -1, batch_rollover_interval: float = -1.0,

@@ -340,13 +340,13 @@ def _disjoint_sparse_fixture(n, dim, nnz, seed):
                    "label": np.asarray(ys, np.int64)})
 
 
-def _ftrl_final_coef(table, warm, batch_size, mode):
+def _ftrl_final_coef(table, warm, batch_size, mode, **kw):
     from alink_tpu.operator.common.linear.base import LinearModelDataConverter
     ftrl = FtrlTrainStreamOp(
         warm, label_col="label", vector_col="vec", alpha=0.5,
         l1=0.001, l2=0.001, time_interval=1e9,
-        update_mode=mode).link_from(MemSourceStreamOp(table,
-                                                      batch_size=batch_size))
+        update_mode=mode, **kw).link_from(MemSourceStreamOp(table,
+                                                            batch_size=batch_size))
     final = list(ftrl.micro_batches())[-1]
     lt = final.schema.types[2]
     return LinearModelDataConverter(lt).load_model(final).coef
@@ -388,6 +388,51 @@ def test_ftrl_batch_mode_quality_with_collisions():
     assert np.abs(c_batch - c_sample).max() / denom < 0.35
     big = np.abs(c_sample) > 0.2 * denom
     assert (np.sign(c_batch[big]) == np.sign(c_sample[big])).all()
+
+
+def test_ftrl_staleness_one_equals_strict():
+    """update_mode="staleness" with staleness=1 degenerates to the strict
+    per-sample scan — bit-level trajectory equality on COLLIDING data."""
+    table = _sparse_lr_fixture(n=256, dim=256, nnz=4, seed=3)
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=3).link_from(
+        MemSourceBatchOp(table.first_n(32)))
+    c_strict = _ftrl_final_coef(table, warm, 32, "sample")
+    c_s1 = _ftrl_final_coef(table, warm, 32, "staleness", staleness=1)
+    np.testing.assert_allclose(c_s1, c_strict, rtol=1e-6, atol=1e-9)
+
+
+def test_ftrl_staleness_exact_on_disjoint_chunks():
+    """When every row in a staleness chunk touches disjoint features, no
+    state is shared inside the chunk and the bounded-staleness program
+    EQUALS the strict per-sample scan."""
+    dim = 64
+    table = _disjoint_sparse_fixture(n=128, dim=dim, nnz=3, seed=7)
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=3,
+        with_intercept=False).link_from(
+        MemSourceBatchOp(_sparse_lr_fixture(64, dim, 4, 1)))
+    c_sample = _ftrl_final_coef(table, warm, 8, "sample")
+    c_stale = _ftrl_final_coef(table, warm, 8, "staleness", staleness=8)
+    np.testing.assert_allclose(c_stale, c_sample, rtol=1e-9, atol=1e-12)
+
+
+def test_ftrl_staleness_quality_with_collisions():
+    """Bounded staleness (the reference's feedback-edge contract) must
+    track the strict trajectory closely on ordinary colliding CTR-shape
+    data and preserve the sign structure of the learned weights."""
+    dim = 2048
+    table = _sparse_lr_fixture(n=1024, dim=dim, nnz=5, seed=11)
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=3).link_from(
+        MemSourceBatchOp(table.first_n(64)))
+    c_sample = _ftrl_final_coef(table, warm, 128, "sample")
+    c_stale = _ftrl_final_coef(table, warm, 128, "staleness", staleness=32)
+    denom = np.abs(c_sample).max()
+    assert denom > 0
+    assert np.abs(c_stale - c_sample).max() / denom < 0.35
+    big = np.abs(c_sample) > 0.2 * denom
+    assert (np.sign(c_stale[big]) == np.sign(c_sample[big])).all()
 
 
 def test_ftrl_batch_mode_dense_path():

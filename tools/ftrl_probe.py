@@ -1,0 +1,87 @@
+"""One-off probe: strict vs bounded-staleness FTRL kernel rates on the
+real chip, mirroring bench.py's ftrl_criteo configuration exactly.
+Run EXCLUSIVELY (no concurrent CPU work — see docs/performance.md)."""
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+import bench  # noqa: E402  (reuses Harness + its timing discipline)
+
+
+def main():
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from alink_tpu.operator.stream.onlinelearning.ftrl import (
+        _ftrl_sparse_staleness_step_factory, _ftrl_sparse_step_factory)
+
+    h = bench.Harness()
+    dim, nnz, B = 65_536, 39, 4096
+    n_dev = h.chips
+    dim_pad = -(-dim // n_dev) * n_dev
+    width = -(-(nnz + 1) // 8) * 8
+    rng = np.random.RandomState(0)
+    w_true = (rng.randn(dim) * (rng.rand(dim) < 0.02)).astype(np.float64)
+
+    def make_batch(seed):
+        r = np.random.RandomState(seed)
+        idx = np.zeros((B, width), np.int32)
+        val = np.zeros((B, width), np.float64)
+        raw = r.randint(1, dim, size=(B, nnz)).astype(np.int32)
+        idx[:, 0] = 0
+        val[:, 0] = 1.0
+        idx[:, 1:nnz + 1] = raw
+        val[:, 1:nnz + 1] = 1.0
+        margin = w_true[raw].sum(1)
+        y = (r.rand(B) < 1.0 / (1.0 + np.exp(-margin))).astype(np.float64)
+        return idx, val, y
+
+    pool = [make_batch(s) for s in range(24)]
+    mesh = h.env.mesh
+    shard = NamedSharding(mesh, P("d"))
+    zrng = np.random.RandomState(3)
+    sp_idx = h.put(np.stack([p[0] for p in pool]))
+    sp_val = h.put(np.stack([p[1] for p in pool]))
+    sp_y = h.put(np.stack([p[2] for p in pool]))
+
+    def rate_for(step, n_pools):
+        @jax.jit
+        def chain(si, sv, sy, z, nacc):
+            def body(carry, xs):
+                z, nacc = carry
+                z, nacc, m = step(xs[0], xs[1], xs[2], z, nacc)
+                return (z, nacc), m[0]
+            (z, nacc), _ = jax.lax.scan(body, (z, nacc), (si, sv, sy))
+            return z, nacc
+
+        def run(k):
+            z = jax.device_put(zrng.randn(dim_pad) * 1e-8, shard)
+            nacc = jax.device_put(np.zeros(dim_pad), shard)
+            for _ in range(k):
+                z, nacc = chain(sp_idx, sp_val, sp_y, z, nacc)
+            np.asarray(z)
+
+        dt = h.delta(run, n_pools)
+        return B * len(pool) * n_pools / dt / h.chips
+
+    results = {}
+    strict = _ftrl_sparse_step_factory(mesh, alpha=0.05, beta=1.0,
+                                       l1=1e-5, l2=1e-5)
+    results["strict_K4"] = rate_for(strict, 8)
+    print("strict_K4", round(results["strict_K4"], 1), flush=True)
+
+    for K in (8, 16, 32, 64, 128):
+        st = _ftrl_sparse_staleness_step_factory(
+            mesh, alpha=0.05, beta=1.0, l1=1e-5, l2=1e-5, K=K)
+        n_pools = 8 if K <= 16 else 16
+        results[f"stale_K{K}"] = rate_for(st, n_pools)
+        print(f"stale_K{K}", round(results[f'stale_K{K}'], 1), flush=True)
+
+    print({k: round(v, 1) for k, v in results.items()})
+
+
+if __name__ == "__main__":
+    main()
