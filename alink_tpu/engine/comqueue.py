@@ -133,11 +133,17 @@ def _freeze_closure_value(v, depth):
         else:
             raw = v.tobytes()
         return ("nd", v.shape, str(v.dtype), raw)
+    if isinstance(v, type):  # a CLASS in a cell (e.g. a slotted type whose
+        # 'shape' attr is a member_descriptor, not a value)
+        return ("type", v.__module__, v.__qualname__)
     if hasattr(v, "shape") and hasattr(v, "dtype"):
         # jax.Array: data belongs in partitioned/broadcast inputs by
         # contract; hashing its CONTENT would round-trip device memory.
         # Shape/dtype suffices to catch structural drift.
-        return ("devarray", tuple(v.shape), str(v.dtype))
+        try:
+            return ("devarray", tuple(v.shape), str(v.dtype))
+        except TypeError:
+            return ("opaque", type(v).__module__, type(v).__qualname__)
     # containers decrement depth too: a cyclic container (cfg['self'] =
     # cfg) must degrade to an opaque token, not overflow the stack
     if isinstance(v, (tuple, list)):

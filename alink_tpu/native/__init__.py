@@ -78,6 +78,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.vec_bounds.argtypes = [c, i64, pi64, pi64]
         lib.vec_fill2.argtypes = [c, i64, pi64, pi32, pd, pi64, pi64, pi64]
         lib.murmur_batch.argtypes = [c, pi64, i64, ctypes.c_uint32, i64, pi64]
+        pf32 = ctypes.POINTER(ctypes.c_float)
+        pi16 = ctypes.POINTER(ctypes.c_int16)
+        lib.svm_fill_fb16.argtypes = [c, i64, i64, i64, i64, pf32, pi16, pi64]
         _lib = lib
         return _lib
 
@@ -121,6 +124,38 @@ def parse_libsvm_bytes(data: bytes, start_index: int = 1
     # oversized allocations are freed (advisor r4)
     return tuple(a.copy() if a.base is not None and
                  a.nbytes < 0.5 * a.base.nbytes else a for a in out)
+
+
+def parse_libsvm_fb16(data: bytes, n_fields: int, field_size: int,
+                      start_index: int = 1
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Fused field-blocked parse: (labels f32, fb int16 (rows, n_fields))
+    for one-value-1.0-per-field field-major LibSVM rows, or None when the
+    native lib is absent OR the data does not have that shape (caller
+    falls back to :func:`parse_libsvm_bytes` + host encode). One pass,
+    2-byte output ids — the disk->device ingest fast path."""
+    lib = get_lib()
+    if lib is None or field_size > np.iinfo(np.int16).max:
+        # int16 output cannot represent larger field-local ids — the C
+        # fill would silently truncate, so refuse up front
+        return None
+    rows_ub = ctypes.c_int64()
+    nnz_ub = ctypes.c_int64()
+    lib.svm_bounds(data, len(data), ctypes.byref(rows_ub),
+                   ctypes.byref(nnz_ub))
+    if nnz_ub.value > rows_ub.value * n_fields:
+        return None    # cheap shape screen; exact validation in the fill
+    labels = np.empty(rows_ub.value, np.float32)
+    fb = np.empty((rows_ub.value, n_fields), np.int16)
+    rows = ctypes.c_int64()
+    rc = lib.svm_fill_fb16(data, len(data), start_index, n_fields,
+                           field_size, _p(labels, ctypes.c_float),
+                           _p(fb, ctypes.c_int16), ctypes.byref(rows))
+    if rc != 0:
+        return None
+    return tuple(a.copy() if a.base is not None and
+                 a.nbytes < 0.5 * a.base.nbytes else a
+                 for a in (labels[:rows.value], fb[:rows.value]))
 
 
 def split_newline_chunks(data: bytes, k: int) -> list:

@@ -239,6 +239,60 @@ int svm_fill2(const char* buf, int64_t len, int64_t start_index,
   return 0;
 }
 
+// Fused field-blocked fast path: for LibSVM rows that are EXACTLY one
+// value-1.0 entry per field in field-major order (global idx =
+// k*field_size + local + start_index for the k-th pair — the shape the
+// field-aware FeatureHasher emits), parse straight into (rows, n_fields)
+// int16 field-LOCAL ids + f32 labels in ONE pass. Writes 2-byte ids
+// instead of 8-byte CSR indices and skips the separate subtract/cast
+// encode pass entirely. Returns -1 on the first row that violates the
+// shape so the caller can fall back to the generic CSR path.
+int svm_fill_fb16(const char* buf, int64_t len, int64_t start_index,
+                  int64_t n_fields, int64_t field_size,
+                  float* labels, int16_t* fb, int64_t* out_rows) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t row = 0;
+  while (p < end) {
+    while (p < end && (is_space(*p) || *p == '\n')) p++;
+    if (p >= end) break;
+    {
+      const char* tok = p;
+      double v = parse_num_fast(p, end);
+      if (p < end && !is_space(*p) && *p != '\n') {
+        char lb[64];
+        int n = 0;
+        const char* q = tok;
+        while (q < end && !is_space(*q) && *q != '\n' && n < 63)
+          lb[n++] = *q++;
+        while (q < end && !is_space(*q) && *q != '\n') q++;
+        lb[n] = '\0';
+        v = std::strtod(lb, nullptr);
+        p = q;
+      }
+      labels[row] = (float)v;
+    }
+    int64_t k = 0;
+    int16_t* out = fb + row * n_fields;
+    while (p < end && *p != '\n') {
+      while (p < end && is_space(*p)) p++;
+      if (p >= end || *p == '\n') break;
+      long idx = parse_int(p, end);
+      if (p >= end || *p != ':') return -1;
+      p++;
+      double v = parse_num_fast(p, end);
+      if (v != 1.0 || k >= n_fields) return -1;
+      long local = idx - start_index - k * field_size;
+      if (local < 0 || local >= field_size) return -1;
+      out[k++] = (int16_t)local;
+    }
+    if (k != n_fields) return -1;
+    row++;
+  }
+  *out_rows = row;
+  return 0;
+}
+
 // ---------------------------------------------------------------------------
 // Numeric CSV: rows of delimiter-separated numbers (no quoting — the
 // general quoted/string path stays in Python's csv module).

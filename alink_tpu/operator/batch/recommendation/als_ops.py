@@ -62,6 +62,11 @@ class AlsTrainBatchOp(BatchOperator, HasSeed):
     IMPLICIT_PREFS = ParamInfo("implicit_prefs", bool, default=False)
     ALPHA = ParamInfo("alpha", float, default=40.0)
     NONNEGATIVE = ParamInfo("nonnegative", bool, default=False)
+    SHARD_SOLVE = ParamInfo("shard_solve", bool, default=False,
+                            description="shard the normal-equation "
+                                        "accumulation + solve by id range "
+                                        "(reduce_scatter) and all_gather "
+                                        "only the solved factors")
 
     def link_from(self, in_op: BatchOperator) -> "AlsTrainBatchOp":
         t = in_op.get_output_table()
@@ -79,7 +84,7 @@ class AlsTrainBatchOp(BatchOperator, HasSeed):
             rank=self.get_rank(), num_iter=self.get_num_iter(),
             lambda_reg=self.get_lambda_(), implicit_prefs=self.get_implicit_prefs(),
             alpha=self.get_alpha(), nonnegative=self.get_nonnegative(),
-            seed=self.get_seed())
+            seed=self.get_seed(), shard_solve=self.get_shard_solve())
         uf, if_, curve = als_train(users, items, ratings, p,
                                    num_users=len(user_ids),
                                    num_items=len(item_ids))

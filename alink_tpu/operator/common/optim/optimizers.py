@@ -123,7 +123,8 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
                   history: int = _HISTORY):
     dim = obj.dim
     data_keys = tuple(data)
-    dtype = np.asarray(data["y"]).dtype
+    dtype = np.dtype(getattr(data["y"], "dtype", None)
+                     or np.asarray(data["y"]).dtype)
     if dtype not in (np.float32, np.float64):
         dtype = np.float32
     m = history
@@ -266,7 +267,8 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
 def _sgd(obj, data, params, env, warm_start):
     dim = obj.dim
     data_keys = tuple(data)
-    dtype = np.asarray(data["y"]).dtype
+    dtype = np.dtype(getattr(data["y"], "dtype", None)
+                     or np.asarray(data["y"]).dtype)
     if dtype not in (np.float32, np.float64):
         dtype = np.float32
     max_iter = params.max_iter
@@ -329,7 +331,8 @@ def _sgd(obj, data, params, env, warm_start):
 def _newton(obj, data, params, env, warm_start):
     dim = obj.dim
     data_keys = tuple(data)
-    dtype = np.asarray(data["y"]).dtype
+    dtype = np.dtype(getattr(data["y"], "dtype", None)
+                     or np.asarray(data["y"]).dtype)
     if dtype not in (np.float32, np.float64):
         dtype = np.float32
     max_iter = params.max_iter

@@ -95,6 +95,13 @@ class AlsTrainParams:
     nonnegative: bool = False
     seed: int = 0
     tol: float = 0.0          # train-RMSE delta early stop; 0 = run num_iter
+    # Shard the post-reduction normal equations + solve by id range
+    # (reduce_scatter instead of psum), then all_gather only the solved
+    # factors. The (U, tri+rank+1) normal-equation buffers — ~6.6x the
+    # factor bytes at rank 10 — stop being replicated per chip, lifting
+    # the docs/parallelism.md HBM cap; the factors themselves remain
+    # replicated (the next half-sweep gathers arbitrary rows of them).
+    shard_solve: bool = False
 
 
 def _sorted_side(ids: np.ndarray, rw: np.ndarray, col: int):
@@ -218,22 +225,38 @@ def als_train(users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
 
         span = (ends - starts).astype(contrib.dtype)[:, None]
         slot = (prefix(ends) - prefix(starts)) + mean * span
-        A = jnp.zeros((n_rows, n_tri), x.dtype).at[ids_].add(
+        n_pad = -(-n_rows // nw) * nw if p.shard_solve else n_rows
+        A = jnp.zeros((n_pad, n_tri), x.dtype).at[ids_].add(
             slot[:, :n_tri])
-        b = jnp.zeros((n_rows, rank), x.dtype).at[ids_].add(
+        b = jnp.zeros((n_pad, rank), x.dtype).at[ids_].add(
             slot[:, n_tri:n_tri + rank])
-        cnt = jnp.zeros((n_rows,), x.dtype).at[ids_].add(slot[:, -1])
-        A = jax.lax.psum(A, "d")
-        b = jax.lax.psum(b, "d")
-        cnt = jax.lax.psum(cnt, "d")
-        A = A[:, unpack].reshape(n_rows, rank, rank)          # symmetrize
+        cnt = jnp.zeros((n_pad,), x.dtype).at[ids_].add(slot[:, -1])
+        if p.shard_solve:
+            # reduce_scatter: worker d receives only its id-range slice of
+            # the summed equations (the replicated-buffer escape hatch,
+            # docs/parallelism.md); the solve below then runs on U/nw ids
+            # per chip and only the solved factors are re-replicated.
+            A = jax.lax.psum_scatter(A, "d", scatter_dimension=0, tiled=True)
+            b = jax.lax.psum_scatter(b, "d", scatter_dimension=0, tiled=True)
+            cnt = jax.lax.psum_scatter(cnt, "d", scatter_dimension=0,
+                                       tiled=True)
+        else:
+            A = jax.lax.psum(A, "d")
+            b = jax.lax.psum(b, "d")
+            cnt = jax.lax.psum(cnt, "d")
+        A = A[:, unpack].reshape(A.shape[0], rank, rank)      # symmetrize
         A = A + lam * jnp.maximum(cnt, 1.0)[:, None, None] * eye
         # batched unrolled Gauss-Jordan: jnp.linalg.solve's batched LU
         # leaves the MXU idle (21 ms vs ~0 ms here, tools/profile_als3.py)
         sol = batched_spd_solve(A, b)
         if p.nonnegative:
             sol = batched_nnls(A, b, x0=jnp.maximum(sol, 0.0))
-        return jnp.where(cnt[:, None] > 0, sol, 0.0)
+        sol = jnp.where(cnt[:, None] > 0, sol, 0.0)
+        if p.shard_solve:
+            # factor all-gather (the north-star collective): every worker
+            # needs the full matrix for the next half-sweep's gathers
+            sol = jax.lax.all_gather(sol, "d", axis=0, tiled=True)[:n_rows]
+        return sol
 
     def step(ctx):
         if ctx.is_init_step:

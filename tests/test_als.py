@@ -224,3 +224,109 @@ def test_als_one_sweep_matches_numpy_normal_equations():
     if_ref = solve_ref(items, users, I, uf_ref)
     np.testing.assert_allclose(uf, uf_ref, rtol=2e-4, atol=2e-5)
     np.testing.assert_allclose(if_, if_ref, rtol=2e-4, atol=2e-5)
+
+
+def _coo(seed=5, n=3000, U=100, I=60):
+    rng = np.random.RandomState(seed)
+    users = rng.randint(0, U, n).astype(np.int32)
+    items = rng.randint(0, I, n).astype(np.int32)
+    ratings = (rng.rand(n) * 5).astype(np.float32)
+    return users, items, ratings, U, I
+
+
+class TestAlsShardSolve:
+    """shard_solve=True: reduce_scatter the normal equations by id range,
+    solve locally, all_gather the solved factors (the escape hatch for
+    the replicated-buffer HBM cap, docs/parallelism.md)."""
+
+    def test_parity_8dev(self):
+        from dataclasses import replace
+        from alink_tpu.operator.common.recommendation.als import (
+            AlsTrainParams, als_train)
+        users, items, ratings, U, I = _coo()
+        p = AlsTrainParams(rank=4, num_iter=6, lambda_reg=0.1, seed=2)
+        uf0, if0, c0 = als_train(users, items, ratings, p,
+                                 num_users=U, num_items=I)
+        uf1, if1, c1 = als_train(users, items, ratings,
+                                 replace(p, shard_solve=True),
+                                 num_users=U, num_items=I)
+        # same math, different reduction order (reduce_scatter vs psum)
+        np.testing.assert_allclose(uf1, uf0, rtol=2e-3, atol=2e-4)
+        np.testing.assert_allclose(if1, if0, rtol=2e-3, atol=2e-4)
+        np.testing.assert_allclose(c1, c0, rtol=1e-3, atol=1e-4)
+
+    def test_parity_nonnegative(self):
+        from dataclasses import replace
+        from alink_tpu.operator.common.recommendation.als import (
+            AlsTrainParams, als_train)
+        users, items, ratings, U, I = _coo(seed=9, n=1500, U=40, I=30)
+        p = AlsTrainParams(rank=3, num_iter=4, nonnegative=True, seed=1)
+        uf0, _, _ = als_train(users, items, ratings, p,
+                              num_users=U, num_items=I)
+        uf1, _, _ = als_train(users, items, ratings,
+                              replace(p, shard_solve=True),
+                              num_users=U, num_items=I)
+        assert (np.asarray(uf1) >= -1e-6).all()
+        np.testing.assert_allclose(uf1, uf0, rtol=5e-3, atol=5e-4)
+
+    def test_hlo_shows_reduce_scatter_and_all_gather(self):
+        """The compiled module must contain the reduce-scatter of the
+        packed equations and the factor all-gather with the expected
+        payload shapes (the HLO-audit obligation from VERDICT r4 #7)."""
+        import re
+        import sys
+        sys.path.insert(0, "tools")
+        from scaling_evidence import capture_lowered
+        from alink_tpu.operator.common.recommendation.als import (
+            AlsTrainParams, als_train)
+        users, items, ratings, U, I = _coo(n=1000, U=64, I=48)
+        p = AlsTrainParams(rank=4, num_iter=3, shard_solve=True)
+        lowered = capture_lowered(
+            lambda: als_train(users, items, ratings, p,
+                              num_users=U, num_items=I))
+        hlo = lowered.compile().as_text()
+        assert re.search(r"reduce-scatter(?:-start)?\(", hlo), \
+            "no reduce-scatter in compiled ALS shard_solve module"
+        assert re.search(r"all-gather(?:-start)?\(", hlo), \
+            "no all-gather in compiled ALS shard_solve module"
+        # factor all-gather payload: (U_pad, rank) per side appears as an
+        # all-gather result with last dim == rank (f64 under the test
+        # mesh's x64 flag, f32 on hardware)
+        ags = re.findall(r"f(?:32|64)\[(\d+),(\d+)\][^\n]*all-gather", hlo)
+        assert any(int(r) == p.rank for _, r in ags), ags
+
+    def test_parity_32dev_subprocess(self):
+        import os
+        import subprocess
+        import sys
+        from bootenv import cpu_mesh_env
+        code = """
+import numpy as np
+from dataclasses import replace
+import jax
+from alink_tpu.common.mlenv import MLEnvironment, MLEnvironmentFactory
+from alink_tpu.operator.common.recommendation.als import AlsTrainParams, als_train
+
+n = len(jax.devices())
+assert n == 32, n
+env = MLEnvironment(parallelism=n)
+MLEnvironmentFactory.set_default(env)
+rng = np.random.RandomState(5)
+users = rng.randint(0, 100, 3000).astype(np.int32)
+items = rng.randint(0, 60, 3000).astype(np.int32)
+ratings = (rng.rand(3000) * 5).astype(np.float32)
+p = AlsTrainParams(rank=4, num_iter=5, seed=2)
+uf0, if0, _ = als_train(users, items, ratings, p, num_users=100, num_items=60)
+uf1, if1, _ = als_train(users, items, ratings, replace(p, shard_solve=True),
+                        num_users=100, num_items=60)
+np.testing.assert_allclose(uf1, uf0, rtol=2e-3, atol=2e-4)
+np.testing.assert_allclose(if1, if0, rtol=2e-3, atol=2e-4)
+print("shard_solve 32dev ok")
+"""
+        env = cpu_mesh_env(32)
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           cwd=os.path.dirname(os.path.dirname(
+                               os.path.abspath(__file__))),
+                           capture_output=True, text=True, timeout=600)
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+        assert "shard_solve 32dev ok" in r.stdout

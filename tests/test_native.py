@@ -321,3 +321,46 @@ def test_fast_float_path_exactness():
     np.testing.assert_array_equal(labels, expect)
     np.testing.assert_array_equal(values, expect)
     assert np.array_equal(indices, np.arange(len(vals), dtype=np.int32))
+
+
+def test_fb16_fused_parse_matches_generic():
+    """svm_fill_fb16 (one-pass field-blocked int16 parse) must agree with
+    the generic CSR parse + host encode on conforming data, and return
+    None (fall back) on every shape violation."""
+    from alink_tpu.native import (get_lib, parse_libsvm_bytes,
+                                  parse_libsvm_fb16)
+    if get_lib() is None:
+        import pytest
+        pytest.skip("native library unavailable")
+    F, S, n = 5, 32, 200
+    rng = np.random.RandomState(0)
+    fb = rng.randint(0, S, size=(n, F))
+    y = rng.choice([-1, 1], n)
+    offs = np.arange(F) * S
+    lines = []
+    for r in range(n):
+        toks = " ".join(f"{fb[r, k] + offs[k] + 1}:1" for k in range(F))
+        lines.append(f"{y[r]} {toks}")
+    data = ("\n".join(lines) + "\n").encode()
+
+    got = parse_libsvm_fb16(data, F, S, 1)
+    assert got is not None
+    lab, fb16 = got
+    assert lab.dtype == np.float32 and fb16.dtype == np.int16
+    np.testing.assert_array_equal(lab, y.astype(np.float32))
+    np.testing.assert_array_equal(fb16, fb.astype(np.int16))
+    # agreement with the generic path + encode
+    labels, indptr, indices, values = parse_libsvm_bytes(data, 1)
+    fb_generic = (indices.reshape(-1, F) - offs[None, :]).astype(np.int16)
+    np.testing.assert_array_equal(fb16, fb_generic)
+    np.testing.assert_array_equal(lab, labels.astype(np.float32))
+
+    # violations -> None (fall back to the generic path)
+    bad_value = data.replace(b":1 ", b":2 ", 1)
+    assert parse_libsvm_fb16(bad_value, F, S, 1) is None
+    assert parse_libsvm_fb16(data, F + 1, S, 1) is None        # wrong F
+    missing = ("\n".join(lines[:1])
+               .rsplit(" ", 1)[0] + "\n").encode()              # 4 pairs
+    assert parse_libsvm_fb16(missing, F, S, 1) is None
+    out_of_field = f"1 {S * F + 7}:1\n".encode()                # idx too big
+    assert parse_libsvm_fb16(out_of_field, 1, S, 1) is None

@@ -2,12 +2,13 @@
 real chip, mirroring bench.py's ftrl_criteo configuration exactly.
 Run EXCLUSIVELY (no concurrent CPU work — see docs/performance.md)."""
 
+import os
 import sys
-import time
 
 import numpy as np
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
 import bench  # noqa: E402  (reuses Harness + its timing discipline)
 
 
