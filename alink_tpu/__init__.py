@@ -13,7 +13,9 @@ from .common import (Params, ParamInfo, WithParams, AlinkTypes, TableSchema,
                      DenseVector, SparseVector, VectorUtil, SparseBatch, DenseMatrix,
                      MTable, MLEnvironment, MLEnvironmentFactory, use_local_env,
                      use_remote_env,
-                     StepTimer, named_stage, trace)
+                     StepTimer, named_stage, trace,
+                     MetricsRegistry, get_registry, set_registry,
+                     metrics_enabled)
 from .engine import (IterativeComQueue, ComContext, ComputeFunction, AllReduce,
                      AllGather, BroadcastFromWorker0)
 

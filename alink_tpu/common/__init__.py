@@ -6,6 +6,8 @@ from .mtable import MTable
 from .mlenv import (MLEnvironment, MLEnvironmentFactory, use_local_env,
                     use_remote_env)
 from .lazy import LazyEvaluation, LazyObjectsManager
+from .metrics import (MetricsRegistry, get_registry, metrics_enabled,
+                      set_registry)
 from .profiling import StepTimer, named_stage, trace
 
 __all__ = [
@@ -14,4 +16,5 @@ __all__ = [
     "SparseBatch", "DenseMatrix", "MTable", "MLEnvironment", "MLEnvironmentFactory",
     "use_local_env", "use_remote_env", "LazyEvaluation", "LazyObjectsManager",
     "StepTimer", "named_stage", "trace",
+    "MetricsRegistry", "get_registry", "set_registry", "metrics_enabled",
 ]

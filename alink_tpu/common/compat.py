@@ -1,0 +1,63 @@
+"""JAX version compatibility shims.
+
+The runtime targets current jax (``jax.shard_map``, ``check_vma``); older
+containers ship jax 0.4.x where shard_map still lives in
+``jax.experimental.shard_map`` and the replication check is spelled
+``check_rep``. Every internal caller goes through :func:`shard_map` here so
+the version probe happens exactly once per process.
+
+Import of jax is deferred to first call — ``alink_tpu.common`` must stay
+importable without touching a backend (XLA flags latch at backend init).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+__all__ = ["shard_map", "lowered_text"]
+
+_impl: Optional[tuple] = None  # (callable, check_kwarg_name)
+
+
+def _resolve() -> tuple:
+    global _impl
+    if _impl is None:
+        try:
+            from jax import shard_map as sm  # jax >= 0.6 style
+            _impl = (sm, "check_vma")
+        except ImportError:
+            from jax.experimental.shard_map import shard_map as sm
+            _impl = (sm, "check_rep")
+    return _impl
+
+
+def shard_map(f: Callable, *, mesh, in_specs, out_specs,
+              check_vma: Optional[bool] = None, **kw) -> Callable:
+    """``jax.shard_map`` with the replication-check kwarg translated for
+    the installed jax. ``check_vma`` unspecified means False on the legacy
+    API (its ``check_rep=True`` default rejects valid collective programs
+    the current checker accepts)."""
+    sm, check_kw = _resolve()
+    if check_vma is None and check_kw == "check_rep":
+        check_vma = False
+    if check_vma is not None:
+        kw[check_kw] = check_vma
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def lowered_text(lowered: Any, debug_info: bool = False) -> str:
+    """``Lowered.as_text`` across jax versions. Older signatures lack the
+    ``debug_info`` kwarg AND strip location metadata from the default
+    text; there the MLIR module's own printer recovers named-scope /
+    location info."""
+    try:
+        return lowered.as_text(debug_info=debug_info)
+    except TypeError:
+        if debug_info:
+            try:
+                ir = lowered.compiler_ir()
+                return ir.operation.get_asm(enable_debug_info=True)
+            except Exception:
+                pass
+        return lowered.as_text()

@@ -19,16 +19,33 @@ The TPU build's equivalents (SURVEY §5):
     attribution;
   * **superstep logging** — set ``ALINK_TPU_STEP_LOG=1`` to emit a host
     callback log line per superstep from inside the compiled while-loop
-    (the slf4j taskId/stepNo analogue; works under jit).
+    (the slf4j taskId/stepNo analogue; works under jit);
+  * **metrics mirror** — every ``StepTimer.span`` exit also lands in the
+    process ``MetricsRegistry`` (common/metrics.py) as one
+    ``alink_step_timer_seconds`` histogram observation labelled by span
+    name, so a single ``registry.dump()`` captures host spans next to
+    engine/collective/stream counters.
+
+Environment flags (parsed by ``common.metrics.env_flag``: unset uses the
+default, ``0``/``false``/``off``/``no`` disable, anything else enables):
+
+  * ``ALINK_TPU_STEP_LOG`` — default off. Per-superstep ``jax.debug.print``
+    from inside compiled loops. Changes the compiled program, so it also
+    participates in the engine's program-cache key.
+  * ``ALINK_TPU_METRICS``  — default on. Master switch for every
+    ``MetricsRegistry`` producer, including the span mirror here; hot
+    paths skip all registry updates when disabled.
 """
 
 from __future__ import annotations
 
 import contextlib
-import os
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
+
+from .metrics import env_flag, get_registry, metrics_enabled
 
 __all__ = ["StepTimer", "named_stage", "trace", "step_log_enabled",
            "log_superstep"]
@@ -58,7 +75,10 @@ def trace(log_dir: str) -> Iterator[None]:
 
 
 def step_log_enabled() -> bool:
-    return os.environ.get("ALINK_TPU_STEP_LOG", "") not in ("", "0")
+    """``ALINK_TPU_STEP_LOG`` flag — unset/``0``/``false``/``off`` all
+    disable (the old parser enabled on any non-empty string except "0",
+    so ``ALINK_TPU_STEP_LOG=false`` silently turned logging ON)."""
+    return env_flag("ALINK_TPU_STEP_LOG", default=False)
 
 
 def log_superstep(step, **values):
@@ -94,32 +114,52 @@ class StepTimer:
     Spans nest freely; each name accumulates (count, total seconds).
     ``jax`` work is asynchronous — wrap the span around a blocking call
     (``collect()``/``block_until_ready``) for meaningful device timings.
+
+    Thread-safe: streams and the bench enter ``span()`` from prefetch /
+    generator threads concurrently with the driver thread; accumulation
+    is guarded by one lock per timer. Unless ``mirror=False`` (or
+    ``ALINK_TPU_METRICS=0``), every span exit is also observed into the
+    process ``MetricsRegistry`` as ``alink_step_timer_seconds`` labelled
+    ``{span: name}`` plus any ``labels=`` passed through.
     """
     _spans: Dict[str, _Span] = field(default_factory=dict)
     _order: List[str] = field(default_factory=list)
+    mirror: bool = True
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    METRIC = "alink_step_timer_seconds"
 
     @contextlib.contextmanager
-    def span(self, name: str) -> Iterator[None]:
+    def span(self, name: str,
+             labels: Optional[Dict[str, str]] = None) -> Iterator[None]:
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
-            if name not in self._spans:
-                self._spans[name] = _Span()
-                self._order.append(name)
-            s = self._spans[name]
-            s.count += 1
-            s.total_s += dt
+            with self._lock:
+                if name not in self._spans:
+                    self._spans[name] = _Span()
+                    self._order.append(name)
+                s = self._spans[name]
+                s.count += 1
+                s.total_s += dt
+            if self.mirror and metrics_enabled():
+                merged = {"span": name}
+                if labels:
+                    merged.update(labels)
+                get_registry().observe(self.METRIC, dt, merged)
 
     def report(self) -> List[Tuple[str, int, float, float]]:
         """[(name, count, total_s, mean_s)] in first-seen order."""
-        return [(n, s.count, s.total_s, s.total_s / s.count)
-                for n, s in ((n, self._spans[n]) for n in self._order)]
+        with self._lock:
+            return [(n, s.count, s.total_s, s.total_s / s.count)
+                    for n, s in ((n, self._spans[n]) for n in self._order)]
 
     def reset(self) -> None:
-        self._spans.clear()
-        self._order.clear()
+        with self._lock:
+            self._spans.clear()
+            self._order.clear()
 
     def pretty(self) -> str:
         rows = self.report()

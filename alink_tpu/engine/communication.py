@@ -7,16 +7,79 @@ The reference implements MPI-style primitives by hand over Flink shuffles:
 Here each primitive is ONE XLA collective over the ICI mesh (SURVEY §2.4):
 psum / pmax / pmin / all_gather / ppermute. Chunking, routing and reassembly
 belong to the compiler.
+
+Telemetry: every communicate stage reports its invocation and logical
+payload bytes through :func:`record_collective` **at trace time** (shapes
+and dtypes are known on tracers; no host callback enters the compiled
+program). The engine installs :func:`collecting` around superstep tracing
+to capture a per-superstep manifest it later multiplies by the executed
+superstep count; outside a collector the record lands directly in the
+process ``MetricsRegistry`` (standalone use of these stages).
 """
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence
+import contextlib
+import threading
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
+import numpy as np
+
+from ..common.metrics import get_registry, metrics_enabled
 from .context import ComContext
+
+# (collective_kind, buffer_name, logical_bytes_per_invocation) triples
+CollectiveRecord = Tuple[str, str, int]
+
+_collector = threading.local()
+
+
+@contextlib.contextmanager
+def collecting(manifest: List[CollectiveRecord]):
+    """Route :func:`record_collective` calls on this thread into
+    ``manifest`` (the engine's per-superstep trace capture) instead of the
+    registry. Nests: the previous sink is restored on exit."""
+    prev = getattr(_collector, "manifest", None)
+    _collector.manifest = manifest
+    try:
+        yield manifest
+    finally:
+        _collector.manifest = prev
+
+
+def payload_nbytes(value) -> int:
+    """Logical payload bytes of a buffer pytree as seen by ONE worker
+    (tracer-safe: reads only aval shape/dtype)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(value):
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", None)
+        itemsize = np.dtype(dtype).itemsize if dtype is not None else 8
+        n = 1
+        for d in shape:
+            n *= int(d)
+        total += n * itemsize
+    return total
+
+
+def record_collective(kind: str, name: str, per_worker_bytes: int,
+                      num_workers: int) -> None:
+    """Record one collective invocation. ``logical bytes moved`` is the
+    payload summed over workers (every worker contributes/receives its
+    copy), not the wire traffic of a particular ring schedule."""
+    logical = int(per_worker_bytes) * int(num_workers)
+    manifest = getattr(_collector, "manifest", None)
+    if manifest is not None:
+        manifest.append((kind, name, logical))
+        return
+    if metrics_enabled():
+        reg = get_registry()
+        lbl = {"collective": kind}
+        reg.inc("alink_collective_calls_total", 1, lbl)
+        reg.inc("alink_collective_logical_bytes_total", logical, lbl)
 
 
 class CommunicateFunction:
@@ -52,6 +115,8 @@ class AllReduce(CommunicateFunction):
         fn = self.OPS[self.op]
         for name in self.buffer_names:
             v = context.get_obj(name)
+            record_collective("AllReduce", name, payload_nbytes(v),
+                              context.num_task)
             out = jax.tree_util.tree_map(lambda x: fn(x, ComContext.AXIS), v)
             if self.mean:
                 out = jax.tree_util.tree_map(lambda x: x / context.num_task, out)
@@ -76,6 +141,8 @@ class AllGather(CommunicateFunction):
     def calc(self, context: ComContext):
         for name in self.buffer_names:
             v = context.get_obj(name)
+            record_collective("AllGather", name, payload_nbytes(v),
+                              context.num_task)
             out = jax.tree_util.tree_map(
                 lambda x: jax.lax.all_gather(x, ComContext.AXIS, axis=self.axis,
                                              tiled=self.tiled), v)
@@ -95,6 +162,8 @@ class BroadcastFromWorker0(CommunicateFunction):
         tid = context.task_id
         for name in self.buffer_names:
             v = context.get_obj(name)
+            record_collective("BroadcastFromWorker0", name, payload_nbytes(v),
+                              context.num_task)
 
             def bcast(x):
                 x = jnp.where(tid == 0, x, jnp.zeros_like(x))

@@ -62,6 +62,24 @@ _PROGRAM_CACHE_MAX = 32
 _PROGRAM_CACHE_STATS = {"hits": 0, "misses": 0}
 # jaxpr text per cached key, populated only under ALINK_VERIFY_PROGRAM_CACHE
 _PROGRAM_CACHE_JAXPRS: Dict[tuple, str] = {}
+# per-superstep collective manifest per cached key (communication.collecting
+# capture, recorded at trace time): {"init": [...], "body": [...]} of
+# (kind, buffer, logical_bytes) triples. Kept OUTSIDE the metrics guard so
+# a program compiled under ALINK_TPU_METRICS=0 still carries its manifest
+# when a later metrics-on exec hits the cache.
+_PROGRAM_CACHE_MANIFESTS: Dict[tuple, dict] = {}
+
+# Engine phase wall-clock (prepare inputs / execute+compile / collect).
+# Spans mirror into the MetricsRegistry as alink_step_timer_seconds via
+# StepTimer itself, so one registry dump carries engine timing too.
+from ..common.profiling import StepTimer as _StepTimer
+
+_ENGINE_TIMER = _StepTimer()
+
+
+def engine_timer():
+    """The engine-phase StepTimer (host wall-clock per exec phase)."""
+    return _ENGINE_TIMER
 
 
 def program_cache_stats() -> Dict[str, int]:
@@ -72,6 +90,7 @@ def program_cache_stats() -> Dict[str, int]:
 def clear_program_cache() -> None:
     _PROGRAM_CACHE.clear()
     _PROGRAM_CACHE_JAXPRS.clear()
+    _PROGRAM_CACHE_MANIFESTS.clear()
 
 
 def freeze_config(v):
@@ -134,8 +153,11 @@ def _freeze_closure_value(v, depth):
             raw = v.tobytes()
         return ("nd", v.shape, str(v.dtype), raw)
     if isinstance(v, type):  # a CLASS in a cell (e.g. a slotted type whose
-        # 'shape' attr is a member_descriptor, not a value)
-        return ("type", v.__module__, v.__qualname__)
+        # 'shape' attr is a member_descriptor, not a value). getattr with
+        # defaults: pybind11-defined classes (old jaxlib's PmapFunction)
+        # can lack __module__/__qualname__, and this function must be TOTAL
+        return ("type", getattr(v, "__module__", "?"),
+                getattr(v, "__qualname__", getattr(v, "__name__", repr(v))))
     if hasattr(v, "shape") and hasattr(v, "dtype"):
         # jax.Array: data belongs in partitioned/broadcast inputs by
         # contract; hashing its CONTENT would round-trip device memory.
@@ -168,7 +190,8 @@ def _freeze_closure_value(v, depth):
         return (type(v).__name__, tuple(sorted(
             (k, _freeze_closure_value(x, depth - 1))
             for k, x in vars(v).items() if not k.startswith("_"))))
-    return ("opaque", type(v).__module__, type(v).__qualname__)
+    return ("opaque", getattr(type(v), "__module__", "?"),
+            getattr(type(v), "__qualname__", type(v).__name__))
 
 
 def _callable_digest(fn, depth=4):
@@ -405,8 +428,11 @@ class IterativeComQueue:
     def _run(self, lower_only: bool = False):
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from ..common.compat import shard_map
+
+        from ..common.metrics import get_registry, metrics_enabled
 
         env = self.env or MLEnvironmentFactory.get_default()
         nw = env.num_workers
@@ -415,48 +441,81 @@ class IterativeComQueue:
         criterion = self._criterion
         max_iter = int(self.max_iter)
         seed = int(self.seed)
+        mx = metrics_enabled() and not lower_only
+        # per-superstep collective capture (trace-time; see communication
+        # .collecting), keyed by the traced input signature: jax.jit keeps
+        # a shape-keyed trace cache underneath each compiled entry, so one
+        # cached program can hold several traces with different payload
+        # sizes — each signature gets its own init/body manifest. A dict
+        # so the superstep closure — which may be retraced later through a
+        # CACHED program — always writes into the manifest object stored
+        # with that program.
+        manifest: Dict[tuple, Dict[str, list]] = {}
 
         parts: Dict[str, Any] = {}
         totals: Dict[str, int] = {}
-        for k, arr in self._partitioned.items():
-            if isinstance(arr, jax.Array):
-                # already device-resident (e.g. precomputed one-hot design
-                # factors): pad on device — np.asarray would round-trip
-                # GBs through the host
+        with _ENGINE_TIMER.span("comqueue.prepare"):
+            for k, arr in self._partitioned.items():
+                if isinstance(arr, jax.Array):
+                    # already device-resident (e.g. precomputed one-hot design
+                    # factors): pad on device — np.asarray would round-trip
+                    # GBs through the host
+                    totals[k] = int(arr.shape[0])
+                    pad = (-arr.shape[0]) % nw
+                    if pad:
+                        arr = jnp.concatenate(
+                            [arr, jnp.zeros((pad, *arr.shape[1:]), arr.dtype)],
+                            axis=0)
+                    parts[k] = arr
+                    continue
+                arr = np.asarray(arr)
                 totals[k] = int(arr.shape[0])
                 pad = (-arr.shape[0]) % nw
                 if pad:
-                    arr = jnp.concatenate(
-                        [arr, jnp.zeros((pad, *arr.shape[1:]), arr.dtype)],
+                    arr = np.concatenate(
+                        [arr, np.zeros((pad, *arr.shape[1:]), dtype=arr.dtype)],
                         axis=0)
-                parts[k] = arr
-                continue
-            arr = np.asarray(arr)
-            totals[k] = int(arr.shape[0])
-            pad = (-arr.shape[0]) % nw
-            if pad:
-                arr = np.concatenate(
-                    [arr, np.zeros((pad, *arr.shape[1:]), dtype=arr.dtype)], axis=0)
-            parts[k] = jnp.asarray(arr)
-        bcast = {k: jax.tree_util.tree_map(jnp.asarray, v)
-                 for k, v in self._broadcast.items()}
-        for k, n in totals.items():
-            bcast[f"__total_{k}"] = jnp.asarray(n, jnp.int32)
+                parts[k] = jnp.asarray(arr)
+            bcast = {k: jax.tree_util.tree_map(jnp.asarray, v)
+                     for k, v in self._broadcast.items()}
+            for k, n in totals.items():
+                bcast[f"__total_{k}"] = jnp.asarray(n, jnp.int32)
 
         from ..common.profiling import log_superstep, named_stage
+        from .communication import collecting
+
+        def static_sig(static):
+            """Trace signature: per-worker shapes/dtypes of every input
+            leaf, computed identically on host inputs (given the P('d')
+            leading-axis split) and on the tracers inside superstep."""
+            items = []
+            for k in sorted(static):
+                for leaf in jax.tree_util.tree_leaves(static[k]):
+                    items.append((k, tuple(map(int, leaf.shape)),
+                                  str(leaf.dtype)))
+            return tuple(items)
 
         def superstep(carry, static, init_pass):
             ctx = ComContext(carry, static, nw, init_pass)
-            for s in stages:
-                # name each compiled stage (the reference .name()s every
-                # dataflow stage for the Flink UI, BaseComQueue.java:172-195)
-                with named_stage(getattr(s, "__name__", type(s).__name__)):
-                    s.calc(ctx)
-            if criterion is not None:
-                stop = criterion(ctx)
-                ctx.put_obj("__stop", jnp.asarray(stop, bool).reshape(()))
-            else:
-                ctx.put_obj("__stop", jnp.asarray(False))
+            # capture this pass's collectives at TRACE time (shapes are on
+            # the tracers; nothing is added to the compiled program).
+            # clear() first: a retrace through a cached program must
+            # OVERWRITE the stored per-pass manifest, not append to it.
+            per = manifest.setdefault(static_sig(static),
+                                      {"init": [], "body": []})
+            entries = per["init" if init_pass else "body"]
+            entries.clear()
+            with collecting(entries):
+                for s in stages:
+                    # name each compiled stage (the reference .name()s every
+                    # dataflow stage for the Flink UI, BaseComQueue.java:172-195)
+                    with named_stage(getattr(s, "__name__", type(s).__name__)):
+                        s.calc(ctx)
+                if criterion is not None:
+                    stop = criterion(ctx)
+                    ctx.put_obj("__stop", jnp.asarray(stop, bool).reshape(()))
+                else:
+                    ctx.put_obj("__stop", jnp.asarray(False))
             log_superstep(ctx.step_no, task=ctx.task_id,
                           stop=ctx.get_obj("__stop"))
             return ctx.carry
@@ -489,6 +548,7 @@ class IterativeComQueue:
             return jax.jit(build_mapped()).lower(parts, bcast)
         compiled = None
         ckey = None
+        cache_status = "uncached"
         if self._program_key is not None:
             from ..common.profiling import step_log_enabled
             # structural guard (advisor r4): the stage bytecode + frozen
@@ -500,13 +560,18 @@ class IterativeComQueue:
                     criterion is not None, step_log_enabled(),
                     tuple(sorted(parts)), tuple(sorted(bcast)))
             compiled = _PROGRAM_CACHE.get(ckey)
-        import os as _os
-        verify = bool(_os.environ.get("ALINK_VERIFY_PROGRAM_CACHE"))
+        from ..common.metrics import env_flag
+        verify = env_flag("ALINK_VERIFY_PROGRAM_CACHE", default=False)
         if compiled is None:
             compiled = jax.jit(build_mapped())
             if ckey is not None:
+                cache_status = "miss"
                 _PROGRAM_CACHE_STATS["misses"] += 1
                 _PROGRAM_CACHE[ckey] = compiled
+                # the cached program's superstep closure writes into THIS
+                # manifest dict; store it so later cache-hit execs can
+                # read the per-superstep collective capture
+                _PROGRAM_CACHE_MANIFESTS[ckey] = manifest
                 if verify:
                     # baseline jaxpr recorded AT COMPILE TIME, so the very
                     # first post-compile drift is caught on the next hit
@@ -515,9 +580,14 @@ class IterativeComQueue:
                 while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
                     old_key, _ = _PROGRAM_CACHE.popitem(last=False)
                     _PROGRAM_CACHE_JAXPRS.pop(old_key, None)
+                    _PROGRAM_CACHE_MANIFESTS.pop(old_key, None)
         elif ckey is not None:
+            cache_status = "hit"
             _PROGRAM_CACHE_STATS["hits"] += 1
             _PROGRAM_CACHE.move_to_end(ckey)
+            # the cached closure traces into the manifest stored at miss
+            # time, not this exec's local dict — read from the stored one
+            manifest = _PROGRAM_CACHE_MANIFESTS.setdefault(ckey, manifest)
             if verify:
                 # debug mode: re-trace on every hit and compare jaxprs —
                 # catches any constant the structural guard cannot see
@@ -529,7 +599,12 @@ class IterativeComQueue:
                         f"{self._program_key!r} no longer matches a fresh "
                         "trace — a stage closure baked state the program_key "
                         "does not cover")
-        stacked = compiled(parts, bcast)
+        if mx and ckey is not None:
+            get_registry().inc("alink_comqueue_program_cache_total", 1,
+                               {"result": cache_status})
+        with _ENGINE_TIMER.span("comqueue.execute",
+                                labels={"program": cache_status}):
+            stacked = compiled(parts, bcast)
         if jax.process_count() > 1:
             # multi-host session: leaves span non-addressable devices —
             # gather every worker's shard to every host before fetching
@@ -544,6 +619,46 @@ class IterativeComQueue:
         # pull the whole carry (L-BFGS sk/yk ring buffers, per-row
         # margins, ...) through a slow host<->device link
         result = ComQueueResult(stacked, nw, totals)
+        if mx:
+            reg = get_registry()
+            # one scalar fetch; on deferred backends this flushes the run,
+            # which the caller's first result read would have done anyway
+            steps = int(result.step_count)
+            reg.inc("alink_comqueue_execs_total", 1)
+            reg.inc("alink_comqueue_supersteps_total", steps)
+            # this exec's trace signature, computed on the HOST inputs
+            # exactly as static_sig sees them inside shard_map: parts are
+            # split on the leading axis by the worker count, bcast is
+            # replicated unchanged
+            items = []
+            for k in sorted(set(parts) | set(bcast)):
+                split = nw if k in parts else 1
+                for leaf in jax.tree_util.tree_leaves(
+                        parts[k] if k in parts else bcast[k]):
+                    sh = tuple(map(int, leaf.shape))
+                    if split > 1 and sh:
+                        sh = (sh[0] // split,) + sh[1:]
+                    items.append((k, sh, str(leaf.dtype)))
+            per = manifest.get(tuple(items))
+            if per is None and len(manifest) == 1:
+                # defensive: a host/trace signature drift should not drop
+                # attribution when only one trace exists
+                per = next(iter(manifest.values()))
+            # the init pass executed once (superstep 1); the while-loop
+            # body executed the remaining steps-1 supersteps (the body is
+            # TRACED even for runs whose criterion stops at step 1, so it
+            # must not be charged for supersteps it never ran)
+            counts = []
+            if per is not None:
+                counts = ([(e, 1) for e in per["init"]]
+                          + [(e, steps - 1) for e in per["body"]])
+            for (kind, _buf, nbytes), times in counts:
+                if times <= 0:
+                    continue
+                lbl = {"collective": kind}
+                reg.inc("alink_collective_calls_total", times, lbl)
+                reg.inc("alink_collective_logical_bytes_total",
+                        times * nbytes, lbl)
         if self._close is not None:
             return self._close(result)
         return result

@@ -78,6 +78,10 @@ class ComContext:
         """Inline psum of a value pytree (communication/AllReduce.java:85-120
         for the common in-stage case; the stage-based ``AllReduce`` class
         remains for queue-structured use)."""
+        # late import: communication imports this module at load time
+        from .communication import payload_nbytes, record_collective
+        record_collective("InlineAllReduce", "<inline>",
+                          payload_nbytes(value), self._num_workers)
         return jax.tree_util.tree_map(
             lambda x: jax.lax.psum(x, self.AXIS), value)
 

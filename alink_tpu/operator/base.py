@@ -13,15 +13,53 @@ the lazy-callback contract (``lazy_print``/``lazy_collect`` firing at
 
 from __future__ import annotations
 
+import functools
+import time
 from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
 
+from ..common.metrics import get_registry, metrics_enabled
 from ..common.mlenv import MLEnvironment, MLEnvironmentFactory
 from ..common.mtable import MTable
 from ..common.params import Params, WithParams
 from ..common.types import TableSchema
 from ..params.shared import HasMLEnvironmentId
+
+
+def _meter_link_from(fn: Callable) -> Callable:
+    """Wrap a ``link_from`` implementation with batch-execute telemetry:
+    wall time (``alink_batch_op_seconds{op=...}``) and rows in/out
+    (``alink_batch_rows_{in,out}_total{op=...}``). Applied automatically
+    to every BatchOperator subclass via ``__init_subclass__`` — operators
+    compute eagerly at link time, so link_from IS the execute path.
+    Reentrant links on the same instance (subclass delegating to a base
+    link_from) record once, at the outermost frame."""
+
+    @functools.wraps(fn)
+    def metered(self, *inputs, **kwargs):
+        if not metrics_enabled() or getattr(self, "_in_metered_link", False):
+            return fn(self, *inputs, **kwargs)
+        self._in_metered_link = True
+        t0 = time.perf_counter()
+        try:
+            res = fn(self, *inputs, **kwargs)
+        finally:
+            self._in_metered_link = False
+        reg = get_registry()
+        lbl = {"op": type(self).__name__}
+        reg.observe("alink_batch_op_seconds", time.perf_counter() - t0, lbl)
+        rows_in = sum(t.num_rows for t in
+                      (getattr(i, "_output", None) for i in inputs)
+                      if t is not None)
+        reg.inc("alink_batch_rows_in_total", rows_in, lbl)
+        out = getattr(self, "_output", None)
+        if out is not None:
+            reg.inc("alink_batch_rows_out_total", out.num_rows, lbl)
+        return res
+
+    metered._alink_metered = True
+    return metered
 
 
 class AlgoOperator(WithParams, HasMLEnvironmentId):
@@ -68,6 +106,16 @@ class AlgoOperator(WithParams, HasMLEnvironmentId):
 
 class BatchOperator(AlgoOperator):
     """Batch operator with link semantics (reference batch/BatchOperator.java)."""
+
+    def __init_subclass__(cls, **kwargs):
+        # every subclass's link_from (the eager execute path) is metered;
+        # see _meter_link_from. Wrapping happens once per class at
+        # definition time, so per-call overhead is one env-flag check.
+        super().__init_subclass__(**kwargs)
+        lf = cls.__dict__.get("link_from")
+        if lf is not None and callable(lf) \
+                and not getattr(lf, "_alink_metered", False):
+            cls.link_from = _meter_link_from(lf)
 
     def link(self, next_op: "BatchOperator") -> "BatchOperator":
         return next_op.link_from(self)
@@ -318,6 +366,12 @@ class StreamOperator(AlgoOperator):
         streams = StreamOperator._session_streams
         StreamOperator._session_streams = []
         for s in streams:
+            mx = metrics_enabled()
+            lbl = {"op": type(s).__name__}
             for mt in prefetch(s.micro_batches()):
+                if mx:
+                    reg = get_registry()
+                    reg.inc("alink_stream_sink_batches_total", 1, lbl)
+                    reg.inc("alink_stream_sink_rows_total", mt.num_rows, lbl)
                 for sink in s._sinks:
                     sink(mt)

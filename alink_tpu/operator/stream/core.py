@@ -10,8 +10,10 @@ co-processing gives the reference's FtrlPredictStreamOp / windowed eval.
 from __future__ import annotations
 
 import heapq
+import time
 from typing import Callable, Iterable, Iterator, Optional, Tuple
 
+from ...common.metrics import get_registry, metrics_enabled
 from ...common.mtable import MTable
 from ...common.types import TableSchema
 from ..base import StreamOperator
@@ -69,12 +71,24 @@ class BaseStreamTransformOp(StreamOperator):
             worker = copy.copy(self)  # per-drain mutable state lives here
             opened = False
             last_t = 0.0
+            # per-drain telemetry: micro-batch count/rows and per-batch
+            # transform latency, labelled by op class. Resolved once per
+            # drain so the per-batch cost is one time.perf_counter pair.
+            mx = metrics_enabled()
+            reg = get_registry() if mx else None
+            lbl = {"op": type(self).__name__}
             for t, mt in in_op.timed_batches():
                 if not opened:
                     self._schema = worker._open(mt.schema)
                     opened = True
                 last_t = t
+                t0 = time.perf_counter()
                 out = worker._transform(mt)
+                if mx:
+                    reg.observe("alink_stream_batch_seconds",
+                                time.perf_counter() - t0, lbl)
+                    reg.inc("alink_stream_batches_total", 1, lbl)
+                    reg.inc("alink_stream_rows_total", mt.num_rows, lbl)
                 if out is STOP:
                     break
                 if out is not None and out.num_rows > 0:

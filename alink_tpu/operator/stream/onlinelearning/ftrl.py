@@ -26,10 +26,12 @@ feedback.
 from __future__ import annotations
 
 import functools
+import time
 from typing import List, Optional
 
 import numpy as np
 
+from ....common.metrics import get_registry, metrics_enabled
 from ....common.mtable import MTable
 from ....common.params import InValidator, ParamInfo, Params, RangeValidator
 from ....common.types import AlinkTypes, TableSchema
@@ -71,7 +73,7 @@ def _ftrl_step_factory(mesh, alpha, beta, l1, l2):
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ....common.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def weights(z, n):
@@ -119,7 +121,7 @@ def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2):
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ....common.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def weights(z, n):
@@ -221,7 +223,7 @@ def _ftrl_sparse_staleness_step_factory(mesh, alpha, beta, l1, l2, K):
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ....common.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def weights(z, n):
@@ -292,7 +294,7 @@ def _ftrl_sparse_batch_step_factory(mesh, alpha, beta, l1, l2):
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ....common.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def weights(z, n):
@@ -347,7 +349,7 @@ def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2,
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ....common.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     from ....ops.fieldblock import FieldBlockMeta, fb_gather, fb_rmatvec
@@ -406,7 +408,7 @@ def _ftrl_dense_batch_step_factory(mesh, alpha, beta, l1, l2):
     factory's docstring for semantics)."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
+    from ....common.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def weights(z, n):
@@ -715,7 +717,14 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
             fb_S = None
             fb_meta = None
             next_emit = None
+            # telemetry is per-micro-batch (HOST dispatch latency: device
+            # work is async, so the histogram reads as dispatch+encode
+            # pressure, not device time) — resolved once per drain
+            mx = metrics_enabled()
+            reg = get_registry() if mx else None
+            m_lbl = {"op": "FtrlTrainStreamOp", "mode": update_mode}
             for t, mt, enc, batch_size in prefetch(encoded_stream()):
+              t0 = time.perf_counter()
               if next_emit is None:
                   next_emit = (np.floor(t / interval) + 1) * interval
               if (layout == "fb" and (
@@ -776,8 +785,18 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                           sparse_step[0] = _ftrl_sparse_step_factory(
                               mesh, alpha, beta, l1, l2)
                   z, n, _ = sparse_step[0](idx, val, y, z, n)
+              if mx:
+                  reg.observe("alink_ftrl_batch_seconds",
+                              time.perf_counter() - t0, m_lbl)
+                  reg.inc("alink_ftrl_rows_total", mt.num_rows, m_lbl)
+                  reg.inc("alink_stream_batches_total", 1,
+                          {"op": "FtrlTrainStreamOp"})
+                  reg.inc("alink_stream_rows_total", mt.num_rows,
+                          {"op": "FtrlTrainStreamOp"})
               if t + 1e-12 >= next_emit:
                   yield (t, snapshot(z, n, fb_S))
+                  if mx:
+                      reg.inc("alink_ftrl_snapshots_total", 1)
                   while next_emit <= t + 1e-12:
                       next_emit += interval
             if z is None:
@@ -785,6 +804,8 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 # allocation used to
                 layout = "std"
                 z, n = alloc(layout)
+            if mx:
+                reg.inc("alink_ftrl_snapshots_total", 1)
             yield (next_emit if next_emit is not None else interval,
                    snapshot(z, n, fb_S))
 
@@ -819,10 +840,15 @@ class FtrlPredictStreamOp(StreamOperator, HasPredictionCol, HasPredictionDetailC
         def gen():
             mapper = None
             latest_model = None
+            last_model_t = None
+            mx = metrics_enabled()
+            reg = get_registry() if mx else None
+            lbl = {"op": "FtrlPredictStreamOp"}
             for t, which, mt in merge_timed(model_op.timed_batches(),
                                             data_op.timed_batches()):
                 if which == 0:     # model stream: hot swap
                     latest_model = mt
+                    last_model_t = t
                     mapper = None  # rebuild lazily against the data schema
                     continue
                 if mapper is None:
@@ -831,8 +857,20 @@ class FtrlPredictStreamOp(StreamOperator, HasPredictionCol, HasPredictionDetailC
                         if self._initial_model is None:
                             continue  # no model yet: drop (reference buffers)
                         model = self._initial_model.get_output_table()
+                    elif mx:
+                        # an actual hot swap (not the warm-start fallback)
+                        reg.inc("alink_ftrl_model_reloads_total", 1, lbl)
                     mapper = make_mapper(model, mt.schema)
                     self._schema = mapper.get_output_schema()
+                if mx:
+                    if last_model_t is not None:
+                        # event-time staleness of the serving model at this
+                        # data batch (the hot-reload lag the reference's
+                        # CollectModel swap hides)
+                        reg.set_gauge("alink_ftrl_model_staleness_seconds",
+                                      float(t - last_model_t), lbl)
+                    reg.inc("alink_stream_batches_total", 1, lbl)
+                    reg.inc("alink_stream_rows_total", mt.num_rows, lbl)
                 yield (t, mapper.map_table(mt))
 
         self._stream_fn = gen

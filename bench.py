@@ -1264,7 +1264,16 @@ def bench_als(h: Harness):
 
 # ---------------------------------------------------------------------------
 
-def main():
+def main(argv=None):
+    import argparse
+    ap = argparse.ArgumentParser(description="alink_tpu benchmark suite")
+    ap.add_argument("--metrics-out", default=None, metavar="PATH",
+                    help="dump the runtime MetricsRegistry (JSONL) to PATH "
+                         "after the suite and attach its snapshot to "
+                         "BENCH_full.json (default: off — existing BENCH "
+                         "json schemas are unchanged without the flag; "
+                         "render with tools/run_report.py)")
+    args = ap.parse_args(argv)
     h = Harness()
     workloads = {}
     for name, fn in (("logreg_criteo", bench_logreg),
@@ -1286,6 +1295,26 @@ def main():
         workloads[name] = r
         print(json.dumps({"workload": name, **r}), flush=True)
 
+    # runtime-emitted telemetry: the registry was filled by the engine /
+    # collective / stream instrumentation DURING the workloads above; with
+    # --metrics-out the JSONL dump is written for tools/run_report.py and
+    # the snapshot rides inside BENCH_full.json (opt-in, so the recorded
+    # BENCH_r*.json schema is unchanged when the flag is absent)
+    full_doc = {"workloads": workloads}
+    if args.metrics_out:
+        from alink_tpu.common.metrics import get_registry
+        try:
+            p = get_registry().dump(args.metrics_out)
+            full_doc["metrics_report"] = os.path.abspath(p)
+            # embed the DUMPED records (not a second snapshot), so the
+            # file and the BENCH_full.json copy can never disagree
+            with open(p) as f:
+                full_doc["metrics"] = [
+                    rec for rec in map(json.loads, f)
+                    if rec.get("kind") != "meta"]
+        except OSError as e:
+            full_doc["metrics_error"] = str(e)
+
     # full per-workload detail goes to a file (and was printed per-row
     # above); the FINAL stdout line must stay well under the driver's
     # 2000-byte tail buffer or it arrives head-truncated and unparseable
@@ -1294,7 +1323,7 @@ def main():
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "BENCH_full.json"), "w") as f:
-            json.dump({"workloads": workloads}, f)
+            json.dump(full_doc, f)
     except OSError:
         pass  # best-effort: per-row lines already carry the full detail
     flag = workloads["logreg_criteo"]

@@ -1,0 +1,465 @@
+"""Runtime telemetry subsystem (common/metrics.py) + instrumented hot paths.
+
+Covers the MetricsRegistry contract (counter/gauge/histogram semantics,
+label cardinality, JSONL round-trip, Prometheus rendering), the
+ALINK_TPU_METRICS=0 guard, StepTimer thread-safety + registry mirroring,
+and the end-to-end engine assertion: one IterativeComQueue.exec() records
+supersteps, per-collective traffic and program-cache hits, the dump renders
+through tools/run_report.py, and metrics add NO host callback to the
+compiled program.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.metrics import (DEFAULT_BUCKETS, MetricsRegistry,
+                                      env_flag, get_registry,
+                                      metrics_enabled, set_registry)
+from alink_tpu.common.profiling import StepTimer, step_log_enabled
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def fresh_registry():
+    """Isolate the process registry per test (engine/ops report into it)."""
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+class TestCounterGaugeHistogram:
+    def test_counter_accumulates(self):
+        reg = MetricsRegistry()
+        reg.inc("c", 1)
+        reg.inc("c", 2.5)
+        assert reg.value("c") == 3.5
+        # labelled series are independent
+        reg.inc("c", 7, {"k": "a"})
+        assert reg.value("c", {"k": "a"}) == 7
+        assert reg.value("c") == 3.5
+
+    def test_counter_rejects_negative(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.inc("c", -1)
+
+    def test_gauge_sets_last_value(self):
+        reg = MetricsRegistry()
+        reg.set_gauge("g", 5)
+        reg.set_gauge("g", 2)
+        assert reg.value("g") == 2
+
+    def test_kind_conflict_fails_loudly(self):
+        reg = MetricsRegistry()
+        reg.inc("m")
+        with pytest.raises(TypeError):
+            reg.set_gauge("m", 1)
+        with pytest.raises(TypeError):
+            reg.observe("m", 1.0)
+
+    def test_histogram_buckets_cumulative_semantics(self):
+        reg = MetricsRegistry()
+        fam = reg.histogram("h", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.1, 0.5, 5.0, 50.0):
+            fam.observe(v)
+        (labels, s), = fam.series()
+        assert labels == {}
+        # le=0.1 gets 0.05 AND the boundary value 0.1; +Inf gets 50.0
+        assert s.counts == [2, 1, 1, 1]
+        assert s.count == 5 and abs(s.sum - 55.65) < 1e-9
+
+    def test_histogram_bucket_validation(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError):
+            reg.histogram("bad", buckets=(1.0, 0.5))
+        reg.histogram("h2", buckets=(1.0, 2.0))
+        with pytest.raises(ValueError):  # conflicting re-registration
+            reg.histogram("h2", buckets=(1.0, 3.0))
+
+    def test_value_reads_never_create_series(self):
+        reg = MetricsRegistry()
+        assert reg.value("missing", {"a": "b"}) == 0.0
+        assert reg.snapshot() == []
+
+
+class TestLabelCardinality:
+    def test_distinct_label_sets_distinct_series(self):
+        reg = MetricsRegistry()
+        reg.inc("c", 1, {"op": "A"})
+        reg.inc("c", 2, {"op": "B"})
+        reg.inc("c", 3, {"op": "A", "x": "1"})
+        got = {tuple(sorted(l.items())): s.value
+               for l, s in reg.counter("c").series()}
+        assert got == {(("op", "A"),): 1, (("op", "B"),): 2,
+                       (("op", "A"), ("x", "1")): 3}
+
+    def test_cardinality_cap_folds_into_overflow(self):
+        reg = MetricsRegistry(max_series_per_metric=4)
+        for i in range(10):
+            reg.inc("c", 1, {"id": str(i)})  # an id leaking into a label
+        fam = reg.counter("c")
+        series = fam.series()
+        assert len(series) == 5  # 4 real + 1 overflow
+        assert reg.value("c", {"alink_overflow": "true"}) == 6
+        assert reg._dropped_series == 6
+
+
+class TestExporters:
+    def _populated(self):
+        reg = MetricsRegistry()
+        reg.inc("alink_requests_total", 3, {"route": "/fit"})
+        reg.set_gauge("alink_depth", 2.5)
+        reg.observe("alink_latency_seconds", 0.02, {"op": "X"},
+                    buckets=(0.01, 0.1))
+        reg.observe("alink_latency_seconds", 0.5, {"op": "X"})
+        return reg
+
+    def test_jsonl_round_trip(self, tmp_path):
+        reg = self._populated()
+        p = reg.dump(str(tmp_path / "run.jsonl"))
+        # every line is one JSON object; first is the meta record
+        lines = [json.loads(l) for l in open(p) if l.strip()]
+        assert lines[0]["kind"] == "meta"
+        assert lines[0]["format"] == "alink_tpu_metrics_v1"
+        loaded = MetricsRegistry.load(p)
+        assert loaded.snapshot() == reg.snapshot()
+        # and a dump of the loaded registry is identical content
+        p2 = loaded.dump(str(tmp_path / "run2.jsonl"))
+        assert ([json.loads(l) for l in open(p2)][1:]
+                == [json.loads(l) for l in open(p)][1:])
+
+    def test_prometheus_text(self):
+        reg = self._populated()
+        txt = reg.render_text()
+        assert '# TYPE alink_requests_total counter' in txt
+        assert 'alink_requests_total{route="/fit"} 3.0' in txt
+        assert '# TYPE alink_depth gauge' in txt
+        assert 'alink_depth 2.5' in txt
+        # histogram: cumulative buckets + implicit +Inf + sum/count
+        assert 'alink_latency_seconds_bucket{op="X",le="0.01"} 0' in txt
+        assert 'alink_latency_seconds_bucket{op="X",le="0.1"} 1' in txt
+        assert 'alink_latency_seconds_bucket{op="X",le="+Inf"} 2' in txt
+        assert 'alink_latency_seconds_count{op="X"} 2' in txt
+
+    def test_prometheus_label_escaping(self):
+        reg = MetricsRegistry()
+        reg.inc("c", 1, {"q": 'say "hi"\nthere'})
+        txt = reg.render_text()
+        assert r'q="say \"hi\"\nthere"' in txt
+
+
+# ---------------------------------------------------------------------------
+# env flags + StepTimer
+# ---------------------------------------------------------------------------
+
+class TestEnvFlags:
+    @pytest.mark.parametrize("val,expect", [
+        ("0", False), ("false", False), ("False", False), ("off", False),
+        ("OFF", False), ("no", False), ("", False),
+        ("1", True), ("true", True), ("on", True), ("anything", True)])
+    def test_step_log_flag_parsing(self, monkeypatch, val, expect):
+        monkeypatch.setenv("ALINK_TPU_STEP_LOG", val)
+        assert step_log_enabled() is expect
+
+    def test_step_log_default_off(self, monkeypatch):
+        monkeypatch.delenv("ALINK_TPU_STEP_LOG", raising=False)
+        assert step_log_enabled() is False
+
+    def test_metrics_default_on_and_disable(self, monkeypatch):
+        monkeypatch.delenv("ALINK_TPU_METRICS", raising=False)
+        assert metrics_enabled() is True
+        for off in ("0", "false", "off"):
+            monkeypatch.setenv("ALINK_TPU_METRICS", off)
+            assert metrics_enabled() is False
+
+    def test_env_flag_default(self, monkeypatch):
+        monkeypatch.delenv("ALINK_X", raising=False)
+        assert env_flag("ALINK_X", default=True) is True
+        assert env_flag("ALINK_X", default=False) is False
+
+
+class TestStepTimer:
+    def test_thread_safe_concurrent_spans(self, fresh_registry):
+        t = StepTimer()
+        n_threads, n_spans = 8, 200
+
+        def work(i):
+            for _ in range(n_spans):
+                with t.span("shared"):
+                    pass
+                with t.span(f"own{i}"):
+                    pass
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        rows = {name: count for name, count, _, _ in t.report()}
+        assert rows["shared"] == n_threads * n_spans
+        for i in range(n_threads):
+            assert rows[f"own{i}"] == n_spans
+        # and the registry mirror saw every span exit
+        fam = fresh_registry.histogram(StepTimer.METRIC)
+        total = sum(s.count for _, s in fam.series())
+        assert total == 2 * n_threads * n_spans
+
+    def test_span_labels_passthrough(self, fresh_registry):
+        t = StepTimer()
+        with t.span("fit", labels={"algo": "kmeans"}):
+            pass
+        fam = fresh_registry.histogram(StepTimer.METRIC)
+        (labels, s), = fam.series()
+        assert labels == {"span": "fit", "algo": "kmeans"} and s.count == 1
+
+    def test_mirror_respects_metrics_guard(self, fresh_registry, monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_METRICS", "0")
+        t = StepTimer()
+        with t.span("fit"):
+            pass
+        assert t.report()[0][1] == 1          # host timer still accumulates
+        assert fresh_registry.snapshot() == []  # registry untouched
+
+    def test_mirror_off(self, fresh_registry):
+        t = StepTimer(mirror=False)
+        with t.span("fit"):
+            pass
+        assert fresh_registry.snapshot() == []
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the instrumented engine
+# ---------------------------------------------------------------------------
+
+def _make_queue(key=None, max_iter=4):
+    import jax.numpy as jnp
+
+    from alink_tpu.engine.communication import AllReduce
+    from alink_tpu.engine.comqueue import IterativeComQueue
+
+    X = np.arange(64.0).reshape(32, 2)
+
+    def stage(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("s", jnp.zeros(()))
+        ctx.put_obj("s", ctx.get_obj("X").sum())
+
+    q = (IterativeComQueue(max_iter=max_iter)
+         .init_with_partitioned_data("X", X)
+         .add(stage)
+         .add(AllReduce("s")))
+    if key is not None:
+        q.set_program_key(key)
+    return q
+
+
+class TestEngineTelemetry:
+    def test_exec_records_supersteps_collectives_and_cache(
+            self, fresh_registry, tmp_path):
+        reg = fresh_registry
+        key = ("test_metrics_e2e", os.urandom(6).hex())
+        q = _make_queue(key=key, max_iter=4)
+        r = q.exec()
+        steps = r.step_count
+        assert steps == 4
+        assert reg.value("alink_comqueue_execs_total") == 1
+        assert reg.value("alink_comqueue_supersteps_total") == steps
+        assert reg.value("alink_comqueue_program_cache_total",
+                         {"result": "miss"}) == 1
+        # one AllReduce per superstep; logical bytes = scalar payload
+        # summed over the 8 workers, per superstep
+        ar = {"collective": "AllReduce"}
+        assert reg.value("alink_collective_calls_total", ar) == steps
+        itemsize = np.asarray(r.get("s")).dtype.itemsize
+        assert reg.value("alink_collective_logical_bytes_total", ar) \
+            == steps * 8 * itemsize
+
+        # re-exec: program-cache HIT, and the cached program's collective
+        # manifest still attributes traffic (nothing is re-traced)
+        q2 = _make_queue(key=key, max_iter=4)
+        q2.exec()
+        assert reg.value("alink_comqueue_program_cache_total",
+                         {"result": "hit"}) == 1
+        assert reg.value("alink_collective_calls_total", ar) == 2 * steps
+        assert reg.value("alink_comqueue_execs_total") == 2
+        assert reg.value("alink_comqueue_supersteps_total") == 2 * steps
+
+        # per-stage wall time (StepTimer spans mirrored into the registry)
+        fam = reg.histogram(StepTimer.METRIC)
+        spans = {l.get("span") for l, _ in fam.series()}
+        assert "comqueue.execute" in spans and "comqueue.prepare" in spans
+
+        # the dump is a complete run report: JSONL with supersteps,
+        # collective bytes, cache hits and stage wall time all present
+        p = reg.dump(str(tmp_path / "run.jsonl"))
+        names = {json.loads(l)["name"] for l in open(p)
+                 if json.loads(l).get("kind") != "meta"}
+        assert {"alink_comqueue_supersteps_total",
+                "alink_collective_calls_total",
+                "alink_collective_logical_bytes_total",
+                "alink_comqueue_program_cache_total",
+                StepTimer.METRIC} <= names
+
+    def test_init_only_collective_charged_once(self, fresh_registry):
+        """A collective that runs only on the init pass (the reference
+        stepNo==1 idiom) executes once per run — not once per superstep;
+        a body collective executes steps-1 times plus the init pass."""
+        import jax.numpy as jnp
+
+        from alink_tpu.engine.comqueue import IterativeComQueue
+
+        def stage(ctx):
+            X = ctx.get_obj("X")
+            if ctx.is_init_step:
+                ctx.put_obj("init_sum", ctx.all_reduce_sum(X.sum()))
+                ctx.put_obj("s", jnp.zeros(()))
+            ctx.put_obj("s", X.sum())
+
+        q = (IterativeComQueue(max_iter=5)
+             .init_with_partitioned_data("X", np.ones((16, 2))).add(stage))
+        r = q.exec()
+        assert r.step_count == 5
+        assert fresh_registry.value("alink_collective_calls_total",
+                                    {"collective": "InlineAllReduce"}) == 1
+
+    def test_cached_program_attribution_tracks_shapes(self, fresh_registry):
+        """One cached program serves several traced shapes; each exec's
+        collective bytes must come from ITS shape's manifest, including
+        when jit reuses an earlier trace on a later cache hit."""
+        reg = fresh_registry
+        key = ("test_metrics_shapes", os.urandom(6).hex())
+        ar = {"collective": "AllReduce"}
+
+        def run(rows):
+            from alink_tpu.engine.communication import AllReduce
+            from alink_tpu.engine.comqueue import IterativeComQueue
+
+            def stage(ctx):
+                X = ctx.get_obj("X")
+                # per-row payload: the AllReduce bytes SCALE with the
+                # input shape, so stale-manifest attribution would show
+                ctx.put_obj("v", X.sum(1))
+
+            return (IterativeComQueue(max_iter=2)
+                    .init_with_partitioned_data("X", np.ones((rows, 2)))
+                    .add(stage).add(AllReduce("v"))
+                    .set_program_key(key).exec())
+
+        itemsize = np.asarray(run(64).get("v")).dtype.itemsize
+
+        def expect(rows):                      # 2 supersteps x 8 workers
+            return 2 * 8 * (rows // 8) * itemsize
+
+        b1 = reg.value("alink_collective_logical_bytes_total", ar)
+        assert b1 == expect(64)
+        run(128)                               # cache hit, NEW trace
+        b2 = reg.value("alink_collective_logical_bytes_total", ar)
+        assert b2 - b1 == expect(128)
+        run(64)                                # cache hit, REUSED old trace
+        b3 = reg.value("alink_collective_logical_bytes_total", ar)
+        assert b3 - b2 == expect(64)
+        assert reg.value("alink_comqueue_program_cache_total",
+                         {"result": "hit"}) == 2
+
+    def test_run_report_renders_dump(self, fresh_registry, tmp_path, capsys):
+        key = ("test_metrics_report", os.urandom(6).hex())
+        _make_queue(key=key).exec()
+        p = fresh_registry.dump(str(tmp_path / "run.jsonl"))
+
+        spec = importlib.util.spec_from_file_location(
+            "run_report", os.path.join(ROOT, "tools", "run_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        assert mod.main([p]) == 0
+        out = capsys.readouterr().out
+        assert "Run summary" in out and "AllReduce" in out
+        assert "supersteps" in out and "comqueue.execute" in out
+        assert mod.main([p, "--prom"]) == 0
+        assert "# TYPE alink_comqueue_supersteps_total counter" \
+            in capsys.readouterr().out
+
+    def test_metrics_disabled_skips_registry_updates(
+            self, fresh_registry, monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_METRICS", "0")
+        r = _make_queue().exec()
+        assert r.step_count == 4          # the run itself is unaffected
+        assert fresh_registry.snapshot() == []
+
+    def test_no_host_callback_in_lowered_hlo(self, fresh_registry,
+                                             monkeypatch):
+        """Metrics-on must not change the compiled program: collective
+        accounting happens at trace time on the host, so the lowered HLO
+        contains no callback custom-calls."""
+        monkeypatch.setenv("ALINK_TPU_METRICS", "1")
+        monkeypatch.delenv("ALINK_TPU_STEP_LOG", raising=False)
+        txt = _make_queue().lowered().as_text().lower()
+        assert "callback" not in txt
+        assert "outfeed" not in txt
+
+
+# ---------------------------------------------------------------------------
+# instrumented operator layers
+# ---------------------------------------------------------------------------
+
+class TestOperatorTelemetry:
+    def test_batch_link_records_time_and_rows(self, fresh_registry):
+        from alink_tpu.common.mtable import MTable
+        from alink_tpu.operator.base import BatchOperator
+        from alink_tpu.operator.batch.sql import SelectBatchOp
+
+        src = BatchOperator.from_table(
+            MTable({"a": np.arange(10.0), "b": np.arange(10.0)}))
+        out = SelectBatchOp(clause="a").link_from(src)
+        assert out.get_output_table().num_rows == 10
+        reg = fresh_registry
+        lbl = {"op": "SelectBatchOp"}
+        assert reg.value("alink_batch_rows_in_total", lbl) == 10
+        assert reg.value("alink_batch_rows_out_total", lbl) == 10
+        fam = reg.histogram("alink_batch_op_seconds")
+        assert any(l == lbl and s.count == 1 for l, s in fam.series())
+
+    def test_stream_transform_records_batches(self, fresh_registry):
+        from alink_tpu.common.mtable import MTable
+        from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+        from alink_tpu.operator.stream.sql import SelectStreamOp
+
+        n, bs = 40, 8
+        src = MemSourceStreamOp(MTable({"a": np.arange(float(n)),
+                                        "b": np.arange(float(n))}),
+                                batch_size=bs)
+        sel = SelectStreamOp(clause="a").link_from(src)
+        total = sum(mt.num_rows for mt in sel.micro_batches())
+        assert total == n
+        reg = fresh_registry
+        lbl = {"op": "SelectStreamOp"}
+        assert reg.value("alink_stream_batches_total", lbl) == n // bs
+        assert reg.value("alink_stream_rows_total", lbl) == n
+        fam = reg.histogram("alink_stream_batch_seconds")
+        assert any(l == lbl and s.count == n // bs for l, s in fam.series())
+
+    def test_operator_paths_respect_guard(self, fresh_registry, monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_METRICS", "off")
+        from alink_tpu.common.mtable import MTable
+        from alink_tpu.operator.base import BatchOperator
+        from alink_tpu.operator.batch.sql import SelectBatchOp
+        from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+        from alink_tpu.operator.stream.sql import SelectStreamOp
+
+        src = BatchOperator.from_table(MTable({"a": np.arange(4.0)}))
+        SelectBatchOp(clause="a").link_from(src)
+        s = SelectStreamOp(clause="a").link_from(
+            MemSourceStreamOp(MTable({"a": np.arange(4.0)}), batch_size=2))
+        assert sum(mt.num_rows for mt in s.micro_batches()) == 4
+        assert fresh_registry.snapshot() == []

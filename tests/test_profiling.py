@@ -53,7 +53,8 @@ class TestNamedStage:
                 y = jnp.tanh(x) * 2.0
             return y
 
-        txt = jax.jit(f).lower(jnp.ones(8)).as_text(debug_info=True)
+        from alink_tpu.common.compat import lowered_text
+        txt = lowered_text(jax.jit(f).lower(jnp.ones(8)), debug_info=True)
         assert "CalcGradientStage" in txt
 
     def test_engine_stages_are_named(self):

@@ -307,7 +307,7 @@ env = use_remote_env(coordinator_address=coordinator, num_processes=nproc,
                      process_id=pid, parallelism=nproc)
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from alink_tpu.common.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 @jax.jit
