@@ -1,0 +1,114 @@
+"""Deterministic fault injection — kill a run at a named site, on purpose.
+
+The reference inherits chaos testing for free from Flink's checkpointing
+integration tests (TaskManager kills mid-job, the job restarts from the
+last completed checkpoint). The TPU build has no cluster to kill, so
+faults are injected *in process*: durability hot paths call
+``maybe_crash(site, index)`` at the exact points where a preemption would
+be survivable — a ComQueue superstep boundary, an FTRL micro-batch
+boundary — and the hook raises :class:`FaultInjected` once the configured
+index is reached.
+
+Configuration rides in one env var so tests (and operators reproducing a
+field failure) need no code changes::
+
+    ALINK_TPU_FAULT_INJECT="comqueue.superstep:9"        # one site
+    ALINK_TPU_FAULT_INJECT="ftrl.batch:5;ckpt.save:2"    # several sites
+
+Each entry is ``site:index``; the hook fires at the FIRST call whose
+``index >= configured`` for that site, which makes the kill deterministic
+even when the site is only visited at coarser granularity than the index
+(a superstep boundary every N steps). Sites are plain dotted strings;
+current producers:
+
+  * ``comqueue.superstep``  — superstep boundary (engine/recovery.py),
+    index = 1-based superstep number;
+  * ``ftrl.batch``          — after an FTRL micro-batch commits
+    (operator/stream/onlinelearning/ftrl.py), index = 1-based batch count;
+  * ``ckpt.save``           — just before a checkpoint directory is
+    published (common/checkpoint.py), index = 1-based save count per
+    process — proves half-written snapshots are never visible.
+
+The env var is re-read on every call (monkeypatch-friendly); parsing is
+cached per raw string so the hot-path cost is one dict lookup.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+__all__ = ["FAULT_ENV", "FaultInjected", "fault_spec", "faults_armed",
+           "maybe_crash"]
+
+FAULT_ENV = "ALINK_TPU_FAULT_INJECT"
+
+
+class FaultInjected(RuntimeError):
+    """Raised by :func:`maybe_crash` — the injected 'process kill'.
+
+    Deliberately NOT a subclass of any alink error type: durability code
+    must not be able to catch it by accident in a generic handler.
+    """
+
+    def __init__(self, site: str, index: int, threshold: int):
+        super().__init__(
+            f"fault injected at {site}:{index} "
+            f"({FAULT_ENV} threshold {threshold})")
+        self.site = site
+        self.index = index
+        self.threshold = threshold
+
+
+# parse cache: raw env string -> {site: threshold}; the env var is read
+# fresh each call but identical strings parse once
+_PARSED: Dict[str, Dict[str, int]] = {}
+
+# per-process visit counters for sites whose callers do not track an
+# index themselves (``maybe_crash(site)`` with index=None)
+_AUTO_INDEX: Dict[str, int] = {}
+
+
+def _parse(raw: str) -> Dict[str, int]:
+    spec = _PARSED.get(raw)
+    if spec is None:
+        spec = {}
+        for entry in raw.replace(",", ";").split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            site, sep, idx = entry.rpartition(":")
+            if not sep or not site:
+                raise ValueError(
+                    f"{FAULT_ENV}: malformed entry {entry!r} "
+                    f"(want site:index)")
+            spec[site.strip()] = int(idx)
+        if len(_PARSED) > 64:   # bound the cache; specs are few in practice
+            _PARSED.clear()
+        _PARSED[raw] = spec
+    return spec
+
+
+def fault_spec() -> Dict[str, int]:
+    """The active {site: threshold} map (empty when unset)."""
+    raw = os.environ.get(FAULT_ENV)
+    return _parse(raw) if raw else {}
+
+
+def faults_armed() -> bool:
+    return bool(fault_spec())
+
+
+def maybe_crash(site: str, index: Optional[int] = None) -> None:
+    """Raise :class:`FaultInjected` if ``site`` is armed and ``index`` has
+    reached its threshold. With ``index=None`` a per-process visit counter
+    for the site is used (1-based)."""
+    spec = fault_spec()
+    if not spec:
+        return
+    if index is None:
+        index = _AUTO_INDEX.get(site, 0) + 1
+        _AUTO_INDEX[site] = index
+    threshold = spec.get(site)
+    if threshold is not None and index >= threshold:
+        raise FaultInjected(site, int(index), threshold)

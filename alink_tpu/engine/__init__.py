@@ -3,9 +3,10 @@ from .comqueue import IterativeComQueue, ComputeFunction, ComQueueResult
 from .communication import (AllReduce, AllGather, BroadcastFromWorker0,
                             CommunicateFunction, distributed_info_start,
                             distributed_info_count)
+from .recovery import CheckpointConfig
 
 __all__ = [
     "ComContext", "IterativeComQueue", "ComputeFunction", "ComQueueResult",
     "AllReduce", "AllGather", "BroadcastFromWorker0", "CommunicateFunction",
-    "distributed_info_start", "distributed_info_count",
+    "distributed_info_start", "distributed_info_count", "CheckpointConfig",
 ]

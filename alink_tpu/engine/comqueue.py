@@ -32,6 +32,7 @@ Contract notes:
 
 from __future__ import annotations
 
+import weakref
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -226,21 +227,67 @@ def _callable_digest(fn, depth=4):
             h.update(c.co_code)
         else:
             h.update(type(c).__name__.encode())
-    cells = ()
+    defaults = ()
+    if fn.__defaults__ or getattr(fn, "__kwdefaults__", None):
+        # default-arg values bake into the trace exactly like closure
+        # cells do (the `def stage(ctx, scale=scale)` idiom); they must
+        # ride in the digest or two structurally-different programs
+        # would collide
+        defaults = (_freeze_closure_value(fn.__defaults__, depth),
+                    _freeze_closure_value(fn.__kwdefaults__, depth))
+    cells = []
     if fn.__closure__:
-        cells = tuple(
-            (name, _freeze_closure_value(cell.cell_contents, depth))
-            for name, cell in zip(code.co_freevars, fn.__closure__))
-    return (code.co_name, h.hexdigest(), cells)
+        for name, cell in zip(code.co_freevars, fn.__closure__):
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                # unbound cell (a closure var referenced before assignment,
+                # e.g. a self-referential recursive fn being built): the
+                # digest must be TOTAL, so degrade to an opaque token
+                cells.append((name, ("opaque", "unbound_cell")))
+                continue
+            cells.append((name, _freeze_closure_value(v, depth)))
+    return (code.co_name, h.hexdigest(), tuple(cells), defaults)
+
+
+# stage object -> digest. Digesting re-hashes every closure cell (data
+# arrays included), so repeated exec() on the same queue object paid the
+# full walk per cache HIT. Keyed on the stage OBJECT: a stage's closure
+# contents are frozen at construction by the set_program_key contract
+# (data flows through partitioned/broadcast inputs, never closures), so
+# object identity implies digest identity.
+_STAGE_DIGEST_MEMO: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _memo_digest(obj, compute):
+    from ..common.metrics import env_flag
+    if env_flag("ALINK_VERIFY_PROGRAM_CACHE", default=False):
+        # debug mode bypasses the memo: a stage whose closure contents
+        # mutated after its first exec (violating the identity contract
+        # above) re-hashes fresh, so the jaxpr-compare guard downstream
+        # sees the drifted key instead of a stale memo hiding it
+        return compute()
+    try:
+        d = _STAGE_DIGEST_MEMO.get(obj)
+    except TypeError:       # not weakref-able: compute every time
+        return compute()
+    if d is None:
+        d = compute()
+        try:
+            _STAGE_DIGEST_MEMO[obj] = d
+        except TypeError:
+            pass
+    return d
 
 
 def _stages_digest(stages, criterion) -> tuple:
     items = []
     for s in stages:
-        fn = s.fn if isinstance(s, _FnStage) else s.calc
-        items.append(_callable_digest(fn))
+        items.append(_memo_digest(s, lambda s=s: _callable_digest(
+            s.fn if isinstance(s, _FnStage) else s.calc)))
     if criterion is not None:
-        items.append(_callable_digest(criterion))
+        items.append(_memo_digest(criterion,
+                                  lambda: _callable_digest(criterion)))
     return tuple(items)
 
 
@@ -279,8 +326,22 @@ class _FnStage(ComputeFunction):
         self.fn(context)
 
 
+def _readonly(arr: np.ndarray) -> np.ndarray:
+    """Flip a host array read-only. Fetched results are MEMOIZED and
+    shared between shards()/get()/concat() callers — a caller writing into
+    one would silently corrupt every later read, so the memo only ever
+    hands out non-writeable arrays (mutators get a loud ValueError and
+    must copy)."""
+    arr.flags.writeable = False
+    return arr
+
+
 class ComQueueResult:
-    """Final per-worker state, stacked on a leading worker axis."""
+    """Final per-worker state, stacked on a leading worker axis.
+
+    Host arrays returned by ``shards()``/``get()`` are read-only views of
+    a per-name memo; ``np.array(...)`` them to get a private writable
+    copy."""
 
     def __init__(self, stacked: Dict[str, Any], num_workers: int,
                  totals: Dict[str, int]):
@@ -290,18 +351,19 @@ class ComQueueResult:
         self._fetched: Dict[tuple, Any] = {}
 
     def shards(self, name: str):
-        """(num_workers, ...) stacked per-worker values."""
+        """(num_workers, ...) stacked per-worker values (read-only)."""
         import jax
         if name not in self._stacked:
             raise KeyError(f"no carry object '{name}'; have {sorted(self._stacked)}")
         got = self._fetched.get(("shards", name))
         if got is None:
             got = self._fetched[("shards", name)] = jax.tree_util.tree_map(
-                np.asarray, self._stacked[name])
+                lambda x: _readonly(np.asarray(x)), self._stacked[name])
         return got
 
     def get(self, name: str):
-        """Worker 0's copy — use for replicated (post-allreduce) state.
+        """Worker 0's copy (read-only) — use for replicated
+        (post-allreduce) state.
 
         Slices BEFORE fetching (x[0] on device): fetching the full
         (num_workers, ...) stack and discarding all but shard 0 on host
@@ -311,12 +373,18 @@ class ComQueueResult:
         import jax
         got = self._fetched.get(("get", name))
         if got is None:
+            # memo first: after release() a get()-only name serves from
+            # its memo even though the stacked entry is gone
+            if name not in self._stacked:
+                raise KeyError(f"no carry object '{name}'; "
+                               f"have {sorted(self._stacked)}")
             full = self._fetched.get(("shards", name))
             if full is not None:  # already on host: slice locally
                 got = jax.tree_util.tree_map(lambda x: x[0], full)
             else:
-                got = jax.tree_util.tree_map(lambda x: np.asarray(x[0]),
-                                             self._stacked[name])
+                got = jax.tree_util.tree_map(
+                    lambda x: _readonly(np.asarray(x[0])),
+                    self._stacked[name])
             self._fetched[("get", name)] = got
         return got
 
@@ -362,7 +430,9 @@ class ComQueueResult:
 
 class IterativeComQueue:
     def __init__(self, env: Optional[MLEnvironment] = None, max_iter: int = 100,
-                 seed: int = 0):
+                 seed: int = 0, checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1, checkpoint_keep: int = 3,
+                 resume_from: Optional[str] = None):
         self.env = env
         self.max_iter = max_iter
         self.seed = seed
@@ -372,14 +442,26 @@ class IterativeComQueue:
         self._criterion: Optional[Callable[[ComContext], Any]] = None
         self._close: Optional[Callable[[ComQueueResult], Any]] = None
         self._program_key: Optional[tuple] = None
+        self._ckpt = None
+        self._data_token = None   # checkpoint-signature memo (see _run)
+        if checkpoint_dir is not None:
+            self.set_checkpoint(checkpoint_dir, every=checkpoint_every,
+                                keep_last=checkpoint_keep,
+                                resume_from=resume_from)
+        elif resume_from is not None:
+            raise ValueError("resume_from= requires checkpoint_dir= "
+                             "(an explicit resume request must not "
+                             "silently retrain from scratch)")
 
     # -- builder API (mirrors BaseComQueue.java:75-148) -------------------
     def init_with_partitioned_data(self, name: str, data) -> "IterativeComQueue":
         self._partitioned[name] = data
+        self._data_token = None
         return self
 
     def init_with_broadcast_data(self, name: str, data) -> "IterativeComQueue":
         self._broadcast[name] = data
+        self._data_token = None
         return self
 
     def add(self, stage) -> "IterativeComQueue":
@@ -414,6 +496,23 @@ class IterativeComQueue:
         self._program_key = key
         return self
 
+    def set_checkpoint(self, directory: str, every: int = 1,
+                       keep_last: int = 3,
+                       resume_from: Optional[str] = None
+                       ) -> "IterativeComQueue":
+        """Persist the superstep carry every ``every`` supersteps (and at
+        the final state) under ``directory`` — durable, checksummed,
+        atomically published snapshots (common/checkpoint.py), fetched
+        to host OUTSIDE the compiled program. ``resume_from=`` restarts
+        a killed run from its newest valid snapshot with bitwise-
+        identical final results (engine/recovery.py)."""
+        from .recovery import CheckpointConfig
+        self._ckpt = CheckpointConfig(directory=str(directory),
+                                      every=int(every),
+                                      keep_last=int(keep_last),
+                                      resume_from=resume_from)
+        return self
+
     # -- execution --------------------------------------------------------
     def lowered(self):
         """Lower (but do not run) the whole-superstep SPMD program;
@@ -422,10 +521,17 @@ class IterativeComQueue:
         shapes from it (tools/scaling_evidence.py)."""
         return self._run(lower_only=True)
 
+    def lowered_chunked(self):
+        """Lower the CHECKPOINT-mode chunk programs; returns
+        ``(first, cont)`` jax.stages.Lowered. The durability test asserts
+        these carry no host callbacks and exactly the collectives of the
+        unchunked program — checkpointing adds zero compiled ops."""
+        return self._run(lower_only=True, lower_chunked=True)
+
     def exec(self):
         return self._run(lower_only=False)
 
-    def _run(self, lower_only: bool = False):
+    def _run(self, lower_only: bool = False, lower_chunked: bool = False):
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
@@ -544,24 +650,146 @@ class IterativeComQueue:
             return shard_map(run, mesh=mesh, in_specs=(P("d"), P()),
                              out_specs=P("d"), check_vma=False)
 
+        # -- checkpoint-mode chunk programs -------------------------------
+        # The SAME superstep body, but the loop's upper bound is a TRACED
+        # scalar: one compiled program serves every chunk between
+        # checkpoint boundaries, and the host persists the carry between
+        # chunk calls (engine/recovery.py). ``first`` runs the init pass;
+        # ``cont`` re-enters with a (possibly disk-round-tripped) stacked
+        # carry.
+        def chunk_body_cond(static, limit):
+            def body(c):
+                c = dict(c)
+                c["__step"] = c["__step"] + 1
+                return superstep(c, static, init_pass=False)
+
+            def cond(c):
+                return ((c["__step"] < limit) & (c["__step"] < max_iter)
+                        & jnp.logical_not(c["__stop"]))
+            return body, cond
+
+        def build_first_chunk():
+            def run_first(parts_shard, bcast_rep, limit):
+                static = {**parts_shard, **bcast_rep}
+                carry = {"__step": jnp.asarray(1, jnp.int32),
+                         "__key": jax.random.PRNGKey(seed)}
+                carry = superstep(carry, static, init_pass=True)
+                body, cond = chunk_body_cond(static, limit)
+                final = jax.lax.while_loop(cond, body, carry) \
+                    if max_iter > 1 else carry
+                return jax.tree_util.tree_map(
+                    lambda x: jnp.expand_dims(x, 0), final)
+            return shard_map(run_first, mesh=mesh,
+                             in_specs=(P("d"), P(), P()),
+                             out_specs=P("d"), check_vma=False)
+
+        def build_cont_chunk():
+            def run_cont(parts_shard, bcast_rep, carry_stacked, limit):
+                static = {**parts_shard, **bcast_rep}
+                carry = jax.tree_util.tree_map(
+                    lambda x: jnp.squeeze(x, 0), dict(carry_stacked))
+                body, cond = chunk_body_cond(static, limit)
+                final = jax.lax.while_loop(cond, body, carry)
+                return jax.tree_util.tree_map(
+                    lambda x: jnp.expand_dims(x, 0), final)
+            return shard_map(run_cont, mesh=mesh,
+                             in_specs=(P("d"), P(), P("d"), P()),
+                             out_specs=P("d"), check_vma=False)
+
         if lower_only:
-            return jax.jit(build_mapped()).lower(parts, bcast)
+            if not lower_chunked:
+                return jax.jit(build_mapped()).lower(parts, bcast)
+            lim = jnp.asarray(max_iter, jnp.int32)
+            first_fn = jax.jit(build_first_chunk())
+            first_low = first_fn.lower(parts, bcast, lim)
+            # the cont program's carry geometry comes from the first
+            # program's abstract output — no execution, no compile
+            carry_shape = jax.eval_shape(first_fn, parts, bcast, lim)
+            cont_low = jax.jit(build_cont_chunk()).lower(
+                parts, bcast, carry_shape, lim)
+            return first_low, cont_low
         compiled = None
         ckey = None
         cache_status = "uncached"
+        stages_dig = None
+        if self._program_key is not None or self._ckpt is not None:
+            stages_dig = _stages_digest(stages, criterion)
         if self._program_key is not None:
             from ..common.profiling import step_log_enabled
             # structural guard (advisor r4): the stage bytecode + frozen
             # closure cells ride in the key, so a program_key that
             # under-specifies a baked constant misses instead of silently
             # re-running a stale program
-            ckey = (self._program_key, _stages_digest(stages, criterion),
+            ckey = (self._program_key, stages_dig,
                     mesh, nw, max_iter, seed,
                     criterion is not None, step_log_enabled(),
                     tuple(sorted(parts)), tuple(sorted(bcast)))
-            compiled = _PROGRAM_CACHE.get(ckey)
+
+        if self._ckpt is not None:
+            # -- durable chunked execution (engine/recovery.py) -----------
+            from . import recovery
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "comqueue checkpointing is single-process for now: the "
+                    "per-boundary carry fetch would need a multihost "
+                    "allgather + single-writer election")
+            ck = self._ckpt
+            first = cont = None
+            ckkey = ("__ckpt__", ckey) if ckey is not None else None
+            if ckkey is not None:
+                cached = _PROGRAM_CACHE.get(ckkey)
+                if cached is not None:
+                    cache_status = "hit"
+                    _PROGRAM_CACHE_STATS["hits"] += 1
+                    _PROGRAM_CACHE.move_to_end(ckkey)
+                    first, cont = cached
+                    manifest = _PROGRAM_CACHE_MANIFESTS.setdefault(ckkey,
+                                                                   manifest)
+            if first is None:
+                first = jax.jit(build_first_chunk())
+                cont = jax.jit(build_cont_chunk())
+                if ckkey is not None:
+                    cache_status = "miss"
+                    _PROGRAM_CACHE_STATS["misses"] += 1
+                    _PROGRAM_CACHE[ckkey] = (first, cont)
+                    _PROGRAM_CACHE_MANIFESTS[ckkey] = manifest
+                    while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+                        old_key, _ = _PROGRAM_CACHE.popitem(last=False)
+                        _PROGRAM_CACHE_JAXPRS.pop(old_key, None)
+                        _PROGRAM_CACHE_MANIFESTS.pop(old_key, None)
+            if mx and ckkey is not None:
+                get_registry().inc("alink_comqueue_program_cache_total", 1,
+                                   {"result": cache_status})
+            part_sig = tuple(
+                (k, tuple(map(int, np.shape(parts[k]))),
+                 str(getattr(parts[k], "dtype", "?"))) for k in sorted(parts))
+            # fingerprint the ORIGINAL (pre-padding, host-side) inputs:
+            # np arrays hash by content, device-resident arrays degrade
+            # to shape/dtype tokens (no forced device->host round trip).
+            # Memoized per queue instance (invalidated by init_with_*):
+            # repeated exec() on the same queue must not re-hash the
+            # whole dataset per program-cache hit
+            data_token = self._data_token
+            if data_token is None:
+                data_token = self._data_token = _freeze_closure_value(
+                    {"parts": dict(self._partitioned),
+                     "bcast": dict(self._broadcast)}, 3)
+            signature = recovery.program_signature(
+                num_workers=nw, max_iter=max_iter, seed=seed,
+                part_sig=part_sig, bcast_names=tuple(sorted(bcast)),
+                stages_digest=stages_dig, data_token=data_token)
+            resumed = recovery.resume_state(ck, signature)
+            with _ENGINE_TIMER.span("comqueue.execute",
+                                    labels={"program": cache_status}):
+                stacked, ck_info = recovery.drive(
+                    ck, first=first, cont=cont, parts=parts, bcast=bcast,
+                    max_iter=max_iter, signature=signature, resumed=resumed)
+            return self._finish(stacked, nw, totals, manifest, parts, bcast,
+                                mx, ck_info)
         from ..common.metrics import env_flag
         verify = env_flag("ALINK_VERIFY_PROGRAM_CACHE", default=False)
+        if ckey is not None:
+            compiled = _PROGRAM_CACHE.get(ckey)
         if compiled is None:
             compiled = jax.jit(build_mapped())
             if ckey is not None:
@@ -614,6 +842,18 @@ class IterativeComQueue:
                 lambda x: np.asarray(
                     multihost_utils.process_allgather(x, tiled=True)),
                 stacked)
+        return self._finish(stacked, nw, totals, manifest, parts, bcast,
+                            mx, None)
+
+    def _finish(self, stacked, nw, totals, manifest, parts, bcast, mx,
+                ck_info):
+        """Shared result assembly + metrics tail for the single-program
+        and checkpoint-chunked execution paths. ``ck_info`` is the
+        recovery driver's accounting (None on the single-program path)."""
+        import jax
+
+        from ..common.metrics import get_registry
+
         # single-process: leave leaves ON DEVICE — ComQueueResult fetches
         # per access, so a fit that only reads coef + loss_curve does not
         # pull the whole carry (L-BFGS sk/yk ring buffers, per-row
@@ -624,8 +864,15 @@ class IterativeComQueue:
             # one scalar fetch; on deferred backends this flushes the run,
             # which the caller's first result read would have done anyway
             steps = int(result.step_count)
+            # a resumed run only EXECUTED the supersteps past its snapshot
+            # (and no init pass); charge collectives/supersteps for those
+            if ck_info is None:
+                executed, init_runs = steps, 1
+            else:
+                init_runs = 1 if ck_info["init_ran"] else 0
+                executed = ck_info["steps_executed"]
             reg.inc("alink_comqueue_execs_total", 1)
-            reg.inc("alink_comqueue_supersteps_total", steps)
+            reg.inc("alink_comqueue_supersteps_total", executed)
             # this exec's trace signature, computed on the HOST inputs
             # exactly as static_sig sees them inside shard_map: parts are
             # split on the leading axis by the worker count, bcast is
@@ -644,14 +891,15 @@ class IterativeComQueue:
                 # defensive: a host/trace signature drift should not drop
                 # attribution when only one trace exists
                 per = next(iter(manifest.values()))
-            # the init pass executed once (superstep 1); the while-loop
-            # body executed the remaining steps-1 supersteps (the body is
-            # TRACED even for runs whose criterion stops at step 1, so it
-            # must not be charged for supersteps it never ran)
+            # the init pass executed at most once (superstep 1; not at all
+            # on a resumed run); the while-loop body executed the other
+            # supersteps (the body is TRACED even for runs whose criterion
+            # stops at step 1, so it must not be charged for supersteps it
+            # never ran)
             counts = []
             if per is not None:
-                counts = ([(e, 1) for e in per["init"]]
-                          + [(e, steps - 1) for e in per["body"]])
+                counts = ([(e, init_runs) for e in per["init"]]
+                          + [(e, executed - init_runs) for e in per["body"]])
             for (kind, _buf, nbytes), times in counts:
                 if times <= 0:
                     continue

@@ -30,7 +30,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..common.mtable import MTable
 from ..common.types import TableSchema
-from .csv import write_csv
+from .csv import format_csv_rows, write_csv
 from .db import BaseDB
 
 
@@ -38,7 +38,20 @@ class TableBucketingSink:
     """Row sink that rolls output into numbered bucket tables
     ``<prefix>_<id>`` — in a BaseDB or as a partitioned directory of CSV
     files — by explicit bucket-id columns (ruler mode) or by
-    size/time rollover (reference common/io/TableBucketingSink.java)."""
+    size/time rollover (reference common/io/TableBucketingSink.java).
+
+    Pre-existing bucket targets: in **ruler mode** a bucket that already
+    exists is an error (the ruler's ``(id, n_tab)`` contract says this
+    process owns the bucket's full row count — TableBucketingSink.java:
+    94-95). In **size/time mode** the reference REUSES an existing table
+    and appends to it (createFormat is only consulted for new tables), so
+    this sink tolerates existing targets and appends.
+
+    Unit note: the reference's ``batchRolloverInterval`` is milliseconds
+    (a Flink config long); here ``batch_rollover_interval`` is **seconds**
+    (a float, matching every other time knob in this codebase — stream
+    ``time_interval``, event times). Divide reference configs by 1000.
+    """
 
     def __init__(self, table_name_prefix: str, schema: TableSchema,
                  db: Optional[BaseDB] = None, base_dir: Optional[str] = None,
@@ -50,6 +63,9 @@ class TableBucketingSink:
         self.schema = schema
         self.db = db
         self.base_dir = base_dir
+        # mode is fixed at construction (before the one-sided widening
+        # below makes both bounds positive)
+        self._ruler = batch_size < 0 and batch_rollover_interval < 0
         # one-sided bounds widen the other side (TableBucketingSink.java:44-51)
         if batch_size > 0 and batch_rollover_interval < 0:
             batch_rollover_interval = float("inf")
@@ -132,14 +148,17 @@ class TableBucketingSink:
         name = self._bucket_name(bucket_id)
         if self.db is not None:
             if self.db.has_table(name):
-                # same contract as TableBucketingSink.java:94-95
-                raise RuntimeError(f"table : {name} has already exists, "
-                                   f"please change your table name.")
+                if self._ruler:
+                    # same contract as TableBucketingSink.java:94-95 —
+                    # ruler mode only; size/time mode reuses the table
+                    raise RuntimeError(f"table : {name} has already exists, "
+                                       f"please change your table name.")
+                return
             self.db.create_table(name, self.schema)
         else:
             os.makedirs(self.base_dir, exist_ok=True)
             path = os.path.join(self.base_dir, name + ".csv")
-            if os.path.exists(path):
+            if os.path.exists(path) and self._ruler:
                 raise RuntimeError(f"table : {name} has already exists, "
                                    f"please change your table name.")
 
@@ -150,4 +169,11 @@ class TableBucketingSink:
         if self.db is not None:
             self.db.write_table(name, mt, append=True)
         else:
-            write_csv(mt, os.path.join(self.base_dir, name + ".csv"))
+            path = os.path.join(self.base_dir, name + ".csv")
+            if not self._ruler and os.path.exists(path):
+                # size/time mode reuses a pre-existing bucket file by
+                # appending, mirroring the db branch's append=True
+                with open(path, "a", newline="", encoding="utf-8") as f:
+                    f.write(format_csv_rows(mt))
+            else:
+                write_csv(mt, path)

@@ -200,9 +200,21 @@ def assign_clusters(X, C, distance_type: str = "EUCLIDEAN"):
 def kmeans_train(X: np.ndarray, k: int, max_iter: int = 50, tol: float = 1e-4,
                  distance_type: str = "EUCLIDEAN", init: str = "K_MEANS_PARALLEL",
                  seed: int = 0, env: Optional[MLEnvironment] = None,
-                 sample_weight: Optional[np.ndarray] = None
+                 sample_weight: Optional[np.ndarray] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 1, checkpoint_keep: int = 3,
+                 resume_from: Optional[str] = None
                  ) -> Tuple[np.ndarray, np.ndarray, int]:
-    """Returns (centroids (k,d), cluster_weights (k,), num_steps)."""
+    """Returns (centroids (k,d), cluster_weights (k,), num_steps).
+
+    ``checkpoint_dir=`` makes the Lloyd loop durable: the superstep carry
+    (centroids, movement, step counter) is snapshotted every
+    ``checkpoint_every`` supersteps outside the compiled program, and
+    ``resume_from=`` re-enters a killed run with bitwise-identical final
+    centroids (engine/recovery.py). The k-means|| init queue is NOT
+    checkpointed — it is short and re-running it is cheaper than a
+    snapshot per sampling round; exact resume still holds because the
+    init is deterministic in ``seed``."""
     X = np.asarray(X)
     n, d = X.shape
     w = np.ones(n, X.dtype) if sample_weight is None else np.asarray(sample_weight, X.dtype)
@@ -239,15 +251,23 @@ def kmeans_train(X: np.ndarray, k: int, max_iter: int = 50, tol: float = 1e-4,
         ctx.put_obj("centroids", newC)
         ctx.put_obj("cluster_weights", cnts)
 
-    result = (IterativeComQueue(env=env, max_iter=max_iter, seed=seed)
-              .init_with_partitioned_data("data", data)
-              .init_with_broadcast_data("init_centroids", init_c)
-              .add(assign)
-              .add(AllReduce("buf"))
-              .add(update)
-              .set_compare_criterion(lambda ctx: ctx.get_obj("movement") < tol)
-              .set_program_key(("kmeans", k, d, distance_type, float(tol),
-                                str(dt)))
-              .exec())
+    queue = (IterativeComQueue(env=env, max_iter=max_iter, seed=seed)
+             .init_with_partitioned_data("data", data)
+             .init_with_broadcast_data("init_centroids", init_c)
+             .add(assign)
+             .add(AllReduce("buf"))
+             .add(update)
+             .set_compare_criterion(lambda ctx: ctx.get_obj("movement") < tol)
+             .set_program_key(("kmeans", k, d, distance_type, float(tol),
+                               str(dt))))
+    if checkpoint_dir:
+        # knob validation (every/keep_last >= 1) lives in CheckpointConfig
+        queue.set_checkpoint(checkpoint_dir, every=int(checkpoint_every),
+                             keep_last=int(checkpoint_keep),
+                             resume_from=resume_from)
+    elif resume_from:
+        raise ValueError("resume_from requires checkpoint_dir (an explicit "
+                         "resume request must not silently retrain)")
+    result = queue.exec()
     return (result.get("centroids"), result.get("cluster_weights"),
             result.step_count)

@@ -50,6 +50,29 @@ class OptimParams:
     learning_rate: float = 1.0
     mini_batch_fraction: float = 0.1
     seed: int = 0
+    # superstep durability (engine/recovery.py): snapshot the optimizer
+    # carry every N supersteps; resume_from= re-enters a killed run with
+    # bitwise-identical final results. None/0 = off. These knobs do not
+    # enter the program cache key: checkpointing runs the same superstep
+    # body, only chunked.
+    checkpoint_dir: Optional[str] = None
+    checkpoint_every: int = 1
+    checkpoint_keep: int = 3
+    resume_from: Optional[str] = None
+
+
+def _apply_checkpoint(queue, params: "OptimParams"):
+    if params.checkpoint_dir:
+        # knob validation (every/keep_last >= 1) lives in CheckpointConfig
+        queue.set_checkpoint(params.checkpoint_dir,
+                             every=int(params.checkpoint_every),
+                             keep_last=int(params.checkpoint_keep),
+                             resume_from=params.resume_from)
+    elif params.resume_from:
+        raise ValueError("OptimParams.resume_from requires checkpoint_dir "
+                         "(an explicit resume request must not silently "
+                         "retrain from scratch)")
+    return queue
 
 
 def optimize(obj: OptimObjFunc, data: Dict[str, np.ndarray], params: OptimParams,
@@ -256,6 +279,7 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
                                _freeze(obj))))
     for k, v in data.items():
         queue.init_with_partitioned_data(k, v)
+    _apply_checkpoint(queue, params)
     res = queue.exec()
     return res.get("coef"), _trim_curve(res.get("loss_curve")), res.step_count
 
@@ -320,6 +344,7 @@ def _sgd(obj, data, params, env, warm_start):
                                data_keys, _freeze(obj))))
     for k, v in data.items():
         queue.init_with_partitioned_data(k, v)
+    _apply_checkpoint(queue, params)
     res = queue.exec()
     return res.get("coef"), _trim_curve(res.get("loss_curve")), res.step_count
 
@@ -376,6 +401,7 @@ def _newton(obj, data, params, env, warm_start):
                                data_keys, _freeze(obj))))
     for k, v in data.items():
         queue.init_with_partitioned_data(k, v)
+    _apply_checkpoint(queue, params)
     res = queue.exec()
     return res.get("coef"), _trim_curve(res.get("loss_curve")), res.step_count
 

@@ -18,7 +18,8 @@ from .predict_ops import __all__ as _predict_all
 from .batch_twins import *  # noqa: F401,F403 — stateless batch-twin stream ops
 from .batch_twins import __all__ as _twin_all
 from .recommendation import AlsPredictStreamOp
-from .sink import (BaseSinkStreamOp, CollectSinkStreamOp, CsvSinkStreamOp,
+from .sink import (BaseSinkStreamOp, CheckpointSinkStreamOp,
+                   CollectSinkStreamOp, CsvSinkStreamOp,
                    DBSinkStreamOp, JdbcRetractSinkStreamOp, LibSvmSinkStreamOp,
                    MySqlSinkStreamOp, TextSinkStreamOp)
 from .source import (BaseSourceStreamOp, CsvSourceStreamOp, DBSourceStreamOp,
@@ -40,7 +41,8 @@ __all__ = [
     "FtrlTrainStreamOp", "FtrlPredictStreamOp",
     "NGramStreamOp", "RegexTokenizerStreamOp", "SegmentStreamOp",
     "StopWordsRemoverStreamOp", "TokenizerStreamOp",
-    "BaseSinkStreamOp", "CollectSinkStreamOp", "CsvSinkStreamOp",
+    "BaseSinkStreamOp", "CheckpointSinkStreamOp", "CollectSinkStreamOp",
+    "CsvSinkStreamOp",
     "DBSinkStreamOp", "JdbcRetractSinkStreamOp", "LibSvmSinkStreamOp",
     "MySqlSinkStreamOp", "TextSinkStreamOp",
     "BaseSourceStreamOp", "CsvSourceStreamOp", "DBSourceStreamOp",

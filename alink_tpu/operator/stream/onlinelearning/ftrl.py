@@ -31,6 +31,8 @@ from typing import List, Optional
 
 import numpy as np
 
+from ....common.checkpoint import load_latest_validated, save_checkpoint
+from ....common.faults import maybe_crash
 from ....common.metrics import get_registry, metrics_enabled
 from ....common.mtable import MTable
 from ....common.params import InValidator, ParamInfo, Params, RangeValidator
@@ -459,6 +461,21 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                                       "'staleness' (max update delay in "
                                       "samples)",
                           validator=RangeValidator(1, None))
+    # stream durability (common/checkpoint.py): persist the (z, n) FTRL
+    # state every N micro-batches with bounded retention; a crash-restarted
+    # op with the same checkpoint_dir resumes from the newest valid
+    # snapshot and SKIPS the already-committed prefix of the (replayed)
+    # input stream — on a deterministic source the recovered model is
+    # bit-identical to the uninterrupted run's.
+    CHECKPOINT_DIR = ParamInfo("checkpoint_dir", str, default=None)
+    CHECKPOINT_EVERY = ParamInfo("checkpoint_every_batches", int, default=0,
+                                 description="micro-batches between state "
+                                             "snapshots (0 = off)")
+    CHECKPOINT_KEEP = ParamInfo("checkpoint_keep", int, default=3,
+                                validator=RangeValidator(1, None))
+    RESUME = ParamInfo("resume", bool, default=True,
+                       description="resume from the newest valid snapshot "
+                                   "in checkpoint_dir when one exists")
 
     def __init__(self, initial_model: Optional[BatchOperator] = None,
                  params: Optional[Params] = None, **kwargs):
@@ -496,6 +513,30 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
         update_mode = self.params._m.get("update_mode", "sample")
         batch_mode = update_mode == "batch"
         staleness = int(self.params._m.get("staleness", 32))
+        ck_dir = self.params._m.get("checkpoint_dir")
+        ck_every = int(self.params._m.get("checkpoint_every_batches", 0) or 0)
+        ck_keep = int(self.params._m.get("checkpoint_keep", 3))
+        ck_resume = bool(self.params._m.get("resume", True))
+        # snapshot identity: a resume target trained with different
+        # hyperparameters, geometry or warm-start model is a different
+        # model — refuse it. The coef fingerprint catches a same-dim but
+        # DIFFERENT warm model; the input stream itself cannot be
+        # fingerprinted at link time (resume assumes a deterministic
+        # replayed source — docs/checkpointing.md)
+        import hashlib as _hashlib
+        _warm_fp = _hashlib.blake2b(
+            np.ascontiguousarray(np.asarray(init.coef)).tobytes(),
+            digest_size=12).hexdigest()
+        ck_signature = {"kind": "ftrl_state", "alpha": alpha, "beta": beta,
+                        "l1": l1, "l2": l2, "dim": dim, "dim_pad": dim_pad,
+                        "update_mode": update_mode,
+                        # the staleness bound shapes the trajectory only in
+                        # staleness mode; None elsewhere so changing the
+                        # (unused) knob does not refuse a valid resume
+                        "staleness": (staleness
+                                      if update_mode == "staleness" else None),
+                        "has_intercept": bool(has_icpt),
+                        "warm_coef_blake2b": _warm_fp}
         allow_fb = [True]    # cleared once the state commits to std layout
         sparse_step = [None]                # built lazily (sparse input only)
         _dense, weights_fn = _ftrl_step_factory(mesh, alpha, beta, l1, l2)
@@ -684,6 +725,20 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                         jax.device_put(val, rep_shard),
                         jax.device_put(y, rep_shard), width)
 
+            # -- crash-restart resume (common/checkpoint.py) --------------
+            # The newest valid snapshot carries the committed (z, n) state
+            # plus the count of micro-batches folded into it; the replayed
+            # input stream's committed prefix is skipped below (before
+            # encode, so recovery pays no wasted hashing/transfer).
+            resume_skip = 0
+            _restored = None
+            if ck_dir and ck_resume:
+                _restored = load_latest_validated(ck_dir, ck_signature,
+                                                  scope="ftrl",
+                                                  what="FTRL program")
+                if _restored is not None:
+                    resume_skip = int(_restored[1]["batches_done"])
+
             def encoded_stream():
                 """(t, mt, enc) with encode AND the host->device transfer
                 running IN the prefetch thread: hashing/padding/shipping
@@ -692,11 +747,19 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 FtrlTrainStreamOp.java:120-135)."""
                 batch_size = None
                 width = 8
+                seen = 0
                 for t, mt in data_op.timed_batches():
                     if mt.num_rows == 0:
                         continue
                     if batch_size is None:
+                        # batch_size is taken from the FIRST batch even
+                        # when resuming, so the padded batch geometry —
+                        # and with it the recovered trajectory — matches
+                        # the uninterrupted run's exactly
                         batch_size = max(1, mt.num_rows)
+                    seen += 1
+                    if seen <= resume_skip:
+                        continue   # committed before the crash
                     enc = encode(mt, max(batch_size, mt.num_rows), width)
                     if enc[0] == "sparse":
                         width = enc[4]
@@ -717,6 +780,39 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
             fb_S = None
             fb_meta = None
             next_emit = None
+            b_done = 0                   # micro-batches committed to state
+            if _restored is not None:
+                _payload, _meta = _restored
+                layout = _meta["layout"]
+                b_done = resume_skip
+                # next_emit is NOT restored: it re-derives from the first
+                # replayed batch's event time (the None branch below), so
+                # a restart may change time_interval freely and never
+                # re-emits for the committed prefix
+                if layout == "fb":
+                    from ....ops.fieldblock import FieldBlockMeta
+                    fb_S = int(_meta["fb_S"])
+                    fb_meta = FieldBlockMeta(int(_meta["fb_num_fields"]),
+                                             int(_meta["fb_field_size"]))
+                else:
+                    allow_fb[0] = False
+                z = jax.device_put(_payload["z"], feat_shard)
+                n = jax.device_put(_payload["n"], feat_shard)
+
+            def save_state():
+                # one host fetch of (z, n) — on deferred backends this
+                # flushes the in-flight batches, which is exactly the
+                # durability point: everything before the snapshot is
+                # committed, everything after replays on restart
+                meta = {"signature": ck_signature, "layout": layout,
+                        "batches_done": b_done, "next_emit": next_emit}
+                if layout == "fb":
+                    meta["fb_S"] = int(fb_S)
+                    meta["fb_num_fields"] = int(fb_meta.num_fields)
+                    meta["fb_field_size"] = int(fb_meta.field_size)
+                save_checkpoint(ck_dir, b_done,
+                                {"z": np.asarray(z), "n": np.asarray(n)},
+                                meta=meta, scope="ftrl", keep_last=ck_keep)
             # telemetry is per-micro-batch (HOST dispatch latency: device
             # work is async, so the histogram reads as dispatch+encode
             # pressure, not device time) — resolved once per drain
@@ -799,6 +895,19 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                       reg.inc("alink_ftrl_snapshots_total", 1)
                   while next_emit <= t + 1e-12:
                       next_emit += interval
+              b_done += 1
+              # the injected-preemption point sits BEFORE the periodic
+              # save: a crash at batch k genuinely loses the work since
+              # the last snapshot, which is what the kill-and-resume
+              # parity test re-executes
+              maybe_crash("ftrl.batch", b_done)
+              if ck_dir and ck_every and b_done % ck_every == 0:
+                  save_state()
+            if ck_dir and ck_every and z is not None \
+                    and b_done > resume_skip and b_done % ck_every != 0:
+                # end-of-stream snapshot so a restart of a COMPLETED drain
+                # resumes instead of retraining the tail
+                save_state()
             if z is None:
                 # empty stream: emit the warm-start model, as the eager
                 # allocation used to

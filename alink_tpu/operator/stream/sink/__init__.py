@@ -1,7 +1,9 @@
-from .sinks import (BaseSinkStreamOp, CollectSinkStreamOp, CsvSinkStreamOp,
+from .sinks import (BaseSinkStreamOp, CheckpointSinkStreamOp,
+                    CollectSinkStreamOp, CsvSinkStreamOp,
                     DBSinkStreamOp, JdbcRetractSinkStreamOp, LibSvmSinkStreamOp,
                     MySqlSinkStreamOp, TextSinkStreamOp)
 
-__all__ = ["BaseSinkStreamOp", "CollectSinkStreamOp", "CsvSinkStreamOp",
+__all__ = ["BaseSinkStreamOp", "CheckpointSinkStreamOp",
+           "CollectSinkStreamOp", "CsvSinkStreamOp",
            "DBSinkStreamOp", "JdbcRetractSinkStreamOp", "LibSvmSinkStreamOp",
            "MySqlSinkStreamOp", "TextSinkStreamOp"]

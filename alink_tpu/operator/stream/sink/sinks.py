@@ -49,6 +49,75 @@ class CollectSinkStreamOp(BaseSinkStreamOp):
         return out
 
 
+class CheckpointSinkStreamOp(BaseSinkStreamOp):
+    """Durable generic sink: micro-batches land as atomic, checksummed
+    checkpoints with bounded retention (common/checkpoint.py).
+
+    Point it at any stream — most usefully a model-snapshot stream (the
+    FTRL trainer's output), which makes the newest complete model survive
+    a process kill: a restarted job reloads it with
+    ``CheckpointSinkStreamOp.load_latest(dir)`` and hands it to the
+    predictor as the warm start. All-numeric tables persist as ``.npy``
+    column payloads; tables with string/vector columns persist via the
+    MTable JSON row codec (exact round trip either way).
+    """
+
+    def __init__(self, checkpoint_dir: str, every: int = 1,
+                 keep_last: int = 5, params: Optional[Params] = None,
+                 **kwargs):
+        super().__init__(params, **kwargs)
+        if int(every) < 1 or int(keep_last) < 1:
+            raise ValueError("every and keep_last must be >= 1")
+        self.checkpoint_dir = checkpoint_dir
+        self.every = int(every)
+        self.keep_last = int(keep_last)
+        self._seen = 0
+
+    def link_from(self, in_op):
+        from ....common.checkpoint import checkpoint_tag, latest_checkpoint
+        # continue the tag sequence across restarts: starting over at 1
+        # would make tag-ordered retention delete every NEW snapshot
+        # while load_latest kept serving the previous run's data
+        latest = latest_checkpoint(self.checkpoint_dir, validate=False)
+        self._seen = checkpoint_tag(latest) if latest is not None else 0
+        return super().link_from(in_op)
+
+    def _consume(self, mt: MTable):
+        from ....common.checkpoint import save_checkpoint
+        self._seen += 1
+        if (self._seen - 1) % self.every:
+            return
+        cols = {name: mt.col(name) for name in mt.col_names}
+        if all(c.dtype != object and c.dtype.kind in "biuf"
+               for c in cols.values()):
+            payload = cols
+            meta = {"mode": "arrays", "schema": mt.schema.to_spec(),
+                    "batch_index": self._seen}
+        else:
+            payload = {}
+            meta = {"mode": "json_rows", "table": mt.to_json_rows(),
+                    "batch_index": self._seen}
+        save_checkpoint(self.checkpoint_dir, self._seen, payload, meta=meta,
+                        scope="stream_sink", keep_last=self.keep_last)
+
+    @staticmethod
+    def load_latest(checkpoint_dir: str) -> Optional[MTable]:
+        """Newest valid persisted batch, or None (corrupted snapshots are
+        skipped — the crash-during-write recovery path)."""
+        from ....common.checkpoint import latest_checkpoint, load_checkpoint
+        from ....common.types import TableSchema
+        path = latest_checkpoint(checkpoint_dir)
+        if path is None:
+            return None
+        # already checksummed by latest_checkpoint
+        payload, meta = load_checkpoint(path, scope="stream_sink",
+                                        validate=False)
+        if meta.get("mode") == "arrays":
+            schema = TableSchema.parse(meta["schema"])
+            return MTable({n: payload[n] for n in schema.names}, schema)
+        return MTable.from_json_rows(meta["table"])
+
+
 class CsvSinkStreamOp(BaseSinkStreamOp):
     """reference: stream/sink/CsvSinkStreamOp (append per micro-batch)."""
 

@@ -1,0 +1,528 @@
+"""Checkpoint & fault-tolerance subsystem tests (tier-1, JAX_PLATFORMS=cpu).
+
+Covers the durability contract end to end: snapshot format round-trip and
+corruption rejection, kill-at-superstep-k resume parity for L-BFGS and
+KMeans ComQueue runs (bitwise), the zero-compiled-ops discipline
+(lowered-HLO), FTRL crash-restart resume, the generic stream checkpoint
+sink, metrics wiring, and the ckpt.py CLI.
+"""
+
+import importlib.util
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from alink_tpu.common.checkpoint import (CheckpointError, latest_checkpoint,
+                                         list_checkpoints, load_checkpoint,
+                                         prune_checkpoints, save_checkpoint,
+                                         validate_checkpoint)
+from alink_tpu.common.faults import FAULT_ENV, FaultInjected, maybe_crash
+from alink_tpu.common.metrics import MetricsRegistry, set_registry
+from alink_tpu.engine import AllReduce, IterativeComQueue
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        yield reg
+    finally:
+        set_registry(prev)
+
+
+# ---------------------------------------------------------------------------
+# snapshot format
+# ---------------------------------------------------------------------------
+
+class TestFormat:
+    PAYLOAD = {
+        "z": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "nested": {"k": np.float64(3.5) * np.ones(5),
+                   "ints": np.arange(4, dtype=np.int32)},
+        "mixed": [np.ones((2, 2)), ("tag", 7, None, 2.5)],
+    }
+
+    def test_round_trip_bitwise(self, tmp_path):
+        meta = {"signature": {"kind": "demo"}, "step": 9}
+        path = save_checkpoint(str(tmp_path), 9, self.PAYLOAD, meta=meta)
+        assert os.path.basename(path) == "ckpt-000000000009"
+        payload, got_meta = load_checkpoint(path)
+        assert got_meta == meta
+        assert payload["z"].tobytes() == self.PAYLOAD["z"].tobytes()
+        assert payload["z"].dtype == np.float32
+        assert payload["nested"]["k"].dtype == np.float64
+        assert payload["mixed"][1] == ("tag", 7, None, 2.5)  # tuple preserved
+        np.testing.assert_array_equal(payload["mixed"][0], np.ones((2, 2)))
+
+    def test_corrupted_payload_rejected(self, tmp_path):
+        path = save_checkpoint(str(tmp_path), 1, self.PAYLOAD)
+        target = os.path.join(path, "arr_00000.npy")
+        with open(target, "r+b") as f:
+            f.seek(-1, os.SEEK_END)
+            f.write(b"\x7f")
+        with pytest.raises(CheckpointError, match="checksum mismatch"):
+            load_checkpoint(path)
+
+    def test_truncated_payload_rejected(self, tmp_path):
+        path = save_checkpoint(str(tmp_path), 1, self.PAYLOAD)
+        target = os.path.join(path, "arr_00000.npy")
+        with open(target, "r+b") as f:
+            f.truncate(os.path.getsize(target) - 8)
+        with pytest.raises(CheckpointError, match="truncated"):
+            load_checkpoint(path)
+
+    def test_missing_manifest_rejected(self, tmp_path):
+        path = save_checkpoint(str(tmp_path), 1, self.PAYLOAD)
+        os.remove(os.path.join(path, "manifest.json"))
+        with pytest.raises(CheckpointError, match="incomplete snapshot"):
+            load_checkpoint(path)
+
+    def test_latest_skips_invalid(self, tmp_path):
+        p1 = save_checkpoint(str(tmp_path), 1, self.PAYLOAD)
+        p2 = save_checkpoint(str(tmp_path), 2, self.PAYLOAD)
+        with open(os.path.join(p2, "arr_00000.npy"), "r+b") as f:
+            f.seek(40)
+            f.write(b"\xff\xff")
+        assert latest_checkpoint(str(tmp_path)) == p1
+        assert latest_checkpoint(str(tmp_path), validate=False) == p2
+
+    def test_tmp_debris_invisible_and_pruned(self, tmp_path):
+        save_checkpoint(str(tmp_path), 3, self.PAYLOAD)
+        debris = tmp_path / ".tmp-ckpt-000000000004-999"
+        debris.mkdir()
+        (debris / "arr_00000.npy").write_bytes(b"partial")
+        assert len(list_checkpoints(str(tmp_path))) == 1
+        prune_checkpoints(str(tmp_path), 5)
+        assert not debris.exists()
+
+    def test_retention(self, tmp_path):
+        for i in range(1, 6):
+            save_checkpoint(str(tmp_path), i, {"x": np.ones(2)}, keep_last=3)
+        tags = [os.path.basename(p) for p in list_checkpoints(str(tmp_path))]
+        assert tags == [f"ckpt-{i:012d}" for i in (3, 4, 5)]
+
+    def test_object_arrays_rejected(self, tmp_path):
+        with pytest.raises(CheckpointError, match="object array"):
+            save_checkpoint(str(tmp_path), 1,
+                            {"bad": np.array(["a", None], dtype=object)})
+
+    def test_crash_during_save_leaves_no_snapshot(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv(FAULT_ENV, "ckpt.save:1")
+        with pytest.raises(FaultInjected):
+            save_checkpoint(str(tmp_path), 7, self.PAYLOAD)
+        assert list_checkpoints(str(tmp_path)) == []
+        assert latest_checkpoint(str(tmp_path)) is None
+
+
+class TestFaults:
+    def test_threshold_and_sites(self, monkeypatch):
+        monkeypatch.setenv(FAULT_ENV, "a.b:3; c.d:1")
+        maybe_crash("a.b", 2)          # below threshold
+        maybe_crash("other", 99)       # unarmed site
+        with pytest.raises(FaultInjected) as ei:
+            maybe_crash("a.b", 5)      # first call past the threshold
+        assert ei.value.site == "a.b" and ei.value.threshold == 3
+        with pytest.raises(FaultInjected):
+            maybe_crash("c.d", 1)
+
+    def test_unset_is_free(self, monkeypatch):
+        monkeypatch.delenv(FAULT_ENV, raising=False)
+        maybe_crash("comqueue.superstep", 10**9)
+
+
+# ---------------------------------------------------------------------------
+# engine: kill-and-resume parity + zero-compiled-ops discipline
+# ---------------------------------------------------------------------------
+
+def _lr_fixture(n=256, d=6, seed=3):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, d).astype(np.float32)
+    y = (X @ r.randn(d) > 0).astype(np.float32) * 2 - 1
+    return {"X": X, "y": y, "w": np.ones(n, np.float32)}
+
+
+def _lbfgs(data, **ck):
+    from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                         UnaryLossObjFunc)
+    from alink_tpu.operator.common.optim.optimizers import (OptimParams,
+                                                            optimize)
+    obj = UnaryLossObjFunc(LogLossFunc(), dim=data["X"].shape[1])
+    params = OptimParams(method="LBFGS", max_iter=12, epsilon=0.0, **ck)
+    return optimize(obj, data, params)
+
+
+class TestComQueueResume:
+    def test_lbfgs_kill_and_resume_bitwise(self, tmp_path, monkeypatch):
+        data = _lr_fixture()
+        coef_plain, curve_plain, steps_plain = _lbfgs(data)
+        # uninterrupted checkpointed run: same compiled superstep body,
+        # chunked — results match the single-program run exactly
+        d_full = str(tmp_path / "full")
+        coef_full, curve_full, steps_full = _lbfgs(
+            data, checkpoint_dir=d_full, checkpoint_every=4)
+        assert steps_full == steps_plain
+        np.testing.assert_array_equal(coef_full, coef_plain)
+        # kill at superstep 8 (a boundary; the crash fires BEFORE that
+        # boundary's snapshot publishes, so only ckpt-4 survives)
+        d_kill = str(tmp_path / "kill")
+        monkeypatch.setenv(FAULT_ENV, "comqueue.superstep:8")
+        with pytest.raises(FaultInjected):
+            _lbfgs(data, checkpoint_dir=d_kill, checkpoint_every=4)
+        monkeypatch.delenv(FAULT_ENV)
+        survivors = [os.path.basename(p)
+                     for p in list_checkpoints(d_kill)]
+        assert survivors == ["ckpt-000000000004"]
+        coef_res, curve_res, steps_res = _lbfgs(
+            data, checkpoint_dir=d_kill, checkpoint_every=4,
+            resume_from=d_kill)
+        assert steps_res == steps_full
+        assert np.asarray(coef_res).tobytes() == \
+            np.asarray(coef_full).tobytes()
+        assert np.asarray(curve_res).tobytes() == \
+            np.asarray(curve_full).tobytes()
+
+    def test_kmeans_kill_and_resume_bitwise(self, tmp_path, monkeypatch):
+        from alink_tpu.operator.common.clustering.kmeans import kmeans_train
+        r = np.random.RandomState(0)
+        X = np.concatenate([r.randn(70, 4) + c
+                            for c in (-4.0, 0.0, 4.0)]).astype(np.float32)
+        kw = dict(k=3, max_iter=9, tol=1e-12, init="RANDOM", seed=5)
+        d_full = str(tmp_path / "full")
+        C_full, w_full, steps_full = kmeans_train(
+            X, checkpoint_dir=d_full, checkpoint_every=3, **kw)
+        d_kill = str(tmp_path / "kill")
+        monkeypatch.setenv(FAULT_ENV, "comqueue.superstep:6")
+        with pytest.raises(FaultInjected):
+            kmeans_train(X, checkpoint_dir=d_kill, checkpoint_every=3, **kw)
+        monkeypatch.delenv(FAULT_ENV)
+        assert [os.path.basename(p) for p in list_checkpoints(d_kill)] \
+            == ["ckpt-000000000003"]
+        C_res, w_res, steps_res = kmeans_train(
+            X, checkpoint_dir=d_kill, checkpoint_every=3,
+            resume_from=d_kill, **kw)
+        assert steps_res == steps_full
+        assert np.asarray(C_res).tobytes() == np.asarray(C_full).tobytes()
+        assert np.asarray(w_res).tobytes() == np.asarray(w_full).tobytes()
+
+    def test_resume_refuses_different_data(self, tmp_path):
+        """Same geometry, different dataset: the data fingerprint in the
+        program signature must refuse the resume (a finished run's final
+        snapshot would otherwise be returned as the new dataset's
+        'result')."""
+        d = str(tmp_path)
+        _lbfgs(_lr_fixture(seed=3), checkpoint_dir=d, checkpoint_every=4)
+        with pytest.raises(CheckpointError, match="different program"):
+            _lbfgs(_lr_fixture(seed=4), checkpoint_dir=d,
+                   checkpoint_every=4, resume_from=d)
+
+    def test_resume_from_requires_checkpoint_dir(self):
+        from alink_tpu.operator.common.optim.optimizers import OptimParams
+        with pytest.raises(ValueError, match="requires checkpoint_dir"):
+            IterativeComQueue(max_iter=2, resume_from="/nowhere")
+        with pytest.raises(ValueError, match="requires checkpoint_dir"):
+            _lbfgs(_lr_fixture(), resume_from="/nowhere")
+
+    def test_resume_refuses_foreign_snapshot(self, tmp_path):
+        def make(scale, resume=None):
+            def stage(ctx, scale=scale):
+                if ctx.is_init_step:
+                    ctx.put_obj("acc", jnp.zeros(()))
+                ctx.put_obj("v", jnp.ones(()) * scale)
+                ctx.put_obj("acc", ctx.get_obj("acc") + ctx.get_obj("v"))
+            q = (IterativeComQueue(max_iter=6).add(stage).add(AllReduce("v")))
+            q.set_checkpoint(str(tmp_path), every=2, resume_from=resume)
+            return q
+        make(1.0).exec()
+        with pytest.raises(CheckpointError, match="different program"):
+            make(2.0, resume=str(tmp_path)).exec()
+
+    def test_chunked_hlo_is_clean(self):
+        """Checkpointing adds ZERO ops to the compiled superstep program:
+        no host callbacks/outfeeds anywhere, and the chunk programs carry
+        exactly the collectives of the unchunked program."""
+        def stage(ctx):
+            if ctx.is_init_step:
+                ctx.put_obj("acc", jnp.zeros(()))
+            ctx.put_obj("v", jnp.ones(()))
+            ctx.put_obj("acc", ctx.get_obj("acc") + ctx.get_obj("v"))
+
+        def make():
+            return (IterativeComQueue(max_iter=8)
+                    .add(stage).add(AllReduce("v")))
+
+        base = make().lowered().as_text().lower()
+        q = make().set_checkpoint("/tmp/unused-ckpt-dir", every=2)
+        first, cont = q.lowered_chunked()
+        ftxt, ctxt = first.as_text().lower(), cont.as_text().lower()
+        for txt in (ftxt, ctxt):
+            assert "callback" not in txt
+            assert "outfeed" not in txt
+            assert "infeed" not in txt
+        n_base = base.count("all_reduce")
+        assert n_base >= 2                      # init pass + loop body
+        assert ftxt.count("all_reduce") == n_base
+        # the cont program has no init pass: body collectives only
+        assert 1 <= ctxt.count("all_reduce") < n_base
+
+    def test_checkpoint_metrics_and_program_cache(self, tmp_path,
+                                                  fresh_registry):
+        def stage(ctx):
+            if ctx.is_init_step:
+                ctx.put_obj("acc", jnp.zeros(()))
+            ctx.put_obj("v", jnp.ones(()))
+            ctx.put_obj("acc", ctx.get_obj("acc") + ctx.get_obj("v"))
+
+        def make(sub):
+            return (IterativeComQueue(max_iter=6)
+                    .add(stage).add(AllReduce("v"))
+                    .set_program_key(("ckpt_metrics_demo",))
+                    .set_checkpoint(str(tmp_path / sub), every=2))
+
+        from alink_tpu.engine.comqueue import program_cache_stats
+        before = program_cache_stats()
+        make("a").exec()
+        make("b").exec()   # same program, fresh dir -> compiled-cache hit
+        after = program_cache_stats()
+        assert after["hits"] >= before["hits"] + 1
+        reg = fresh_registry
+        lbl = {"scope": "comqueue"}
+        assert reg.value("alink_checkpoint_total", lbl) >= 6  # 2 runs x 3
+        assert reg.value("alink_checkpoint_bytes_total", lbl) > 0
+        fam = reg.histogram("alink_checkpoint_seconds")
+        assert any(s.count > 0 for _, s in fam.series())
+        # the dump carries the checkpoint series (acceptance criterion)
+        names = {rec["name"] for rec in reg.snapshot()}
+        assert {"alink_checkpoint_total", "alink_checkpoint_bytes_total",
+                "alink_checkpoint_seconds"} <= names
+
+    def test_result_views_are_read_only(self):
+        """Regression: shards()/get() memoize fetched arrays; a caller
+        mutating the returned array must fail instead of silently
+        corrupting later reads."""
+        def stage(ctx):
+            ctx.put_obj("v", jnp.ones(3))
+        r = IterativeComQueue(max_iter=1).add(stage).exec()
+        sh = r.shards("v")
+        assert not sh.flags.writeable
+        with pytest.raises(ValueError):
+            sh[0, 0] = 99.0
+        g = r.get("v")
+        with pytest.raises(ValueError):
+            g[0] = 99.0
+        np.testing.assert_array_equal(r.get("v"), np.ones(3))
+        # writable private copy is one np.array() away
+        cp = np.array(sh)
+        cp[0, 0] = 7.0
+
+
+# ---------------------------------------------------------------------------
+# FTRL stream durability
+# ---------------------------------------------------------------------------
+
+def _ftrl_fixture(n=320, seed=7):
+    from alink_tpu.common.mtable import MTable
+    r = np.random.RandomState(seed)
+    X = r.randn(n, 3)
+    w = np.array([1.5, -2.0, 0.5])
+    y = (X @ w + 0.1 * r.randn(n) > 0).astype(np.int64)
+    return MTable({"f0": X[:, 0], "f1": X[:, 1], "f2": X[:, 2], "label": y})
+
+
+class TestFtrlDurability:
+    @pytest.fixture
+    def warm(self):
+        from alink_tpu.operator.batch.classification import (
+            LogisticRegressionTrainBatchOp)
+        from alink_tpu.operator.batch.source import MemSourceBatchOp
+        table = _ftrl_fixture()
+        op = LogisticRegressionTrainBatchOp(
+            feature_cols=["f0", "f1", "f2"], label_col="label",
+            max_iter=5).link_from(MemSourceBatchOp(table.first_n(64)))
+        return table, op
+
+    def _final_model(self, table, warm_op, alpha=0.5, **kw):
+        from alink_tpu.operator.stream import (FtrlTrainStreamOp,
+                                               MemSourceStreamOp)
+        src = MemSourceStreamOp(table, batch_size=32)
+        ftrl = FtrlTrainStreamOp(
+            warm_op, label_col="label", feature_cols=["f0", "f1", "f2"],
+            alpha=alpha, l1=0.001, l2=0.001, time_interval=1e9,
+            **kw).link_from(src)
+        return list(ftrl.micro_batches())[-1]
+
+    @staticmethod
+    def _coef(model_table):
+        from alink_tpu.operator.common.linear.base import (
+            LinearModelDataConverter)
+        lt = model_table.schema.types[2]
+        return np.asarray(
+            LinearModelDataConverter(lt).load_model(model_table).coef)
+
+    def test_crash_restart_resumes_bitwise(self, tmp_path, warm,
+                                           monkeypatch, fresh_registry):
+        table, warm_op = warm
+        base = self._coef(self._final_model(table, warm_op))
+        d = str(tmp_path / "ftrl")
+        monkeypatch.setenv(FAULT_ENV, "ftrl.batch:8")
+        with pytest.raises(FaultInjected):
+            self._final_model(table, warm_op, checkpoint_dir=d,
+                              checkpoint_every_batches=3)
+        monkeypatch.delenv(FAULT_ENV)
+        # batches 1..7 committed, snapshots at 3 and 6 survive
+        tags = [os.path.basename(p) for p in list_checkpoints(d)]
+        assert tags == ["ckpt-000000000003", "ckpt-000000000006"]
+        resumed = self._final_model(table, warm_op, checkpoint_dir=d,
+                                    checkpoint_every_batches=3)
+        assert self._coef(resumed).tobytes() == base.tobytes()
+        reg = fresh_registry
+        assert reg.value("alink_checkpoint_total", {"scope": "ftrl"}) >= 2
+        assert reg.value("alink_checkpoint_restore_total",
+                         {"scope": "ftrl"}) >= 1
+
+    def test_resume_refuses_other_hyperparams(self, tmp_path, warm):
+        table, warm_op = warm
+        d = str(tmp_path / "ftrl")
+        self._final_model(table, warm_op, checkpoint_dir=d,
+                          checkpoint_every_batches=4)
+        with pytest.raises(CheckpointError, match="different FTRL program"):
+            self._final_model(table, warm_op, checkpoint_dir=d,
+                              checkpoint_every_batches=4, alpha=0.9)
+
+    def test_recovered_model_quality_and_staleness(self, tmp_path, warm,
+                                                   monkeypatch,
+                                                   fresh_registry):
+        """After a crash-restart the model stream keeps serving the
+        predictor: accuracy/AUC hold and the hot-reload staleness gauge is
+        populated."""
+        from alink_tpu.operator.stream import (CollectSinkStreamOp,
+                                               FtrlPredictStreamOp,
+                                               FtrlTrainStreamOp,
+                                               MemSourceStreamOp)
+        from alink_tpu.operator.base import StreamOperator
+        table, warm_op = warm
+        d = str(tmp_path / "ftrl")
+        monkeypatch.setenv(FAULT_ENV, "ftrl.batch:6")
+        with pytest.raises(FaultInjected):
+            self._final_model(table, warm_op, checkpoint_dir=d,
+                              checkpoint_every_batches=2)
+        monkeypatch.delenv(FAULT_ENV)
+        src = MemSourceStreamOp(table, batch_size=32, time_per_batch=1.0)
+        ftrl = FtrlTrainStreamOp(
+            warm_op, label_col="label", feature_cols=["f0", "f1", "f2"],
+            alpha=0.5, l1=0.001, l2=0.001, time_interval=4.0,
+            checkpoint_dir=d, checkpoint_every_batches=2).link_from(src)
+        data = MemSourceStreamOp(table, batch_size=32, time_per_batch=1.0)
+        pred = FtrlPredictStreamOp(
+            warm_op, prediction_col="pred",
+            prediction_detail_col="detail").link_from(ftrl, data)
+        sink = CollectSinkStreamOp().link_from(pred)
+        StreamOperator.execute()
+        out = sink.get_and_remove_values()
+        assert out.num_rows == table.num_rows
+        acc = np.mean(np.asarray(out.col("pred"))
+                      == np.asarray(out.col("label")))
+        assert acc > 0.85
+        reg = fresh_registry
+        assert reg.value("alink_ftrl_model_staleness_seconds",
+                         {"op": "FtrlPredictStreamOp"}) >= 0.0
+        assert reg.value("alink_ftrl_model_reloads_total",
+                         {"op": "FtrlPredictStreamOp"}) >= 1
+
+
+class TestCheckpointSink:
+    def test_persist_reload_retention(self, tmp_path):
+        from alink_tpu.common.mtable import MTable
+        from alink_tpu.operator.base import StreamOperator
+        from alink_tpu.operator.stream import (CheckpointSinkStreamOp,
+                                               MemSourceStreamOp)
+        d = str(tmp_path / "sink")
+        table = MTable({"x": np.arange(20.0),
+                        "s": np.asarray([f"row{i}" for i in range(20)],
+                                        object)})
+        src = MemSourceStreamOp(table, batch_size=4)
+        sink = CheckpointSinkStreamOp(d, keep_last=2).link_from(src)
+        StreamOperator.execute()
+        assert len(list_checkpoints(d)) == 2
+        got = CheckpointSinkStreamOp.load_latest(d)
+        np.testing.assert_array_equal(got.col("x"), np.arange(16.0, 20.0))
+        assert list(got.col("s")) == [f"row{i}" for i in range(16, 20)]
+
+    def test_restart_continues_tag_sequence(self, tmp_path):
+        """A restarted sink must continue the tag sequence: restarting at
+        tag 1 would make tag-ordered retention delete every new snapshot
+        while load_latest kept serving the previous run's data."""
+        from alink_tpu.common.mtable import MTable
+        from alink_tpu.common.checkpoint import checkpoint_tag
+        from alink_tpu.operator.base import StreamOperator
+        from alink_tpu.operator.stream import (CheckpointSinkStreamOp,
+                                               MemSourceStreamOp)
+        d = str(tmp_path / "sink")
+
+        def drain(values):
+            src = MemSourceStreamOp({"x": np.asarray(values, float)},
+                                    batch_size=2)
+            CheckpointSinkStreamOp(d, keep_last=3).link_from(src)
+            StreamOperator.execute()
+        drain(np.arange(8.0))                      # tags 1..4 -> keep 2..4
+        drain(np.arange(100.0, 104.0))             # restart: tags 5..6
+        tags = [checkpoint_tag(p) for p in list_checkpoints(d)]
+        assert tags == [4, 5, 6]
+        got = CheckpointSinkStreamOp.load_latest(d)
+        np.testing.assert_array_equal(got.col("x"), [102.0, 103.0])
+
+    def test_all_numeric_tables_persist_as_arrays(self, tmp_path):
+        from alink_tpu.common.mtable import MTable
+        from alink_tpu.operator.base import StreamOperator
+        from alink_tpu.operator.stream import (CheckpointSinkStreamOp,
+                                               MemSourceStreamOp)
+        d = str(tmp_path / "sink")
+        table = MTable({"a": np.arange(6.0), "b": np.arange(6)})
+        src = MemSourceStreamOp(table, batch_size=6)
+        CheckpointSinkStreamOp(d).link_from(src)
+        StreamOperator.execute()
+        path = latest_checkpoint(d)
+        manifest = validate_checkpoint(path)
+        assert manifest["meta"]["mode"] == "arrays"
+        assert len(manifest["arrays"]) == 2
+        got = CheckpointSinkStreamOp.load_latest(d)
+        np.testing.assert_array_equal(got.col("a"), np.arange(6.0))
+        assert got.col("b").dtype.kind == "i"
+
+
+# ---------------------------------------------------------------------------
+# ckpt.py CLI
+# ---------------------------------------------------------------------------
+
+class TestCkptCli:
+    @pytest.fixture
+    def cli(self):
+        spec = importlib.util.spec_from_file_location(
+            "ckpt_cli", os.path.join(ROOT, "tools", "ckpt.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_list_validate_prune(self, tmp_path, cli, capsys):
+        d = str(tmp_path)
+        for i in (1, 2, 3):
+            save_checkpoint(d, i, {"z": np.arange(4.0) * i},
+                            meta={"signature": {"kind": "demo"}, "step": i})
+        assert cli.main([d]) == 0
+        out = capsys.readouterr().out
+        assert "ok" in out and "demo" in out
+        # corrupt one -> --validate flags it and exits nonzero
+        with open(os.path.join(d, "ckpt-000000000002",
+                               "arr_00000.npy"), "r+b") as f:
+            f.seek(40)
+            f.write(b"\xff")
+        assert cli.main([d, "--validate"]) == 1
+        assert "INVALID" in capsys.readouterr().out
+        assert cli.main([d, "--prune", "1"]) == 0
+        assert len(list_checkpoints(d)) == 1
+        assert cli.main([str(tmp_path / "nope")]) == 2

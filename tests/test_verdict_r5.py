@@ -81,12 +81,29 @@ class TestTableBucketingSink:
         sink.close()
         assert sink.bucket_names() == ["c_0", "c_1"]
 
-    def test_duplicate_bucket_rejected(self, tmp_path):
+    def test_duplicate_bucket_rejected_in_ruler_mode(self, tmp_path):
+        # the already-exists contract is RULER-mode only
+        # (TableBucketingSink.java:94-95; size/time mode reuses the table)
         (tmp_path / "d_0.csv").write_text("stale\n")
-        sink = TableBucketingSink("d", SCHEMA, base_dir=str(tmp_path),
-                                  batch_size=1)
+        sink = TableBucketingSink("d", SCHEMA, base_dir=str(tmp_path))
         with pytest.raises(RuntimeError, match="already exists"):
-            sink.invoke(_rows(0, 1)[0])
+            sink.invoke((0, 1) + _rows(0, 1)[0])
+
+    def test_size_mode_reuses_existing_bucket(self, tmp_path):
+        # size/time mode appends into a pre-existing bucket target, like
+        # the reference's writeBySizeOrTime reusing the table across runs
+        s1 = TableBucketingSink("d", SCHEMA, base_dir=str(tmp_path),
+                                batch_size=2)
+        for r in _rows(0, 2):
+            s1.invoke(r)
+        s1.close()
+        s2 = TableBucketingSink("d", SCHEMA, base_dir=str(tmp_path),
+                                batch_size=2)
+        for r in _rows(2, 4):
+            s2.invoke(r)
+        s2.close()
+        txt = (tmp_path / "d_0.csv").read_text()
+        assert txt.splitlines() == ["0.0,s0", "1.0,s1", "2.0,s2", "3.0,s3"]
 
     def test_exactly_one_target(self, tmp_path):
         with pytest.raises(ValueError):

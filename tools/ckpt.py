@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""Inspect / validate / prune alink_tpu checkpoint directories.
+
+Usage:
+    python tools/ckpt.py <dir>                      # list snapshots
+    python tools/ckpt.py <dir> --validate           # full checksum audit
+    python tools/ckpt.py <dir> --prune KEEP         # keep newest KEEP
+    python tools/ckpt.py <dir> --json               # machine-readable list
+
+The on-disk format is common/checkpoint.py's ``ckpt-<tag>/`` layout
+(manifest.json + per-array .npy payloads); see docs/checkpointing.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from alink_tpu.common.checkpoint import (CheckpointError,  # noqa: E402
+                                         checkpoint_tag, list_checkpoints,
+                                         prune_checkpoints, read_manifest,
+                                         validate_checkpoint)
+
+
+def _row(path: str, validate: bool) -> dict:
+    rec = {"path": path, "tag": checkpoint_tag(path)}
+    try:
+        manifest = validate_checkpoint(path) if validate \
+            else read_manifest(path)
+        rec["valid"] = True
+        rec["created_unix"] = manifest.get("created_unix")
+        rec["arrays"] = len(manifest.get("arrays", []))
+        rec["bytes"] = sum(a.get("bytes", 0)
+                           for a in manifest.get("arrays", []))
+        meta = manifest.get("meta", {})
+        sig = meta.get("signature")
+        rec["kind"] = (sig or {}).get("kind") or meta.get("mode") or "?"
+        for k in ("step", "batches_done", "batch_index"):
+            if k in meta:
+                rec["progress"] = f"{k}={meta[k]}"
+    except CheckpointError as e:
+        rec["valid"] = False
+        rec["error"] = str(e)
+    return rec
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ckpt.py", description=__doc__.splitlines()[0])
+    ap.add_argument("directory", help="checkpoint directory")
+    ap.add_argument("--validate", action="store_true",
+                    help="checksum every payload file (slow but thorough)")
+    ap.add_argument("--prune", type=int, metavar="KEEP",
+                    help="delete all but the newest KEEP snapshots "
+                         "(and stale .tmp debris)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object per snapshot")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.directory):
+        print(f"ckpt.py: no such directory: {args.directory}",
+              file=sys.stderr)
+        return 2
+
+    if args.prune is not None:
+        if args.prune < 1:
+            print("ckpt.py: --prune KEEP must be >= 1", file=sys.stderr)
+            return 2
+        removed = prune_checkpoints(args.directory, args.prune)
+        for p in removed:
+            print(f"removed {p}")
+        print(f"{len(removed)} removed, "
+              f"{len(list_checkpoints(args.directory))} kept")
+        return 0
+
+    rows = [_row(p, args.validate) for p in list_checkpoints(args.directory)]
+    if args.json:
+        for rec in rows:
+            print(json.dumps(rec))
+        return 0 if all(r["valid"] for r in rows) else 1
+    if not rows:
+        print(f"no snapshots under {args.directory}")
+        return 0
+    print(f"{'tag':>12}  {'status':7}  {'arrays':>6}  {'bytes':>12}  "
+          f"{'created':19}  progress")
+    for r in rows:
+        if r["valid"]:
+            created = time.strftime("%Y-%m-%d %H:%M:%S",
+                                    time.localtime(r["created_unix"]))
+            print(f"{r['tag']:>12}  {'ok':7}  {r['arrays']:>6}  "
+                  f"{r['bytes']:>12}  {created:19}  "
+                  f"{r.get('kind', '?')} {r.get('progress', '')}")
+        else:
+            print(f"{r['tag']:>12}  {'INVALID':7}  {'-':>6}  {'-':>12}  "
+                  f"{'-':19}  {r['error']}")
+    bad = [r for r in rows if not r["valid"]]
+    if bad:
+        print(f"{len(bad)} invalid snapshot(s)"
+              + ("" if args.validate else
+                 " (manifest check only; --validate checksums payloads)"))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -35,6 +35,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from tools.zh_core_vocab import CORE_VOCAB  # noqa: E402
 from tools.zh_vocab_extended import EXTENDED_VOCAB  # noqa: E402
+from tools.zh_vocab_r5 import R5_BLOCKS  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(__file__), "..", "alink_tpu",
                    "operator", "common", "nlp", "zh_dict.txt")
@@ -310,6 +311,13 @@ def main():
         put(w, f)
     for w, f in EXTENDED_VOCAB:
         put(w, f)
+    # round-5 domain vocabulary (medicine/law/IT/daily life/geo/mind) plus
+    # enumerated verb-complement compounds; each block maps a frequency
+    # band to its whitespace-separated words (tools/zh_vocab_r5.py)
+    for block in R5_BLOCKS:
+        for band, text in sorted(block.items()):
+            for w in text.split():
+                put(w, band, "r5")
     # round-2's hand-tuned 1.1k list rides along as a base layer (it is
     # equally original and already covers the segmenter's fixture set)
     base = os.path.join(os.path.dirname(__file__), "zh_base_vocab.txt")
