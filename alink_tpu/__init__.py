@@ -15,7 +15,8 @@ from .common import (Params, ParamInfo, WithParams, AlinkTypes, TableSchema,
                      use_remote_env,
                      StepTimer, named_stage, trace,
                      MetricsRegistry, get_registry, set_registry,
-                     metrics_enabled)
+                     metrics_enabled,
+                     Tracer, get_tracer, set_tracer, tracing_enabled)
 from .engine import (IterativeComQueue, ComContext, ComputeFunction, AllReduce,
                      AllGather, BroadcastFromWorker0)
 

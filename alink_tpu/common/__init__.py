@@ -9,6 +9,8 @@ from .lazy import LazyEvaluation, LazyObjectsManager
 from .metrics import (MetricsRegistry, get_registry, metrics_enabled,
                       set_registry)
 from .profiling import StepTimer, named_stage, trace
+from .tracing import (Tracer, get_tracer, set_tracer, tracing_enabled,
+                      trace_span, trace_instant)
 
 __all__ = [
     "Params", "ParamInfo", "WithParams", "RangeValidator", "InValidator", "MinValidator",
@@ -17,4 +19,6 @@ __all__ = [
     "use_local_env", "use_remote_env", "LazyEvaluation", "LazyObjectsManager",
     "StepTimer", "named_stage", "trace",
     "MetricsRegistry", "get_registry", "set_registry", "metrics_enabled",
+    "Tracer", "get_tracer", "set_tracer", "tracing_enabled",
+    "trace_span", "trace_instant",
 ]

@@ -60,6 +60,7 @@ import numpy as np
 
 from .faults import maybe_crash
 from .metrics import get_registry, metrics_enabled
+from .tracing import trace_instant
 
 __all__ = [
     "CheckpointError", "FORMAT_NAME", "FORMAT_VERSION",
@@ -246,6 +247,9 @@ def save_checkpoint(directory: str, tag: int, payload: Any,
         reg.inc("alink_checkpoint_bytes_total", total_bytes, lbl)
         reg.observe("alink_checkpoint_seconds", time.perf_counter() - t0, lbl)
         reg.set_gauge("alink_checkpoint_last_tag", tag, lbl)
+    trace_instant("checkpoint.save", cat="ckpt",
+                  args={"scope": scope, "tag": tag, "bytes": total_bytes,
+                        "seconds": round(time.perf_counter() - t0, 6)})
     if keep_last is not None:
         prune_checkpoints(directory, keep_last)
     return final
@@ -323,6 +327,8 @@ def load_checkpoint(path: str, *, scope: str = "default",
     if metrics_enabled():
         get_registry().inc("alink_checkpoint_restore_total", 1,
                            {"scope": scope})
+    trace_instant("checkpoint.restore", cat="ckpt",
+                  args={"scope": scope, "tag": manifest.get("tag")})
     return payload, manifest.get("meta", {})
 
 

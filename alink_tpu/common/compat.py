@@ -15,7 +15,7 @@ from __future__ import annotations
 import functools
 from typing import Any, Callable, Optional
 
-__all__ = ["shard_map", "lowered_text"]
+__all__ = ["shard_map", "lowered_text", "compiled_cost_analysis"]
 
 _impl: Optional[tuple] = None  # (callable, check_kwarg_name)
 
@@ -44,6 +44,44 @@ def shard_map(f: Callable, *, mesh, in_specs, out_specs,
     if check_vma is not None:
         kw[check_kw] = check_vma
     return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
+def compiled_cost_analysis(stage: Any) -> Optional[dict]:
+    """XLA's static cost model for a ``jax.stages.Lowered`` or
+    ``Compiled`` object, normalized across jax versions.
+
+    The underlying ``cost_analysis()`` has returned, depending on
+    version, a dict, a one-element **list** of dicts (one per program),
+    or raised/been absent entirely (older jaxlibs, some backends). This
+    shim always returns either a flat ``{str: float}`` dict — the
+    interesting keys are ``"flops"`` and ``"bytes accessed"`` — or
+    ``None`` (never an exception), so telemetry callers can attach cost
+    data when available and degrade silently when not.
+
+    Caveats (documented in docs/observability.md): the model is *static*
+    — a ``while``-loop body is costed once, not per trip, so for the
+    engine's superstep programs the figures describe one loop pass;
+    non-arithmetic ops (data movement, collectives) may be missing or
+    backend-approximate.
+    """
+    fn = getattr(stage, "cost_analysis", None)
+    if fn is None:
+        return None
+    try:
+        ca = fn()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    for k, v in ca.items():
+        try:
+            out[str(k)] = float(v)
+        except (TypeError, ValueError):
+            continue
+    return out or None
 
 
 def lowered_text(lowered: Any, debug_info: bool = False) -> str:

@@ -111,4 +111,11 @@ def maybe_crash(site: str, index: Optional[int] = None) -> None:
         _AUTO_INDEX[site] = index
     threshold = spec.get(site)
     if threshold is not None and index >= threshold:
+        # mark the kill in the trace timeline BEFORE raising, so a flight
+        # recorder dumped by the crash handler shows exactly where the
+        # injected preemption hit relative to checkpoint saves
+        from .tracing import trace_instant
+        trace_instant("fault.injected", cat="fault",
+                      args={"site": site, "index": int(index),
+                            "threshold": threshold})
         raise FaultInjected(site, int(index), threshold)

@@ -41,6 +41,7 @@ import json
 import os
 import threading
 import time
+import warnings
 from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = [
@@ -115,6 +116,7 @@ class _Family:
         else:
             self.buckets = ()
         self._series: Dict[Tuple[Tuple[str, str], ...], _Series] = {}
+        self._overflow_warned = False
 
     # -- series management ------------------------------------------------
     _OVERFLOW_KEY = (("alink_overflow", "true"),)
@@ -127,7 +129,19 @@ class _Family:
                     and key != self._OVERFLOW_KEY:
                 # cardinality guard: runaway label values (e.g. an id
                 # leaking into a label) collapse into one overflow series
-                # instead of growing the registry without bound
+                # instead of growing the registry without bound. Warn ONCE
+                # per metric name — per-sample warnings on a hot path
+                # would be their own flood (the samples keep folding into
+                # the overflow series regardless)
+                if not self._overflow_warned:
+                    self._overflow_warned = True
+                    warnings.warn(
+                        f"metric {self.name!r}: label-set cardinality cap "
+                        f"({self._registry.max_series_per_metric}) reached; "
+                        f"further new label sets fold into the "
+                        f"alink_overflow=true series (is an unbounded id "
+                        f"leaking into a label?)",
+                        RuntimeWarning, stacklevel=4)
                 self._registry._dropped_series += 1
                 return self._get_series(dict(self._OVERFLOW_KEY))
             n_b = len(self.buckets) + 1 if self.kind == "histogram" else 0

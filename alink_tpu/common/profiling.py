@@ -35,6 +35,11 @@ default, ``0``/``false``/``off``/``no`` disable, anything else enables):
   * ``ALINK_TPU_METRICS``  — default on. Master switch for every
     ``MetricsRegistry`` producer, including the span mirror here; hot
     paths skip all registry updates when disabled.
+  * ``ALINK_TPU_TRACE``    — default off. When enabled, every
+    ``StepTimer.span`` additionally opens a span on the process tracer
+    (``common/tracing.py``), so StepTimer call sites land in the trace
+    timeline with correct parent/child nesting and need no second
+    instrumentation of their own.
 """
 
 from __future__ import annotations
@@ -46,6 +51,7 @@ from dataclasses import dataclass, field
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from .metrics import env_flag, get_registry, metrics_enabled
+from .tracing import trace_span
 
 __all__ = ["StepTimer", "named_stage", "trace", "step_log_enabled",
            "log_superstep"]
@@ -134,7 +140,11 @@ class StepTimer:
              labels: Optional[Dict[str, str]] = None) -> Iterator[None]:
         t0 = time.perf_counter()
         try:
-            yield
+            # single source of truth: under ALINK_TPU_TRACE the same span
+            # also lands on the process tracer (nested via contextvars),
+            # so StepTimer call sites never need double-instrumentation
+            with trace_span(name, cat="steptimer", args=labels):
+                yield
         finally:
             dt = time.perf_counter() - t0
             with self._lock:

@@ -32,6 +32,7 @@ Contract notes:
 
 from __future__ import annotations
 
+import time
 import weakref
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence
@@ -39,6 +40,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from ..common.mlenv import MLEnvironment, MLEnvironmentFactory
+from ..common.tracing import trace_instant, trace_span, tracing_enabled
 from .context import ComContext
 from .communication import CommunicateFunction
 
@@ -69,6 +71,10 @@ _PROGRAM_CACHE_JAXPRS: Dict[tuple, str] = {}
 # a program compiled under ALINK_TPU_METRICS=0 still carries its manifest
 # when a later metrics-on exec hits the cache.
 _PROGRAM_CACHE_MANIFESTS: Dict[tuple, dict] = {}
+# XLA static cost model per cached key (compat.compiled_cost_analysis on
+# the lowered program). Computed lazily and only under ALINK_TPU_TRACE —
+# the lowering costs a full re-trace, so the default path never pays it.
+_PROGRAM_CACHE_COSTS: Dict[tuple, dict] = {}
 
 # Engine phase wall-clock (prepare inputs / execute+compile / collect).
 # Spans mirror into the MetricsRegistry as alink_step_timer_seconds via
@@ -92,6 +98,39 @@ def clear_program_cache() -> None:
     _PROGRAM_CACHE.clear()
     _PROGRAM_CACHE_JAXPRS.clear()
     _PROGRAM_CACHE_MANIFESTS.clear()
+    _PROGRAM_CACHE_COSTS.clear()
+
+
+def _program_label(program_key) -> str:
+    """Human-readable, bounded-cardinality label for per-program metrics.
+    Callers conventionally lead their ``set_program_key`` tuple with a
+    short algorithm string (``("qn", ...)``, ``("als", ...)``); fall back
+    to a digest when the key has no such prefix."""
+    if isinstance(program_key, (tuple, list)) and program_key \
+            and isinstance(program_key[0], str):
+        return program_key[0]
+    import hashlib
+    return hashlib.blake2b(repr(program_key).encode(),
+                           digest_size=6).hexdigest()
+
+
+def _maybe_cost(ckey: Optional[tuple], lower_thunk: Callable) -> Optional[dict]:
+    """The cached program's static XLA cost dict, memoized per key.
+
+    Computed only under ``ALINK_TPU_TRACE`` (``lower_thunk`` re-traces the
+    program, seconds for the big optimizer programs); once computed it is
+    served from the memo so later traced execs pay a dict lookup. An
+    unavailable cost model memoizes as ``{}`` — degraded jax versions must
+    not re-pay the lowering on every traced exec just to learn None
+    again."""
+    if ckey is None:
+        return None
+    cost = _PROGRAM_CACHE_COSTS.get(ckey)
+    if cost is None and tracing_enabled():
+        from ..common.compat import compiled_cost_analysis
+        cost = compiled_cost_analysis(lower_thunk()) or {}
+        _PROGRAM_CACHE_COSTS[ckey] = cost
+    return cost or None
 
 
 def freeze_config(v):
@@ -529,7 +568,14 @@ class IterativeComQueue:
         return self._run(lower_only=True, lower_chunked=True)
 
     def exec(self):
-        return self._run(lower_only=False)
+        # one root span per exec: every phase span (prepare / execute via
+        # StepTimer), chunk span and instant event below nests under it,
+        # so a trace file reads as one tree per fit
+        with trace_span("comqueue.exec", cat="engine") as sp:
+            sp.set(max_iter=int(self.max_iter),
+                   program=_program_label(self._program_key)
+                   if self._program_key is not None else "uncached")
+            return self._run(lower_only=False)
 
     def _run(self, lower_only: bool = False, lower_chunked: bool = False):
         import jax
@@ -757,9 +803,15 @@ class IterativeComQueue:
                         old_key, _ = _PROGRAM_CACHE.popitem(last=False)
                         _PROGRAM_CACHE_JAXPRS.pop(old_key, None)
                         _PROGRAM_CACHE_MANIFESTS.pop(old_key, None)
+                        _PROGRAM_CACHE_COSTS.pop(old_key, None)
             if mx and ckkey is not None:
                 get_registry().inc("alink_comqueue_program_cache_total", 1,
                                    {"result": cache_status})
+            if ckkey is not None:
+                trace_instant("comqueue.program_cache", cat="engine",
+                              args={"result": cache_status})
+            cost = _maybe_cost(ckkey, lambda: first.lower(
+                parts, bcast, jnp.asarray(max_iter, jnp.int32)))
             part_sig = tuple(
                 (k, tuple(map(int, np.shape(parts[k]))),
                  str(getattr(parts[k], "dtype", "?"))) for k in sorted(parts))
@@ -784,8 +836,13 @@ class IterativeComQueue:
                 stacked, ck_info = recovery.drive(
                     ck, first=first, cont=cont, parts=parts, bcast=bcast,
                     max_iter=max_iter, signature=signature, resumed=resumed)
+            # chunked path: the program runs once per chunk, so only the
+            # STATIC cost gauges are meaningful (no exec_t0 -> no achieved
+            # rates; see _finish)
             return self._finish(stacked, nw, totals, manifest, parts, bcast,
-                                mx, ck_info)
+                                mx, ck_info, cost=cost,
+                                prog_label=_program_label(self._program_key)
+                                if self._program_key is not None else None)
         from ..common.metrics import env_flag
         verify = env_flag("ALINK_VERIFY_PROGRAM_CACHE", default=False)
         if ckey is not None:
@@ -809,6 +866,7 @@ class IterativeComQueue:
                     old_key, _ = _PROGRAM_CACHE.popitem(last=False)
                     _PROGRAM_CACHE_JAXPRS.pop(old_key, None)
                     _PROGRAM_CACHE_MANIFESTS.pop(old_key, None)
+                    _PROGRAM_CACHE_COSTS.pop(old_key, None)
         elif ckey is not None:
             cache_status = "hit"
             _PROGRAM_CACHE_STATS["hits"] += 1
@@ -830,6 +888,11 @@ class IterativeComQueue:
         if mx and ckey is not None:
             get_registry().inc("alink_comqueue_program_cache_total", 1,
                                {"result": cache_status})
+        if ckey is not None:
+            trace_instant("comqueue.program_cache", cat="engine",
+                          args={"result": cache_status})
+        cost = _maybe_cost(ckey, lambda: compiled.lower(parts, bcast))
+        exec_t0 = time.perf_counter()
         with _ENGINE_TIMER.span("comqueue.execute",
                                 labels={"program": cache_status}):
             stacked = compiled(parts, bcast)
@@ -843,13 +906,18 @@ class IterativeComQueue:
                     multihost_utils.process_allgather(x, tiled=True)),
                 stacked)
         return self._finish(stacked, nw, totals, manifest, parts, bcast,
-                            mx, None)
+                            mx, None, cost=cost, exec_t0=exec_t0,
+                            prog_label=_program_label(self._program_key)
+                            if self._program_key is not None else None)
 
     def _finish(self, stacked, nw, totals, manifest, parts, bcast, mx,
-                ck_info):
+                ck_info, cost=None, exec_t0=None, prog_label=None):
         """Shared result assembly + metrics tail for the single-program
         and checkpoint-chunked execution paths. ``ck_info`` is the
-        recovery driver's accounting (None on the single-program path)."""
+        recovery driver's accounting (None on the single-program path).
+        ``cost`` is the program's static XLA cost dict (tracing-only, see
+        _maybe_cost); ``exec_t0`` the dispatch start on the single-program
+        path, used for achieved-rate gauges."""
         import jax
 
         from ..common.metrics import get_registry
@@ -907,6 +975,30 @@ class IterativeComQueue:
                 reg.inc("alink_collective_calls_total", times, lbl)
                 reg.inc("alink_collective_logical_bytes_total",
                         times * nbytes, lbl)
+            if cost is not None:
+                # XLA's static cost model for this program (ALINK_TPU_TRACE
+                # runs only — _maybe_cost). The step_count fetch above
+                # flushed the run, so elapsed-since-dispatch is an honest
+                # wall-clock bound for the achieved rates; NOTE the static
+                # model costs a while-loop body ONCE, so treat achieved
+                # figures as per-program-pass, not per-superstep totals.
+                plbl = {"program": prog_label or "?"}
+                flops = cost.get("flops")
+                acc_bytes = cost.get("bytes accessed")
+                if flops is not None:
+                    reg.set_gauge("alink_program_flops", flops, plbl)
+                if acc_bytes is not None:
+                    reg.set_gauge("alink_program_bytes_accessed",
+                                  acc_bytes, plbl)
+                if exec_t0 is not None:
+                    elapsed = time.perf_counter() - exec_t0
+                    if elapsed > 0:
+                        if flops:
+                            reg.set_gauge("alink_program_achieved_flops_per_s",
+                                          flops / elapsed, plbl)
+                        if acc_bytes:
+                            reg.set_gauge("alink_program_achieved_bytes_per_s",
+                                          acc_bytes / elapsed, plbl)
         if self._close is not None:
             return self._close(result)
         return result

@@ -35,6 +35,7 @@ import numpy as np
 
 from ..common.checkpoint import load_latest_validated, save_checkpoint
 from ..common.faults import maybe_crash
+from ..common.tracing import trace_span
 
 __all__ = ["CheckpointConfig", "program_signature", "resume_state", "drive"]
 
@@ -143,19 +144,32 @@ def drive(config: CheckpointConfig, *,
         stop = bool(np.asarray(stacked["__stop"])[0])
         return step, stop
 
+    def chunk(fn, args, from_step, limit):
+        """One compiled-chunk pass: dispatch + the boundary sync that
+        flushes it. The span tree (exec -> execute -> chunk ->
+        superstep.sync) is what lets a trace answer 'which chunk of
+        which exec was slow' — the aggregate metrics cannot."""
+        with trace_span("comqueue.chunk", cat="engine") as sp:
+            out = fn(*args, jnp.asarray(limit, jnp.int32))
+            # the device work materializes at this host fetch — timed as
+            # its own phase span so dispatch vs sync split is visible
+            with trace_span("superstep.sync", cat="engine"):
+                step, stop = boundary(out)
+            sp.set(from_step=from_step, limit=limit, step=step)
+        return out, step, stop
+
     info: Dict[str, Any] = {"init_ran": resumed is None, "resumed_at": None}
     if resumed is None:
-        stacked = first(parts, bcast,
-                        jnp.asarray(_next_limit(1, every, max_iter),
-                                    jnp.int32))
+        stacked, step, stop = chunk(first, (parts, bcast), 1,
+                                    _next_limit(1, every, max_iter))
         start_step = 0
     else:
         stacked = resumed
-        start_step, _ = boundary(stacked)
+        step, stop = boundary(stacked)
+        start_step = step
         info["resumed_at"] = start_step
     last_saved = start_step if resumed is not None else None
     while True:
-        step, stop = boundary(stacked)
         # the injected-preemption point: BEFORE the snapshot publish, so a
         # killed run genuinely loses the work since the last checkpoint
         # and the resume has supersteps to re-execute
@@ -169,9 +183,8 @@ def drive(config: CheckpointConfig, *,
             last_saved = step
         if stop or step >= max_iter:
             break
-        stacked = cont(parts, bcast, stacked,
-                       jnp.asarray(_next_limit(step, every, max_iter),
-                                   jnp.int32))
+        stacked, step, stop = chunk(cont, (parts, bcast, stacked), step,
+                                    _next_limit(step, every, max_iter))
     info["steps_executed"] = step - start_step
     return stacked, info
 

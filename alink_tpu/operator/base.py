@@ -20,6 +20,7 @@ from typing import Any, Callable, List, Optional, Sequence
 import numpy as np
 
 from ..common.metrics import get_registry, metrics_enabled
+from ..common.tracing import trace_span, tracing_enabled
 from ..common.mlenv import MLEnvironment, MLEnvironmentFactory
 from ..common.mtable import MTable
 from ..common.params import Params, WithParams
@@ -34,18 +35,31 @@ def _meter_link_from(fn: Callable) -> Callable:
     to every BatchOperator subclass via ``__init_subclass__`` — operators
     compute eagerly at link time, so link_from IS the execute path.
     Reentrant links on the same instance (subclass delegating to a base
-    link_from) record once, at the outermost frame."""
+    link_from) record once, at the outermost frame.
+
+    Under ``ALINK_TPU_TRACE`` the same frame also opens a tracer span
+    (``link:<Op>``): composite operators link their sub-operators inside
+    their own link_from, so the spans nest into the pipeline DAG with no
+    per-operator instrumentation."""
 
     @functools.wraps(fn)
     def metered(self, *inputs, **kwargs):
-        if not metrics_enabled() or getattr(self, "_in_metered_link", False):
+        mx = metrics_enabled()
+        if (not mx and not tracing_enabled()) \
+                or getattr(self, "_in_metered_link", False):
             return fn(self, *inputs, **kwargs)
         self._in_metered_link = True
         t0 = time.perf_counter()
         try:
-            res = fn(self, *inputs, **kwargs)
+            with trace_span(f"link:{type(self).__name__}", cat="batch") as sp:
+                res = fn(self, *inputs, **kwargs)
+                out_t = getattr(self, "_output", None)
+                if out_t is not None:
+                    sp.set(rows_out=out_t.num_rows)
         finally:
             self._in_metered_link = False
+        if not mx:
+            return res
         reg = get_registry()
         lbl = {"op": type(self).__name__}
         reg.observe("alink_batch_op_seconds", time.perf_counter() - t0, lbl)

@@ -15,6 +15,7 @@ from typing import Callable, Iterable, Iterator, Optional, Tuple
 
 from ...common.metrics import get_registry, metrics_enabled
 from ...common.mtable import MTable
+from ...common.tracing import trace_complete
 from ...common.types import TableSchema
 from ..base import StreamOperator
 
@@ -84,9 +85,17 @@ class BaseStreamTransformOp(StreamOperator):
                 last_t = t
                 t0 = time.perf_counter()
                 out = worker._transform(mt)
+                dt = time.perf_counter() - t0
+                # retroactive span (trace_complete, not a ``with`` block):
+                # this generator body suspends at ``yield`` in the
+                # CALLER's context, so an open span held across the yield
+                # would adopt unrelated downstream spans as children
+                trace_complete(f"stream:{type(self).__name__}", dt,
+                               cat="stream",
+                               args={"rows": mt.num_rows,
+                                     "event_time": t})
                 if mx:
-                    reg.observe("alink_stream_batch_seconds",
-                                time.perf_counter() - t0, lbl)
+                    reg.observe("alink_stream_batch_seconds", dt, lbl)
                     reg.inc("alink_stream_batches_total", 1, lbl)
                     reg.inc("alink_stream_rows_total", mt.num_rows, lbl)
                 if out is STOP:

@@ -36,6 +36,7 @@ from ....common.faults import maybe_crash
 from ....common.metrics import get_registry, metrics_enabled
 from ....common.mtable import MTable
 from ....common.params import InValidator, ParamInfo, Params, RangeValidator
+from ....common.tracing import trace_complete, trace_instant
 from ....common.types import AlinkTypes, TableSchema
 from ....params.shared import (HasFeatureCols, HasLabelCol, HasPredictionCol,
                                HasPredictionDetailCol, HasReservedCols,
@@ -881,6 +882,14 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                           sparse_step[0] = _ftrl_sparse_step_factory(
                               mesh, alpha, beta, l1, l2)
                   z, n, _ = sparse_step[0](idx, val, y, z, n)
+              # retroactive span (generator body; see stream/core.py on
+              # why an open span must not cross a yield): encode overlap
+              # happens in the prefetch thread, so this span reads as the
+              # consumer-side dispatch latency of one micro-batch
+              trace_complete("ftrl.batch", time.perf_counter() - t0,
+                             cat="stream",
+                             args={"mode": update_mode, "rows": mt.num_rows,
+                                   "batch": b_done + 1})
               if mx:
                   reg.observe("alink_ftrl_batch_seconds",
                               time.perf_counter() - t0, m_lbl)
@@ -890,6 +899,8 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                   reg.inc("alink_stream_rows_total", mt.num_rows,
                           {"op": "FtrlTrainStreamOp"})
               if t + 1e-12 >= next_emit:
+                  trace_instant("ftrl.snapshot", cat="stream",
+                                args={"event_time": t, "batch": b_done + 1})
                   yield (t, snapshot(z, n, fb_S))
                   if mx:
                       reg.inc("alink_ftrl_snapshots_total", 1)
@@ -915,6 +926,8 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 z, n = alloc(layout)
             if mx:
                 reg.inc("alink_ftrl_snapshots_total", 1)
+            trace_instant("ftrl.snapshot", cat="stream",
+                          args={"batch": b_done, "final": True})
             yield (next_emit if next_emit is not None else interval,
                    snapshot(z, n, fb_S))
 
@@ -966,9 +979,13 @@ class FtrlPredictStreamOp(StreamOperator, HasPredictionCol, HasPredictionDetailC
                         if self._initial_model is None:
                             continue  # no model yet: drop (reference buffers)
                         model = self._initial_model.get_output_table()
-                    elif mx:
+                    else:
                         # an actual hot swap (not the warm-start fallback)
-                        reg.inc("alink_ftrl_model_reloads_total", 1, lbl)
+                        if mx:
+                            reg.inc("alink_ftrl_model_reloads_total", 1, lbl)
+                        trace_instant("ftrl.model_reload", cat="stream",
+                                      args={"model_time": last_model_t,
+                                            "data_time": t})
                     mapper = make_mapper(model, mt.schema)
                     self._schema = mapper.get_output_schema()
                 if mx:

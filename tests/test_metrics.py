@@ -114,6 +114,23 @@ class TestLabelCardinality:
         assert reg.value("c", {"alink_overflow": "true"}) == 6
         assert reg._dropped_series == 6
 
+    def test_cardinality_overflow_warns_once_per_metric(self):
+        import warnings
+        reg = MetricsRegistry(max_series_per_metric=2)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            for i in range(50):               # 48 overflowing samples...
+                reg.inc("hot", 1, {"id": str(i)})
+            for i in range(10):               # second metric overflows too
+                reg.inc("hot2", 1, {"id": str(i)})
+        got = [x for x in w if issubclass(x.category, RuntimeWarning)]
+        # ...but exactly ONE warning per metric NAME, not per sample
+        assert len(got) == 2
+        assert "'hot'" in str(got[0].message)
+        assert "'hot2'" in str(got[1].message)
+        # the fold-in behaviour is unchanged
+        assert reg.value("hot", {"alink_overflow": "true"}) == 48
+
 
 class TestExporters:
     def _populated(self):

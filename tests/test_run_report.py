@@ -1,0 +1,140 @@
+"""tools/run_report.py CLI — dump round-trip + rendered table contents.
+
+The report renderer is the operator-facing surface of the metrics
+subsystem; these tests pin the section layout and the actual numbers a
+known registry dump renders to (not just "exit code 0"), plus the
+--prom / --all / --trace modes.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from alink_tpu.common.metrics import MetricsRegistry
+from alink_tpu.common.tracing import Tracer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_run_report():
+    spec = importlib.util.spec_from_file_location(
+        "run_report_under_test", os.path.join(ROOT, "tools", "run_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _populated_registry() -> MetricsRegistry:
+    """A registry shaped like a real run: engine, collectives, spans,
+    stream, FTRL, batch ops, and one uncovered extra."""
+    reg = MetricsRegistry()
+    reg.inc("alink_comqueue_execs_total", 2)
+    reg.inc("alink_comqueue_supersteps_total", 10)
+    reg.inc("alink_comqueue_program_cache_total", 1, {"result": "hit"})
+    reg.inc("alink_comqueue_program_cache_total", 1, {"result": "miss"})
+    ar = {"collective": "AllReduce"}
+    reg.inc("alink_collective_calls_total", 10, ar)
+    reg.inc("alink_collective_logical_bytes_total", 320, ar)
+    reg.observe("alink_step_timer_seconds", 0.137,
+                {"span": "comqueue.execute", "program": "miss"})
+    reg.observe("alink_stream_batch_seconds", 0.004, {"op": "SelectStreamOp"})
+    reg.inc("alink_stream_batches_total", 5, {"op": "SelectStreamOp"})
+    reg.inc("alink_stream_rows_total", 40, {"op": "SelectStreamOp"})
+    reg.observe("alink_ftrl_batch_seconds", 0.002,
+                {"op": "FtrlTrainStreamOp", "mode": "batch"})
+    reg.inc("alink_ftrl_rows_total", 1000,
+            {"op": "FtrlTrainStreamOp", "mode": "batch"})
+    reg.observe("alink_batch_op_seconds", 0.05, {"op": "SelectBatchOp"})
+    reg.inc("alink_batch_rows_in_total", 10, {"op": "SelectBatchOp"})
+    reg.inc("alink_batch_rows_out_total", 10, {"op": "SelectBatchOp"})
+    reg.set_gauge("alink_program_flops", 1234.0, {"program": "qn"})
+    return reg
+
+
+@pytest.fixture
+def dump_path(tmp_path):
+    return _populated_registry().dump(str(tmp_path / "run.jsonl"))
+
+
+class TestRunReportCli:
+    def test_dump_round_trips_before_rendering(self, dump_path):
+        reg = _populated_registry()
+        loaded = MetricsRegistry.load(dump_path)
+        assert loaded.snapshot() == reg.snapshot()
+
+    def test_rendered_tables_carry_the_numbers(self, dump_path, capsys):
+        mod = _load_run_report()
+        assert mod.main([dump_path]) == 0
+        out = capsys.readouterr().out
+        # run summary: totals and the derived rates
+        assert "== Run summary ==" in out
+        assert "comqueue execs" in out and "supersteps" in out
+        assert "50.0%" in out            # 1 hit / (1 hit + 1 miss)
+        assert "5.0" in out              # supersteps / exec
+        # collectives: calls, formatted bytes, bytes/call
+        assert "AllReduce" in out and "320 B" in out and "32 B" in out
+        # host spans with merged extra labels
+        assert "comqueue.execute [program=miss]" in out
+        # stream throughput: 40 rows / 0.004 s = 10,000 rows/s
+        assert "SelectStreamOp" in out and "10,000" in out
+        # FTRL section with its mode label
+        assert "== FTRL ==" in out and "mode=batch" in out
+        # batch ops
+        assert "SelectBatchOp" in out
+        # the uncovered gauge falls through to Other metrics
+        assert "== Other metrics ==" in out
+        assert "alink_program_flops" in out and "program=qn" in out
+
+    def test_all_flag_lists_claimed_series_too(self, dump_path, capsys):
+        mod = _load_run_report()
+        assert mod.main([dump_path, "--all"]) == 0
+        out = capsys.readouterr().out
+        # --all repeats section-claimed metrics under Other metrics
+        assert "alink_comqueue_execs_total" in out
+
+    def test_prom_mode_emits_exposition_text(self, dump_path, capsys):
+        mod = _load_run_report()
+        assert mod.main([dump_path, "--prom"]) == 0
+        out = capsys.readouterr().out
+        assert "# TYPE alink_comqueue_execs_total counter" in out
+        assert 'alink_collective_calls_total{collective="AllReduce"} 10.0' \
+            in out
+
+    def test_trace_flag_appends_span_summary(self, dump_path, tmp_path,
+                                             capsys):
+        tr = Tracer()
+        with tr.span("comqueue.exec", cat="engine"):
+            with tr.span("comqueue.execute", cat="steptimer"):
+                pass
+            tr.instant("comqueue.program_cache", args={"result": "hit"})
+        tp = tr.export_jsonl(str(tmp_path / "trace.jsonl"))
+        mod = _load_run_report()
+        assert mod.main([dump_path, "--trace", tp]) == 0
+        out = capsys.readouterr().out
+        # metrics tables AND the trace rollup in one report
+        assert "== Run summary ==" in out
+        assert "== Trace summary ==" in out
+        assert "== Top spans by self time" in out
+        assert "comqueue.program_cache" in out
+
+    def test_prom_mode_never_appends_trace_tables(self, dump_path,
+                                                  tmp_path, capsys):
+        tr = Tracer()
+        with tr.span("s"):
+            pass
+        tp = tr.export_jsonl(str(tmp_path / "trace.jsonl"))
+        mod = _load_run_report()
+        assert mod.main([dump_path, "--prom", "--trace", tp]) == 0
+        out = capsys.readouterr().out
+        # stdout stays pure Prometheus exposition text
+        assert "Trace summary" not in out
+        assert "# TYPE alink_comqueue_execs_total counter" in out
+
+    def test_empty_registry_renders(self, tmp_path, capsys):
+        p = MetricsRegistry().dump(str(tmp_path / "empty.jsonl"))
+        mod = _load_run_report()
+        assert mod.main([p]) == 0
+        out = capsys.readouterr().out
+        assert "(none)" in out

@@ -2,6 +2,7 @@
 
 Usage:
     python tools/run_report.py RUN_REPORT.jsonl [--prom] [--all]
+                               [--trace TRACE.jsonl]
 
 The input is a ``MetricsRegistry.dump()`` file (one JSON object per line;
 written by ``registry.dump(path)``, by ``bench.py --metrics-out``, or by
@@ -16,6 +17,9 @@ any caller of ``alink_tpu.get_registry()``). Output sections:
     (``--all`` prints the remainder even when a section claimed them).
 
 ``--prom`` prints the Prometheus exposition text instead of tables.
+``--trace TRACE.jsonl`` appends the span-tracer summary (tools/trace.py)
+for a flight-recorder export from the same run, so one report carries
+both the aggregates and the timeline rollup.
 """
 
 from __future__ import annotations
@@ -210,13 +214,34 @@ def main(argv=None) -> int:
     ap.add_argument("--all", action="store_true",
                     help="also list section-claimed metrics under "
                          "'Other metrics'")
+    ap.add_argument("--trace", metavar="TRACE",
+                    help="append the span-trace summary for a "
+                         "Tracer.export_jsonl()/export_chrome() file "
+                         "from the same run")
     args = ap.parse_args(argv)
     reg = MetricsRegistry.load(args.report)
     if args.prom:
         sys.stdout.write(reg.render_text())
     else:
         print(render(reg, show_all=args.all))
+    if args.trace and not args.prom:
+        # never appended in --prom mode: the exposition text on stdout
+        # must stay parseable by Prometheus scrapers
+        trace_mod = _load_trace_tool()
+        meta, events = trace_mod.load_events(args.trace)
+        print()
+        print(trace_mod.summarize(meta, events))
     return 0
+
+
+def _load_trace_tool():
+    """Import the sibling trace.py (tools/ is not a package)."""
+    import importlib.util
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "trace.py")
+    spec = importlib.util.spec_from_file_location("alink_tpu_tool_trace", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 if __name__ == "__main__":

@@ -1,0 +1,280 @@
+"""Summarize an alink_tpu trace (flight-recorder JSONL or Chrome JSON).
+
+Usage:
+    python tools/trace.py TRACE [--top N] [--chrome OUT.json]
+
+``TRACE`` is either a ``Tracer.export_jsonl()`` run log or a
+``Tracer.export_chrome()`` JSON (the format is auto-detected). Output
+sections:
+
+  * Top spans by self time — per span name: count, total wall, total
+    *self* time (wall minus time inside child spans), mean;
+  * Per-phase rollup      — self time aggregated by category
+    (``engine`` / ``steptimer`` / ``batch`` / ``stream`` / ``ckpt`` ...);
+  * Instant events        — counts per marker name;
+  * Critical path         — trace wall clock, plus per-thread busy time
+    (union of that thread's root spans); the busiest lane is the
+    critical-path *estimate* — host work below it overlapped something
+    longer and cannot have gated the run.
+
+``--chrome OUT.json`` additionally converts a JSONL run log to Chrome
+Trace Event Format for Perfetto / ``chrome://tracing``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from alink_tpu.common.tracing import events_to_chrome  # noqa: E402
+
+
+def load_events(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Parse a trace file; returns ``(meta, events)`` with events
+    normalized to the tracer's internal shape ``{ph, name, cat, ts, dur,
+    tid, id, parent, args}`` and sorted by ``ts``. Chrome-format inputs
+    (object form — possibly pretty-printed — or the bare event-array
+    form) recover ``id``/``parent`` from ``args.span_id``/
+    ``args.parent_id`` when present, else by interval containment per
+    tid."""
+    with open(path) as f:
+        first_line = f.readline()
+        f.seek(0)
+        doc = None
+        try:
+            doc = json.loads(first_line)
+        except ValueError:
+            pass           # pretty-printed JSON: first line is a fragment
+        if isinstance(doc, dict) and doc.get("kind") == "meta":
+            # JSONL run log (Tracer.export_jsonl)
+            meta = doc
+            events = [json.loads(ln) for ln in f.readlines()[1:]
+                      if ln.strip()]
+        else:                                   # one Chrome JSON document
+            try:
+                whole = json.load(f)
+            except ValueError as e:
+                raise ValueError(f"{path}: neither an alink_tpu trace "
+                                 f"JSONL nor a Chrome trace JSON: {e}")
+            if isinstance(whole, list):
+                # the bare-array Chrome form is also valid
+                whole = {"traceEvents": whole}
+            if not isinstance(whole, dict) or "traceEvents" not in whole:
+                raise ValueError(f"{path}: neither an alink_tpu trace "
+                                 f"JSONL nor a Chrome trace JSON")
+            meta = dict(whole.get("otherData") or {})
+            meta.setdefault("format", "chrome")
+            threads = {}
+            events = []
+            for ce in whole["traceEvents"]:
+                if ce.get("ph") == "M" and ce.get("name") == "thread_name":
+                    threads[str(ce.get("tid"))] = \
+                        (ce.get("args") or {}).get("name", "?")
+                if ce.get("ph") not in ("X", "i", "I"):
+                    continue                   # metadata/flow/... events
+                args = dict(ce.get("args") or {})
+                ev: Dict[str, Any] = {
+                    "ph": "i" if ce["ph"] == "I" else ce["ph"],
+                    "name": ce.get("name", "?"),
+                    "cat": ce.get("cat", "?"),
+                    "ts": float(ce.get("ts", 0.0)),
+                    "tid": ce.get("tid", 0)}
+                if ev["ph"] == "X":
+                    ev["dur"] = float(ce.get("dur", 0.0))
+                if "span_id" in args:
+                    ev["id"] = args.pop("span_id")
+                if "parent_id" in args:
+                    ev["parent"] = args.pop("parent_id")
+                if args:
+                    ev["args"] = args
+                events.append(ev)
+            if threads:
+                meta.setdefault("threads", threads)
+    events.sort(key=lambda e: e["ts"])
+    if not any("parent" in e for e in events):
+        _infer_parents(events)
+    return meta, events
+
+
+def _infer_parents(events: List[Dict[str, Any]]) -> None:
+    """Assign ids/parents by interval containment per tid (for foreign
+    Chrome traces that carry no explicit span ids)."""
+    next_id = max((e.get("id", 0) for e in events), default=0) + 1
+    by_tid: Dict[Any, List[Dict[str, Any]]] = {}
+    for e in events:
+        by_tid.setdefault(e["tid"], []).append(e)
+    for evs in by_tid.values():
+        # parents first: same start -> longer span encloses
+        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        stack: List[Dict[str, Any]] = []
+        for e in evs:
+            while stack and e["ts"] >= stack[-1]["ts"] + stack[-1]["dur"]:
+                stack.pop()
+            if stack:
+                e["parent"] = stack[-1]["id"]
+            if e["ph"] == "X":
+                if "id" not in e:
+                    e["id"] = next_id
+                    next_id += 1
+                stack.append(e)
+
+
+def _fmt_ms(us: float) -> str:
+    return f"{us / 1e3:,.2f}"
+
+
+def _table(headers: List[str], rows: List[List[str]]) -> str:
+    if not rows:
+        return "  (none)"
+    widths = [max(len(str(headers[i])), *(len(str(r[i])) for r in rows))
+              for i in range(len(headers))]
+    def fmt(cells):
+        return "  " + "  ".join(
+            str(c).ljust(widths[i]) if i == 0 else str(c).rjust(widths[i])
+            for i, c in enumerate(cells)).rstrip()
+    sep = "  " + "  ".join("-" * w for w in widths)
+    return "\n".join([fmt(headers), sep] + [fmt(r) for r in rows])
+
+
+def self_times(events: List[Dict[str, Any]]) -> Dict[int, float]:
+    """Per-span self time (µs): own duration minus direct children's.
+    Clamped at 0 — concurrent children (spawned threads reporting a
+    parent from another lane) can overlap their parent."""
+    spans = {e["id"]: e for e in events if e["ph"] == "X" and "id" in e}
+    child_sum: Dict[int, float] = {}
+    for e in spans.values():
+        p = e.get("parent")
+        if p in spans:
+            child_sum[p] = child_sum.get(p, 0.0) + e.get("dur", 0.0)
+    return {i: max(0.0, e.get("dur", 0.0) - child_sum.get(i, 0.0))
+            for i, e in spans.items()}
+
+
+def summarize(meta: Dict[str, Any], events: List[Dict[str, Any]],
+              top: int = 15) -> str:
+    out: List[str] = []
+    spans = [e for e in events if e["ph"] == "X"]
+    instants = [e for e in events if e["ph"] == "i"]
+    selfs = self_times(events)
+
+    out.append("== Trace summary ==")
+    wall = (max((e["ts"] + e.get("dur", 0.0) for e in events), default=0.0)
+            - min((e["ts"] for e in events), default=0.0))
+    rows = [["events", f"{len(events):,}"],
+            ["spans", f"{len(spans):,}"],
+            ["instants", f"{len(instants):,}"],
+            ["wall clock (ms)", _fmt_ms(wall)]]
+    if meta.get("dropped"):
+        rows.append(["dropped (ring overflow)", f"{meta['dropped']:,}"])
+    out.append(_table(["metric", "value"], rows))
+
+    # -- top spans by self time -------------------------------------------
+    agg: Dict[str, List[float]] = {}
+    for e in spans:
+        a = agg.setdefault(e["name"], [0, 0.0, 0.0])
+        a[0] += 1
+        a[1] += e.get("dur", 0.0)
+        a[2] += selfs.get(e.get("id"), e.get("dur", 0.0))
+    ranked = sorted(agg.items(), key=lambda kv: -kv[1][2])
+    out.append(f"\n== Top spans by self time (top {top}) ==")
+    out.append(_table(
+        ["span", "count", "total_ms", "self_ms", "mean_ms"],
+        [[n, f"{int(c):,}", _fmt_ms(tot), _fmt_ms(slf),
+          _fmt_ms(tot / c)] for n, (c, tot, slf) in ranked[:top]]))
+
+    # -- per-phase (category) rollup --------------------------------------
+    cat: Dict[str, List[float]] = {}
+    for e in spans:
+        a = cat.setdefault(e.get("cat", "?"), [0, 0.0])
+        a[0] += 1
+        a[1] += selfs.get(e.get("id"), e.get("dur", 0.0))
+    out.append("\n== Per-phase rollup (self time) ==")
+    out.append(_table(["phase", "spans", "self_ms"],
+                      [[k, f"{int(c):,}", _fmt_ms(s)] for k, (c, s)
+                       in sorted(cat.items(), key=lambda kv: -kv[1][1])]))
+
+    # -- instants ----------------------------------------------------------
+    icnt: Dict[str, int] = {}
+    for e in instants:
+        icnt[e["name"]] = icnt.get(e["name"], 0) + 1
+    out.append("\n== Instant events ==")
+    out.append(_table(["event", "count"],
+                      [[k, f"{v:,}"] for k, v in sorted(icnt.items())]))
+
+    # -- critical path estimate -------------------------------------------
+    # per thread: union length of ROOT spans (children are inside their
+    # parents by construction); the busiest lane bounds the host critical
+    # path — everything shorter overlapped it
+    ids = {e.get("id") for e in spans}
+    lanes: Dict[Any, List[Tuple[float, float]]] = {}
+    for e in spans:
+        if e.get("parent") in ids:
+            continue                     # not a root (parent is in-buffer)
+        lanes.setdefault(e["tid"], []).append(
+            (e["ts"], e["ts"] + e.get("dur", 0.0)))
+    tnames = meta.get("threads") or {}
+    lrows = []
+    best = 0.0
+    for tid, iv in lanes.items():
+        iv.sort()
+        busy, cur_s, cur_e = 0.0, None, None
+        for s, t in iv:
+            if cur_e is None or s > cur_e:
+                if cur_e is not None:
+                    busy += cur_e - cur_s
+                cur_s, cur_e = s, t
+            else:
+                cur_e = max(cur_e, t)
+        if cur_e is not None:
+            busy += cur_e - cur_s
+        best = max(best, busy)
+        lrows.append([tnames.get(str(tid), str(tid)), f"{len(iv):,}",
+                      _fmt_ms(busy)])
+    lrows.sort(key=lambda r: -float(r[2].replace(",", "")))
+    out.append("\n== Critical path (host busy time per thread) ==")
+    out.append(_table(["thread", "root spans", "busy_ms"], lrows))
+    if wall > 0:
+        out.append(f"\ncritical-path estimate: {_fmt_ms(best)} ms busy on "
+                   f"the hottest lane over {_fmt_ms(wall)} ms wall "
+                   f"({100.0 * best / wall:.0f}% utilized)")
+    return "\n".join(out)
+
+
+def to_chrome(meta: Dict[str, Any],
+              events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Chrome Trace Event Format document from normalized events (the
+    ``--chrome`` conversion for JSONL run logs). Delegates to the one
+    shared emitter in ``alink_tpu.common.tracing``."""
+    return events_to_chrome(meta, events)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize an alink_tpu trace "
+                    "(flight-recorder JSONL or Chrome JSON)")
+    ap.add_argument("trace", help="Tracer.export_jsonl() run log or "
+                                  "Tracer.export_chrome() JSON")
+    ap.add_argument("--top", type=int, default=15,
+                    help="rows in the top-spans table (default 15)")
+    ap.add_argument("--chrome", metavar="OUT",
+                    help="also write a Chrome-trace JSON conversion "
+                         "(open in Perfetto / chrome://tracing)")
+    args = ap.parse_args(argv)
+    meta, events = load_events(args.trace)
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(to_chrome(meta, events), f)
+        print(f"wrote {args.chrome}")
+    print(summarize(meta, events, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
