@@ -16,7 +16,11 @@ from .common import (Params, ParamInfo, WithParams, AlinkTypes, TableSchema,
                      StepTimer, named_stage, trace,
                      MetricsRegistry, get_registry, set_registry,
                      metrics_enabled,
-                     Tracer, get_tracer, set_tracer, tracing_enabled)
+                     Tracer, get_tracer, set_tracer, tracing_enabled,
+                     HealthAlert, HealthAlertError, HealthMonitor,
+                     HealthRule, NonFiniteRule, DivergenceRule, PlateauRule,
+                     ThresholdRule, UpdateRatioRule, DriftRule,
+                     default_rules, health_enabled)
 from .engine import (IterativeComQueue, ComContext, ComputeFunction, AllReduce,
                      AllGather, BroadcastFromWorker0)
 

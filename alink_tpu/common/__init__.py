@@ -6,6 +6,10 @@ from .mtable import MTable
 from .mlenv import (MLEnvironment, MLEnvironmentFactory, use_local_env,
                     use_remote_env)
 from .lazy import LazyEvaluation, LazyObjectsManager
+from .health import (HealthAlert, HealthAlertError, HealthMonitor,
+                     HealthRule, NonFiniteRule, DivergenceRule, PlateauRule,
+                     ThresholdRule, UpdateRatioRule, DriftRule,
+                     default_rules, health_enabled)
 from .metrics import (MetricsRegistry, get_registry, metrics_enabled,
                       set_registry)
 from .profiling import StepTimer, named_stage, trace
@@ -21,4 +25,7 @@ __all__ = [
     "MetricsRegistry", "get_registry", "set_registry", "metrics_enabled",
     "Tracer", "get_tracer", "set_tracer", "tracing_enabled",
     "trace_span", "trace_instant",
+    "HealthAlert", "HealthAlertError", "HealthMonitor", "HealthRule",
+    "NonFiniteRule", "DivergenceRule", "PlateauRule", "ThresholdRule",
+    "UpdateRatioRule", "DriftRule", "default_rules", "health_enabled",
 ]

@@ -33,12 +33,14 @@ Contract notes:
 from __future__ import annotations
 
 import time
+import warnings
 import weakref
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..common.health import health_enabled
 from ..common.mlenv import MLEnvironment, MLEnvironmentFactory
 from ..common.tracing import trace_instant, trace_span, tracing_enabled
 from .context import ComContext
@@ -234,6 +236,62 @@ def _freeze_closure_value(v, depth):
             getattr(type(v), "__qualname__", type(v).__name__))
 
 
+# one-time flag for the devarray-in-closure warning below (module-level:
+# the silent-staleness class it flags is a process-wide modeling error,
+# and a warning per stage per exec would be noise)
+_DEVARRAY_CELL_WARNED = [False]
+
+
+def _contains_devarray(v, depth=3) -> bool:
+    """True when a closure-cell value holds a jax.Array (directly or
+    nested in a shallow container). The check is a POSITIVE isinstance
+    against jax.Array — duck-typing on shape/dtype would also trip on
+    numpy scalars, pandas Series, or ShapeDtypeStructs, and a false
+    positive here both misleads the user and burns the once-per-process
+    warning before a genuine device-array capture can use it."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes, type,
+                                   np.ndarray, np.generic)):
+        return False
+    try:
+        import jax
+        if isinstance(v, jax.Array):
+            return True
+    except (ImportError, AttributeError):  # pragma: no cover - old jax
+        if isinstance(getattr(v, "shape", None), tuple) \
+                and hasattr(v, "dtype") \
+                and type(v).__module__.split(".")[0] in ("jax", "jaxlib"):
+            return True
+    if depth <= 0:
+        return False
+    if isinstance(v, (tuple, list)):
+        return any(_contains_devarray(x, depth - 1) for x in v)
+    if isinstance(v, dict):
+        return any(_contains_devarray(x, depth - 1) for x in v.values())
+    return False
+
+
+def _warn_devarray_cell(fn_name: str, cell_name: str) -> None:
+    """The structural cache guard tokenizes device arrays by shape/dtype
+    ONLY (hashing content would round-trip device memory per exec), so a
+    stage closure holding a jax.Array whose CONTENT changes between
+    execs would silently re-run the stale cached program — the content
+    is baked into the trace as a constant (ADVICE round 5,
+    comqueue.py:144). Warn ONCE per process: data belongs in
+    partitioned/broadcast inputs, not closures."""
+    if _DEVARRAY_CELL_WARNED[0]:
+        return
+    _DEVARRAY_CELL_WARNED[0] = True
+    warnings.warn(
+        f"comqueue stage {fn_name!r}: closure variable {cell_name!r} "
+        f"captures a device array (jax.Array). The program cache "
+        f"tokenizes device arrays by shape/dtype only, so if its CONTENT "
+        f"changes between execs a stale compiled program would be reused "
+        f"silently. Route data through init_with_partitioned_data/"
+        f"init_with_broadcast_data instead, or set "
+        f"ALINK_VERIFY_PROGRAM_CACHE=1 to catch drift by jaxpr "
+        f"comparison.", RuntimeWarning, stacklevel=3)
+
+
 def _callable_digest(fn, depth=4):
     """Structural token of a stage callable: bytecode + constants + frozen
     closure cells (+ bound-object public attrs for methods). Appended to
@@ -285,6 +343,8 @@ def _callable_digest(fn, depth=4):
                 # digest must be TOTAL, so degrade to an opaque token
                 cells.append((name, ("opaque", "unbound_cell")))
                 continue
+            if _contains_devarray(v):
+                _warn_devarray_cell(code.co_name, name)
             cells.append((name, _freeze_closure_value(v, depth)))
     return (code.co_name, h.hexdigest(), tuple(cells), defaults)
 
@@ -466,6 +526,26 @@ class ComQueueResult:
     def keys(self):
         return [k for k in self._stacked.keys() if not k.startswith("__")]
 
+    # -- health probe channel (common/health.py) -------------------------
+    def probe_names(self):
+        """Names published via ``ctx.probe`` during the run (sorted)."""
+        pre = ComContext.PROBE_PREFIX
+        return sorted(k[len(pre):] for k in self._stacked
+                      if k.startswith(pre))
+
+    def probe_series(self, name: str, trim: bool = True):
+        """One probe's per-superstep series (worker 0's copy — probes
+        conventionally record replicated post-allreduce scalars). With
+        ``trim`` the NaN prefill past the executed step count is cut, so
+        ``series[i]`` is superstep ``i + 1``'s value."""
+        s = self.get(ComContext.PROBE_PREFIX + name)
+        return s[:self.step_count] if trim else s
+
+    def probes(self, trim: bool = True):
+        """Every probe series as ``{name: (steps,) array}`` (read-only)."""
+        return {n: self.probe_series(n, trim=trim)
+                for n in self.probe_names()}
+
 
 class IterativeComQueue:
     def __init__(self, env: Optional[MLEnvironment] = None, max_iter: int = 100,
@@ -482,6 +562,7 @@ class IterativeComQueue:
         self._close: Optional[Callable[[ComQueueResult], Any]] = None
         self._program_key: Optional[tuple] = None
         self._ckpt = None
+        self._health = None       # HealthMonitor (set_health)
         self._data_token = None   # checkpoint-signature memo (see _run)
         if checkpoint_dir is not None:
             self.set_checkpoint(checkpoint_dir, every=checkpoint_every,
@@ -552,6 +633,18 @@ class IterativeComQueue:
                                       resume_from=resume_from)
         return self
 
+    def set_health(self, monitor) -> "IterativeComQueue":
+        """Attach a ``common.health.HealthMonitor``: after the run (and,
+        for checkpointed runs, at every snapshot boundary — where the
+        carry is already host-synced) the engine feeds it every
+        ``ctx.probe`` series and calls ``evaluate()``. A monitor with
+        ``raise_on={"critical"}`` therefore aborts a poisoned
+        checkpointed run at the next boundary instead of burning the
+        remaining superstep budget. No-op when ``ALINK_TPU_HEALTH`` is
+        off (stages record no probes)."""
+        self._health = monitor
+        return self
+
     # -- execution --------------------------------------------------------
     def lowered(self):
         """Lower (but do not run) the whole-superstep SPMD program;
@@ -594,6 +687,11 @@ class IterativeComQueue:
         max_iter = int(self.max_iter)
         seed = int(self.seed)
         mx = metrics_enabled() and not lower_only
+        # health-probe switch, latched per run at trace time. It MUST ride
+        # the program-cache key and the checkpoint signature: probes add
+        # stacked (max_iter,) carry entries, so a toggled flag is a
+        # structurally different program
+        probes_on = health_enabled()
         # per-superstep collective capture (trace-time; see communication
         # .collecting), keyed by the traced input signature: jax.jit keeps
         # a shape-keyed trace cache underneath each compiled entry, so one
@@ -648,7 +746,8 @@ class IterativeComQueue:
             return tuple(items)
 
         def superstep(carry, static, init_pass):
-            ctx = ComContext(carry, static, nw, init_pass)
+            ctx = ComContext(carry, static, nw, init_pass,
+                             max_iter=max_iter, probes_on=probes_on)
             # capture this pass's collectives at TRACE time (shapes are on
             # the tracers; nothing is added to the compiled program).
             # clear() first: a retrace through a cached program must
@@ -768,7 +867,7 @@ class IterativeComQueue:
             # re-running a stale program
             ckey = (self._program_key, stages_dig,
                     mesh, nw, max_iter, seed,
-                    criterion is not None, step_log_enabled(),
+                    criterion is not None, step_log_enabled(), probes_on,
                     tuple(sorted(parts)), tuple(sorted(bcast)))
 
         if self._ckpt is not None:
@@ -829,20 +928,32 @@ class IterativeComQueue:
             signature = recovery.program_signature(
                 num_workers=nw, max_iter=max_iter, seed=seed,
                 part_sig=part_sig, bcast_names=tuple(sorted(bcast)),
-                stages_digest=stages_dig, data_token=data_token)
+                stages_digest=stages_dig, data_token=data_token,
+                probes_on=probes_on)
             resumed = recovery.resume_state(ck, signature)
+            on_snapshot = None
+            if self._health is not None and probes_on:
+                # mid-run watchdog: evaluate on the carry the boundary
+                # save just fetched — zero extra device->host traffic.
+                # evaluate() may raise HealthAlertError (raise_on=...),
+                # aborting AFTER the snapshot published, so the run stays
+                # resumable/inspectable
+                def on_snapshot(host, step, _m=self._health):
+                    self._ingest_probes(_m, host, step)
             with _ENGINE_TIMER.span("comqueue.execute",
                                     labels={"program": cache_status}):
                 stacked, ck_info = recovery.drive(
                     ck, first=first, cont=cont, parts=parts, bcast=bcast,
-                    max_iter=max_iter, signature=signature, resumed=resumed)
+                    max_iter=max_iter, signature=signature, resumed=resumed,
+                    on_snapshot=on_snapshot)
             # chunked path: the program runs once per chunk, so only the
             # STATIC cost gauges are meaningful (no exec_t0 -> no achieved
             # rates; see _finish)
             return self._finish(stacked, nw, totals, manifest, parts, bcast,
                                 mx, ck_info, cost=cost,
                                 prog_label=_program_label(self._program_key)
-                                if self._program_key is not None else None)
+                                if self._program_key is not None else None,
+                                probes_on=probes_on)
         from ..common.metrics import env_flag
         verify = env_flag("ALINK_VERIFY_PROGRAM_CACHE", default=False)
         if ckey is not None:
@@ -908,10 +1019,24 @@ class IterativeComQueue:
         return self._finish(stacked, nw, totals, manifest, parts, bcast,
                             mx, None, cost=cost, exec_t0=exec_t0,
                             prog_label=_program_label(self._program_key)
-                            if self._program_key is not None else None)
+                            if self._program_key is not None else None,
+                            probes_on=probes_on)
+
+    @staticmethod
+    def _ingest_probes(monitor, host, step):
+        """Feed the probe prefix of a host (stacked) carry to a
+        HealthMonitor and evaluate. Worker 0's copy: probes record
+        replicated post-allreduce scalars by convention."""
+        pre = ComContext.PROBE_PREFIX
+        series = {k[len(pre):]: np.asarray(v)[0][:int(step)]
+                  for k, v in host.items() if k.startswith(pre)}
+        if series:
+            monitor.ingest(series)
+            monitor.evaluate()
 
     def _finish(self, stacked, nw, totals, manifest, parts, bcast, mx,
-                ck_info, cost=None, exec_t0=None, prog_label=None):
+                ck_info, cost=None, exec_t0=None, prog_label=None,
+                probes_on=False):
         """Shared result assembly + metrics tail for the single-program
         and checkpoint-chunked execution paths. ``ck_info`` is the
         recovery driver's accounting (None on the single-program path).
@@ -999,6 +1124,14 @@ class IterativeComQueue:
                         if acc_bytes:
                             reg.set_gauge("alink_program_achieved_bytes_per_s",
                                           acc_bytes / elapsed, plbl)
+        if self._health is not None and probes_on:
+            # final pass (also re-runs after a chunked run's last
+            # boundary ingest — alerts are deduped by the monitor). The
+            # probe fetch is a handful of (max_iter,) f32 series
+            names = result.probe_names()
+            if names:
+                self._health.ingest_result(result)
+                self._health.evaluate()
         if self._close is not None:
             return self._close(result)
         return result

@@ -74,7 +74,8 @@ class CheckpointConfig:
 def program_signature(*, num_workers: int, max_iter: int, seed: int,
                       part_sig: Tuple, bcast_names: Tuple,
                       stages_digest: Any,
-                      data_token: Any = None) -> Dict[str, Any]:
+                      data_token: Any = None,
+                      probes_on: bool = False) -> Dict[str, Any]:
     """JSON identity of the compiled superstep program a snapshot belongs
     to. A resume target must match exactly: same worker count, same input
     geometry, same stage structure — otherwise the carry pytree would be
@@ -94,6 +95,11 @@ def program_signature(*, num_workers: int, max_iter: int, seed: int,
            "parts": [list(map(str, item)) for item in part_sig],
            "bcast": [str(n) for n in bcast_names],
            "stages_blake2b": stages}
+    if probes_on:
+        # health probes add stacked carry entries: a probe-less snapshot
+        # must not resume a probed program (and vice versa). Emitted only
+        # when on, so pre-health snapshots stay resumable unchanged.
+        sig["health_probes"] = True
     if data_token is not None:
         sig["data_blake2b"] = hashlib.blake2b(
             repr(data_token).encode(), digest_size=12).hexdigest()
@@ -122,16 +128,21 @@ def drive(config: CheckpointConfig, *,
           first: Callable, cont: Callable,
           parts: Dict[str, Any], bcast: Dict[str, Any],
           max_iter: int, signature: Dict[str, Any],
-          resumed: Optional[Dict[str, Any]] = None
+          resumed: Optional[Dict[str, Any]] = None,
+          on_snapshot: Optional[Callable] = None
           ) -> Tuple[Any, Dict[str, Any]]:
     """Run the chunked superstep loop with host-side persistence.
 
     ``first(parts, bcast, limit)`` runs the init pass + loop to ``limit``;
     ``cont(parts, bcast, carry, limit)`` continues a stacked carry.
     ``resumed`` is a host carry from :func:`resume_state` (skips
-    ``first``). Returns ``(stacked_carry, info)`` where ``info`` carries
-    the superstep accounting the metrics tail needs
-    (``steps_executed``, ``init_ran``, ``resumed_at``).
+    ``first``). ``on_snapshot(host_carry, step)`` — if given — fires
+    right after each snapshot publishes, with the host carry the save
+    already fetched (the health monitor's mid-run hook; it may raise to
+    abort the run, and because the snapshot is already on disk the
+    aborted run stays resumable). Returns ``(stacked_carry, info)``
+    where ``info`` carries the superstep accounting the metrics tail
+    needs (``steps_executed``, ``init_ran``, ``resumed_at``).
     """
     import jax.numpy as jnp
 
@@ -181,6 +192,8 @@ def drive(config: CheckpointConfig, *,
                                   "stopped": stop or step >= max_iter},
                             scope=SCOPE, keep_last=config.keep_last)
             last_saved = step
+            if on_snapshot is not None:
+                on_snapshot(host, step)
         if stop or step >= max_iter:
             break
         stacked, step, stop = chunk(cont, (parts, bcast, stacked), step,

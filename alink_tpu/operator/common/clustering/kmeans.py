@@ -203,9 +203,15 @@ def kmeans_train(X: np.ndarray, k: int, max_iter: int = 50, tol: float = 1e-4,
                  sample_weight: Optional[np.ndarray] = None,
                  checkpoint_dir: Optional[str] = None,
                  checkpoint_every: int = 1, checkpoint_keep: int = 3,
-                 resume_from: Optional[str] = None
+                 resume_from: Optional[str] = None,
+                 health=None
                  ) -> Tuple[np.ndarray, np.ndarray, int]:
     """Returns (centroids (k,d), cluster_weights (k,), num_steps).
+
+    ``health=`` attaches a ``common.health.HealthMonitor`` fed the Lloyd
+    loop's probe series (``inertia``, ``movement``, ``empty_clusters``)
+    after the run and at every checkpoint boundary; probes record only
+    while ``ALINK_TPU_HEALTH`` is on.
 
     ``checkpoint_dir=`` makes the Lloyd loop durable: the superstep carry
     (centroids, movement, step counter) is snapshotted every
@@ -236,18 +242,34 @@ def kmeans_train(X: np.ndarray, k: int, max_iter: int = 50, tol: float = 1e-4,
         block = ctx.get_obj("data")
         Xb, wb = block[:, :d], block[:, d]
         C = ctx.get_obj("centroids")
-        ids, _ = assign_clusters(Xb, C, distance_type)
+        ids, dist = assign_clusters(Xb, C, distance_type)
         onehot = jax.nn.one_hot(ids, k, dtype=dt) * wb[:, None]   # (n, k), weighted
         sums = onehot.T @ Xb                                      # (k, d) on MXU
         cnts = onehot.sum(0)                                      # (k,)
-        ctx.put_obj("buf", jnp.concatenate([sums, cnts[:, None]], 1))
+        buf = jnp.concatenate([sums, cnts[:, None]], 1)
+        if ctx.probes_enabled:
+            # weighted inertia (sum of assigned distances) rides the
+            # EXISTING buf AllReduce as one extra row — a probe must not
+            # add a collective of its own (padding rows have wb == 0)
+            inertia = jnp.concatenate(
+                [(dist * wb).sum().reshape(1, 1), jnp.zeros((1, d), dt)], 1)
+            buf = jnp.concatenate([buf, inertia.astype(dt)], 0)
+        ctx.put_obj("buf", buf)
 
     def update(ctx):
         buf = ctx.get_obj("buf")
         C = ctx.get_obj("centroids")
+        if ctx.probes_enabled:
+            # pre-update inertia: the objective of the assignment the
+            # centroids being replaced produced (standard Lloyd bookkeeping)
+            ctx.probe("inertia", buf[k, 0])
+            buf = buf[:k]
         sums, cnts = buf[:, :d], buf[:, d]
         newC = jnp.where(cnts[:, None] > 0, sums / jnp.maximum(cnts[:, None], 1e-12), C)
-        ctx.put_obj("movement", jnp.sqrt(((newC - C) ** 2).sum(1)).max())
+        movement = jnp.sqrt(((newC - C) ** 2).sum(1)).max()
+        ctx.put_obj("movement", movement)
+        ctx.probe("movement", movement)
+        ctx.probe("empty_clusters", (cnts <= 0).sum())
         ctx.put_obj("centroids", newC)
         ctx.put_obj("cluster_weights", cnts)
 
@@ -268,6 +290,10 @@ def kmeans_train(X: np.ndarray, k: int, max_iter: int = 50, tol: float = 1e-4,
     elif resume_from:
         raise ValueError("resume_from requires checkpoint_dir (an explicit "
                          "resume request must not silently retrain)")
+    if health is not None:
+        from ....common.health import warn_if_disabled
+        warn_if_disabled("kmeans_train(health=...)", stacklevel=3)
+        queue.set_health(health)
     result = queue.exec()
     return (result.get("centroids"), result.get("cluster_weights"),
             result.step_count)

@@ -59,6 +59,13 @@ class OptimParams:
     checkpoint_every: int = 1
     checkpoint_keep: int = 3
     resume_from: Optional[str] = None
+    # training-health watchdog (common/health.py): a HealthMonitor fed
+    # the run's probe series (loss, grad_norm, update_ratio,
+    # nonfinite.grad — recorded by every trainer whenever
+    # ALINK_TPU_HEALTH is on) after the run and, on checkpointed runs,
+    # at every snapshot boundary. Not part of the program-cache key:
+    # probes are recorded regardless; the monitor only READS them.
+    health: Optional[object] = None
 
 
 def _apply_checkpoint(queue, params: "OptimParams"):
@@ -72,6 +79,10 @@ def _apply_checkpoint(queue, params: "OptimParams"):
         raise ValueError("OptimParams.resume_from requires checkpoint_dir "
                          "(an explicit resume request must not silently "
                          "retrain from scratch)")
+    if params.health is not None:
+        from ....common.health import warn_if_disabled
+        warn_if_disabled("OptimParams.health", stacklevel=4)
+        queue.set_health(params.health)
     return queue
 
 
@@ -208,6 +219,11 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
             g_dir = g_plain
         gnorm = jnp.linalg.norm(g_dir) / jnp.maximum(1.0, jnp.linalg.norm(coef))
         ctx.put_obj("conv", gnorm < eps)
+        # default health probes (common/health.py): replicated scalars
+        # only, so no collective is added — the series ride the carry
+        ctx.probe("loss", loss_total)
+        ctx.probe("grad_norm", gnorm)
+        ctx.probe_nonfinite("grad", g_plain)
 
         if m > 0:
             # push pair (coef - coef_prev, g - g_prev); masked out on step 1
@@ -259,6 +275,8 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
             new_coef = jnp.where(new_coef * orthant < 0, 0.0, new_coef)
         ctx.put_obj("coef_prev", coef)
         ctx.put_obj("coef", new_coef)
+        ctx.probe("update_ratio", jnp.linalg.norm(new_coef - coef)
+                  / jnp.maximum(1.0, jnp.linalg.norm(coef)))
         # adapt the ladder like the reference's step grow/shrink heuristic
         scale = ctx.get_obj("step_scale")
         scale = jnp.where(best == 0, scale * 0.25,
@@ -281,7 +299,8 @@ def _quasi_newton(obj, data, params, env, warm_start, owlqn: bool,
         queue.init_with_partitioned_data(k, v)
     _apply_checkpoint(queue, params)
     res = queue.exec()
-    return res.get("coef"), _trim_curve(res.get("loss_curve")), res.step_count
+    steps = res.step_count
+    return res.get("coef"), _trim_curve(res.get("loss_curve"), steps), steps
 
 
 # ---------------------------------------------------------------------------
@@ -332,6 +351,12 @@ def _sgd(obj, data, params, env, warm_start):
             ctx.get_obj("loss_curve"), loss_total.astype(dtype), step - 1, 0))
         ctx.put_obj("conv", nonempty & (jnp.linalg.norm(lr * g) <
                     params.epsilon * jnp.maximum(1.0, jnp.linalg.norm(coef))))
+        # default health probes — replicated post-allreduce scalars only
+        ctx.probe("loss", loss_total)
+        ctx.probe("grad_norm", jnp.linalg.norm(g))
+        ctx.probe_nonfinite("grad", g)
+        ctx.probe("update_ratio", jnp.linalg.norm(new_coef - coef)
+                  / jnp.maximum(1.0, jnp.linalg.norm(coef)))
 
     queue = (IterativeComQueue(env=env, max_iter=max_iter, seed=params.seed)
              .init_with_broadcast_data("coef0", w0)
@@ -346,7 +371,8 @@ def _sgd(obj, data, params, env, warm_start):
         queue.init_with_partitioned_data(k, v)
     _apply_checkpoint(queue, params)
     res = queue.exec()
-    return res.get("coef"), _trim_curve(res.get("loss_curve")), res.step_count
+    steps = res.step_count
+    return res.get("coef"), _trim_curve(res.get("loss_curve"), steps), steps
 
 
 # ---------------------------------------------------------------------------
@@ -389,6 +415,12 @@ def _newton(obj, data, params, env, warm_start):
             ctx.get_obj("loss_curve"), loss_total.astype(dtype), step - 1, 0))
         ctx.put_obj("conv", jnp.linalg.norm(d) <
                     params.epsilon * jnp.maximum(1.0, jnp.linalg.norm(coef)))
+        # default health probes — replicated post-allreduce scalars only
+        ctx.probe("loss", loss_total)
+        ctx.probe("grad_norm", jnp.linalg.norm(g))
+        ctx.probe_nonfinite("grad", g)
+        ctx.probe("update_ratio", jnp.linalg.norm(d)
+                  / jnp.maximum(1.0, jnp.linalg.norm(coef)))
 
     queue = (IterativeComQueue(env=env, max_iter=max_iter, seed=params.seed)
              .init_with_broadcast_data("coef0", w0)
@@ -403,7 +435,8 @@ def _newton(obj, data, params, env, warm_start):
         queue.init_with_partitioned_data(k, v)
     _apply_checkpoint(queue, params)
     res = queue.exec()
-    return res.get("coef"), _trim_curve(res.get("loss_curve")), res.step_count
+    steps = res.step_count
+    return res.get("coef"), _trim_curve(res.get("loss_curve"), steps), steps
 
 
 # ---------------------------------------------------------------------------
@@ -441,7 +474,13 @@ def _fb_precompute_ok(obj, data) -> bool:
     return need <= budget
 
 
-def _trim_curve(curve: np.ndarray) -> np.ndarray:
+def _trim_curve(curve: np.ndarray, steps: int) -> np.ndarray:
+    """The executed-prefix of the preallocated loss history.
+
+    Trimmed by the engine's superstep count — the SAME truth the health
+    probe series trim by (``ComQueueResult.probe_series``) — never by
+    counting non-NaN entries: a mid-run NaN loss (exactly the case the
+    health watchdog exists for) would make the count undershoot and
+    silently mis-index the curve against the probe series."""
     curve = np.asarray(curve)
-    valid = ~np.isnan(curve)
-    return curve[:int(valid.sum())]
+    return curve[:int(steps)]

@@ -405,6 +405,43 @@ def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2,
     return jax.jit(fn)
 
 
+@functools.lru_cache(maxsize=1)
+def _pv_stats_fn():
+    """Jitted progressive-validation reducer: margins are computed at
+    PRE-update weights in every FTRL mode (per sample in the strict scan,
+    per chunk/batch in the others), so scoring them against the labels is
+    exactly the progressive validation of the FTRL ad-click papers — an
+    honest online estimate of held-out loss with zero extra passes.
+    Returns (sum logloss, #correct, #non-finite margins) as device
+    scalars; the caller defers the host fetch to snapshot/checkpoint
+    boundaries (forcing a fetch per batch measured strictly worse on
+    deferred backends — see the drain NOTE below).
+
+    Takes the FULL padded batch plus a traced row count and masks inside
+    the program: slicing to the per-batch row count on the host would
+    recompile the reducer for every distinct batch size, defeating the
+    padded-shape scheme every step factory uses."""
+    import jax
+    import jax.numpy as jnp
+
+    def stats(margins, y, nrows):
+        real = jnp.arange(margins.shape[0]) < nrows
+        finite = jnp.isfinite(margins)
+        m = jnp.clip(margins, -35.0, 35.0)
+        ll = jnp.logaddexp(0.0, -m) * y + jnp.logaddexp(0.0, m) * (1.0 - y)
+        # propagate non-finiteness the clip would hide: a NaN/Inf margin
+        # must surface in the logloss sum, not be laundered by clipping
+        ll = jnp.where(finite, ll, jnp.nan)
+        # a non-finite margin is never a correct prediction — without the
+        # finite mask, NaN > 0 == False would score label-0 rows 'right'
+        # on exactly the diverged batches the monitor exists to flag
+        correct = (((margins > 0) == (y > 0.5)) & finite & real).sum()
+        nonfinite = ((~finite) & real).sum()
+        return jnp.where(real, ll, 0.0).sum(), correct, nonfinite
+
+    return jax.jit(stats)
+
+
 @functools.lru_cache(maxsize=64)
 def _ftrl_dense_batch_step_factory(mesh, alpha, beta, l1, l2):
     """Batched-update twin of the dense program (see the sparse batch
@@ -477,6 +514,15 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
     RESUME = ParamInfo("resume", bool, default=True,
                        description="resume from the newest valid snapshot "
                                    "in checkpoint_dir when one exists")
+    # training-health monitoring (common/health.py): a HealthMonitor fed
+    # per-micro-batch progressive-validation logloss/accuracy (margins at
+    # pre-update weights), non-finite margin counts, and per-snapshot
+    # weight drift vs the previous emitted model. Host fetches of the
+    # monitoring scalars are deferred to snapshot/checkpoint boundaries
+    # so the deferred-backend pipeline stays unbroken.
+    HEALTH = ParamInfo("health", object, default=None,
+                       description="HealthMonitor for per-batch "
+                                   "progressive validation + drift")
 
     def __init__(self, initial_model: Optional[BatchOperator] = None,
                  params: Optional[Params] = None, **kwargs):
@@ -518,6 +564,10 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
         ck_every = int(self.params._m.get("checkpoint_every_batches", 0) or 0)
         ck_keep = int(self.params._m.get("checkpoint_keep", 3))
         ck_resume = bool(self.params._m.get("resume", True))
+        from ....common.health import warn_if_disabled
+        monitor = self.params._m.get("health")
+        mon_on = monitor is not None \
+            and warn_if_disabled("FtrlTrainStreamOp(health=...)")
         # snapshot identity: a resume target trained with different
         # hyperparameters, geometry or warm-start model is a different
         # model — refuse it. The coef fingerprint catches a same-dim but
@@ -548,9 +598,30 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
         # gather-bound, so the chunked kernel buys nothing there)
         dense_step = [_dense]
 
+        _prev_w = [None]   # last emitted snapshot's weights (drift base)
+
         def snapshot(z_host: np.ndarray, n_host: np.ndarray,
-                     fb_S: Optional[int] = None) -> MTable:
+                     fb_S: Optional[int] = None,
+                     batch: Optional[int] = None) -> MTable:
             w_full = np.asarray(weights_fn(z_host, n_host))
+            if mon_on and batch is not None:
+                # weight drift vs the PREVIOUS emitted snapshot — the
+                # 'model silently walked away' detector. Reuses the host
+                # weight fetch the snapshot already pays; layout changes
+                # (fb -> std demotion) reset the base instead of
+                # reporting a phantom jump
+                prev = _prev_w[0]
+                if prev is not None and prev.shape == w_full.shape:
+                    # denominator includes the NEW norm: an l1-regularized
+                    # cold start commonly emits an all-zero first snapshot,
+                    # and norm/1e-12 would flag a healthy warm-up as
+                    # ~1e12 'drift' (growth from zero caps at 1.0)
+                    denom = max(float(np.linalg.norm(prev)),
+                                float(np.linalg.norm(w_full)), 1e-12)
+                    monitor.record("ftrl.weight_drift", int(batch),
+                                   float(np.linalg.norm(w_full - prev))
+                                   / denom)
+                _prev_w[0] = w_full.copy()
             if fb_S is None:
                 w = w_full[:dim]
             elif has_icpt:
@@ -814,6 +885,43 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 save_checkpoint(ck_dir, b_done,
                                 {"z": np.asarray(z), "n": np.asarray(n)},
                                 meta=meta, scope="ftrl", keep_last=ck_keep)
+                if mon_on:
+                    # the snapshot fetch just synced the device queue, so
+                    # the pending pv scalars are free to read now; a
+                    # watchdog abort here leaves the snapshot on disk
+                    flush_pv()
+            # -- per-micro-batch health monitoring (common/health.py) -----
+            # pv stats are DEVICE scalars queued here and fetched in bulk
+            # at snapshot/checkpoint boundaries (plus a cap, so an
+            # emission-less drain cannot queue unboundedly) — per-batch
+            # host fetches would break the deferred-backend pipeline
+            pv_pending: List[tuple] = []
+
+            def flush_pv():
+                if not pv_pending:
+                    if mon_on:
+                        monitor.evaluate()
+                    return
+                import jax
+                # ONE batched fetch of every queued scalar: device_get
+                # starts all host copies async and blocks once — per-item
+                # np.asarray would serialize hundreds of link round trips
+                # on exactly the deferred backends the queue exists for
+                fetched = jax.device_get(
+                    [(ll, ok, nf) for _, _, ll, ok, nf in pv_pending])
+                for (bi, rows, *_), (ll, ok, nf) in zip(pv_pending, fetched):
+                    rows = max(int(rows), 1)
+                    monitor.record("ftrl.pv_logloss", bi,
+                                   float(ll) / rows)
+                    monitor.record("ftrl.pv_accuracy", bi,
+                                   float(ok) / rows)
+                    monitor.record("nonfinite.margin", bi, float(nf))
+                pv_pending.clear()
+                # may raise HealthAlertError (monitor raise_on=...): the
+                # watchdog abort propagates out of the drain, AFTER any
+                # checkpoint this boundary published
+                monitor.evaluate()
+
             # telemetry is per-micro-batch (HOST dispatch latency: device
             # work is async, so the histogram reads as dispatch+encode
             # pressure, not device time) — resolved once per drain
@@ -855,16 +963,16 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                   step = _ftrl_fb_batch_step_factory(
                       mesh, meta, alpha, beta, l1, l2, fbv is not None)
                   if fbv is None:
-                      z, n, _ = step(fbi, y, z, n)
+                      z, n, mg = step(fbi, y, z, n)
                   else:
-                      z, n, _ = step(fbi, fbv, y, z, n)
+                      z, n, mg = step(fbi, fbv, y, z, n)
               elif enc[0] == "dense":
                   if layout is None:
                       layout = "std"
                       allow_fb[0] = False
                       z, n = alloc(layout)
                   _, X, y = enc
-                  z, n, _ = dense_step[0](X, y, z, n)
+                  z, n, mg = dense_step[0](X, y, z, n)
               else:
                   if layout is None:
                       layout = "std"
@@ -881,7 +989,17 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                       else:
                           sparse_step[0] = _ftrl_sparse_step_factory(
                               mesh, alpha, beta, l1, l2)
-                  z, n, _ = sparse_step[0](idx, val, y, z, n)
+                  z, n, mg = sparse_step[0](idx, val, y, z, n)
+              if mon_on:
+                  # progressive validation on the device scalars; real
+                  # rows only (padding rows would score as margin-0
+                  # coin flips — the reducer masks them by row count).
+                  # Host fetch deferred to flush_pv.
+                  b = mt.num_rows
+                  ll, ok, nf = _pv_stats_fn()(mg, y, b)
+                  pv_pending.append((b_done + 1, b, ll, ok, nf))
+                  if len(pv_pending) >= 512:
+                      flush_pv()
               # retroactive span (generator body; see stream/core.py on
               # why an open span must not cross a yield): encode overlap
               # happens in the prefetch thread, so this span reads as the
@@ -901,7 +1019,10 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
               if t + 1e-12 >= next_emit:
                   trace_instant("ftrl.snapshot", cat="stream",
                                 args={"event_time": t, "batch": b_done + 1})
-                  yield (t, snapshot(z, n, fb_S))
+                  snap = snapshot(z, n, fb_S, batch=b_done + 1)
+                  if mon_on:
+                      flush_pv()     # pv + drift evaluated per emission
+                  yield (t, snap)
                   if mx:
                       reg.inc("alink_ftrl_snapshots_total", 1)
                   while next_emit <= t + 1e-12:
@@ -928,8 +1049,11 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 reg.inc("alink_ftrl_snapshots_total", 1)
             trace_instant("ftrl.snapshot", cat="stream",
                           args={"batch": b_done, "final": True})
-            yield (next_emit if next_emit is not None else interval,
-                   snapshot(z, n, fb_S))
+            snap = snapshot(z, n, fb_S,
+                            batch=b_done if b_done > 0 else None)
+            if mon_on:
+                flush_pv()
+            yield (next_emit if next_emit is not None else interval, snap)
 
         self._stream_fn = gen
         return self

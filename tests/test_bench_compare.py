@@ -1,0 +1,143 @@
+"""tools/bench_compare.py — bench regression gate on synthetic dumps.
+
+No jax needed: the tool is pure-host JSON diffing. Covers both accepted
+file shapes (driver dump with ``parsed``, bare final-line object), the
+newest-pair discovery, per-workload deltas incl. appear/disappear, the
+``--threshold`` exit-code gate, and the ``--json`` machine output.
+"""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def cli():
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare_cli", os.path.join(ROOT, "tools", "bench_compare.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _driver_dump(path, workloads, n=1):
+    """The BENCH_r*.json driver shape (final line under 'parsed')."""
+    doc = {"n": n, "cmd": "python bench.py", "rc": 0, "tail": "...",
+           "parsed": {"metric": "m", "value": 1.0, "unit": "sps",
+                      "workloads_sps_vs": {
+                          k: [v, 1.0, 0.5] for k, v in workloads.items()}}}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path
+
+
+def _bare_dump(path, workloads):
+    with open(path, "w") as f:
+        json.dump({"workloads_sps_vs":
+                   {k: [v, 2.0, 0.1] for k, v in workloads.items()}}, f)
+    return path
+
+
+class TestLoadAndCompare:
+    def test_both_shapes_load(self, cli, tmp_path):
+        a = _driver_dump(str(tmp_path / "a.json"), {"x": 100.0})
+        b = _bare_dump(str(tmp_path / "b.json"), {"x": 50.0})
+        assert cli.load_workloads(a) == {"x": 100.0}
+        assert cli.load_workloads(b) == {"x": 50.0}
+
+    def test_not_a_bench_dump(self, cli, tmp_path):
+        p = str(tmp_path / "junk.json")
+        with open(p, "w") as f:
+            json.dump({"hello": 1}, f)
+        with pytest.raises(ValueError, match="workloads_sps_vs"):
+            cli.load_workloads(p)
+
+    def test_compare_deltas_and_membership(self, cli):
+        rows = cli.compare({"a": 100.0, "gone": 5.0},
+                           {"a": 110.0, "fresh": 7.0})
+        by = {r["workload"]: r for r in rows}
+        assert by["a"]["delta_pct"] == pytest.approx(10.0)
+        assert by["gone"]["new"] is None and by["gone"]["delta_pct"] is None
+        assert by["fresh"]["old"] is None and by["fresh"]["delta_pct"] is None
+
+    def test_regressions_threshold(self, cli):
+        rows = cli.compare({"a": 100.0, "b": 100.0}, {"a": 80.0, "b": 95.0})
+        assert [r["workload"] for r in cli.regressions(rows, 10.0)] == ["a"]
+        assert cli.regressions(rows, 25.0) == []
+
+    def test_zero_old_rate_is_na_not_gone(self, cli, tmp_path, capsys):
+        """A failed/zeroed old run has no percentage baseline: the
+        workload must render as n/a (present in both), never 'gone'."""
+        rows = cli.compare({"a": 0.0}, {"a": 500.0})
+        assert rows[0]["old"] == 0.0 and rows[0]["new"] == 500.0
+        assert rows[0]["delta_pct"] is None
+        old = _driver_dump(str(tmp_path / "o.json"), {"a": 0.0})
+        new = _driver_dump(str(tmp_path / "n.json"), {"a": 500.0})
+        assert cli.main([old, new, "--threshold", "10"]) == 0
+        out = capsys.readouterr().out
+        assert "n/a" in out and "gone" not in out
+
+    def test_newest_pair_by_mtime(self, cli, tmp_path):
+        p1 = _driver_dump(str(tmp_path / "BENCH_r01.json"), {"x": 1.0})
+        p2 = _driver_dump(str(tmp_path / "BENCH_r02.json"), {"x": 2.0})
+        p3 = _driver_dump(str(tmp_path / "BENCH_full.json"), {"x": 9.0})
+        now = time.time()
+        os.utime(p1, (now - 20, now - 20))
+        os.utime(p2, (now - 10, now - 10))
+        os.utime(p3, (now, now))          # per-run detail: never selected
+        old, new = cli.newest_pair(str(tmp_path))
+        assert os.path.basename(old) == "BENCH_r01.json"
+        assert os.path.basename(new) == "BENCH_r02.json"
+        with pytest.raises(ValueError, match="at least two"):
+            cli.newest_pair(str(tmp_path / "empty"))
+
+
+class TestCli:
+    def test_ok_and_gate(self, cli, tmp_path, capsys):
+        old = _driver_dump(str(tmp_path / "old.json"),
+                           {"a": 100.0, "b": 200.0})
+        new = _driver_dump(str(tmp_path / "new.json"),
+                           {"a": 80.0, "b": 210.0})
+        # report-only: exit 0 even with the regression visible
+        assert cli.main([old, new]) == 0
+        out = capsys.readouterr().out
+        assert "-20.0%" in out and "+5.0%" in out
+        # gated: exit 2 past the threshold, 0 within it
+        assert cli.main([old, new, "--threshold", "10"]) == 2
+        assert "REGRESSION" in capsys.readouterr().out
+        assert cli.main([old, new, "--threshold", "30"]) == 0
+        assert "ok:" in capsys.readouterr().out
+
+    def test_newest_pair_mode_and_json(self, cli, tmp_path, capsys):
+        p1 = _driver_dump(str(tmp_path / "BENCH_r01.json"), {"a": 100.0})
+        p2 = _driver_dump(str(tmp_path / "BENCH_r02.json"), {"a": 50.0})
+        now = time.time()
+        os.utime(p1, (now - 10, now - 10))
+        os.utime(p2, (now, now))
+        rc = cli.main(["--dir", str(tmp_path), "--threshold", "25",
+                       "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 2
+        assert doc["regressions"] == ["a"]
+        assert doc["workloads"][0]["delta_pct"] == pytest.approx(-50.0)
+        assert os.path.basename(doc["old"]) == "BENCH_r01.json"
+
+    def test_error_paths(self, cli, tmp_path, capsys):
+        assert cli.main([str(tmp_path / "nope.json"),
+                         str(tmp_path / "nope2.json")]) == 1
+        assert "bench_compare.py:" in capsys.readouterr().err
+        assert cli.main(["--dir", str(tmp_path)]) == 1
+
+    def test_real_repo_dumps_if_present(self, cli, capsys):
+        """The recorded BENCH_r*.json dumps in the repo root must parse."""
+        import glob
+        dumps = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+        if len(dumps) < 2:
+            pytest.skip("fewer than two recorded dumps")
+        assert cli.main([dumps[-2], dumps[-1]]) == 0
+        assert "bench compare" in capsys.readouterr().out

@@ -526,3 +526,39 @@ class TestCkptCli:
         assert cli.main([d, "--prune", "1"]) == 0
         assert len(list_checkpoints(d)) == 1
         assert cli.main([str(tmp_path / "nope")]) == 2
+
+    def test_json_round_trip_on_real_engine_dir(self, tmp_path, cli,
+                                                capsys):
+        """--json on a REAL engine checkpoint dir: one strict-JSON object
+        per snapshot, fields matching the on-disk manifests, exit code
+        tracking validity."""
+        import json
+        d = str(tmp_path / "ck")
+        _lbfgs(_lr_fixture(), checkpoint_dir=d, checkpoint_every=4)
+        paths = list_checkpoints(d)
+        assert len(paths) >= 2              # boundary + final snapshots
+        assert cli.main([d, "--json"]) == 0
+        lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+        recs = [json.loads(ln) for ln in lines]
+        assert len(recs) == len(paths)
+        for rec, p in zip(recs, paths):
+            assert rec["path"] == p
+            assert rec["valid"] is True
+            assert rec["kind"] == "comqueue_carry"
+            assert rec["tag"] == int(os.path.basename(p)[len("ckpt-"):])
+            assert rec["progress"] == f"step={rec['tag']}"
+            assert rec["arrays"] > 0 and rec["bytes"] > 0
+        # --validate --json stays parseable and still exits 0
+        assert cli.main([d, "--validate", "--json"]) == 0
+        recs2 = [json.loads(ln) for ln in
+                 capsys.readouterr().out.splitlines() if ln]
+        assert recs2 == recs
+        # corrupt a payload: --json reports the invalid row, exit 1
+        with open(os.path.join(paths[0], "arr_00000.npy"), "r+b") as f:
+            f.seek(40)
+            f.write(b"\xff")
+        assert cli.main([d, "--validate", "--json"]) == 1
+        bad = [json.loads(ln) for ln in
+               capsys.readouterr().out.splitlines() if ln]
+        flagged = [r for r in bad if not r["valid"]]
+        assert len(flagged) == 1 and "error" in flagged[0]

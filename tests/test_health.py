@@ -1,0 +1,655 @@
+"""Training-health observability (common/health.py + engine probe channel).
+
+Covers the rule catalog (nonfinite / divergence / plateau / threshold /
+drift), HealthMonitor dedupe + raise_on + report round-trip, the engine
+probe channel (series correctness, trimming, carry hygiene), the
+lowered-HLO guard (probes add ONLY the stacked scalar carry — no
+callbacks, collectives unchanged; with ALINK_TPU_HEALTH off the HLO is
+byte-identical to a probe-less program and the cache hit path is
+unchanged), the optimizer/kmeans/FTRL default probes, and the acceptance
+end-to-end: an L-BFGS run seeded with a NaN gradient records a critical
+``nonfinite`` alert naming the superstep — visible in tools/health.py
+output, ``run_report --health`` and as a ``health.alert`` trace instant —
+and kill-and-resume stitches the probe history bitwise-identically.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.health import (DivergenceRule, DriftRule, HealthAlert,
+                                     HealthAlertError, HealthMonitor,
+                                     NonFiniteRule, PlateauRule,
+                                     ThresholdRule, UpdateRatioRule,
+                                     default_rules, health_enabled,
+                                     sparkline)
+from alink_tpu.common.metrics import MetricsRegistry, set_registry
+from alink_tpu.common.tracing import Tracer, set_tracer
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"tool_{name}", os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        yield reg
+    finally:
+        set_registry(prev)
+
+
+@pytest.fixture
+def fresh_tracer(monkeypatch):
+    monkeypatch.setenv("ALINK_TPU_TRACE", "1")
+    tr = Tracer()
+    prev = set_tracer(tr)
+    try:
+        yield tr
+    finally:
+        set_tracer(prev)
+
+
+# ---------------------------------------------------------------------------
+# rule catalog (pure host, no engine)
+# ---------------------------------------------------------------------------
+
+class TestRules:
+    def test_nonfinite_count_probe_first_step(self):
+        mon = HealthMonitor(rules=[NonFiniteRule()])
+        mon.ingest({"nonfinite.grad": [0.0, 0.0, 3.0, 5.0]})
+        alerts = mon.evaluate()
+        assert len(alerts) == 1
+        a = alerts[0]
+        assert a.rule == "nonfinite" and a.severity == "critical"
+        assert a.step == 3 and "step 3" in a.message
+        assert "3 non-finite element(s)" in a.message
+
+    def test_nonfinite_value_in_any_series(self):
+        mon = HealthMonitor(rules=[NonFiniteRule()])
+        mon.ingest({"loss": [0.7, 0.5, np.nan, np.nan]})
+        (a,) = mon.evaluate()
+        assert a.series == "loss" and a.step == 3
+
+    def test_divergence_fires_on_rise_not_on_zero_noise(self):
+        rule = DivergenceRule(rel=0.5, grace=3)
+        mon = HealthMonitor(rules=[rule])
+        # converged-to-zero noise: 1e-7 over a 1e-11 best must NOT fire
+        # (the floor self-scales to the first value)
+        mon.ingest({"loss": [0.7, 1e-3, 1e-11, 2e-7, 1.8e-7, 1e-8]})
+        assert mon.evaluate() == []
+        # a genuine rise back toward the starting loss must fire
+        mon2 = HealthMonitor(rules=[DivergenceRule(rel=0.5, grace=3)])
+        mon2.ingest({"loss": [0.7, 0.3, 0.1, 0.1, 0.4, 0.9]})
+        (a,) = mon2.evaluate()
+        assert a.rule == "divergence" and a.step == 5
+
+    def test_plateau(self):
+        mon = HealthMonitor(rules=[PlateauRule(window=4, rel_tol=1e-4)])
+        mon.ingest({"loss": [1.0, 0.5, 0.3] + [0.2999] * 8})
+        (a,) = mon.evaluate()
+        assert a.rule == "plateau" and a.severity == "info"
+        # a steadily-improving series stays quiet
+        mon2 = HealthMonitor(rules=[PlateauRule(window=4, rel_tol=1e-4)])
+        mon2.ingest({"loss": list(np.geomspace(1.0, 1e-4, 12))})
+        assert mon2.evaluate() == []
+
+    def test_update_ratio_and_drift_thresholds(self):
+        mon = HealthMonitor(rules=[UpdateRatioRule(threshold=10.0),
+                                   DriftRule(threshold=1.0)])
+        mon.ingest({"update_ratio": [0.5, 30.0, 40.0],
+                    "ftrl.weight_drift": [0.1, 0.2]})
+        alerts = mon.evaluate()
+        assert [a.rule for a in alerts] == ["update_ratio"]
+        assert alerts[0].step == 2
+        mon.record("ftrl.weight_drift", 3, 2.5)
+        (a,) = mon.evaluate()
+        assert a.rule == "drift" and a.step == 3
+
+    def test_threshold_rule_generic(self):
+        mon = HealthMonitor(rules=[ThresholdRule("queue_depth", 100)])
+        mon.ingest({"queue_depth": [5, 150]})
+        (a,) = mon.evaluate()
+        assert a.rule == "threshold" and a.value == 150
+
+    def test_evaluate_dedupes_and_reingest_grows(self):
+        mon = HealthMonitor(rules=[NonFiniteRule()])
+        mon.ingest({"nonfinite.grad": [0.0, 1.0]})
+        assert len(mon.evaluate()) == 1
+        assert mon.evaluate() == []          # same violation: deduped
+        # longer prefix of the same run replaces the series; the old
+        # alert stays deduped, a NEW series' violation still fires
+        mon.ingest({"nonfinite.grad": [0.0, 1.0, 1.0],
+                    "nonfinite.hess": [2.0]})
+        new = mon.evaluate()
+        assert [a.series for a in new] == ["nonfinite.hess"]
+        assert len(mon.alerts) == 2
+
+    def test_raise_on_watchdog(self):
+        mon = HealthMonitor(rules=[NonFiniteRule()],
+                            raise_on={"critical"})
+        mon.ingest({"nonfinite.grad": [1.0]})
+        with pytest.raises(HealthAlertError, match="non-finite"):
+            mon.evaluate()
+        assert len(mon.alerts) == 1          # recorded BEFORE raising
+        with pytest.raises(ValueError, match="unknown severities"):
+            HealthMonitor(raise_on={"fatal"})
+        # custom rules with out-of-ladder severities fail at construction
+        bad = NonFiniteRule()
+        bad.severity = "error"
+        with pytest.raises(ValueError, match="unknown severity"):
+            HealthMonitor(rules=[bad])
+
+    def test_healthy_ignores_info(self):
+        mon = HealthMonitor(rules=[PlateauRule(window=2, rel_tol=1e-4)])
+        mon.ingest({"loss": [1.0] * 8})
+        mon.evaluate()
+        assert mon.alerts and mon.healthy
+        assert mon.worst_severity() == "info"
+
+    def test_metrics_and_trace_emission(self, fresh_registry, fresh_tracer):
+        mon = HealthMonitor(rules=[NonFiniteRule()], source="unit")
+        mon.ingest({"nonfinite.grad": [0.0, 2.0]})
+        mon.evaluate()
+        assert fresh_registry.value(
+            "alink_health_alerts_total",
+            {"rule": "nonfinite", "severity": "critical",
+             "source": "unit"}) == 1
+        assert fresh_registry.value("alink_health_last_alert_step",
+                                    {"source": "unit"}) == 2
+        assert fresh_registry.value(
+            "alink_health_probe_last",
+            {"probe": "nonfinite.grad", "source": "unit"}) == 2.0
+        evs = [e for e in fresh_tracer.events()
+               if e["name"] == "health.alert"]
+        assert len(evs) == 1
+        assert evs[0]["args"]["rule"] == "nonfinite"
+        assert evs[0]["args"]["step"] == 2
+
+    def test_report_round_trip_with_nonfinite(self, tmp_path):
+        mon = HealthMonitor(source="unit")
+        mon.ingest({"loss": [0.5, np.nan, np.inf]})
+        mon.evaluate()
+        p = str(tmp_path / "health.json")
+        mon.save_report(p)
+        # strict JSON on disk (no bare NaN tokens)
+        raw = open(p).read()
+        json.loads(raw)
+        assert "NaN" in raw and "Infinity" in raw
+        doc = HealthMonitor.load_report(p)
+        assert doc["format"] == "alink_tpu_health_v1"
+        vals = doc["series"]["loss"]["values"]
+        assert vals[0] == 0.5 and np.isnan(vals[1]) and np.isinf(vals[2])
+        assert doc["healthy"] is False
+        assert doc["worst_severity"] == "critical"
+
+    def test_sparkline(self):
+        s = sparkline([0, 1, 2, 3, np.nan])
+        assert len(s) == 5 and s[-1] == "!" and s[0] == "▁" and s[3] == "█"
+        assert len(sparkline(list(range(1000)), width=40)) == 40
+        assert sparkline([]) == ""
+
+    def test_persistent_incident_reports_once_under_trimming(self):
+        """A continuing violation must report ONE alert even as the
+        bounded retention window slides past its original first step —
+        and may re-alert only after the series recovers."""
+        mon = HealthMonitor(rules=[NonFiniteRule()], max_points=8)
+        for i in range(1, 60):
+            mon.record("nonfinite.m", i, 1.0 if i >= 5 else 0.0)
+            if i % 4 == 0:
+                mon.evaluate()
+        mon.evaluate()
+        assert len(mon.alerts) == 1
+        assert mon.alerts[0].step == 5
+        # recovery then a NEW incident: a second alert fires
+        for i in range(60, 80):
+            mon.record("nonfinite.m", i, 0.0)
+        mon.evaluate()
+        mon.record("nonfinite.m", 80, 2.0)
+        mon.evaluate()
+        assert len(mon.alerts) == 2 and mon.alerts[1].step == 80
+
+    def test_bounded_retention(self):
+        """A stream monitor must not grow without bound: only the newest
+        max_points points per series are retained (absolute steps kept)."""
+        mon = HealthMonitor(rules=[], max_points=8)
+        for i in range(1, 101):
+            mon.record("pv", i, float(i))
+        steps, vals = mon.series("pv")
+        assert len(vals) <= 10                  # cap + amortization slack
+        assert steps[-1] == 100 and vals[-1] == 100.0
+        assert steps[0] == 100 - len(steps) + 1
+        mon.ingest({"loss": np.arange(100.0)})
+        s2, v2 = mon.series("loss")
+        assert len(v2) == 8 and s2[0] == 93 and v2[-1] == 99.0
+        with pytest.raises(ValueError, match="max_points"):
+            HealthMonitor(max_points=2)
+
+    def test_cli_renders_empty_series(self, tmp_path, capsys):
+        mon = HealthMonitor(source="unit")
+        mon.ingest({"loss": []})
+        p = str(tmp_path / "h.json")
+        mon.save_report(p)
+        cli = _load_tool("health")
+        assert cli.main([p]) == 0
+        assert "(empty series)" in capsys.readouterr().out
+
+    def test_default_rules_cover_catalog(self):
+        names = {r.name for r in default_rules()}
+        assert names == {"nonfinite", "divergence", "plateau",
+                         "update_ratio", "drift"}
+
+
+# ---------------------------------------------------------------------------
+# engine probe channel
+# ---------------------------------------------------------------------------
+
+def _probe_queue(key, max_iter=5, with_probes=True, **ck):
+    import jax.numpy as jnp
+    from alink_tpu.engine.communication import AllReduce
+    from alink_tpu.engine.comqueue import IterativeComQueue
+
+    X = np.arange(64.0).reshape(32, 2)
+
+    def stage(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("s", jnp.zeros(()))
+        ctx.put_obj("s", ctx.get_obj("X").sum())
+
+    def stage_probed(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("s", jnp.zeros(()))
+        ctx.put_obj("s", ctx.get_obj("X").sum())
+        # replicated scalars only: no collective may be added
+        ctx.probe("step", ctx.step_no)
+        ctx.probe_nonfinite("s", ctx.get_obj("s"))
+
+    q = (IterativeComQueue(max_iter=max_iter, **ck)
+         .init_with_partitioned_data("X", X)
+         .add(stage_probed if with_probes else stage)
+         .add(AllReduce("s")))
+    if key is not None:
+        q.set_program_key(key)
+    return q
+
+
+class TestProbeChannel:
+    def test_probe_series_values_and_trim(self):
+        r = _probe_queue(key=None, max_iter=5).exec()
+        assert r.probe_names() == ["nonfinite.s", "step"]
+        step = np.asarray(r.probe_series("step"))
+        np.testing.assert_array_equal(step, [1, 2, 3, 4, 5])
+        assert step.dtype == np.float32
+        full = np.asarray(r.probe_series("step", trim=False))
+        assert full.shape == (5,)
+        nf = np.asarray(r.probe_series("nonfinite.s"))
+        np.testing.assert_array_equal(nf, np.zeros(5))
+        # probes() mirrors the names; carry keys() stays clean
+        assert sorted(r.probes()) == ["nonfinite.s", "step"]
+        assert all(not k.startswith("__") for k in r.keys())
+
+    def test_probe_series_trim_stops_at_criterion(self):
+        from alink_tpu.engine.comqueue import IterativeComQueue
+
+        def stage(ctx):
+            ctx.probe("v", ctx.step_no * 10)
+            ctx.put_obj("done", ctx.step_no >= 3)
+
+        r = (IterativeComQueue(max_iter=10)
+             .init_with_partitioned_data("X", np.ones((8, 1)))
+             .add(stage)
+             .set_compare_criterion(lambda c: c.get_obj("done"))
+             .exec())
+        np.testing.assert_array_equal(np.asarray(r.probe_series("v")),
+                                      [10.0, 20.0, 30.0])
+        full = np.asarray(r.probe_series("v", trim=False))
+        assert np.isnan(full[3:]).all()
+
+    def test_health_off_hlo_byte_identical_and_no_probes(self, monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_HEALTH", "0")
+        key = ("test_health_hlo_off", os.urandom(6).hex())
+        plain = _probe_queue(key=key, with_probes=False).lowered().as_text()
+        probed = _probe_queue(key=key, with_probes=True).lowered().as_text()
+        assert probed == plain
+        r = _probe_queue(key=None, with_probes=True).exec()
+        assert r.probe_names() == []
+
+    def test_health_on_hlo_only_adds_carry(self, monkeypatch):
+        """The acceptance guard: probes add only the stacked scalar
+        carry — no callbacks/outfeeds, and exactly the same collectives
+        as the probe-less program."""
+        monkeypatch.setenv("ALINK_TPU_HEALTH", "1")
+        key = ("test_health_hlo_on", os.urandom(6).hex())
+        probed = _probe_queue(key=key, with_probes=True).lowered().as_text()
+        plain = _probe_queue(key=key, with_probes=False).lowered().as_text()
+        low = probed.lower()
+        assert "callback" not in low and "outfeed" not in low \
+            and "infeed" not in low
+        for coll in ("all-reduce", "all-gather", "collective-permute",
+                     "all-to-all"):
+            assert probed.lower().count(coll) == plain.lower().count(coll)
+
+    def test_cache_hit_path_unchanged_and_keyed_on_flag(self, monkeypatch):
+        from alink_tpu.engine.comqueue import program_cache_stats
+        key = ("test_health_cache", os.urandom(6).hex())
+        monkeypatch.setenv("ALINK_TPU_HEALTH", "0")
+        _probe_queue(key=key).exec()
+        before = program_cache_stats()
+        _probe_queue(key=key).exec()
+        mid = program_cache_stats()
+        assert mid["hits"] == before["hits"] + 1      # off-path still hits
+        monkeypatch.setenv("ALINK_TPU_HEALTH", "1")
+        _probe_queue(key=key).exec()                  # new key: miss
+        after = program_cache_stats()
+        assert after["misses"] == mid["misses"] + 1
+        _probe_queue(key=key).exec()                  # and then hits
+        assert program_cache_stats()["hits"] == after["hits"] + 1
+
+    def test_queue_monitor_auto_evaluates(self):
+        mon = HealthMonitor(source="queue")
+        _probe_queue(key=None).set_health(mon).exec()
+        assert mon.series_names() == ["nonfinite.s", "step"]
+        assert mon.healthy
+
+    def test_closure_devarray_warning_once(self, monkeypatch):
+        import jax.numpy as jnp
+        import alink_tpu.engine.comqueue as cq
+        monkeypatch.setattr(cq, "_DEVARRAY_CELL_WARNED", [False])
+        dev = jnp.ones((3,))
+
+        def stage(ctx):
+            ctx.put_obj("s", dev.sum())   # jax.Array baked via closure
+
+        with pytest.warns(RuntimeWarning,
+                          match="ALINK_VERIFY_PROGRAM_CACHE"):
+            cq._callable_digest(stage)
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("error")      # second digest must NOT warn
+            cq._callable_digest(stage)
+        # host arrays and numpy scalars stay silent (np.float32 has a
+        # () shape tuple + dtype but is host data, not a jax.Array)
+        monkeypatch.setattr(cq, "_DEVARRAY_CELL_WARNED", [False])
+        host = np.ones((3,))
+        tol = np.float32(1e-4)
+
+        def stage2(ctx):
+            ctx.put_obj("s", host.sum() * tol)
+
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            cq._callable_digest(stage2)
+
+
+# ---------------------------------------------------------------------------
+# optimizer default probes + acceptance e2e
+# ---------------------------------------------------------------------------
+
+def _lr_fixture(n=256, d=6, seed=3, nan_at=None):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, d).astype(np.float32)
+    y = (X @ r.randn(d) > 0).astype(np.float32) * 2 - 1
+    if nan_at is not None:
+        X[nan_at] = np.nan
+    return {"X": X, "y": y, "w": np.ones(n, np.float32)}
+
+
+def _lbfgs(data, health=None, max_iter=12, **ck):
+    from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                         UnaryLossObjFunc)
+    from alink_tpu.operator.common.optim.optimizers import (OptimParams,
+                                                            optimize)
+    obj = UnaryLossObjFunc(LogLossFunc(), dim=data["X"].shape[1])
+    params = OptimParams(method="LBFGS", max_iter=max_iter, epsilon=0.0,
+                         health=health, **ck)
+    return optimize(obj, data, params)
+
+
+class TestOptimizerHealth:
+    def test_probes_align_with_loss_curve(self):
+        mon = HealthMonitor(source="qn")
+        coef, curve, steps = _lbfgs(_lr_fixture(), health=mon)
+        assert set(mon.series_names()) == {"loss", "grad_norm",
+                                           "update_ratio", "nonfinite.grad"}
+        ls, lv = mon.series("loss")
+        # satellite: the stored loss history and the probe series agree
+        # in length AND indexing (single source of truth = step count)
+        assert len(lv) == steps == len(curve)
+        np.testing.assert_allclose(lv, np.asarray(curve, np.float64),
+                                   rtol=1e-5)
+        assert mon.healthy
+
+    @pytest.mark.parametrize("method", ["SGD", "NEWTON", "GD", "OWLQN"])
+    def test_all_trainers_probe(self, method):
+        from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                             UnaryLossObjFunc)
+        from alink_tpu.operator.common.optim.optimizers import (OptimParams,
+                                                                optimize)
+        data = _lr_fixture(n=128, d=4)
+        obj = UnaryLossObjFunc(LogLossFunc(), dim=4)
+        mon = HealthMonitor(source=method.lower())
+        coef, curve, steps = optimize(
+            obj, data, OptimParams(method=method, max_iter=6, epsilon=0.0,
+                                   seed=1, health=mon))
+        assert {"loss", "grad_norm", "update_ratio",
+                "nonfinite.grad"} <= set(mon.series_names())
+        _, lv = mon.series("loss")
+        assert len(lv) == steps == len(curve)
+
+    def test_trim_curve_regression_nan_loss(self):
+        """A NaN loss mid-curve must NOT shorten the curve (the old
+        non-NaN-count trim did): length stays the executed step count."""
+        mon = HealthMonitor(source="qn")
+        coef, curve, steps = _lbfgs(_lr_fixture(nan_at=0), health=mon,
+                                    max_iter=4)
+        assert steps == 4
+        assert len(curve) == 4               # NaNs included, not dropped
+        assert np.isnan(np.asarray(curve)).all()
+        _, lv = mon.series("loss")
+        assert len(lv) == 4
+
+    def test_nan_gradient_acceptance_e2e(self, tmp_path, fresh_registry,
+                                         fresh_tracer, capsys):
+        """ISSUE acceptance: NaN-seeded L-BFGS -> critical nonfinite
+        alert naming the superstep, visible in tools/health.py,
+        run_report --health, and as a health.alert trace instant."""
+        mon = HealthMonitor(source="qn")
+        _lbfgs(_lr_fixture(nan_at=3), health=mon, max_iter=4)
+        assert not mon.healthy
+        nf = [a for a in mon.alerts if a.rule == "nonfinite"
+              and a.series == "nonfinite.grad"]
+        assert nf and nf[0].severity == "critical"
+        assert "step 1" in nf[0].message
+        # trace instant
+        evs = [e for e in fresh_tracer.events()
+               if e["name"] == "health.alert"]
+        assert any(e["args"]["rule"] == "nonfinite" for e in evs)
+        # metrics
+        assert fresh_registry.value(
+            "alink_health_alerts_total",
+            {"rule": "nonfinite", "severity": "critical",
+             "source": "qn"}) >= 1
+        # tools/health.py
+        hp = str(tmp_path / "health.json")
+        mon.save_report(hp)
+        health_cli = _load_tool("health")
+        rc = health_cli.main([hp])
+        out = capsys.readouterr().out
+        assert rc == 1                      # unhealthy -> nonzero
+        assert "nonfinite" in out and "critical" in out
+        assert "NO" in out                  # healthy: NO
+        assert "nonfinite.grad" in out
+        # --json round-trips through load_report
+        assert health_cli.main([hp, "--json"]) == 1
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["format"] == "alink_tpu_health_v1"
+        # run_report --health merges the summary
+        rp = str(tmp_path / "run.jsonl")
+        fresh_registry.dump(rp)
+        run_report = _load_tool("run_report")
+        assert run_report.main([rp, "--health", hp]) == 0
+        out = capsys.readouterr().out
+        assert "== Health summary ==" in out
+        assert "nonfinite" in out
+
+    def test_watchdog_raises(self):
+        mon = HealthMonitor(source="qn", raise_on={"critical"})
+        with pytest.raises(HealthAlertError, match="non-finite"):
+            _lbfgs(_lr_fixture(nan_at=0), health=mon, max_iter=3)
+
+    def test_kill_and_resume_stitches_probes_bitwise(self, tmp_path,
+                                                     monkeypatch):
+        """ISSUE acceptance: the resumed run's probe history equals the
+        uninterrupted run's, bitwise."""
+        from alink_tpu.common.faults import FAULT_ENV, FaultInjected
+        data = _lr_fixture()
+        m_full = HealthMonitor(source="qn")
+        d_full = str(tmp_path / "full")
+        _lbfgs(data, health=m_full, checkpoint_dir=d_full,
+               checkpoint_every=4)
+        m_kill = HealthMonitor(source="qn")
+        d_kill = str(tmp_path / "kill")
+        monkeypatch.setenv(FAULT_ENV, "comqueue.superstep:8")
+        with pytest.raises(FaultInjected):
+            _lbfgs(data, health=m_kill, checkpoint_dir=d_kill,
+                   checkpoint_every=4)
+        monkeypatch.delenv(FAULT_ENV)
+        # the killed run's monitor saw only the first boundary's prefix
+        _, lv_kill = m_kill.series("loss")
+        assert len(lv_kill) == 4
+        m_res = HealthMonitor(source="qn")
+        _lbfgs(data, health=m_res, checkpoint_dir=d_kill,
+               checkpoint_every=4, resume_from=d_kill)
+        for name in m_full.series_names():
+            sf, vf = m_full.series(name)
+            sr, vr = m_res.series(name)
+            np.testing.assert_array_equal(sf, sr)
+            assert vf.tobytes() == vr.tobytes(), name
+        # and the stitched prefix is the killed run's prefix, bitwise
+        _, lv_full = m_full.series("loss")
+        assert lv_full[:4].tobytes() == lv_kill.tobytes()
+
+    def test_checkpoint_refuses_cross_flag_resume(self, tmp_path,
+                                                  monkeypatch):
+        from alink_tpu.common.checkpoint import CheckpointError
+        d = str(tmp_path)
+        _lbfgs(_lr_fixture(), checkpoint_dir=d, checkpoint_every=4)
+        monkeypatch.setenv("ALINK_TPU_HEALTH", "0")
+        with pytest.raises(CheckpointError, match="different program"):
+            _lbfgs(_lr_fixture(), checkpoint_dir=d, checkpoint_every=4,
+                   resume_from=d)
+
+
+# ---------------------------------------------------------------------------
+# kmeans probes
+# ---------------------------------------------------------------------------
+
+class TestKMeansHealth:
+    def test_inertia_and_movement_series(self):
+        from alink_tpu.operator.common.clustering.kmeans import kmeans_train
+        r = np.random.RandomState(0)
+        X = np.concatenate([r.randn(70, 4) + c
+                            for c in (-4.0, 0.0, 4.0)]).astype(np.float32)
+        mon = HealthMonitor(source="kmeans")
+        C, w, steps = kmeans_train(X, k=3, max_iter=9, tol=1e-12,
+                                   init="RANDOM", seed=5, health=mon)
+        assert set(mon.series_names()) == {"inertia", "movement",
+                                           "empty_clusters"}
+        _, vi = mon.series("inertia")
+        assert len(vi) == steps
+        # Lloyd monotonicity: pre-update inertia is non-increasing
+        assert (np.diff(vi) <= 1e-3 * vi[0]).all()
+        assert mon.healthy
+
+    def test_health_flag_does_not_change_results(self, monkeypatch):
+        from alink_tpu.operator.common.clustering.kmeans import kmeans_train
+        r = np.random.RandomState(1)
+        X = r.randn(96, 3).astype(np.float32)
+        kw = dict(k=4, max_iter=6, tol=1e-12, init="RANDOM", seed=2)
+        monkeypatch.setenv("ALINK_TPU_HEALTH", "1")
+        C_on, w_on, s_on = kmeans_train(X, **kw)
+        monkeypatch.setenv("ALINK_TPU_HEALTH", "0")
+        C_off, w_off, s_off = kmeans_train(X, **kw)
+        assert s_on == s_off
+        assert np.asarray(C_on).tobytes() == np.asarray(C_off).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# FTRL progressive validation + drift
+# ---------------------------------------------------------------------------
+
+def _ftrl_run(table, mon, n_warm=100, **kw):
+    from alink_tpu.operator.batch.classification import \
+        LogisticRegressionTrainBatchOp
+    from alink_tpu.operator.batch.source import MemSourceBatchOp
+    from alink_tpu.operator.stream import (FtrlTrainStreamOp,
+                                           MemSourceStreamOp)
+    warm = LogisticRegressionTrainBatchOp(
+        feature_cols=["f0", "f1", "f2"], label_col="label",
+        max_iter=10).link_from(MemSourceBatchOp(table.first_n(n_warm)))
+    stream = MemSourceStreamOp(table, batch_size=32, time_per_batch=1.0)
+    ftrl = FtrlTrainStreamOp(
+        warm, label_col="label", feature_cols=["f0", "f1", "f2"],
+        alpha=0.5, beta=1.0, time_interval=3.0, health=mon,
+        **kw).link_from(stream)
+    return list(ftrl.micro_batches())
+
+
+def _lr_table(n=300, seed=11, nan_row=None):
+    from alink_tpu.common import MTable
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 3)
+    w = np.array([1.5, -2.0, 0.7])
+    y = (X @ w > 0).astype(np.int64)
+    if nan_row is not None:
+        X[nan_row, 0] = np.nan
+    return MTable({"f0": X[:, 0], "f1": X[:, 1], "f2": X[:, 2],
+                   "label": y})
+
+
+class TestFtrlHealth:
+    def test_progressive_validation_and_drift(self):
+        mon = HealthMonitor(source="ftrl")
+        snaps = _ftrl_run(_lr_table(), mon)
+        assert len(snaps) >= 2
+        assert set(mon.series_names()) == {
+            "ftrl.pv_accuracy", "ftrl.pv_logloss", "ftrl.weight_drift",
+            "nonfinite.margin"}
+        bs, acc = mon.series("ftrl.pv_accuracy")
+        assert len(acc) == 300 // 32 + 1     # one point per micro-batch
+        assert list(bs) == list(range(1, len(acc) + 1))
+        assert acc[-1] > 0.8                 # warm-started model scores well
+        _, ll = mon.series("ftrl.pv_logloss")
+        assert np.isfinite(ll).all() and (ll >= 0).all()
+        _, nf = mon.series("nonfinite.margin")
+        assert (nf == 0).all()
+        _, dr = mon.series("ftrl.weight_drift")
+        assert len(dr) >= 1 and np.isfinite(dr).all()
+        assert mon.healthy
+
+    def test_nan_stream_fires_nonfinite_margin(self):
+        mon = HealthMonitor(source="ftrl")
+        # row 150 sits past the 100-row warm-start slice (the warm model
+        # must stay finite) inside micro-batch 5 (rows 128..159)
+        _ftrl_run(_lr_table(nan_row=150), mon)
+        bad = [a for a in mon.alerts if a.series == "nonfinite.margin"]
+        assert bad and bad[0].severity == "critical"
+        assert bad[0].step == 5
+
+    def test_health_off_records_nothing(self, monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_HEALTH", "0")
+        mon = HealthMonitor(source="ftrl")
+        with pytest.warns(RuntimeWarning, match="ALINK_TPU_HEALTH"):
+            _ftrl_run(_lr_table(), mon)
+        assert mon.series_names() == []

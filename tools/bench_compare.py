@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Diff two BENCH_*.json dumps — the bench regression gate.
+
+Usage:
+    python tools/bench_compare.py OLD.json NEW.json [--threshold PCT]
+    python tools/bench_compare.py                   # newest pair in repo root
+    python tools/bench_compare.py --dir DIR [--threshold PCT] [--json]
+
+Accepted file shapes (both appear in this repo):
+  * the driver dump ``{"n", "cmd", "rc", "tail", "parsed": {...}}``
+    (``BENCH_r*.json``) — the bench's final combined line lives under
+    ``parsed``;
+  * a bare final-line object carrying ``workloads_sps_vs`` directly.
+
+``workloads_sps_vs`` maps workload name -> ``[samples/sec/chip,
+vs_baseline, pct_chip_peak_flops]``; the diff is on samples/sec/chip.
+
+``--threshold PCT`` turns the report into a gate: exit 2 when any
+workload present in both dumps regressed by more than PCT percent
+(workloads appearing or disappearing are reported but never gated).
+Without it the tool only reports (exit 0). ``--json`` emits the machine
+shape instead of the table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_workloads(path: str) -> Dict[str, float]:
+    """``{workload: samples_per_sec_per_chip}`` from either file shape."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and "parsed" in doc \
+            and isinstance(doc["parsed"], dict):
+        doc = doc["parsed"]
+    wl = doc.get("workloads_sps_vs") if isinstance(doc, dict) else None
+    if not isinstance(wl, dict) or not wl:
+        raise ValueError(f"{path}: no workloads_sps_vs map found "
+                         f"(not a bench dump?)")
+    out = {}
+    for name, row in wl.items():
+        sps = row[0] if isinstance(row, (list, tuple)) else row
+        out[str(name)] = float(sps)
+    return out
+
+
+def newest_pair(directory: str) -> Tuple[str, str]:
+    """The two most recent ``BENCH_*.json`` dumps (by mtime, name as the
+    tie-break) — returned (older, newer)."""
+    cands = [p for p in glob.glob(os.path.join(directory, "BENCH_*.json"))
+             if os.path.basename(p) != "BENCH_full.json"]  # per-run detail
+    if len(cands) < 2:
+        raise ValueError(f"{directory}: need at least two BENCH_*.json "
+                         f"dumps, found {len(cands)}")
+    cands.sort(key=lambda p: (os.path.getmtime(p), p))
+    return cands[-2], cands[-1]
+
+
+def compare(old: Dict[str, float], new: Dict[str, float]) -> List[dict]:
+    """One record per workload: old/new samples-per-sec and delta_pct
+    (None when the workload exists on only one side, or when the old
+    rate is 0 — a failed/zeroed run has no percentage baseline)."""
+    rows = []
+    for name in sorted(set(old) | set(new)):
+        o, n = old.get(name), new.get(name)
+        delta = (100.0 * (n - o) / o) \
+            if o is not None and n is not None and o != 0 else None
+        rows.append({"workload": name, "old": o, "new": n,
+                     "delta_pct": delta})
+    return rows
+
+
+def regressions(rows: List[dict], threshold_pct: float) -> List[dict]:
+    return [r for r in rows
+            if r["delta_pct"] is not None
+            and r["delta_pct"] < -abs(threshold_pct)]
+
+
+def _fmt_sps(v: Optional[float]) -> str:
+    return f"{v:,.1f}" if v is not None else "-"
+
+
+def render(rows: List[dict], old_path: str, new_path: str) -> str:
+    out = [f"bench compare: {os.path.basename(old_path)} -> "
+           f"{os.path.basename(new_path)}  (samples/sec/chip)"]
+    headers = ["workload", "old", "new", "delta"]
+    widths = [max(len(headers[0]), *(len(r["workload"]) for r in rows)),
+              max(len(headers[1]), *(len(_fmt_sps(r["old"])) for r in rows)),
+              max(len(headers[2]), *(len(_fmt_sps(r["new"])) for r in rows)),
+              8]
+    def line(cells, pads="lrrr"):
+        return "  " + "  ".join(
+            str(c).rjust(w) if p == "r" else str(c).ljust(w)
+            for c, w, p in zip(cells, widths, pads)).rstrip()
+    out.append(line(headers))
+    out.append("  " + "  ".join("-" * w for w in widths))
+    for r in rows:
+        if r["delta_pct"] is not None:
+            d = f"{r['delta_pct']:+.1f}%"
+        elif r["old"] is None:
+            d = "new"
+        elif r["new"] is None:
+            d = "gone"
+        else:
+            d = "n/a"          # present in both, old rate 0: no baseline
+        out.append(line([r["workload"], _fmt_sps(r["old"]),
+                         _fmt_sps(r["new"]), d]))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_compare.py", description=__doc__.splitlines()[0])
+    ap.add_argument("old", nargs="?", help="older BENCH_*.json")
+    ap.add_argument("new", nargs="?", help="newer BENCH_*.json")
+    ap.add_argument("--dir", default=ROOT,
+                    help="directory to find the newest pair in when no "
+                         "files are given (default: repo root)")
+    ap.add_argument("--threshold", type=float, metavar="PCT",
+                    help="exit 2 when any shared workload regressed by "
+                         "more than PCT percent")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the comparison as JSON")
+    args = ap.parse_args(argv)
+    if (args.old is None) != (args.new is None):
+        ap.error("give both OLD and NEW, or neither (newest pair)")
+    if args.old is None:
+        try:
+            old_path, new_path = newest_pair(args.dir)
+        except ValueError as e:
+            print(f"bench_compare.py: {e}", file=sys.stderr)
+            return 1
+    else:
+        old_path, new_path = args.old, args.new
+    try:
+        rows = compare(load_workloads(old_path), load_workloads(new_path))
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"bench_compare.py: {e}", file=sys.stderr)
+        return 1
+    bad = regressions(rows, args.threshold) \
+        if args.threshold is not None else []
+    if args.json:
+        json.dump({"old": old_path, "new": new_path,
+                   "threshold_pct": args.threshold,
+                   "workloads": rows,
+                   "regressions": [r["workload"] for r in bad]},
+                  sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        print(render(rows, old_path, new_path))
+        if args.threshold is not None:
+            if bad:
+                print(f"REGRESSION: {len(bad)} workload(s) slower than "
+                      f"-{abs(args.threshold):g}%: "
+                      + ", ".join(f"{r['workload']} "
+                                  f"({r['delta_pct']:+.1f}%)"
+                                  for r in bad))
+            else:
+                print(f"ok: no workload regressed more than "
+                      f"{abs(args.threshold):g}%")
+    return 2 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Render an alink_tpu HealthReport (training-health) JSON.
+
+Usage:
+    python tools/health.py HEALTH.json             # summary tables
+    python tools/health.py HEALTH.json --series loss   # sparkline one series
+    python tools/health.py HEALTH.json --json      # normalized JSON
+
+The input is a ``HealthMonitor.save_report()`` file
+(``alink_tpu_health_v1``): alert list + probe series recorded by the
+engine probe channel (``ctx.probe``), the optimizers' default probes, or
+the FTRL progressive-validation path — see docs/observability.md
+"Layer 2 — training health".
+
+Exit code: 0 when the report is healthy (nothing above ``info``),
+1 otherwise — so a CI step can gate on training health directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+from alink_tpu.common.health import (HEALTH_FORMAT,  # noqa: E402
+                                     HealthMonitor, _jsonify, sparkline)
+
+
+def _table(headers: List[str], rows: List[List[str]],
+           align_right=None) -> str:
+    if not rows:
+        return "  (none)"
+    ar = align_right or [False] + [True] * (len(headers) - 1)
+    widths = [max(len(str(headers[i])), *(len(str(r[i])) for r in rows))
+              for i in range(len(headers))]
+
+    def fmt(cells):
+        return "  " + "  ".join(
+            str(c).rjust(widths[i]) if ar[i] else str(c).ljust(widths[i])
+            for i, c in enumerate(cells)).rstrip()
+
+    sep = "  " + "  ".join("-" * w for w in widths)
+    return "\n".join([fmt(headers), sep] + [fmt(r) for r in rows])
+
+
+def _fmt(v) -> str:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return str(v)
+    if f != f:
+        return "NaN"
+    return f"{f:.6g}"
+
+
+def render(doc: dict, series_name=None) -> str:
+    out: List[str] = []
+    alerts = doc.get("alerts") or []
+    series = doc.get("series") or {}
+
+    out.append("== Health summary ==")
+    by_sev = {}
+    for a in alerts:
+        by_sev[a["severity"]] = by_sev.get(a["severity"], 0) + 1
+    rows = [["source", doc.get("source", "?")],
+            ["healthy", "yes" if doc.get("healthy") else "NO"],
+            ["worst severity", doc.get("worst_severity") or "-"],
+            ["alerts", f"{len(alerts):,}"
+             + (" (" + ", ".join(f"{k}={v}" for k, v in
+                                 sorted(by_sev.items())) + ")"
+                if by_sev else "")],
+            ["probe series", f"{len(series):,}"],
+            ["rules", ", ".join(r.get("rule", "?")
+                                for r in doc.get("rules", [])) or "-"]]
+    out.append(_table(["field", "value"], rows,
+                      align_right=[False, False]))
+
+    out.append("\n== Alerts ==")
+    arows = [[a["severity"], a["rule"], a["series"], f"{a['step']:,}",
+              _fmt(a["value"]), a["message"]] for a in alerts]
+    out.append(_table(["severity", "rule", "series", "step", "value",
+                       "message"], arows,
+                      align_right=[False, False, False, True, True, False]))
+
+    out.append("\n== Probe series ==")
+    srows = []
+    for name in sorted(series):
+        vals = [v for v in series[name]["values"]]
+        fv = [v for v in vals if isinstance(v, (int, float)) and v == v]
+        srows.append([name, f"{len(vals):,}",
+                      _fmt(vals[0]) if vals else "-",
+                      _fmt(vals[-1]) if vals else "-",
+                      _fmt(min(fv)) if fv else "-",
+                      _fmt(max(fv)) if fv else "-"])
+    out.append(_table(["series", "points", "first", "last", "min", "max"],
+                      srows))
+
+    # sparkline: the requested series, else the conventional objective
+    # ("loss", "inertia", or the first pv loss), else the first series
+    cand = [series_name] if series_name else \
+        ["loss", "inertia"] + [n for n in sorted(series) if "logloss" in n] \
+        + sorted(series)
+    pick = next((n for n in cand if n in series), None)
+    if series_name and pick is None:
+        raise SystemExit(f"health.py: no series {series_name!r}; "
+                         f"have {sorted(series)}")
+    if pick is not None:
+        vals = series[pick]["values"]
+        steps = series[pick]["steps"]
+        out.append(f"\n== {pick} ==")
+        if not vals:
+            out.append("  (empty series)")
+        else:
+            out.append("  " + sparkline(vals))
+            fv = [v for v in vals
+                  if isinstance(v, (int, float)) and v == v]
+            out.append(f"  steps {steps[0]}..{steps[-1]}"
+                       + (f"  first {_fmt(vals[0])}  last {_fmt(vals[-1])}"
+                          f"  min {_fmt(min(fv))}  max {_fmt(max(fv))}"
+                          if fv else "  (no finite values)")
+                       + ("  (! = non-finite)"
+                          if len(fv) != len(vals) else ""))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="health.py", description=__doc__.splitlines()[0])
+    ap.add_argument("report", help=f"path to a {HEALTH_FORMAT} JSON "
+                                   f"(HealthMonitor.save_report)")
+    ap.add_argument("--series", metavar="NAME",
+                    help="sparkline this probe series")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the normalized report JSON instead of tables")
+    args = ap.parse_args(argv)
+    doc = HealthMonitor.load_report(args.report)
+    if args.json:
+        # same strict-JSON encoding save_report uses (non-finite floats
+        # as strings), so the output round-trips through load_report
+        json.dump(_jsonify(doc), sys.stdout, indent=1, allow_nan=False)
+        sys.stdout.write("\n")
+    else:
+        print(render(doc, series_name=args.series))
+    return 0 if doc.get("healthy") else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

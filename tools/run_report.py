@@ -3,6 +3,7 @@
 Usage:
     python tools/run_report.py RUN_REPORT.jsonl [--prom] [--all]
                                [--trace TRACE.jsonl]
+                               [--health HEALTH.json]
 
 The input is a ``MetricsRegistry.dump()`` file (one JSON object per line;
 written by ``registry.dump(path)``, by ``bench.py --metrics-out``, or by
@@ -19,7 +20,10 @@ any caller of ``alink_tpu.get_registry()``). Output sections:
 ``--prom`` prints the Prometheus exposition text instead of tables.
 ``--trace TRACE.jsonl`` appends the span-tracer summary (tools/trace.py)
 for a flight-recorder export from the same run, so one report carries
-both the aggregates and the timeline rollup.
+both the aggregates and the timeline rollup. ``--health HEALTH.json``
+appends the training-health summary (tools/health.py) for a
+``HealthMonitor.save_report()`` file from the same run — aggregates,
+timeline, and model health in one report.
 """
 
 from __future__ import annotations
@@ -218,6 +222,10 @@ def main(argv=None) -> int:
                     help="append the span-trace summary for a "
                          "Tracer.export_jsonl()/export_chrome() file "
                          "from the same run")
+    ap.add_argument("--health", metavar="HEALTH",
+                    help="append the training-health summary for a "
+                         "HealthMonitor.save_report() JSON from the "
+                         "same run")
     args = ap.parse_args(argv)
     reg = MetricsRegistry.load(args.report)
     if args.prom:
@@ -227,18 +235,25 @@ def main(argv=None) -> int:
     if args.trace and not args.prom:
         # never appended in --prom mode: the exposition text on stdout
         # must stay parseable by Prometheus scrapers
-        trace_mod = _load_trace_tool()
+        trace_mod = _load_sibling_tool("trace")
         meta, events = trace_mod.load_events(args.trace)
         print()
         print(trace_mod.summarize(meta, events))
+    if args.health and not args.prom:
+        health_mod = _load_sibling_tool("health")
+        from alink_tpu.common.health import HealthMonitor
+        print()
+        print(health_mod.render(HealthMonitor.load_report(args.health)))
     return 0
 
 
-def _load_trace_tool():
-    """Import the sibling trace.py (tools/ is not a package)."""
+def _load_sibling_tool(name: str):
+    """Import a sibling tools/*.py module (tools/ is not a package)."""
     import importlib.util
-    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "trace.py")
-    spec = importlib.util.spec_from_file_location("alink_tpu_tool_trace", p)
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     f"{name}.py")
+    spec = importlib.util.spec_from_file_location(
+        f"alink_tpu_tool_{name}", p)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
