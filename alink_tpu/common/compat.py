@@ -15,7 +15,8 @@ from __future__ import annotations
 import functools
 from typing import Any, Callable, Optional
 
-__all__ = ["shard_map", "lowered_text", "compiled_cost_analysis"]
+__all__ = ["shard_map", "lowered_text", "compiled_cost_analysis",
+           "device_get_tree"]
 
 _impl: Optional[tuple] = None  # (callable, check_kwarg_name)
 
@@ -99,3 +100,18 @@ def lowered_text(lowered: Any, debug_info: bool = False) -> str:
             except Exception:
                 pass
         return lowered.as_text()
+
+
+def device_get_tree(tree: Any) -> Any:
+    """Fetch every leaf of a pytree to host numpy in ONE batched
+    ``jax.device_get``: the batched call starts all device->host copies
+    asynchronously and blocks once, where per-leaf ``np.asarray``
+    serializes a link round trip per leaf (~100 ms each on tunneled
+    backends). Host leaves pass through as numpy. The one batched-fetch
+    idiom every boundary shares (ComQueueResult reads, snapshot
+    persistence) — fix fetch behavior here, not at call sites."""
+    import jax
+    import numpy as np
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [np.asarray(x) for x in jax.device_get(leaves)])

@@ -96,6 +96,29 @@ def program_cache_stats() -> Dict[str, int]:
     return dict(_PROGRAM_CACHE_STATS)
 
 
+def donation_enabled() -> bool:
+    """``ALINK_TPU_DONATE`` (default ON): donate the chunk-loop carry into
+    the compiled ``cont`` chunk program (``jax.jit(donate_argnums=...)``).
+    XLA then aliases the carry's input buffers to the output buffers —
+    the per-chunk copy-on-entry disappears and the carry's HBM working
+    set halves for large models (the reference mutates its shared model
+    state in place, SessionSharedObjs; donation is the compiled-loop
+    analogue). Read live and folded into the program-cache key, so
+    toggling it recompiles instead of serving a structurally different
+    cached program.
+
+    Only the ``cont`` program has a carry INPUT to donate: the single
+    and first-chunk programs construct the carry inside the trace (the
+    init pass), so there is nothing to alias — the flag is a no-op for
+    them beyond the cache-key fold. Donation contract for callers: a
+    buffer passed into a donated argument is dead after the call
+    (``RuntimeError: Array has been deleted`` on reuse) — fetch anything
+    you still need BEFORE re-entering the program
+    (docs/performance.md)."""
+    from ..common.metrics import env_flag
+    return env_flag("ALINK_TPU_DONATE", default=True)
+
+
 def clear_program_cache() -> None:
     _PROGRAM_CACHE.clear()
     _PROGRAM_CACHE_JAXPRS.clear()
@@ -435,6 +458,15 @@ def _readonly(arr: np.ndarray) -> np.ndarray:
     return arr
 
 
+def _fetch_tree(tree):
+    """ONE batched device->host fetch of every leaf in ``tree`` (the
+    shared ``common.compat.device_get_tree`` idiom), with every returned
+    leaf flipped read-only (the memo contract above)."""
+    import jax
+    from ..common.compat import device_get_tree
+    return jax.tree_util.tree_map(_readonly, device_get_tree(tree))
+
+
 class ComQueueResult:
     """Final per-worker state, stacked on a leading worker axis.
 
@@ -450,14 +482,17 @@ class ComQueueResult:
         self._fetched: Dict[tuple, Any] = {}
 
     def shards(self, name: str):
-        """(num_workers, ...) stacked per-worker values (read-only)."""
-        import jax
+        """(num_workers, ...) stacked per-worker values (read-only).
+
+        Multi-leaf carry objects fetch in ONE batched ``jax.device_get``
+        (see :func:`_fetch_tree`) — one link round trip per call, not
+        per leaf."""
         if name not in self._stacked:
             raise KeyError(f"no carry object '{name}'; have {sorted(self._stacked)}")
         got = self._fetched.get(("shards", name))
         if got is None:
-            got = self._fetched[("shards", name)] = jax.tree_util.tree_map(
-                lambda x: _readonly(np.asarray(x)), self._stacked[name])
+            got = self._fetched[("shards", name)] = _fetch_tree(
+                self._stacked[name])
         return got
 
     def get(self, name: str):
@@ -468,7 +503,8 @@ class ComQueueResult:
         (num_workers, ...) stack and discarding all but shard 0 on host
         would pay num_workers x the bytes over the device link. Fetched
         leaves are memoized per name, so repeated get() calls pay the
-        link once (advisor r4)."""
+        link once (advisor r4); multi-leaf objects fetch in ONE batched
+        ``jax.device_get``."""
         import jax
         got = self._fetched.get(("get", name))
         if got is None:
@@ -481,9 +517,8 @@ class ComQueueResult:
             if full is not None:  # already on host: slice locally
                 got = jax.tree_util.tree_map(lambda x: x[0], full)
             else:
-                got = jax.tree_util.tree_map(
-                    lambda x: _readonly(np.asarray(x[0])),
-                    self._stacked[name])
+                got = _fetch_tree(jax.tree_util.tree_map(
+                    lambda x: x[0], self._stacked[name]))
             self._fetched[("get", name)] = got
         return got
 
@@ -542,9 +577,28 @@ class ComQueueResult:
         return s[:self.step_count] if trim else s
 
     def probes(self, trim: bool = True):
-        """Every probe series as ``{name: (steps,) array}`` (read-only)."""
-        return {n: self.probe_series(n, trim=trim)
-                for n in self.probe_names()}
+        """Every probe series as ``{name: (steps,) array}`` (read-only).
+
+        All not-yet-memoized series (plus the ``__step`` count the trim
+        needs) fetch in ONE batched ``jax.device_get`` — a run with a
+        dozen probes pays one link round trip here, not thirteen."""
+        import jax
+        pre = ComContext.PROBE_PREFIX
+        names = self.probe_names()
+        missing = [pre + n for n in names
+                   if ("get", pre + n) not in self._fetched]
+        if trim and ("get", "__step") not in self._fetched \
+                and "__step" in self._stacked:
+            missing.append("__step")
+        if missing:
+            sliced = [jax.tree_util.tree_map(lambda x: x[0],
+                                             self._stacked[k])
+                      for k in missing]
+            fetched = jax.device_get(sliced)
+            for k, v in zip(missing, fetched):
+                self._fetched[("get", k)] = jax.tree_util.tree_map(
+                    lambda x: _readonly(np.asarray(x)), v)
+        return {n: self.probe_series(n, trim=trim) for n in names}
 
 
 class IterativeComQueue:
@@ -692,6 +746,11 @@ class IterativeComQueue:
         # stacked (max_iter,) carry entries, so a toggled flag is a
         # structurally different program
         probes_on = health_enabled()
+        # carry-donation switch, latched per run. Rides the program-cache
+        # key: a donated program's buffer-aliasing contract differs from
+        # the non-donated one's even though the HLO ops are identical, so
+        # a toggle must recompile, never alias-through a cached entry
+        donate = donation_enabled()
         # per-superstep collective capture (trace-time; see communication
         # .collecting), keyed by the traced input signature: jax.jit keeps
         # a shape-keyed trace cache underneath each compiled entry, so one
@@ -841,6 +900,13 @@ class IterativeComQueue:
                              in_specs=(P("d"), P(), P("d"), P()),
                              out_specs=P("d"), check_vma=False)
 
+        def jit_cont():
+            # carry donation (ALINK_TPU_DONATE): argnum 2 is the stacked
+            # chunk carry — the ONLY input a chunk pass consumes. parts/
+            # bcast are never donatable (every later chunk re-reads them)
+            return jax.jit(build_cont_chunk(),
+                           donate_argnums=(2,) if donate else ())
+
         if lower_only:
             if not lower_chunked:
                 return jax.jit(build_mapped()).lower(parts, bcast)
@@ -850,8 +916,7 @@ class IterativeComQueue:
             # the cont program's carry geometry comes from the first
             # program's abstract output — no execution, no compile
             carry_shape = jax.eval_shape(first_fn, parts, bcast, lim)
-            cont_low = jax.jit(build_cont_chunk()).lower(
-                parts, bcast, carry_shape, lim)
+            cont_low = jit_cont().lower(parts, bcast, carry_shape, lim)
             return first_low, cont_low
         compiled = None
         ckey = None
@@ -868,7 +933,7 @@ class IterativeComQueue:
             ckey = (self._program_key, stages_dig,
                     mesh, nw, max_iter, seed,
                     criterion is not None, step_log_enabled(), probes_on,
-                    tuple(sorted(parts)), tuple(sorted(bcast)))
+                    donate, tuple(sorted(parts)), tuple(sorted(bcast)))
 
         if self._ckpt is not None:
             # -- durable chunked execution (engine/recovery.py) -----------
@@ -892,7 +957,7 @@ class IterativeComQueue:
                                                                    manifest)
             if first is None:
                 first = jax.jit(build_first_chunk())
-                cont = jax.jit(build_cont_chunk())
+                cont = jit_cont()
                 if ckkey is not None:
                     cache_status = "miss"
                     _PROGRAM_CACHE_STATS["misses"] += 1
@@ -945,7 +1010,7 @@ class IterativeComQueue:
                 stacked, ck_info = recovery.drive(
                     ck, first=first, cont=cont, parts=parts, bcast=bcast,
                     max_iter=max_iter, signature=signature, resumed=resumed,
-                    on_snapshot=on_snapshot)
+                    on_snapshot=on_snapshot, donate=donate)
             # chunked path: the program runs once per chunk, so only the
             # STATIC cost gauges are meaningful (no exec_t0 -> no achieved
             # rates; see _finish)

@@ -24,10 +24,26 @@ supersteps plus the file writes — and nothing inside the compiled
 program. The lowered chunk programs contain no host callbacks and exactly
 the collectives of the unchunked program (asserted by a lowered-HLO test,
 the same discipline as the collective-manifest accounting).
+
+Overlap (``ALINK_TPU_ASYNC_SNAPSHOT``, default on): the fetch + file
+write above no longer sit on the accelerator's critical path. At a chunk
+boundary the driver takes a device-side copy of the carry (one HBM copy;
+with donation on, the original is about to be consumed by the next chunk
+anyway), dispatches chunk t+1 immediately, and a bounded background
+writer (ONE snapshot in flight) fetches and persists snapshot t while
+the device runs t+1. The writer commits strictly in order and the driver
+barriers on it before returning, so the on-disk snapshot sequence — and
+kill-and-resume parity — is bitwise identical to the synchronous path;
+``on_snapshot`` (the health watchdog) fires from the writer after each
+publish, and its abort surfaces on the main thread at the next boundary,
+at most one chunk later, with the triggering snapshot already durable.
 """
 
 from __future__ import annotations
 
+import queue
+import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -35,9 +51,11 @@ import numpy as np
 
 from ..common.checkpoint import load_latest_validated, save_checkpoint
 from ..common.faults import maybe_crash
-from ..common.tracing import trace_span
+from ..common.metrics import env_flag, get_registry, metrics_enabled
+from ..common.tracing import trace_instant, trace_span
 
-__all__ = ["CheckpointConfig", "program_signature", "resume_state", "drive"]
+__all__ = ["CheckpointConfig", "program_signature", "resume_state", "drive",
+           "async_snapshot_enabled"]
 
 SCOPE = "comqueue"
 SITE = "comqueue.superstep"
@@ -112,6 +130,134 @@ def _next_limit(step: int, every: int, max_iter: int) -> int:
     return min(max_iter, (step // every + 1) * every)
 
 
+def async_snapshot_enabled() -> bool:
+    """``ALINK_TPU_ASYNC_SNAPSHOT`` (default on): persist boundary
+    snapshots in a bounded background writer instead of blocking the
+    chunk loop on the device->host fetch + file write. Off restores the
+    strictly synchronous r02 behavior (identical on-disk artifacts)."""
+    return env_flag("ALINK_TPU_ASYNC_SNAPSHOT", default=True)
+
+
+def _device_copy(stacked) -> Dict[str, Any]:
+    """Device-side copy of a stacked carry (sharding preserved). Taken at
+    a boundary so the donated ``cont`` program is free to CONSUME the
+    original while the background writer still holds live buffers to
+    fetch. One HBM-to-HBM pass — orders of magnitude cheaper than the
+    host fetch it decouples. Host leaves (a resumed numpy carry) copy on
+    host."""
+    import jax
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(
+        lambda x: jnp.copy(x) if isinstance(x, jax.Array) else np.copy(x),
+        dict(stacked))
+
+
+def _to_host(stacked) -> Dict[str, Any]:
+    """Fetch every carry leaf to host numpy in ONE batched transfer (the
+    persistence payload) — the shared
+    :func:`common.compat.device_get_tree` idiom. The ONLY persistence
+    fetch: async writer and synchronous path both go through it, so the
+    payload bytes cannot diverge between them."""
+    from ..common.compat import device_get_tree
+    return device_get_tree(dict(stacked))
+
+
+class _SnapshotWriter:
+    """Bounded background snapshot writer — ONE snapshot in flight.
+
+    ``submit()`` hands over a device-side carry (a copy when donation is
+    on) and returns once the PREVIOUS snapshot has committed (the bound:
+    the driver can run at most one chunk ahead of durability). The worker
+    thread fetches the carry to host (one batched ``jax.device_get``),
+    persists it through ``save_checkpoint`` (same atomic-publish path as
+    the synchronous writer — artifacts are bitwise identical), then fires
+    ``on_snapshot``. Commits are strictly in submission order, so
+    retention pruning, ``alink_checkpoint_last_tag`` and the health
+    watchdog observe the same sequence the synchronous path produces.
+
+    Any exception — an injected ``ckpt.save`` kill, a watchdog
+    ``HealthAlertError``, a real IO error — is captured and re-raised ON
+    THE MAIN THREAD (original object, type preserved) at the next
+    ``submit()``/``check()``/``barrier()``, i.e. before the driver
+    dispatches further work past the failed boundary."""
+
+    def __init__(self, config: CheckpointConfig, signature: Dict[str, Any],
+                 on_snapshot: Optional[Callable] = None):
+        self._config = config
+        self._signature = signature
+        self._on_snapshot = on_snapshot
+        self._q: "queue.Queue" = queue.Queue(maxsize=1)
+        self._errs: list = []
+        self._writes = 0
+        self._th = threading.Thread(target=self._worker, daemon=True,
+                                    name="alink-ckpt-writer")
+        self._th.start()
+
+    # -- worker thread ---------------------------------------------------
+    def _worker(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                carry, step, stopped = item
+                with trace_span("snapshot.write", cat="ckpt") as sp:
+                    host = _to_host(carry)
+                    save_checkpoint(
+                        self._config.directory, step, host,
+                        meta={"signature": self._signature, "step": step,
+                              "stopped": stopped},
+                        scope=SCOPE, keep_last=self._config.keep_last)
+                    sp.set(step=step, mode="async")
+                self._writes += 1
+                if metrics_enabled():
+                    get_registry().inc("alink_overlap_snapshot_writes_total",
+                                       1, {"scope": SCOPE})
+                if self._on_snapshot is not None:
+                    # the watchdog hook: may raise HealthAlertError — it
+                    # lands in _errs and aborts the run at the next
+                    # boundary, with THIS snapshot already on disk
+                    self._on_snapshot(host, step)
+            except BaseException as e:
+                self._errs.append(e)
+            finally:
+                self._q.task_done()
+
+    # -- driver-thread API -----------------------------------------------
+    def check(self):
+        """Re-raise the first captured writer exception (original object,
+        so FaultInjected/HealthAlertError keep their types)."""
+        if self._errs:
+            raise self._errs[0]
+
+    def submit(self, carry, step: int, stopped: bool):
+        t0 = time.perf_counter()
+        self._q.join()       # previous snapshot must commit first (bound)
+        wait = time.perf_counter() - t0
+        self.check()         # a failed previous write aborts HERE, before
+        #                      this boundary's state is handed over
+        if metrics_enabled():
+            get_registry().observe("alink_overlap_submit_wait_seconds",
+                                   wait, {"scope": SCOPE})
+        trace_instant("snapshot.submit", cat="ckpt",
+                      args={"step": step, "waited_s": round(wait, 6)})
+        self._q.put((carry, step, stopped))
+
+    def barrier(self):
+        """Final durability barrier: every submitted snapshot is on disk
+        (or its error raised) before the driver returns."""
+        self._q.join()
+        self.check()
+
+    def shutdown(self):
+        """Stop the worker without raising (the ``finally`` path). Any
+        queued snapshot is still committed first — a run aborted by a
+        superstep fault keeps the durability of its last boundary, same
+        as the synchronous writer."""
+        self._q.put(None)
+        self._th.join(timeout=60.0)
+
+
 def resume_state(config: CheckpointConfig,
                  signature: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     """Load the newest valid snapshot from ``config.resume_from`` and
@@ -129,7 +275,8 @@ def drive(config: CheckpointConfig, *,
           parts: Dict[str, Any], bcast: Dict[str, Any],
           max_iter: int, signature: Dict[str, Any],
           resumed: Optional[Dict[str, Any]] = None,
-          on_snapshot: Optional[Callable] = None
+          on_snapshot: Optional[Callable] = None,
+          donate: bool = False
           ) -> Tuple[Any, Dict[str, Any]]:
     """Run the chunked superstep loop with host-side persistence.
 
@@ -140,9 +287,14 @@ def drive(config: CheckpointConfig, *,
     right after each snapshot publishes, with the host carry the save
     already fetched (the health monitor's mid-run hook; it may raise to
     abort the run, and because the snapshot is already on disk the
-    aborted run stays resumable). Returns ``(stacked_carry, info)``
-    where ``info`` carries the superstep accounting the metrics tail
-    needs (``steps_executed``, ``init_ran``, ``resumed_at``).
+    aborted run stays resumable; with the async writer the abort
+    surfaces on the main thread at the next boundary, at most one chunk
+    later). ``donate=True`` declares that ``cont`` CONSUMES its carry
+    argument (``ALINK_TPU_DONATE``), so the async writer is handed a
+    device-side copy instead of the live carry. Returns
+    ``(stacked_carry, info)`` where ``info`` carries the superstep
+    accounting the metrics tail needs (``steps_executed``, ``init_ran``,
+    ``resumed_at``).
     """
     import jax.numpy as jnp
 
@@ -150,10 +302,13 @@ def drive(config: CheckpointConfig, *,
     max_iter = int(max_iter)
 
     def boundary(stacked):
-        # worker 0's copy — __step/__stop are replicated by construction
-        step = int(np.asarray(stacked["__step"])[0])
-        stop = bool(np.asarray(stacked["__stop"])[0])
-        return step, stop
+        # worker 0's copy — __step/__stop are replicated by construction.
+        # ONE batched fetch: this sits inside the per-chunk critical path
+        # (superstep.sync), where two serialized np.asarray round trips
+        # cost ~200 ms per chunk on tunneled backends
+        import jax
+        step, stop = jax.device_get([stacked["__step"], stacked["__stop"]])
+        return int(np.asarray(step)[0]), bool(np.asarray(stop)[0])
 
     def chunk(fn, args, from_step, limit):
         """One compiled-chunk pass: dispatch + the boundary sync that
@@ -169,40 +324,61 @@ def drive(config: CheckpointConfig, *,
             sp.set(from_step=from_step, limit=limit, step=step)
         return out, step, stop
 
+    writer = _SnapshotWriter(config, signature, on_snapshot) \
+        if async_snapshot_enabled() else None
+
+    def persist(stacked, step, stopped):
+        if writer is not None:
+            # hand the writer buffers the next chunk cannot invalidate:
+            # a device-side copy when the donated cont will consume the
+            # carry; the live carry itself otherwise (a non-donated cont
+            # only READS it, and a concurrent device_get is safe)
+            writer.submit(_device_copy(stacked) if donate else stacked,
+                          step, stopped)
+            return
+        host = _to_host(stacked)
+        save_checkpoint(config.directory, step, host,
+                        meta={"signature": signature, "step": step,
+                              "stopped": stopped},
+                        scope=SCOPE, keep_last=config.keep_last)
+        if on_snapshot is not None:
+            on_snapshot(host, step)
+
     info: Dict[str, Any] = {"init_ran": resumed is None, "resumed_at": None}
-    if resumed is None:
-        stacked, step, stop = chunk(first, (parts, bcast), 1,
-                                    _next_limit(1, every, max_iter))
-        start_step = 0
-    else:
-        stacked = resumed
-        step, stop = boundary(stacked)
-        start_step = step
-        info["resumed_at"] = start_step
-    last_saved = start_step if resumed is not None else None
-    while True:
-        # the injected-preemption point: BEFORE the snapshot publish, so a
-        # killed run genuinely loses the work since the last checkpoint
-        # and the resume has supersteps to re-execute
-        maybe_crash(SITE, step)
-        if step != last_saved:
-            host = _to_host(stacked)
-            save_checkpoint(config.directory, step, host,
-                            meta={"signature": signature, "step": step,
-                                  "stopped": stop or step >= max_iter},
-                            scope=SCOPE, keep_last=config.keep_last)
-            last_saved = step
-            if on_snapshot is not None:
-                on_snapshot(host, step)
-        if stop or step >= max_iter:
-            break
-        stacked, step, stop = chunk(cont, (parts, bcast, stacked), step,
-                                    _next_limit(step, every, max_iter))
+    try:
+        if resumed is None:
+            stacked, step, stop = chunk(first, (parts, bcast), 1,
+                                        _next_limit(1, every, max_iter))
+            start_step = 0
+        else:
+            stacked = resumed
+            step, stop = boundary(stacked)
+            start_step = step
+            info["resumed_at"] = start_step
+        last_saved = start_step if resumed is not None else None
+        while True:
+            # the injected-preemption point: BEFORE the snapshot publish,
+            # so a killed run genuinely loses the work since the last
+            # checkpoint and the resume has supersteps to re-execute
+            maybe_crash(SITE, step)
+            if step != last_saved:
+                persist(stacked, step, stop or step >= max_iter)
+                last_saved = step
+            if stop or step >= max_iter:
+                break
+            # snapshot t is now fetching/writing in the background; chunk
+            # t+1 dispatches immediately — THE overlap this module buys
+            stacked, step, stop = chunk(cont, (parts, bcast, stacked), step,
+                                        _next_limit(step, every, max_iter))
+        if writer is not None:
+            # durability barrier: drive returns only once every boundary
+            # is on disk (or its failure raised) — callers observe the
+            # exact guarantees of the synchronous path
+            writer.barrier()
+    finally:
+        if writer is not None:
+            writer.shutdown()
     info["steps_executed"] = step - start_step
     return stacked, info
 
 
-def _to_host(stacked) -> Dict[str, Any]:
-    """Fetch every carry leaf to host numpy (the persistence payload)."""
-    import jax
-    return jax.tree_util.tree_map(np.asarray, dict(stacked))

@@ -382,7 +382,9 @@ class StreamOperator(AlgoOperator):
         for s in streams:
             mx = metrics_enabled()
             lbl = {"op": type(s).__name__}
-            for mt in prefetch(s.micro_batches()):
+            # per-op gauge label: concurrent sink drains must not
+            # overwrite each other's alink_prefetch_depth reading
+            for mt in prefetch(s.micro_batches(), name=type(s).__name__):
                 if mx:
                     reg = get_registry()
                     reg.inc("alink_stream_sink_batches_total", 1, lbl)

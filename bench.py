@@ -173,6 +173,38 @@ class Harness:
         import jax
         return jax.device_put(a) if jax.process_count() == 1 else a
 
+    def dispatch_gap(self, n: int = 200) -> float:
+        """Per-dispatch host gap estimate (seconds): the median wall time
+        of one step in a chain of ``n`` back-to-back trivial jitted calls
+        (device work ~0, so the chain measures dispatch + queueing, not
+        compute). This is the rig's floor for any per-call serial path —
+        the latency-bound workloads (gbdt/als/kmeans supersteps, strict
+        FTRL micro-batches) cannot beat ``1 / dispatch_gap`` calls/s no
+        matter how fast the kernels are, which is exactly what the
+        overlap/donation work routes around.
+
+        Memoized per harness (first call's ``n`` wins): on the tunneled
+        rig each dispatch is ~100 ms, so re-measuring for every caller
+        (the ftrl row + the rig header) would add a minute of pure
+        probing to the suite."""
+        got = getattr(self, "_dispatch_gap", None)
+        if got is not None:
+            return got
+        import jax
+        f = jax.jit(lambda x: x + 1.0)
+        x = jax.device_put(np.zeros(8, np.float32))
+        np.asarray(f(x))                      # warm the compile
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            y = x
+            for _ in range(n):
+                y = f(y)
+            np.asarray(y)                     # flush the chain
+            ts.append((time.perf_counter() - t0) / n)
+        self._dispatch_gap = sorted(ts)[1]
+        return self._dispatch_gap
+
 
 # ---------------------------------------------------------------------------
 # 1. LogReg / Criteo-shape (north star; unchanged methodology from round 1)
@@ -894,6 +926,10 @@ def bench_ftrl(h: Harness):
             "stream_dag_s": round(stream_dag_s, 3),
             "stream_dag_auc": round(dag_auc, 4),
             "stream_dag_bound": "link",
+            # the rig's per-dispatch serial floor (Harness.dispatch_gap):
+            # strict FTRL's samples/s is bounded by ~K_scan_chunks /
+            # dispatch_gap; read the latency-bound rows against it
+            "dispatch_gap_est_s": round(h.dispatch_gap(), 6),
             **cpu_spread}
 
 
@@ -1263,6 +1299,241 @@ def bench_als(h: Harness):
 
 
 # ---------------------------------------------------------------------------
+# --quick: the <60 s smoke suite (the perf regression gate's input)
+# ---------------------------------------------------------------------------
+#
+# Same workload NAMES and JSON shape as the full suite so the dump feeds
+# tools/bench_compare.py unchanged, but tiny fixtures and short spans: the
+# point is a tier-1-adjacent gate (run before/after a change, diff with
+# --threshold), not publishable absolute numbers. The final line carries
+# "mode": "quick" and bench_compare warns when quick and full dumps are
+# mixed. Workflow: docs/performance.md "Quick bench gate".
+
+def quick_logreg(h: Harness):
+    n_rows, iters = 8_000, 12
+    from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                         UnaryLossObjFunc)
+    from alink_tpu.operator.common.optim.optimizers import OptimParams, optimize
+    from alink_tpu.ops.fieldblock import FieldBlockMeta
+    fb_idx, y = make_ctr_fieldblock(n_rows)
+    meta = FieldBlockMeta(N_FIELDS, FIELD_SIZE)
+    data = {"fb_idx": fb_idx, "y": y, "w": np.ones(n_rows, np.float32)}
+    wrng = np.random.RandomState(123)
+
+    def run(n_iter):
+        obj = UnaryLossObjFunc(LogLossFunc(), DIM, l2=1e-4, fb_meta=meta)
+        w0 = (wrng.randn(DIM) * 1e-6).astype(np.float32)
+        coef, _, _ = optimize(obj, data, OptimParams(
+            method="LBFGS", max_iter=n_iter, epsilon=0.0), h.env,
+            warm_start=w0)
+        np.asarray(coef)
+
+    dt = h.delta(run, iters, reps=2)
+    sps = n_rows * iters / dt / h.chips
+    return {"samples_per_sec_per_chip": round(sps, 1),
+            "dt_s": round(dt, 3)}
+
+
+def quick_kmeans(h: Harness):
+    from sklearn.datasets import load_iris
+    from alink_tpu.operator.common.clustering.kmeans import kmeans_train
+    iris = load_iris().data.astype(np.float32)
+    rng = np.random.RandomState(0)
+    X = np.tile(iris, (300, 1)) + rng.randn(150 * 300, 4).astype(
+        np.float32) * 0.05
+    iters = 200
+    jrng = np.random.RandomState(7)
+
+    def run(n_iter):
+        Xj = X + jrng.randn(1, 4).astype(np.float32) * 1e-5
+        C, _, _ = kmeans_train(Xj, k=3, max_iter=n_iter, tol=0.0,
+                               init="RANDOM", seed=0, env=h.env)
+        np.asarray(C)
+
+    dt = h.delta(run, iters, reps=2)
+    return {"samples_per_sec_per_chip":
+            round(X.shape[0] * iters / dt / h.chips, 1),
+            "dt_s": round(dt, 3)}
+
+
+def quick_ftrl(h: Harness):
+    """Strict + staleness sparse FTRL KERNEL rates on a shrunken Criteo
+    shape, chained in one jitted scan exactly like the full row (inner
+    donation is inlined away here — the production drain's donated/
+    pooled path is the separate ftrl_stream_drain row)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from alink_tpu.operator.stream.onlinelearning.ftrl import (
+        _ftrl_sparse_staleness_step_factory, _ftrl_sparse_step_factory)
+    dim, nnz, B, n_pool = 4_096, 16, 256, 4
+    n_dev = h.chips
+    dim_pad = -(-dim // n_dev) * n_dev
+    width = -(-(nnz + 1) // 8) * 8
+    rng = np.random.RandomState(0)
+
+    def make_batch(seed):
+        r = np.random.RandomState(seed)
+        idx = np.zeros((B, width), np.int32)
+        val = np.zeros((B, width), np.float64)
+        idx[:, 0], val[:, 0] = 0, 1.0
+        idx[:, 1:nnz + 1] = r.randint(1, dim, size=(B, nnz))
+        val[:, 1:nnz + 1] = 1.0
+        y = (r.rand(B) < 0.5).astype(np.float64)
+        return idx, val, y
+
+    pool = [make_batch(s) for s in range(n_pool)]
+    mesh = h.env.mesh
+    shard = NamedSharding(mesh, P("d"))
+    sp_idx = h.put(np.stack([p[0] for p in pool]))
+    sp_val = h.put(np.stack([p[1] for p in pool]))
+    sp_y = h.put(np.stack([p[2] for p in pool]))
+    zrng = np.random.RandomState(3)
+    out = {}
+    for key, step in (
+            ("strict", _ftrl_sparse_step_factory(
+                mesh, alpha=0.05, beta=1.0, l1=1e-5, l2=1e-5)),
+            ("stale", _ftrl_sparse_staleness_step_factory(
+                mesh, alpha=0.05, beta=1.0, l1=1e-5, l2=1e-5, K=32))):
+        @jax.jit
+        def pool_fn(sp_idx, sp_val, sp_y, z, nacc, step=step):
+            def body(carry, xs):
+                z, nacc = carry
+                z, nacc, m = step(xs[0], xs[1], xs[2], z, nacc)
+                return (z, nacc), m[0]
+            (z, nacc), _ = jax.lax.scan(body, (z, nacc),
+                                        (sp_idx, sp_val, sp_y))
+            return z, nacc
+
+        def run(n_pools, pool_fn=pool_fn):
+            z = jax.device_put(zrng.randn(dim_pad) * 1e-8, shard)
+            nacc = jax.device_put(np.zeros(dim_pad), shard)
+            for _ in range(n_pools):
+                z, nacc = pool_fn(sp_idx, sp_val, sp_y, z, nacc)
+            np.asarray(z)
+
+        dt = h.delta(run, 3, reps=2)
+        out[key] = B * n_pool * 3 / dt / h.chips
+    return {"samples_per_sec_per_chip": round(out["stale"], 1),
+            "strict_samples_per_sec_per_chip": round(out["strict"], 1),
+            "dispatch_gap_est_s": round(h.dispatch_gap(50), 6)}
+
+
+def quick_from_disk(h: Harness):
+    """The full logreg_from_disk pipeline (sharded read -> native parse
+    -> fb encode -> train) on a small fixture: pipeline_vs_memory is the
+    gate column the overlap work targets."""
+    prev = os.environ.get("ALINK_TPU_DISKBENCH_ROWS")
+    os.environ["ALINK_TPU_DISKBENCH_ROWS"] = prev or "30000"
+    try:
+        return bench_logreg_from_disk(h)
+    finally:
+        # restore the EXACT prior state ("" included) — a smoke row must
+        # not leak its fixture size into later workloads/processes
+        if prev is None:
+            del os.environ["ALINK_TPU_DISKBENCH_ROWS"]
+        else:
+            os.environ["ALINK_TPU_DISKBENCH_ROWS"] = prev
+
+
+def quick_logreg_ckpt(h: Harness):
+    """Checkpointed L-BFGS — the DONATED cont chunk program plus the
+    async snapshot writer on its hot path (the plain quick_logreg row
+    never enters recovery.drive, so without this row the gate is blind
+    to regressions in exactly the paths the overlap work changed).
+    Measures one whole checkpointed fit, boundary persistence included."""
+    import shutil
+    import tempfile
+    from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                         UnaryLossObjFunc)
+    from alink_tpu.operator.common.optim.optimizers import OptimParams, optimize
+    n, d, iters = 20_000, 32, 12
+    rng = np.random.RandomState(2)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X @ rng.randn(d) > 0).astype(np.float32) * 2 - 1
+    data = {"X": X, "y": y, "w": np.ones(n, np.float32)}
+
+    def fit(ckdir):
+        obj = UnaryLossObjFunc(LogLossFunc(), dim=d)
+        coef, _, _ = optimize(obj, data, OptimParams(
+            method="LBFGS", max_iter=iters, epsilon=0.0,
+            checkpoint_dir=ckdir, checkpoint_every=3), h.env)
+        np.asarray(coef)
+
+    base = tempfile.mkdtemp(prefix="alink_quick_ckpt_")
+    try:
+        fit(os.path.join(base, "warm"))       # compile outside the timing
+        ts = []
+        for i in range(3):
+            t0 = time.perf_counter()
+            fit(os.path.join(base, f"r{i}"))
+            ts.append(time.perf_counter() - t0)
+        dt = sorted(ts)[1]
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+    return {"samples_per_sec_per_chip": round(n * iters / dt / h.chips, 1),
+            "dt_s": round(dt, 3)}
+
+
+def quick_ftrl_drain(h: Harness):
+    """The PRODUCTION stream drain at quick scale: raw rows ->
+    field-aware hash -> FtrlTrainStreamOp, i.e. the prefetch_map encode
+    pool, the donated (z, n) step programs, and the batched emission
+    fetch — none of which the chained-jit quick_ftrl row touches."""
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.operator.batch.classification.linear import (
+        LogisticRegressionTrainBatchOp)
+    from alink_tpu.operator.batch.feature.feature_ops import (
+        FeatureHasherBatchOp)
+    from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+    from alink_tpu.operator.stream.batch_twins import FeatureHasherStreamOp
+    from alink_tpu.operator.stream.onlinelearning.ftrl import (
+        FtrlTrainStreamOp)
+    from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+    n_stream, bs = 32_768, 4_096
+    srng = np.random.RandomState(17)
+    site_ids = srng.randint(0, 1000, n_stream)
+    cols = {"site": np.char.add("s", site_ids.astype("U6")).astype(object),
+            "dev": np.char.add("d", srng.randint(0, 1000, n_stream)
+                               .astype("U6")).astype(object),
+            "click": (srng.rand(n_stream)
+                      < 0.1 + 0.8 * (site_ids % 2)).astype(np.int64)}
+    schema = "site STRING, dev STRING, click LONG"
+    hk = dict(selected_cols=["site", "dev"], categorical_cols=["site", "dev"],
+              output_col="vec", num_features=2 * 1024, field_aware=True)
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="click", max_iter=2).link_from(
+        FeatureHasherBatchOp(**hk).link_from(
+            MemSourceBatchOp(MTable(cols, schema).first_n(2048))))
+
+    def drain():
+        src = MemSourceStreamOp(MTable(cols, schema), batch_size=bs)
+        feat = FeatureHasherStreamOp(**hk).link_from(src)
+        ftrl = FtrlTrainStreamOp(warm, vector_col="vec", label_col="click",
+                                 alpha=0.05, update_mode="batch",
+                                 time_interval=1e9).link_from(feat)
+        for _ in ftrl.micro_batches():
+            pass
+
+    drain()                                   # warm compiles
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        drain()
+        ts.append(time.perf_counter() - t0)
+    dt = sorted(ts)[1]
+    return {"samples_per_sec_per_chip": round(n_stream / dt / h.chips, 1),
+            "dt_s": round(dt, 3)}
+
+
+QUICK_WORKLOADS = (("logreg_criteo", quick_logreg),
+                   ("logreg_ckpt", quick_logreg_ckpt),
+                   ("kmeans_iris", quick_kmeans),
+                   ("ftrl_criteo", quick_ftrl),
+                   ("ftrl_stream_drain", quick_ftrl_drain),
+                   ("logreg_from_disk", quick_from_disk))
+
+
+# ---------------------------------------------------------------------------
 
 def main(argv=None):
     import argparse
@@ -1273,16 +1544,27 @@ def main(argv=None):
                          "BENCH_full.json (default: off — existing BENCH "
                          "json schemas are unchanged without the flag; "
                          "render with tools/run_report.py)")
+    ap.add_argument("--quick", action="store_true",
+                    help="smoke mode: tiny fixtures, <60 s — same workload "
+                         "names/JSON shape so the dump feeds "
+                         "tools/bench_compare.py --threshold as a perf "
+                         "regression gate (not publishable numbers)")
+    ap.add_argument("--out", default=None, metavar="PATH",
+                    help="write the final combined JSON line to PATH too "
+                         "(--quick default: BENCH_quick.json; pass "
+                         "distinct paths for the before/after gate pair)")
     args = ap.parse_args(argv)
     h = Harness()
     workloads = {}
-    for name, fn in (("logreg_criteo", bench_logreg),
+    suite = QUICK_WORKLOADS if args.quick else (
+                     ("logreg_criteo", bench_logreg),
                      ("kmeans_iris", bench_kmeans),
                      ("softmax_mnist", bench_softmax),
                      ("ftrl_criteo", bench_ftrl),
                      ("logreg_from_disk", bench_logreg_from_disk),
                      ("gbdt_adult", bench_gbdt),
-                     ("als_movielens", bench_als)):
+                     ("als_movielens", bench_als))
+    for name, fn in suite:
         r = None
         for attempt in (1, 2):
             try:
@@ -1300,7 +1582,11 @@ def main(argv=None):
     # --metrics-out the JSONL dump is written for tools/run_report.py and
     # the snapshot rides inside BENCH_full.json (opt-in, so the recorded
     # BENCH_r*.json schema is unchanged when the flag is absent)
-    full_doc = {"workloads": workloads}
+    mode = "quick" if args.quick else "full"
+    full_doc = {"workloads": workloads, "mode": mode,
+                # the rig's serial per-dispatch floor, measured once per
+                # capture so latency-bound rows can be read against it
+                "rig": {"dispatch_gap_est_s": round(h.dispatch_gap(), 6)}}
     if args.metrics_out:
         from alink_tpu.common.metrics import get_registry
         try:
@@ -1319,13 +1605,17 @@ def main(argv=None):
     # above); the FINAL stdout line must stay well under the driver's
     # 2000-byte tail buffer or it arrives head-truncated and unparseable
     # (BENCH_r03.json: parsed=null). Keep it to the flagship metric plus
-    # a compact per-workload (sps, vs_baseline) map.
-    try:
-        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_full.json"), "w") as f:
-            json.dump(full_doc, f)
-    except OSError:
-        pass  # best-effort: per-row lines already carry the full detail
+    # a compact per-workload (sps, vs_baseline) map. Quick mode never
+    # touches BENCH_full.json (a smoke capture must not shadow the last
+    # full capture's detail) — its artifact is --out below.
+    if not args.quick:
+        try:
+            with open(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_full.json"), "w") as f:
+                json.dump(full_doc, f)
+        except OSError:
+            pass  # best-effort: per-row lines carry the full detail
     flag = workloads["logreg_criteo"]
     # error rows are omitted (not encoded as zeros) so the README
     # generator renders them as "(failed)" rather than a measured 0
@@ -1352,6 +1642,10 @@ def main(argv=None):
         "unit": "samples/sec/chip",
         "vs_baseline": flag.get("vs_baseline", 0.0),
     }
+    if args.quick:
+        # quick dumps must be distinguishable: bench_compare warns when
+        # a quick and a full capture are diffed against each other
+        head["mode"] = "quick"
     line = json.dumps({**head, "workloads_sps_vs": compact})
     if len(line) >= 1900:
         # never let the final line overflow the driver's tail buffer —
@@ -1359,6 +1653,13 @@ def main(argv=None):
         # flagship metric (full detail is in BENCH_full.json anyway)
         line = json.dumps(head)
     print(line)
+    out_path = args.out or ("BENCH_quick.json" if args.quick else None)
+    if out_path:
+        # the gate artifact: the combined final-line object (the shape
+        # tools/bench_compare.py reads) plus the per-workload detail
+        with open(out_path, "w") as f:
+            json.dump({**head, "workloads_sps_vs": compact,
+                       "workloads": workloads, "rig": full_doc["rig"]}, f)
 
 
 if __name__ == "__main__":

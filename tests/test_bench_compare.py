@@ -47,8 +47,29 @@ class TestLoadAndCompare:
     def test_both_shapes_load(self, cli, tmp_path):
         a = _driver_dump(str(tmp_path / "a.json"), {"x": 100.0})
         b = _bare_dump(str(tmp_path / "b.json"), {"x": 50.0})
-        assert cli.load_workloads(a) == {"x": 100.0}
-        assert cli.load_workloads(b) == {"x": 50.0}
+        # unmarked dumps (everything pre --quick) load as mode "full"
+        assert cli.load_workloads(a) == ({"x": 100.0}, "full")
+        assert cli.load_workloads(b) == ({"x": 50.0}, "full")
+
+    def test_quick_mode_marker_and_mismatch_warning(self, cli, tmp_path,
+                                                    capsys):
+        a = _bare_dump(str(tmp_path / "full.json"), {"x": 100.0})
+        q = str(tmp_path / "quick.json")
+        with open(q, "w") as f:
+            json.dump({"mode": "quick",
+                       "workloads_sps_vs": {"x": [10.0, 1.0, 0.0]}}, f)
+        assert cli.load_workloads(q) == ({"x": 10.0}, "quick")
+        # cross-mode diff: reported, but loudly flagged as fixture-size
+        assert cli.main([a, q]) == 0
+        err = capsys.readouterr().err
+        assert "WARNING" in err and "quick" in err
+        # same-mode diff: no warning
+        q2 = str(tmp_path / "quick2.json")
+        with open(q2, "w") as f:
+            json.dump({"mode": "quick",
+                       "workloads_sps_vs": {"x": [11.0, 1.0, 0.0]}}, f)
+        assert cli.main([q, q2]) == 0
+        assert "WARNING" not in capsys.readouterr().err
 
     def test_not_a_bench_dump(self, cli, tmp_path):
         p = str(tmp_path / "junk.json")
@@ -90,6 +111,10 @@ class TestLoadAndCompare:
         os.utime(p1, (now - 20, now - 20))
         os.utime(p2, (now - 10, now - 10))
         os.utime(p3, (now, now))          # per-run detail: never selected
+        # quick smoke dumps are excluded too: auto-pairing one against a
+        # full capture would gate on fixture-size deltas
+        p4 = _driver_dump(str(tmp_path / "BENCH_quick.json"), {"x": 0.1})
+        os.utime(p4, (now + 5, now + 5))
         old, new = cli.newest_pair(str(tmp_path))
         assert os.path.basename(old) == "BENCH_r01.json"
         assert os.path.basename(new) == "BENCH_r02.json"

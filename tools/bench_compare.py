@@ -34,8 +34,10 @@ from typing import Dict, List, Optional, Tuple
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def load_workloads(path: str) -> Dict[str, float]:
-    """``{workload: samples_per_sec_per_chip}`` from either file shape."""
+def load_workloads(path: str) -> Tuple[Dict[str, float], str]:
+    """``({workload: samples_per_sec_per_chip}, mode)`` from either file
+    shape; ``mode`` is ``"quick"`` for ``bench.py --quick`` dumps, else
+    ``"full"`` (pre-quick dumps carry no marker and are full)."""
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, dict) and "parsed" in doc \
@@ -49,14 +51,18 @@ def load_workloads(path: str) -> Dict[str, float]:
     for name, row in wl.items():
         sps = row[0] if isinstance(row, (list, tuple)) else row
         out[str(name)] = float(sps)
-    return out
+    return out, str(doc.get("mode", "full"))
 
 
 def newest_pair(directory: str) -> Tuple[str, str]:
     """The two most recent ``BENCH_*.json`` dumps (by mtime, name as the
-    tie-break) — returned (older, newer)."""
+    tie-break) — returned (older, newer). Excludes ``BENCH_full.json``
+    (per-run detail, not a comparable dump) and ``BENCH_quick*.json``
+    (smoke fixtures — auto-pairing one against a full capture would gate
+    on fixture-size deltas; quick dumps compare via explicit paths)."""
     cands = [p for p in glob.glob(os.path.join(directory, "BENCH_*.json"))
-             if os.path.basename(p) != "BENCH_full.json"]  # per-run detail
+             if os.path.basename(p) != "BENCH_full.json"
+             and not os.path.basename(p).startswith("BENCH_quick")]
     if len(cands) < 2:
         raise ValueError(f"{directory}: need at least two BENCH_*.json "
                          f"dumps, found {len(cands)}")
@@ -141,10 +147,21 @@ def main(argv=None) -> int:
     else:
         old_path, new_path = args.old, args.new
     try:
-        rows = compare(load_workloads(old_path), load_workloads(new_path))
+        old_wl, old_mode = load_workloads(old_path)
+        new_wl, new_mode = load_workloads(new_path)
+        rows = compare(old_wl, new_wl)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"bench_compare.py: {e}", file=sys.stderr)
         return 1
+    if old_mode != new_mode:
+        # quick fixtures are a fraction of the full suite's — a cross-
+        # mode delta is a fixture-size artifact, not a regression. Warn
+        # loudly but keep reporting (the workload sets barely overlap
+        # anyway when one side errored out).
+        print(f"WARNING: comparing a {old_mode!r} dump against a "
+              f"{new_mode!r} dump — deltas reflect fixture sizes, not "
+              f"code changes (use two --quick runs for the gate)",
+              file=sys.stderr)
     bad = regressions(rows, args.threshold) \
         if args.threshold is not None else []
     if args.json:
