@@ -81,6 +81,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
         pf32 = ctypes.POINTER(ctypes.c_float)
         pi16 = ctypes.POINTER(ctypes.c_int16)
         lib.svm_fill_fb16.argtypes = [c, i64, i64, i64, i64, pf32, pi16, pi64]
+        dbl = ctypes.c_double
+        lib.ftrl_slot_run.argtypes = [pi32, pd, pd, i64, i64,
+                                      dbl, dbl, dbl, dbl, pd, pd]
         _lib = lib
         return _lib
 
@@ -156,6 +159,35 @@ def parse_libsvm_fb16(data: bytes, n_fields: int, field_size: int,
     return tuple(a.copy() if a.base is not None and
                  a.nbytes < 0.5 * a.base.nbytes else a
                  for a in (labels[:rows.value], fb[:rows.value]))
+
+
+def ftrl_slot_run(idx: np.ndarray, val: np.ndarray, y: np.ndarray,
+                  z: np.ndarray, n: np.ndarray, alpha: float, beta: float,
+                  l1: float, l2: float) -> bool:
+    """Run the compiled single-slot strict FTRL baseline IN PLACE over a
+    padded COO micro-batch (``idx``/``val`` shaped (rows, width), padding
+    entries carry ``val == 0``). Mutates ``z``/``n`` (float64, contiguous)
+    and returns True; returns False when the native library is
+    unavailable (caller falls back to the interpreted numpy loop).
+
+    This is bench.py's PINNED baseline kernel (BASELINE_compiled.json):
+    the same per-sample FTRL-proximal math as the device kernels and the
+    former numpy baseline, compiled -O3 so the measured rate is a stable
+    property of the rig, not of interpreter load."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    idx = np.ascontiguousarray(idx, np.int32)
+    val = np.ascontiguousarray(val, np.float64)
+    y = np.ascontiguousarray(y, np.float64)
+    assert z.dtype == np.float64 and z.flags.c_contiguous
+    assert n.dtype == np.float64 and n.flags.c_contiguous
+    rows, width = idx.shape
+    lib.ftrl_slot_run(_p(idx, ctypes.c_int32), _p(val, ctypes.c_double),
+                      _p(y, ctypes.c_double), rows, width,
+                      float(alpha), float(beta), float(l1), float(l2),
+                      _p(z, ctypes.c_double), _p(n, ctypes.c_double))
+    return True
 
 
 def split_newline_chunks(data: bytes, k: int) -> list:

@@ -11,6 +11,7 @@
 //
 // Build: see alink_tpu/native/__init__.py (cc -O3 -shared -fPIC).
 
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -546,6 +547,57 @@ int64_t murmur_batch(const char* buf, const int64_t* offsets, int64_t n,
     size_t len = (size_t)(offsets[i + 1] - offsets[i]);
     uint32_t h = murmur3_32(p, len, seed);
     out[i] = (mod > 0) ? (int64_t)(h % (uint64_t)mod) : (int64_t)h;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// ftrl_slot_run — the PINNED compiled single-slot CPU FTRL baseline.
+//
+// bench.py's `vs_baseline` stand-in for one Flink task-slot worker used to
+// be a per-sample numpy loop re-measured every capture; its rate swung
+// ±30-50% with host load and moved the strict-FTRL ratio across the 10x
+// bar between otherwise identical rounds (VERDICT r5 #1). This is the same
+// strict per-sample FTRL-proximal update as a compiled -O3 loop: no Python
+// dispatch, no allocation, deterministic — measured best-of-N ONCE per rig
+// and committed to BASELINE_compiled.json with the rig fingerprint, so
+// `vs_baseline` is comparable round-over-round.
+//
+// Inputs are the padded COO micro-batch the device kernels consume
+// (padding entries carry val == 0 and are algebraic no-ops: g = 0,
+// sigma = 0, state unchanged). Two passes per row: the margin is computed
+// at pre-update weights for EVERY slot (strict semantics), then the
+// update is applied slot-by-slot.
+int64_t ftrl_slot_run(const int32_t* idx, const double* val, const double* y,
+                      int64_t rows, int64_t width, double alpha, double beta,
+                      double l1, double l2, double* z, double* n) {
+  for (int64_t i = 0; i < rows; i++) {
+    const int32_t* ii = idx + i * width;
+    const double* vv = val + i * width;
+    double margin = 0.0;
+    for (int64_t k = 0; k < width; k++) {
+      double zi = z[ii[k]], ni = n[ii[k]];
+      double decay = (beta + std::sqrt(ni)) / alpha + l2;
+      double wi =
+          (std::fabs(zi) <= l1) ? 0.0 : -(zi - std::copysign(l1, zi)) / decay;
+      margin += wi * vv[k];
+    }
+    if (margin > 35.0) margin = 35.0;
+    if (margin < -35.0) margin = -35.0;
+    double c = 1.0 / (1.0 + std::exp(-margin)) - y[i];
+    for (int64_t k = 0; k < width; k++) {
+      int32_t j = ii[k];
+      double v = vv[k];
+      if (v == 0.0) continue;  // padding slot: exact no-op
+      double zi = z[j], ni = n[j];
+      double decay = (beta + std::sqrt(ni)) / alpha + l2;
+      double wi =
+          (std::fabs(zi) <= l1) ? 0.0 : -(zi - std::copysign(l1, zi)) / decay;
+      double g = c * v;
+      double sigma = (std::sqrt(ni + g * g) - std::sqrt(ni)) / alpha;
+      z[j] = zi + g - sigma * wi;
+      n[j] = ni + g * g;
+    }
   }
   return 0;
 }

@@ -145,41 +145,236 @@ def make_xgb_leaf(reg_lambda: float):
 # ---------------------------------------------------------------------------
 
 def level_hist(binned, stats, node_id, n_nodes: int, n_bins: int,
-               use_onehot: bool, onehot_dtype=None):
+               use_onehot: bool, onehot_dtype=None, pre=None):
     """(n_nodes, F, n_bins, m) per-(node,feature,bin) stat sums for one level.
 
     ``use_onehot`` selects a one-hot MXU einsum instead of scatter-add —
     XLA serializes random scatter on TPU (~2.5x slower than the einsum at
-    64 nodes); on CPU the scatter is the fast path."""
+    64 nodes); on CPU the scatter is the fast path.
+
+    ``pre`` (fused path): the level-invariant ``(ohB, s2)`` operands from
+    :func:`_fused_hist_precompute`, hoisted out of the level loop — ONE
+    implementation of the compensated-split einsum serves both the
+    default and the fused kernels (with ``pre=None`` the primitive
+    sequence is exactly the pre-fused one, preserving the byte-identical
+    flag-off HLO contract)."""
     import jax.numpy as jnp
     n, F = binned.shape
     m = stats.shape[1]
     dt = stats.dtype
-    if use_onehot:
-        hdt = onehot_dtype or jnp.bfloat16
+    if use_onehot or pre is not None:
+        hdt = (pre[0].dtype if pre is not None
+               else (onehot_dtype or jnp.bfloat16))
         ohN = (node_id[:, None] == jnp.arange(n_nodes)[None, :]).astype(hdt)
-        ohB = (binned[..., None] == jnp.arange(n_bins)[None, None, :]).astype(hdt)
-        # Compensated bf16 split of the stats: hi + lo reconstructs f32 to
-        # ~2^-16 relative, so the bf16 MXU path no longer quantizes grad/hess
-        # per element (~0.4%) and near-tie splits agree with the exact CPU
-        # scatter. One einsum over the stacked (hi|lo) stats, halves summed
-        # in f32 after.
-        f32 = jnp.float32
-        s32 = stats.astype(f32)
-        s_hi = s32.astype(hdt)
-        s_lo = (s32 - s_hi.astype(f32)).astype(hdt)
-        s2 = jnp.concatenate([s_hi, s_lo], axis=1)           # (n, 2m)
+        ohB, s2 = (pre if pre is not None
+                   else _fused_hist_precompute(binned, stats, n_bins,
+                                               onehot_dtype))
         # contract (node-one-hot x stats) FIRST: the (i, n_nodes, 2m)
         # intermediate is ~KBs/sample, where the old explicit
         # ohB[..., None] * s2 product materialized an (i, F, bins, 2m)
         # tensor (~0.5 GB at adult scale) every level
         h2 = jnp.einsum("in,iM,ifb->nfbM", ohN, s2, ohB,
-                        preferred_element_type=f32)
+                        preferred_element_type=jnp.float32)
         return (h2[..., :m] + h2[..., m:]).astype(dt)
     flat_idx = (node_id[:, None] * F + jnp.arange(F)[None, :]) * n_bins + binned
     hist = jnp.zeros((n_nodes * F * n_bins, m), dt)
     hist = hist.at[flat_idx.reshape(-1)].add(jnp.repeat(stats, F, axis=0))
     return hist.reshape(n_nodes, F, n_bins, m)
+
+
+# ---------------------------------------------------------------------------
+# fused histogram kernels (ALINK_TPU_FUSED_HIST) — ISSUE 6 tentpole (b)
+# ---------------------------------------------------------------------------
+#
+# The default per-level formulation rebuilds the bin one-hot AND the
+# compensated hi/lo stat split EVERY level even though both are
+# level-invariant within one tree, and on non-TPU backends it falls back
+# to a scatter-add that materializes an (n*F, m) jnp.repeat of the stats.
+# The fused kernel hoists the level-invariant operands out of the level
+# loop and reduces each level to ONE batched contraction
+# (gradient+hessian+count together, all nodes x features x bins at once):
+#
+#   "xla"    — precompute ohB (n, F, B) + s2 (n, 2m) once per tree; per
+#              level a single einsum "in,iM,ifb->nfbM" (two MXU dots, no
+#              giant intermediate) on every backend.
+#   "pallas" — a hand-written accumulation kernel: grid over
+#              (feature, row-block), each step one-hots the COMBINED
+#              (node, bin) id in VMEM and accumulates a (B_blk, Q)^T @
+#              (B_blk, m) dot into the output block — exact f32
+#              accumulation, no hi/lo split, no HBM one-hot
+#              materialization. Gated on backend availability (TPU, or
+#              interpret mode for tests); demotes to "xla" with a
+#              one-time warning when lowering fails.
+#
+# The mode is resolved at TRACE time and folded into the engine
+# program-cache key by the tree trainers, so toggling recompiles instead
+# of serving a stale program. With the flag off, build_tree executes the
+# pre-existing statements unchanged — the lowered HLO is byte-identical
+# to pre-flag programs (pinned by tests/test_perf_kernels.py) and the
+# collective set (one psum per level, after the histogram) is identical
+# in every mode.
+
+import os as _os
+import warnings as _warnings
+
+FUSED_HIST_ENV = "ALINK_TPU_FUSED_HIST"
+_PALLAS_WARNED = [False]
+
+
+def fused_hist_mode() -> str:
+    """Resolved fused-histogram mode: "off" (default) | "xla" | "pallas".
+
+    ``ALINK_TPU_FUSED_HIST`` values: 0/off/false -> "off"; "pallas" ->
+    the Pallas kernel when the backend can run it (TPU, or any backend
+    with ``ALINK_TPU_PALLAS_INTERPRET=1``), else "xla"; anything truthy
+    else -> "xla"."""
+    v = _os.environ.get(FUSED_HIST_ENV, "0").strip().lower()
+    if v in ("", "0", "off", "false", "no"):
+        return "off"
+    if v == "pallas":
+        if (jax.default_backend() == "tpu"
+                or _os.environ.get("ALINK_TPU_PALLAS_INTERPRET")):
+            return "pallas"
+        return "xla"
+    return "xla"
+
+
+def _fused_hist_precompute(binned, stats, n_bins: int, onehot_dtype=None):
+    """The one-hot-path operands of :func:`level_hist` that are
+    level-invariant within one tree (the fused kernel builds them once;
+    the default kernel calls this per level — ONE implementation).
+
+    Compensated bf16 split of the stats: hi + lo reconstructs f32 to
+    ~2^-16 relative, so the bf16 MXU path does not quantize grad/hess
+    per element (~0.4%) and near-tie splits agree with the exact CPU
+    scatter. One einsum over the stacked (hi|lo) stats downstream,
+    halves summed in f32 after."""
+    hdt = onehot_dtype or jnp.bfloat16
+    ohB = (binned[..., None] == jnp.arange(n_bins)[None, None, :]).astype(hdt)
+    s32 = stats.astype(jnp.float32)
+    s_hi = s32.astype(hdt)
+    s_lo = (s32 - s_hi.astype(jnp.float32)).astype(hdt)
+    s2 = jnp.concatenate([s_hi, s_lo], axis=1)               # (n, 2m)
+    return ohB, s2
+
+
+def _pallas_level_hist(binned, stats, node_id, n_nodes: int, n_bins: int):
+    """Hand-written histogram accumulation kernel (tentpole (b) Pallas
+    path): grid (feature, row-block); each step builds the combined
+    (node, bin) one-hot for its rows IN VMEM and accumulates one
+    ``(Q, blk) @ (blk, m)`` dot into its feature's output block. Exact
+    f32 accumulation (no bf16 quantization, no hi/lo split); the only
+    HBM traffic is the binned rows, the stats, and the output —
+    the one-hot never materializes outside VMEM. Falls back to the XLA
+    fused formulation (one-time warning) if lowering/tracing fails."""
+    from jax.experimental import pallas as pl
+
+    n, F = binned.shape
+    m = stats.shape[1]
+    Q = n_nodes * n_bins
+    blk = min(512, max(8, n))
+    npad = -(-n // blk) * blk
+    if npad != n:                      # zero-stat rows are inert
+        pz = npad - n
+        binned = jnp.concatenate([binned, jnp.zeros((pz, F), binned.dtype)])
+        node_id = jnp.concatenate([node_id, jnp.zeros((pz,), node_id.dtype)])
+        stats = jnp.concatenate(
+            [stats, jnp.zeros((pz, m), stats.dtype)])
+    s32 = stats.astype(jnp.float32)
+    nid2 = node_id[:, None].astype(jnp.int32)               # (n, 1)
+
+    def kernel(b_ref, nid_ref, s_ref, out_ref):
+        r = pl.program_id(1)
+
+        @pl.when(r == 0)
+        def _init():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+        b = b_ref[...][:, 0].astype(jnp.int32)              # (blk,)
+        nid = nid_ref[...][:, 0]                            # (blk,)
+        s = s_ref[...]                                      # (blk, m)
+        q = nid * n_bins + b                                # combined id
+        oh = (q[:, None] == jnp.arange(Q)[None, :]).astype(jnp.float32)
+        acc = jnp.dot(oh.T, s, preferred_element_type=jnp.float32)
+        out_ref[...] += acc.reshape(1, n_nodes, n_bins, m)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(F, npad // blk),
+        in_specs=[pl.BlockSpec((blk, 1), lambda f, r: (r, f)),
+                  pl.BlockSpec((blk, 1), lambda f, r: (r, 0)),
+                  pl.BlockSpec((blk, m), lambda f, r: (r, 0))],
+        out_specs=pl.BlockSpec((1, n_nodes, n_bins, m),
+                               lambda f, r: (f, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F, n_nodes, n_bins, m), jnp.float32),
+        interpret=jax.default_backend() != "tpu",
+    )(binned, nid2, s32)
+    return out.transpose(1, 0, 2, 3).astype(stats.dtype)
+
+
+_PALLAS_PROBED: dict = {}      # (n_nodes, n_bins, m) -> bool (compiled ok)
+
+
+def _pallas_probe(n_nodes: int, n_bins: int, m: int) -> bool:
+    """EAGERLY compile+run the Pallas kernel at this level's shape class
+    (tiny row count, one feature) before tracing it into the engine
+    program. ``pl.pallas_call`` only stages the primitive at trace time —
+    a Mosaic/interpreter failure would otherwise surface at
+    ``queue.exec()``'s compile, OUTSIDE any try/except around the traced
+    call — so the probe is what makes the demotion contract real for
+    compile-time failures (VMEM overflow at deep levels, lane-alignment
+    rejections), not just trace-time ones. One probe per shape class per
+    process; probe failure demotes with the one-time warning."""
+    key = (n_nodes, n_bins, m)
+    ok = _PALLAS_PROBED.get(key)
+    if ok is None:
+        def probe():
+            out = _pallas_level_hist(
+                np.zeros((8, 1), np.int32), np.zeros((8, m), np.float32),
+                np.zeros((8,), np.int32), n_nodes, n_bins)
+            np.asarray(out)              # force the eager compile+run
+        try:
+            # jax trace contexts are THREAD-LOCAL: the dispatch call site
+            # sits inside the engine's shard_map/jit trace, where even
+            # concrete-input pallas_calls bind into the trace as tracers.
+            # A fresh thread is a genuinely eager context, so the probe
+            # really compiles+runs the kernel here and now.
+            import concurrent.futures
+            with concurrent.futures.ThreadPoolExecutor(1) as ex:
+                ex.submit(probe).result()
+            ok = True
+        except Exception as e:  # pragma: no cover - backend-specific
+            ok = False
+            if not _PALLAS_WARNED[0]:
+                _PALLAS_WARNED[0] = True
+                _warnings.warn(
+                    f"ALINK_TPU_FUSED_HIST=pallas failed to compile at "
+                    f"level shape (n_nodes={n_nodes}, n_bins={n_bins}, "
+                    f"m={m}) ({type(e).__name__}: {e}); demoting to the "
+                    f"fused XLA formulation", RuntimeWarning)
+        _PALLAS_PROBED[key] = ok
+    return ok
+
+
+def _hist_dispatch(hist_mode, pre, binned, stats, node_id, n_nodes, n_bins):
+    """Per-level histogram under the resolved mode. Kept OUT of
+    :func:`build_tree`'s flag-off path: with the flag off the original
+    :func:`level_hist` call is executed verbatim (byte-identical HLO)."""
+    if hist_mode == "pallas" and _pallas_probe(n_nodes, n_bins,
+                                               stats.shape[1]):
+        try:
+            return _pallas_level_hist(binned, stats, node_id, n_nodes,
+                                      n_bins)
+        except Exception as e:  # pragma: no cover - backend-specific
+            if not _PALLAS_WARNED[0]:
+                _PALLAS_WARNED[0] = True
+                _warnings.warn(
+                    f"ALINK_TPU_FUSED_HIST=pallas failed to trace "
+                    f"({type(e).__name__}: {e}); demoting to the fused "
+                    f"XLA formulation", RuntimeWarning)
+    return level_hist(binned, stats, node_id, n_nodes, n_bins,
+                      use_onehot=True, pre=pre)
+
 
 def _default_cat_order(hist):
     """Per-(node,feature,bin) ordering score for categorical subset splits:
@@ -234,9 +429,22 @@ def build_tree(binned, stats, max_depth: int, n_bins: int,
             cat_arr = jnp.asarray(cat_np)
 
     use_onehot = jax.default_backend() == "tpu"
+    # ALINK_TPU_FUSED_HIST: resolved at trace time, folded into the
+    # trainers' program-cache key. "off" executes the original
+    # level_hist call verbatim (lowered HLO byte-identical to pre-flag
+    # programs); the psum placement below is shared by every mode, so
+    # the collective set never changes.
+    hist_mode = fused_hist_mode()
+    pre = (_fused_hist_precompute(binned, stats, n_bins)
+           if hist_mode != "off" else None)
     for level in range(max_depth):
         n_nodes = 1 << level
-        hist = level_hist(binned, stats, node_id, n_nodes, n_bins, use_onehot)
+        if hist_mode != "off":
+            hist = _hist_dispatch(hist_mode, pre, binned, stats, node_id,
+                                  n_nodes, n_bins)
+        else:
+            hist = level_hist(binned, stats, node_id, n_nodes, n_bins,
+                              use_onehot)
         if axis_name is not None:
             hist = jax.lax.psum(hist, axis_name)
         cum = jnp.cumsum(hist, axis=2)

@@ -21,9 +21,24 @@ import jax.numpy as jnp
 
 from ....common.mlenv import MLEnvironment, MLEnvironmentFactory
 from ....engine import IterativeComQueue
-from .hist import (bin_data, build_tree, gini_gain, gini_leaf, make_bin_edges,
-                   make_xgb_gain, make_xgb_leaf, tree_apply_binned,
-                   variance_gain, variance_leaf)
+from .hist import (bin_data, build_tree, fused_hist_mode, gini_gain,
+                   gini_leaf, make_bin_edges, make_xgb_gain, make_xgb_leaf,
+                   tree_apply_binned, variance_gain, variance_leaf)
+
+
+def _feature_subsample_mask(key, F: int, ratio: float, dtype):
+    """Exactly ``max(1, round(ratio * F))`` features survive, chosen
+    uniformly per tree. A Bernoulli-per-feature draw (the former
+    implementation) selects ZERO features with probability (1-ratio)^F —
+    on a 1-feature dataset at the default RF ratio that is a 30% chance
+    per tree of a root-only stump (tier-1 regression: the seed-0 draw
+    masked the only feature on every kept ensemble worker). Exact-count
+    subsets are also the reference's featureSubsamplingRatio semantics
+    (BaseRandomForestTrainBatchOp.java) and sklearn's ``max_features``."""
+    kf = max(1, int(round(ratio * F)))
+    u = jax.random.uniform(key, (F,))
+    thr = jnp.sort(u)[kf - 1]
+    return (u <= thr).astype(dtype)
 
 
 @dataclass
@@ -94,9 +109,9 @@ def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
             wb = wl * bag
         else:
             wb = wl
-        fmask = (jax.random.uniform(jax.random.fold_in(key, 1), (F,))
-                 < p.feature_subsample_ratio).astype(dtype) \
-            if p.feature_subsample_ratio < 1.0 else None
+        fmask = _feature_subsample_mask(
+            jax.random.fold_in(key, 1), F, p.feature_subsample_ratio,
+            dtype) if p.feature_subsample_ratio < 1.0 else None
         stats = jnp.stack([g, h, wb], axis=1)
         tf, tb, tm, tv, node_id, _, imp = build_tree(
             binned_l, stats, d, p.n_bins, gain_fn, leaf_fn,
@@ -126,8 +141,12 @@ def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
              .init_with_partitioned_data("y", y)
              .init_with_partitioned_data("w", w)
              .add(grow)
-             # base is a data-derived Python float baked into the trace
+             # base is a data-derived Python float baked into the trace;
+             # the fused-histogram mode selects a different lowering, so
+             # it must ride the key (a toggle recompiles, never serves a
+             # stale program)
              .set_program_key(("gbdt", is_regression, F, base,
+                               fused_hist_mode(),
                                freeze_config(p), freeze_config(cat_mask))))
     res = queue.exec()
     return (res.get("trees_f"), res.get("trees_b"), res.get("trees_m"),
@@ -196,9 +215,9 @@ def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
             bag = jax.random.bernoulli(key, p.subsample_ratio,
                                        (stats.shape[0],)).astype(dtype)
             stats = stats * bag[:, None]
-        fmask = (jax.random.uniform(jax.random.fold_in(key, 1), (F,))
-                 < p.feature_subsample_ratio).astype(dtype) \
-            if p.feature_subsample_ratio < 1.0 else None
+        fmask = _feature_subsample_mask(
+            jax.random.fold_in(key, 1), F, p.feature_subsample_ratio,
+            dtype) if p.feature_subsample_ratio < 1.0 else None
         tf, tb, tm, tv, _, _, imp = build_tree(
             binned_l, stats, d, p.n_bins, gain_fn, leaf_fn,
             min_samples_leaf=float(p.min_samples_leaf), feature_mask=fmask,
@@ -225,6 +244,7 @@ def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
              .init_with_partitioned_data("stats", y_stats.astype(dtype))
              .add(grow)
              .set_program_key(("forest", kind, F, m, bool(ensemble), T,
+                               fused_hist_mode(),
                                freeze_config(p), freeze_config(cat_mask))))
     res = queue.exec()
     if not ensemble:

@@ -215,6 +215,109 @@ def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2, donate=False):
 
 
 @functools.lru_cache(maxsize=64)
+def _ftrl_sparse_chained_step_factory(mesh, alpha, beta, l1, l2, K=16,
+                                      donate=False):
+    """Chained-correction strict FTRL — EXACT strict semantics at chunked
+    throughput (``update_mode="chained"``).
+
+    The strict per-sample contract is inherently a chain: sample k's
+    margin must be computed at weights reflecting samples 0..k-1. The
+    K=4 kernel above pays that chain with k-1 PAIRS of same-feature
+    matmuls per sample — O(K^2) dependent ops — which is why K=8/16
+    measured slower (docs/performance.md "Why the strict scan sits
+    near ~320k"). This kernel restructures the correction so the chain
+    stays O(K) dependent ops:
+
+      * ONE gather of the K rows' (z, n) slots at the pre-chunk state,
+        stacked (K, w, 2);
+      * a collision tensor ``M[k, j, a, b] = [sample k's slot a and
+        sample j's slot b address the same local state element]`` built
+        once per chunk OFF the dependent chain (pure elementwise
+        compares, (K, K, w, w));
+      * per sample, ONE dense triangular matvec
+        ``corr_k = einsum('jab,jbc->ac', M[k], D)`` over the stacked
+        delta buffer D (rows j >= k are still zero, so the triangular
+        masking is implicit) corrects both z and n in a single
+        contraction — sample k sees exactly the earlier samples'
+        deltas at shared features;
+      * all K deltas land in ONE duplicate-safe scatter-add.
+
+    The scan shortens K-fold while each sample costs ~5 dependent ops
+    (matvec, weights, psum, grad, delta-write) instead of the per-sample
+    kernel's gather+scatter+chain. Semantics: bit-identical to the
+    per-sample scan on collision-free chunks (the matvec adds an exact
+    0.0); on colliding chunks the only difference is ASSOCIATION —
+    fl(base + fl(d1 + d2)) instead of fl(fl(base + d1) + d2) — i.e.
+    f32-round-level (documented tolerance: rtol 1e-4 on trajectories,
+    tests/test_perf_kernels.py). ``K`` rides the lru/jit cache key, so
+    changing the chunk length can never serve a stale program.
+    """
+    import jax
+    import jax.numpy as jnp
+    from ....common.compat import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def weights(z, n):
+        return _ftrl_weights(z, n, alpha, beta, l1, l2)
+
+    def shard_fn(idx, val, y, z, n):
+        shard = z.shape[0]
+        lo = jax.lax.axis_index("d") * shard
+        B, w = idx.shape
+        Bp = -(-B // K) * K
+        if Bp != B:               # zero rows are algebraic no-ops
+            idx = jnp.concatenate([idx, jnp.zeros((Bp - B, w), idx.dtype)])
+            val = jnp.concatenate([val, jnp.zeros((Bp - B, w), val.dtype)])
+            y = jnp.concatenate([y, jnp.zeros((Bp - B,), y.dtype)])
+
+        def body(carry, xvy):
+            z, n = carry
+            xi, xv, yy = xvy                  # (K, w), (K, w), (K,)
+            local = (xi >= lo) & (xi < lo + shard)
+            li = jnp.clip(xi - lo, 0, shard - 1)
+            flat = li.reshape(-1)
+            zs = jnp.where(local, z[flat].reshape(K, w), 0.0)
+            ns = jnp.where(local, n[flat].reshape(K, w), 0.0)
+            # collision tensor, built once per chunk in parallel (not on
+            # the dependent chain)
+            M = ((xi[:, None, :, None] == xi[None, :, None, :])
+                 & local[:, None, :, None] & local[None, :, None, :]
+                 ).astype(zs.dtype)           # (K, K, w, w)
+            D = jnp.zeros((K, w, 2), zs.dtype)
+            margins = []
+            for k in range(K):
+                # HIGHEST: bf16 MXU rounding of the f32 deltas would
+                # break the exact-strict-semantics claim under collisions
+                corr = jnp.einsum("jab,jbc->ac", M[k], D,
+                                  precision=jax.lax.Precision.HIGHEST)
+                zk = zs[k] + corr[:, 0]
+                nk = ns[k] + corr[:, 1]
+                wk = jnp.where(local[k], weights(zk, nk), 0.0)
+                margin = jax.lax.psum(jnp.sum(xv[k] * wk), "d")
+                p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margin, -35.0, 35.0)))
+                g = (p - yy[k]) * xv[k]
+                sigma = (jnp.sqrt(nk + g * g) - jnp.sqrt(nk)) / alpha
+                D = D.at[k].set(jnp.stack(
+                    [jnp.where(local[k], g - sigma * wk, 0.0),
+                     jnp.where(local[k], g * g, 0.0)], axis=-1))
+                margins.append(margin)
+            z = z.at[flat].add(D[..., 0].reshape(-1))
+            n = n.at[flat].add(D[..., 1].reshape(-1))
+            return (z, n), jnp.stack(margins)
+
+        (z, n), margins = jax.lax.scan(
+            body, (z, n), (idx.reshape(Bp // K, K, w),
+                           val.reshape(Bp // K, K, w),
+                           y.reshape(Bp // K, K)))
+        return z, n, margins.reshape(Bp)[:B]
+
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(P(), P(), P(), P("d"), P("d")),
+                   out_specs=(P("d"), P("d"), P()))
+    return jax.jit(fn, donate_argnums=(3, 4) if donate else ())
+
+
+@functools.lru_cache(maxsize=64)
 def _ftrl_sparse_staleness_step_factory(mesh, alpha, beta, l1, l2, K,
                                         donate=False):
     """Bounded-staleness sparse FTRL — the reference's ACTUAL feedback-edge
@@ -502,20 +605,30 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
     VECTOR_SIZE = ParamInfo("vector_size", int, default=0)
     WITH_INTERCEPT = ParamInfo("with_intercept", bool, default=True)
     # "sample" = STRICT per-sample scan (a stronger ordering guarantee than
-    # the reference gives); "staleness" = bounded-staleness chunked updates
-    # (gradients at weights <= staleness-1 samples old — the reference's
-    # actual feedback-edge contract, FtrlTrainStreamOp.java:120-135, with
-    # the bound made explicit); "batch" = fused per-micro-batch updates
-    # (gradients at pre-batch weights) — the TPU-first high-throughput
-    # mode, exact for collision-free batches
+    # the reference gives); "chained" = the SAME strict semantics through
+    # the chained-correction chunk kernel (K-fold shorter scan, exact on
+    # collision-free chunks, f32-round-equal under collisions — see
+    # _ftrl_sparse_chained_step_factory); "staleness" = bounded-staleness
+    # chunked updates (gradients at weights <= staleness-1 samples old —
+    # the reference's actual feedback-edge contract,
+    # FtrlTrainStreamOp.java:120-135, with the bound made explicit);
+    # "batch" = fused per-micro-batch updates (gradients at pre-batch
+    # weights) — the TPU-first high-throughput mode, exact for
+    # collision-free batches
     UPDATE_MODE = ParamInfo("update_mode", str, default="sample",
-                            validator=InValidator(["sample", "staleness",
-                                                   "batch"]))
+                            validator=InValidator(["sample", "chained",
+                                                   "staleness", "batch"]))
     STALENESS = ParamInfo("staleness", int, default=32,
                           description="chunk size for update_mode="
                                       "'staleness' (max update delay in "
                                       "samples)",
                           validator=RangeValidator(1, None))
+    CHUNK_SIZE = ParamInfo("chunk_size", int, default=16,
+                           description="chunk length for update_mode="
+                                       "'chained' (strict semantics at "
+                                       "any value; larger = shorter scan "
+                                       "+ more correction flops)",
+                           validator=RangeValidator(1, None))
     # stream durability (common/checkpoint.py): persist the (z, n) FTRL
     # state every N micro-batches with bounded retention; a crash-restarted
     # op with the same checkpoint_dir resumes from the newest valid
@@ -577,6 +690,7 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
         update_mode = self.params._m.get("update_mode", "sample")
         batch_mode = update_mode == "batch"
         staleness = int(self.params._m.get("staleness", 32))
+        chunk_size = int(self.params._m.get("chunk_size", 16))
         ck_dir = self.params._m.get("checkpoint_dir")
         ck_every = int(self.params._m.get("checkpoint_every_batches", 0) or 0)
         ck_keep = int(self.params._m.get("checkpoint_keep", 3))
@@ -605,6 +719,12 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                                       if update_mode == "staleness" else None),
                         "has_intercept": bool(has_icpt),
                         "warm_coef_blake2b": _warm_fp}
+        if update_mode == "chained":
+            # the chunk length changes fp association under collisions,
+            # so a chained-mode resume must match it; the key is added
+            # CONDITIONALLY so pre-existing snapshots of the other modes
+            # keep their exact signature and stay resumable
+            ck_signature["chunk_size"] = chunk_size
         allow_fb = [True]    # cleared once the state commits to std layout
         sparse_step = [None]                # built lazily (sparse input only)
         # (z, n) buffer donation (ALINK_TPU_DONATE, default on): every
@@ -1049,6 +1169,14 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                       elif update_mode == "staleness":
                           sparse_step[0] = _ftrl_sparse_staleness_step_factory(
                               mesh, alpha, beta, l1, l2, staleness,
+                              donate=don)
+                      elif update_mode == "chained":
+                          # strict semantics through the chained-
+                          # correction chunk kernel; dense rows keep the
+                          # per-sample scan (matvec-bound, not
+                          # gather-bound — chunking buys nothing there)
+                          sparse_step[0] = _ftrl_sparse_chained_step_factory(
+                              mesh, alpha, beta, l1, l2, chunk_size,
                               donate=don)
                       else:
                           sparse_step[0] = _ftrl_sparse_step_factory(

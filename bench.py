@@ -107,6 +107,253 @@ def mfu(sps_per_chip, flops_per_sample, bytes_per_sample, bound=None):
             "bound": bound}
 
 
+# ---------------------------------------------------------------------------
+# Pinned compiled CPU baseline (VERDICT r5 #1 / ISSUE 6 tentpole (c))
+# ---------------------------------------------------------------------------
+#
+# The FTRL `vs_baseline` denominator used to be a per-sample numpy loop
+# re-measured every capture; host load swung it ±30-50% and moved the
+# strict-FTRL ratio across the 10x bar between rounds with identical
+# device throughput (r04 9.55x -> r05 7.0x on a 33k->46k baseline drift).
+# The denominator is now a COMPILED single-slot FTRL loop
+# (native/parser.cpp ftrl_slot_run, the stand-in for one Flink task-slot
+# CalcTask) measured best-of-7 ONCE per rig and committed to
+# BASELINE_compiled.json keyed by a rig fingerprint. Later captures on
+# the same rig REUSE the pinned rate (no re-measure), so vs_baseline is
+# comparable round-over-round; a different rig pins its own entry, and
+# tools/bench_compare.py --baseline-provenance refuses to diff captures
+# whose fingerprints differ. ALINK_TPU_REPIN_BASELINE=1 forces a
+# re-measure (a deliberate, visible act — the file diff shows it).
+
+BASELINE_COMPILED_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BASELINE_compiled.json")
+
+
+def rig_fingerprint():
+    """(fp_hash, info): a stable identity for the measuring host. The
+    hash keys BASELINE_compiled.json entries and rides every bench
+    artifact as ``baseline_fp`` so cross-rig ratios can be refused."""
+    import hashlib
+    import platform
+    cpu_model = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    cpu_model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        cpu_model = platform.processor() or ""
+    info = {"machine": platform.machine(), "system": platform.system(),
+            "cpu_model": cpu_model, "cores": os.cpu_count(),
+            "python": platform.python_version(),
+            "numpy": np.__version__}
+    fp = hashlib.blake2b(json.dumps(info, sort_keys=True).encode(),
+                         digest_size=6).hexdigest()
+    return fp, info
+
+
+def _numpy_ftrl_slot_loop(idx, val, y, z, n,
+                          alpha=0.05, beta=1.0, l1=1e-5, l2=1e-5):
+    """THE interpreted per-sample FTRL-proximal loop, in one place: the
+    pinned-baseline fallback and the vs_live_numpy context row both call
+    it, so the two 'baselines' can never silently diverge. Mutates
+    ``z``/``n`` in place (same contract as native ftrl_slot_run)."""
+    for i in range(len(y)):
+        ii, vv, yy = idx[i], val[i], y[i]
+        zi, ni = z[ii], n[ii]
+        decay = (beta + np.sqrt(ni)) / alpha + l2
+        wi = np.where(np.abs(zi) <= l1, 0.0,
+                      -(zi - np.sign(zi) * l1) / decay)
+        p = 1.0 / (1.0 + np.exp(-np.clip(wi @ vv, -35, 35)))
+        g = (p - yy) * vv
+        sigma = (np.sqrt(ni + g * g) - np.sqrt(ni)) / alpha
+        z[ii] = zi + g - sigma * wi
+        n[ii] = ni + g * g
+
+
+def _measure_compiled_ftrl_baseline(idx, val, y, reps: int = 7):
+    """(sps_best, sps_median, impl): best-of-``reps`` of the compiled
+    single-slot loop over the canonical Criteo-shape batch; falls back to
+    the interpreted numpy loop (impl="numpy-interpreted") without the
+    native lib so the pin is always produced — the impl tag makes the
+    fallback visible in the artifact."""
+    from alink_tpu.native import ftrl_slot_run
+    dim = int(idx.max()) + 1
+    rows = idx.shape[0]
+
+    def run_native():
+        z = np.zeros(dim)
+        n = np.zeros(dim)
+        t0 = time.perf_counter()
+        ftrl_slot_run(idx, val, y, z, n, 0.05, 1.0, 1e-5, 1e-5)
+        return time.perf_counter() - t0, z
+
+    def run_numpy():
+        zc = np.zeros(dim)
+        nc = np.zeros(dim)
+        t0 = time.perf_counter()
+        _numpy_ftrl_slot_loop(idx, val, y, zc, nc)
+        return time.perf_counter() - t0, zc
+
+    probe_t, probe_z = run_native() if _native_available() else (None, None)
+    runner, impl = ((run_native, "native-c") if probe_t is not None
+                    else (run_numpy, "numpy-interpreted"))
+    ts = sorted(runner()[0] for _ in range(reps))
+    return (rows / ts[0], rows / ts[len(ts) // 2], impl)
+
+
+def _native_available() -> bool:
+    from alink_tpu.native import get_lib
+    return get_lib() is not None
+
+
+def pinned_ftrl_baseline(path: str = None):
+    """The pinned baseline record for THIS rig: loads the committed
+    entry when the fingerprint matches; otherwise measures the compiled
+    loop on the canonical workload (best-of-7) and writes the entry —
+    the one-time pin. Returns the record dict (fp, sps, impl,
+    provenance...)."""
+    path = path or BASELINE_COMPILED_PATH
+    fp, info = rig_fingerprint()
+    doc = {"version": 1, "workload": {
+        "name": "ftrl_criteo_single_slot",
+        "dim": 65_536, "nnz": 39, "width": 40, "rows": 4096, "seed": 0,
+        "alpha": 0.05, "beta": 1.0, "l1": 1e-5, "l2": 1e-5},
+        "rigs": {}}
+    import sys
+    load_failed = False
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            # NEVER rewrite over a file we could not read: the committed
+            # file carries OTHER rigs' pins, and resetting it to the
+            # default doc would silently erase them all
+            load_failed = True
+            print(f"WARNING: {path} exists but could not be read ({e}); "
+                  f"measuring an in-memory baseline for this run and "
+                  f"REFUSING to rewrite the file — restore it from git "
+                  f"before the next capture", file=sys.stderr)
+    rec = doc.get("rigs", {}).get(fp)
+    if rec is not None and not os.environ.get("ALINK_TPU_REPIN_BASELINE"):
+        if rec.get("impl") == "numpy-interpreted" and _native_available():
+            # the pin predates the native toolchain: dividing by the
+            # ~30x-slower interpreted loop would inflate vs_baseline in
+            # a way the provenance gate cannot catch (same rig hash).
+            # Re-pin with the compiled kernel; the provenance fp changes,
+            # so old-vs-new comparisons refuse — correctly, they are not
+            # the same denominator.
+            print(f"NOTE: replacing this rig's numpy-interpreted baseline "
+                  f"pin with the now-available compiled kernel "
+                  f"(provenance fingerprint changes)", file=sys.stderr)
+        else:
+            return {"fp": fp, "provenance_fp": _provenance_fp(fp, rec),
+                    **rec}
+    # the canonical batch: the SAME make_batch(0) shape the device rows
+    # train on (intercept slot + 39 one-hot CTR features, width 40)
+    idx, val, y = make_batch_criteo(0)
+    best, med, impl = _measure_compiled_ftrl_baseline(idx, val, y)
+    import datetime
+    rec = {"fingerprint": info, "impl": impl,
+           "sps_best": round(best, 1), "sps_median": round(med, 1),
+           "reps": 7,
+           "pinned_at": datetime.datetime.now(
+               datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ"),
+           "provenance": {
+               "kernel": "alink_tpu/native/parser.cpp:ftrl_slot_run",
+               "estimator": "best-of-7 (one-sided contention noise)",
+               "note": "single Flink task-slot stand-in; strict "
+                       "per-sample FTRL-proximal, compiled -O3"}}
+    doc.setdefault("rigs", {})[fp] = rec
+    if not load_failed:
+        try:
+            # write-tmp-then-rename: a killed process can truncate a
+            # plain overwrite, and a truncated committed file would cost
+            # every rig its pin
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+        except OSError as e:
+            # a pin that cannot persist will be RE-MEASURED next capture
+            # — the exact drift the pin exists to kill. Say so loudly
+            # (the run itself still works against the in-memory record).
+            print(f"WARNING: could not persist the compiled baseline pin "
+                  f"to {path} ({e}); the next capture will re-measure it "
+                  f"and vs_baseline will NOT be comparable "
+                  f"round-over-round", file=sys.stderr)
+    return {"fp": fp, "provenance_fp": _provenance_fp(fp, rec), **rec}
+
+
+def _provenance_fp(fp: str, rec: dict) -> str:
+    """rig fingerprint + digest of the pinned record itself: changes when
+    EITHER the rig or the pinned baseline changes, so
+    ``bench_compare --baseline-provenance`` also refuses a SAME-rig
+    re-pin (ALINK_TPU_REPIN_BASELINE) from silently moving
+    vs_baseline."""
+    import hashlib
+    digest = hashlib.blake2b(
+        json.dumps({"sps_best": rec.get("sps_best"),
+                    "pinned_at": rec.get("pinned_at"),
+                    "impl": rec.get("impl")}, sort_keys=True).encode(),
+        digest_size=4).hexdigest()
+    return f"{fp}-{digest}"
+
+
+def baseline_provenance_fp() -> str:
+    """The provenance fingerprint every bench dump carries as
+    ``baseline_fp`` (pins the baseline first if this rig has none)."""
+    return pinned_ftrl_baseline()["provenance_fp"]
+
+
+def make_batch_criteo(seed, dim=65_536, nnz=39, B=4096):
+    """The canonical Criteo-shape padded COO batch shared by the FTRL
+    device rows and the pinned baseline (module-level so both cite ONE
+    definition). Every row's slots are DISTINCT: duplicate-slot update
+    semantics differ between numpy fancy-assignment (last-write-wins),
+    the sequential C loop (read-modify-write) and the device scatter-add
+    (delta accumulation), so distinct slots are what put every baseline
+    implementation in exact agreement on the canonical workload."""
+    width = -(-(nnz + 1) // 8) * 8
+    r = np.random.RandomState(seed)
+    rngw = np.random.RandomState(0)
+    w_true = (rngw.randn(dim) * (rngw.rand(dim) < 0.02)).astype(np.float64)
+    idx = np.zeros((B, width), np.int32)
+    val = np.zeros((B, width), np.float64)
+    raw = r.randint(1, dim, size=(B, nnz)).astype(np.int32)
+    for _ in range(64):                  # resample intra-row collisions
+        srt = np.sort(raw, axis=1)
+        dup = (srt[:, 1:] == srt[:, :-1]).any(1)
+        if not dup.any():
+            break
+        raw[dup] = r.randint(1, dim, size=(int(dup.sum()), nnz))
+    idx[:, 0] = 0                        # intercept
+    val[:, 0] = 1.0
+    idx[:, 1:nnz + 1] = raw
+    val[:, 1:nnz + 1] = 1.0              # one-hot CTR features
+    margin = w_true[raw].sum(1)
+    y = (r.rand(B) < 1.0 / (1.0 + np.exp(-margin))).astype(np.float64)
+    return idx, val, y
+
+
+_DEVICE_CONCAT = None
+
+
+def _device_concat(*parts):
+    """Module-level jitted concatenate: ONE traced function for the whole
+    process (jax.jit caches by function identity), so the timed
+    from-disk pipeline leg only ever compiles it during warmup."""
+    global _DEVICE_CONCAT
+    if _DEVICE_CONCAT is None:
+        import jax
+        import jax.numpy as jnp
+        _DEVICE_CONCAT = jax.jit(lambda *xs: jnp.concatenate(xs))
+    return _DEVICE_CONCAT(*parts)
+
+
 class Harness:
     def __init__(self):
         import tempfile
@@ -449,30 +696,16 @@ def bench_ftrl(h: Harness):
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from alink_tpu.operator.stream.onlinelearning.ftrl import (
-        _ftrl_sparse_batch_step_factory, _ftrl_sparse_staleness_step_factory,
-        _ftrl_sparse_step_factory, _ftrl_weights)
+        _ftrl_sparse_batch_step_factory, _ftrl_sparse_chained_step_factory,
+        _ftrl_sparse_staleness_step_factory, _ftrl_sparse_step_factory,
+        _ftrl_weights)
 
     dim, nnz, B = 65_536, 39, 4096          # Criteo: 39 fields
     n_dev = h.chips
     dim_pad = -(-dim // n_dev) * n_dev
     width = -(-(nnz + 1) // 8) * 8          # +1 intercept slot
-    rng = np.random.RandomState(0)
-    w_true = (rng.randn(dim) * (rng.rand(dim) < 0.02)).astype(np.float64)
 
-    def make_batch(seed):
-        r = np.random.RandomState(seed)
-        idx = np.zeros((B, width), np.int32)
-        val = np.zeros((B, width), np.float64)
-        raw = r.randint(1, dim, size=(B, nnz)).astype(np.int32)
-        idx[:, 0] = 0                        # intercept
-        val[:, 0] = 1.0
-        idx[:, 1:nnz + 1] = raw
-        val[:, 1:nnz + 1] = 1.0              # one-hot CTR features
-        margin = w_true[raw].sum(1)
-        y = (r.rand(B) < 1.0 / (1.0 + np.exp(-margin))).astype(np.float64)
-        return idx, val, y
-
-    pool = [make_batch(s) for s in range(24)]
+    pool = [make_batch_criteo(s, dim=dim, nnz=nnz, B=B) for s in range(24)]
     mesh = h.env.mesh
     step = _ftrl_sparse_step_factory(mesh, alpha=0.05, beta=1.0,
                                      l1=1e-5, l2=1e-5)
@@ -503,7 +736,50 @@ def bench_ftrl(h: Harness):
 
     K = 8                                    # 8 pools = 192 batches
     dt = h.delta(run, K)
-    sps_strict = B * len(pool) * K / dt / h.chips
+    sps_persample = B * len(pool) * K / dt / h.chips
+
+    # ----- Chained-correction strict kernel (ISSUE 6 tentpole (a)) --------
+    # SAME strict semantics (bit-identical on collision-free chunks,
+    # f32-round-equal under collisions — tests/test_perf_kernels.py), but
+    # the scan is CHAIN_K-fold shorter: one state gather/scatter per
+    # chunk and one dense triangular correction matvec per sample instead
+    # of the K=4 kernel's O(K^2) pairwise matmuls. This is the strict
+    # HEADLINE row (ftrl_criteo_strict); the per-sample K=4 kernel rides
+    # alongside as strict_persample_* for continuity.
+    chained = {}
+    for CHAIN_K in (8, 16):
+        cstep = _ftrl_sparse_chained_step_factory(
+            mesh, alpha=0.05, beta=1.0, l1=1e-5, l2=1e-5, K=CHAIN_K)
+
+        @jax.jit
+        def chain_pool(sp_idx, sp_val, sp_y, z, nacc, cstep=cstep):
+            def body(carry, xs):
+                z, nacc = carry
+                z, nacc, m = cstep(xs[0], xs[1], xs[2], z, nacc)
+                return (z, nacc), m[0]
+            (z, nacc), _ = jax.lax.scan(body, (z, nacc),
+                                        (sp_idx, sp_val, sp_y))
+            return z, nacc
+
+        def run_chain(n_pools, chain_pool=chain_pool):
+            z = jax.device_put(zrng.randn(dim_pad) * 1e-8, shard)
+            nacc = jax.device_put(np.zeros(dim_pad), shard)
+            for _ in range(n_pools):
+                z, nacc = chain_pool(sp_idx, sp_val, sp_y, z, nacc)
+            np.asarray(z)
+
+        dt_c = h.delta(run_chain, K)
+        chained[CHAIN_K] = B * len(pool) * K / dt_c / h.chips
+    # the strict HEADLINE is the fastest strict-semantics kernel, with
+    # the winner recorded: on issue-latency-bound backends (TPU) that is
+    # the chained scan; on compute-bound hosts (CPU smoke rigs) the
+    # per-chunk collision tensor costs real flops and the per-sample
+    # kernel can win — the artifact says which ran
+    candidates = {"per_sample(K=4)": sps_persample,
+                  **{f"chained_correction(K={k})": v
+                     for k, v in chained.items()}}
+    strict_kernel = max(candidates, key=candidates.get)
+    sps_strict = candidates[strict_kernel]
 
     # ----- Bounded-staleness mode: the reference's ACTUAL semantics -------
     # The reference's sharded CalcTasks apply each sample's update only
@@ -841,13 +1117,11 @@ def bench_ftrl(h: Harness):
     stream_dag_s = time.perf_counter() - t0
     stream_dag_sps = n_stream / stream_dag_s / h.chips
 
-    # CPU baseline: per-sample O(nnz) FTRL loop in numpy (one task slot).
-    # Median-of-7 with the spread RECORDED (VERDICT r3 #4b): a single
-    # timing of a 4096-sample Python loop swings 30-50% with host load,
-    # which alone moved vs_baseline across the 10x bar between otherwise
-    # identical runs (r3 trial: 6.8 vs 10.2). The artifact now carries
-    # the baseline's min/median/max so a driver capture's ratio can be
-    # read against the measured noise.
+    # LIVE interpreted-loop context (the pre-r06 denominator, kept as
+    # vs_live_numpy): per-sample O(nnz) FTRL loop in numpy (one task
+    # slot), median-of-7 with the spread RECORDED (VERDICT r3 #4b) — its
+    # 30-50% host-load swing is exactly why the HEADLINE denominator is
+    # now the pinned compiled baseline (pinned_ftrl_baseline below).
     bidx, bval, by = pool[0]
     n_base = 4096
 
@@ -855,17 +1129,8 @@ def bench_ftrl(h: Harness):
         zc = np.zeros(dim)
         nc = np.zeros(dim)
         t0 = time.perf_counter()
-        for i in range(n_base):
-            ii, vv, yy = bidx[i], bval[i], by[i]
-            zi, ni = zc[ii], nc[ii]
-            decay = (1.0 + np.sqrt(ni)) / 0.05 + 1e-5
-            wi = np.where(np.abs(zi) <= 1e-5, 0.0,
-                          -(zi - np.sign(zi) * 1e-5) / decay)
-            p = 1.0 / (1.0 + np.exp(-np.clip(wi @ vv, -35, 35)))
-            g = (p - yy) * vv
-            sigma = (np.sqrt(ni + g * g) - np.sqrt(ni)) / 0.05
-            zc[ii] = zi + g - sigma * wi
-            nc[ii] = ni + g * g
+        _numpy_ftrl_slot_loop(bidx[:n_base], bval[:n_base], by[:n_base],
+                              zc, nc)
         return time.perf_counter() - t0
 
     # median per the r3 verdict's explicit ask for THIS row ("report the
@@ -877,6 +1142,16 @@ def bench_ftrl(h: Harness):
     cpu_spread = {"cpu_baseline_sps_min": round(n_base / cpu_ts[-1], 1),
                   "cpu_baseline_sps_median": round(cpu_sps, 1),
                   "cpu_baseline_sps_max": round(n_base / cpu_ts[0], 1)}
+
+    # ----- PINNED compiled baseline (tentpole (c)) ------------------------
+    # vs_baseline now divides by the committed BASELINE_compiled.json rate
+    # for this rig (compiled single-slot loop, best-of-7, measured once) —
+    # stable round-over-round where the live numpy loop above drifted
+    # ±30-50% with host load. The live spread stays in the artifact as
+    # vs_live_numpy context; bench_compare --baseline-provenance gates on
+    # the fingerprint.
+    pinned = pinned_ftrl_baseline()
+    base_sps = float(pinned["sps_best"])
     # FTRL is elementwise over width=40 slots (~15 flops each) —
     # gather/state-bound, not MXU work; its honest peak metric is HBM
     # traffic (~width * 3 state vectors * 2 dirs * 8B). The batch-mode row
@@ -897,19 +1172,37 @@ def bench_ftrl(h: Harness):
     # mode is the whole-micro-batch relaxation.
     return {"update_mode": "staleness", "staleness": STALE_K,
             "samples_per_sec_per_chip": round(sps, 1),
-            "vs_baseline": round(sps / cpu_sps, 3),
+            "vs_baseline": round(sps / base_sps, 3),
             "auc": round(auc, 4),
             "auc_minus_batch_lr": round(auc - batch_lr_auc, 4),
+            # strict headline = the chained-correction kernel (exact
+            # strict semantics, tests pin parity); the per-sample K=4
+            # kernel rides alongside for continuity with r03-r05 rows
             "strict_samples_per_sec_per_chip": round(sps_strict, 1),
-            "strict_vs_baseline": round(sps_strict / cpu_sps, 3),
+            "strict_vs_baseline": round(sps_strict / base_sps, 3),
+            "strict_kernel": strict_kernel,
+            "strict_chained_sps_by_k": {str(k): round(v, 1)
+                                        for k, v in chained.items()},
+            "strict_persample_samples_per_sec_per_chip":
+                round(sps_persample, 1),
             "strict_auc": round(strict_auc, 4),
+            # the pinned compiled denominator + provenance (the fp also
+            # digests the pinned record, so a re-pin changes it)
+            "baseline_fp": pinned["provenance_fp"],
+            "baseline_impl": pinned["impl"],
+            "baseline_sps": round(base_sps, 1),
+            "baseline_pinned_at": pinned.get("pinned_at"),
+            # live interpreted-loop context (the former denominator):
+            # vs_live_numpy shows what r05-style ratios would have read
+            "vs_live_numpy": round(sps / cpu_sps, 3),
+            "strict_vs_live_numpy": round(sps_strict / cpu_sps, 3),
             "batch_mode_auc": round(batch_mode_auc, 4),
             "batch_lr_auc": round(batch_lr_auc, 4),
             "oracle_auc": round(oracle_auc, 4),
             "dt_s": round(dt_stale, 3),
             **stale_roof,
             "batch_mode_samples_per_sec_per_chip": round(sps_batch, 1),
-            "batch_mode_vs_baseline": round(sps_batch / cpu_sps, 3),
+            "batch_mode_vs_baseline": round(sps_batch / base_sps, 3),
             "batch_mode_pct_chip_peak_flops": batch["pct_chip_peak_flops"],
             "stream_e2e_samples_per_sec_per_chip": round(stream_e2e_sps, 1),
             "stream_e2e_host_samples_per_sec": round(stream_host_sps, 1),
@@ -989,20 +1282,24 @@ def bench_logreg_from_disk(h: Harness):
     offs = (np.arange(N_FIELDS, dtype=np.int64) * FIELD_SIZE)[None, :]
 
     def load_from_disk():
-        # each shard reads, parses AND encodes in ONE pooled task (ctypes
-        # C calls release the GIL — io/sharding.parallel_shard_map — and
-        # the big numpy subtract/cast ufuncs do too), so shard i's disk
-        # read overlaps shard j's parse/encode; read_s/parse_s/encode_s
-        # are per-shard attribution SUMS (they exceed the wall time when
-        # overlapped), rp_wall_s is the wall clock for the whole phase.
-        # Fusing the former separate encode pass into the shard task took
-        # it off the critical path (VERDICT r4 #2: it was a serial 0.9 s).
-        # NOTE: device_put-per-shard from the pooled tasks was tried and
-        # REVERTED: on the deferred tunneled backend the committed arrays
-        # made the train leg ~2x slower (measured pipeline_vs_memory
-        # 0.46) — transfers batch better when the jit call ships the one
-        # concatenated host array itself.
-        from alink_tpu.io.sharding import parallel_shard_map
+        # ISSUE 6 satellite (VERDICT r5 #2): the parse leg now streams
+        # through the ORDERED prefetch_map pool (stream/prefetch.py) —
+        # shard i's disk read overlaps shard j's parse/encode exactly as
+        # before, but completed shards are grouped into a few super-
+        # groups and each group's host->device transfer is DISPATCHED
+        # (async) while later shards still parse, so the ~60 MB ship
+        # that used to serialize inside the train leg hides behind the
+        # parse wall. read_s/parse_s/encode_s stay per-shard attribution
+        # SUMS; rp_wall_s is the loader wall clock (transfers may still
+        # be in flight when it returns — that IS the overlap, they
+        # complete under the train leg's first dispatch).
+        # r05 NOTE (device_put-per-shard reverted as 2x slower on the
+        # deferred tunnel): 64 tiny committed arrays batched terribly.
+        # Grouped transfers (~16 shards / ~16 MB each, ALINK_TPU_
+        # DISK_GROUPS) keep the link busy with large writes instead;
+        # ALINK_TPU_DISK_COMMIT=0 restores the host-array path.
+        import jax
+        from alink_tpu.operator.stream.prefetch import prefetch_map
 
         def load_shard(i):
             t0 = time.perf_counter()
@@ -1025,15 +1322,62 @@ def bench_logreg_from_disk(h: Harness):
                 t3 = time.perf_counter()
             return (fb_i, lab), t1 - t0, t2 - t1, t3 - t2
 
+        commit = (os.environ.get("ALINK_TPU_DISK_COMMIT", "1") != "0"
+                  and jax.process_count() == 1)
+        n_groups = max(1, int(os.environ.get("ALINK_TPU_DISK_GROUPS", "4")))
+        per_group = -(-n_shards // n_groups)
+        workers = int(os.environ.get("ALINK_TPU_STREAM_WORKERS", "0") or 0)
+        if workers <= 0:
+            workers = min(8, os.cpu_count() or 1)
         t0 = time.perf_counter()
-        res = parallel_shard_map(load_shard, n_shards)
-        fb = np.concatenate([r[0][0] for r in res])
-        labels = np.concatenate([r[0][1] for r in res])
+        fb_parts, lab_parts, pend, stats = [], [], [], [0.0, 0.0, 0.0]
+
+        def flush_group():
+            if not pend:
+                return
+            fb_g = np.concatenate([p[0] for p in pend])
+            lab_g = np.concatenate([p[1] for p in pend])
+            pend.clear()
+            if commit:
+                # async dispatch: the transfer overlaps the pool parsing
+                # the NEXT group's shards
+                fb_g = jax.device_put(fb_g)
+                lab_g = jax.device_put(lab_g)
+            fb_parts.append(fb_g)
+            lab_parts.append(lab_g)
+
+        for k, (part, r_s, p_s, e_s) in enumerate(
+                prefetch_map(iter(range(n_shards)), load_shard,
+                             workers=workers, name="diskbench")):
+            stats[0] += r_s
+            stats[1] += p_s
+            stats[2] += e_s
+            pend.append(part)
+            if len(pend) >= per_group:
+                flush_group()
+        flush_group()
+        if commit and len(fb_parts) > 1:
+            # one compiled concat on DEVICE — through the module-level
+            # jitted helper so jax's cache (keyed on function identity)
+            # actually hits across reps: a per-call lambda would re-trace
+            # INSIDE the timed pipeline leg and deflate
+            # pipeline_vs_memory with compile cost
+            fb = _device_concat(*fb_parts)
+            labels = _device_concat(*lab_parts)
+        else:
+            # single part (committed or not) passes through; multiple
+            # parts only reach here on the host path (commit=False)
+            fb = fb_parts[0] if len(fb_parts) == 1 else \
+                np.concatenate(fb_parts)
+            labels = (lab_parts[0] if len(lab_parts) == 1
+                      else np.concatenate(lab_parts))
         rp_wall = time.perf_counter() - t0
-        return fb, labels, {"read_s": round(sum(r[1] for r in res), 3),
-                            "parse_s": round(sum(r[2] for r in res), 3),
-                            "encode_s": round(sum(r[3] for r in res), 3),
-                            "rp_wall_s": round(rp_wall, 3)}
+        return fb, labels, {"read_s": round(stats[0], 3),
+                            "parse_s": round(stats[1], 3),
+                            "encode_s": round(stats[2], 3),
+                            "rp_wall_s": round(rp_wall, 3),
+                            "ingest_workers": workers,
+                            "ingest_committed": bool(commit)}
 
     def train(fb, labels):
         data = {"fb_idx": fb, "y": labels,
@@ -1046,7 +1390,7 @@ def bench_logreg_from_disk(h: Harness):
     # warm the compile cache so neither timing includes compilation
     fb0, y0, _ = load_from_disk()
     train(fb0, y0)
-    assert (fb0 == fb_idx_true).all() and len(y0) == n_rows
+    assert (np.asarray(fb0) == fb_idx_true).all() and len(y0) == n_rows
 
     # PAIRED reps: the train leg's wall time swings 2x with rig/tunnel
     # contention on the single-core capture box, so timing the pipeline
@@ -1224,6 +1568,92 @@ def bench_gbdt(h: Harness):
 
 
 # ---------------------------------------------------------------------------
+# 5b. GBDT at 10x-adult — the large-shape roofline row (VERDICT r5 #5)
+# ---------------------------------------------------------------------------
+
+def bench_gbdt_large(h: Harness):
+    """GBDT at 10x the adult shape with the FUSED histogram kernel on the
+    measured path (ALINK_TPU_FUSED_HIST, ISSUE 6 tentpole (b)): at 488k
+    rows the per-level one-hot contractions do chip-scale work and the
+    row leaves `bound: latency` for a hardware roof. The uniform roofline
+    fields use the FUSED formulation's issued flops (the two MXU dots per
+    level) — the design tradeoff being measured. Scale knob for smoke
+    rigs: ALINK_TPU_GBDT_LARGE_ROWS."""
+    from alink_tpu.operator.common.tree.hist import (FUSED_HIST_ENV,
+                                                     fused_hist_mode)
+    from alink_tpu.operator.common.tree.trainers import (TreeTrainParams,
+                                                         gbdt_train)
+
+    n = int(os.environ.get("ALINK_TPU_GBDT_LARGE_ROWS", "488420"))
+    F, depth, n_bins = 14, 6, 64
+    rng = np.random.RandomState(0)
+    Xc = rng.randn(n, 6).astype(np.float32)
+    Xd = rng.randint(0, 12, size=(n, 8)).astype(np.float32)
+    X = np.concatenate([Xc, Xd], 1)
+    margin = (Xc[:, 0] + 0.8 * Xc[:, 1] * (Xd[:, 0] > 5)
+              - 0.6 * (Xd[:, 1] % 3) + 0.4 * Xc[:, 2])
+    y = (margin + 0.3 * rng.randn(n) > 0).astype(np.float32)
+    jrng = np.random.RandomState(5)
+    prev = os.environ.get(FUSED_HIST_ENV)
+    # "pallas" on TPU backends that lower it; the XLA fused formulation
+    # is the portable default
+    os.environ[FUSED_HIST_ENV] = os.environ.get(
+        "ALINK_TPU_GBDT_LARGE_HIST", "xla")
+    try:
+        mode = fused_hist_mode()
+
+        def run(n_trees):
+            p = TreeTrainParams(num_trees=n_trees, max_depth=depth,
+                                n_bins=n_bins, learning_rate=0.3)
+            Xj = X + jrng.randn(1, F).astype(np.float32) * 1e-6
+            out = gbdt_train(Xj, y, p, False, h.env)
+            np.asarray(out[6])               # loss curve: full fetch
+
+        span = 24
+        dt = h.delta(run, span, reps=3)
+        sps = n * span / dt / h.chips
+
+        # quality: one short fit; the planted signal must survive the
+        # fused kernel (parity with the default kernel is pinned by
+        # tests — this is the in-artifact anchor)
+        import jax
+        import jax.numpy as jnp
+        from alink_tpu.operator.common.tree.hist import (bin_data,
+                                                         tree_apply_binned)
+        trees_q = 20
+        tf, tb, tm, tv, edges, base, curve, _ = gbdt_train(
+            X, y, TreeTrainParams(num_trees=trees_q, max_depth=depth,
+                                  n_bins=n_bins, learning_rate=0.3),
+            False, h.env)
+        binned = bin_data(X, edges)
+        leaf = jax.vmap(lambda f, b: tree_apply_binned(
+            jnp.asarray(binned), f, b, depth))(jnp.asarray(tf),
+                                               jnp.asarray(tb))
+        scores = base + 0.3 * np.asarray(
+            jnp.take_along_axis(jnp.asarray(tv), leaf, 1)).sum(0)
+        auc = _auc(y, scores)
+    finally:
+        if prev is None:
+            os.environ.pop(FUSED_HIST_ENV, None)
+        else:
+            os.environ[FUSED_HIST_ENV] = prev
+
+    # issued flops/sample/tree of the fused contraction: the level-l
+    # histogram dot contracts (n, n_nodes*2m) x (n, F*n_bins) ->
+    # 2*n_nodes*2m*F*n_bins per sample; sum(n_nodes) over levels =
+    # 2^depth - 1. HBM/sample/tree: the bf16 ohB (F*n_bins*2B) + s2
+    # (2m*2B) stream through every level.
+    m = 3
+    fps = 2 * ((1 << depth) - 1) * (2 * m) * (F * n_bins)
+    bps = depth * (F * n_bins * 2 + 2 * m * 2)
+    return {"samples_per_sec_per_chip": round(sps, 1),
+            "rows": n, "hist_kernel": mode,
+            "iters_trees_x_depth": f"{span}x{depth}",
+            "auc": round(auc, 4), "dt_s": round(dt, 3),
+            **mfu(sps, fps, bps)}
+
+
+# ---------------------------------------------------------------------------
 # 6. ALS / MovieLens-1M shape
 # ---------------------------------------------------------------------------
 
@@ -1299,6 +1729,65 @@ def bench_als(h: Harness):
 
 
 # ---------------------------------------------------------------------------
+# 6b. ALS at MovieLens-10M shape — the large-shape roofline row
+# ---------------------------------------------------------------------------
+
+def bench_als_large(h: Harness):
+    """ALS at the MovieLens-10M shape (69,878 x 10,677 users/items, 10M
+    ratings, rank 10): ten times the 1M row's work per sweep, so the
+    prefix-sum/normal-equation pipeline streams enough bytes to press
+    the HBM roof instead of the dispatch floor (VERDICT r5 #5). Scale
+    knob for smoke rigs: ALINK_TPU_ALS_LARGE_NNZ."""
+    from alink_tpu.operator.common.recommendation.als import (AlsTrainParams,
+                                                              als_train)
+
+    U, I, rank = 69_878, 10_677, 10          # MovieLens-10M shape
+    nnz = int(os.environ.get("ALINK_TPU_ALS_LARGE_NNZ", "10000000"))
+    rng = np.random.RandomState(0)
+    users = rng.randint(0, U, nnz).astype(np.int32)
+    items = rng.randint(0, I, nnz).astype(np.int32)
+    uf_true = rng.randn(U, rank).astype(np.float32) / np.sqrt(rank)
+    if_true = rng.randn(I, rank).astype(np.float32) / np.sqrt(rank)
+    ratings = ((uf_true[users] * if_true[items]).sum(1) * 1.5 + 3.5
+               + 0.2 * rng.randn(nnz)).astype(np.float32)
+    # at 10M nnz one sweep is ~10x the 1M row's device work, so a short
+    # span clears the fixed-cost noise the 1M row needed 40 iters for
+    iters = 8
+    jrng = np.random.RandomState(9)
+
+    def run(n_iter):
+        p = AlsTrainParams(rank=rank, num_iter=n_iter, lambda_reg=0.1)
+        rj = ratings + jrng.randn(1).astype(np.float32) * 1e-6
+        out = als_train(users, items, rj, p, h.env, num_users=U, num_items=I)
+        np.asarray(out[0])
+        return out
+
+    dt = h.delta(run, iters, reps=2)
+    sps = nnz * iters / dt / h.chips
+
+    # quality anchor: one short fit's training RMSE (the generating
+    # noise floor is 0.2)
+    uf, if_, curve = als_train(users, items, ratings,
+                               AlsTrainParams(rank=rank, num_iter=5,
+                                              lambda_reg=0.1),
+                               h.env, num_users=U, num_items=I)
+    uf, if_ = np.asarray(uf), np.asarray(if_)
+    preds = (uf[users] * if_[items]).sum(1)
+    rmse = float(np.sqrt(((preds - ratings) ** 2).mean()))
+
+    # same roofline accounting as the 1M row (packed-symmetric
+    # contribution columns; 6 prefix passes per side over the (nnz, K)
+    # f32 contribs is the binding HBM term)
+    K_cols = rank * (rank + 1) // 2 + rank + 1
+    fps = 2 * 2 * K_cols + (U + I) * 2 * rank ** 3 // nnz
+    bps = 2 * 6 * K_cols * 4
+    return {"samples_per_sec_per_chip": round(sps, 1),
+            "nnz": nnz, "shape": f"{U}x{I}", "rank": rank,
+            "rmse": round(rmse, 4), "dt_s": round(dt, 3),
+            **mfu(sps, fps, bytes_per_sample=bps)}
+
+
+# ---------------------------------------------------------------------------
 # --quick: the <60 s smoke suite (the perf regression gate's input)
 # ---------------------------------------------------------------------------
 #
@@ -1364,6 +1853,7 @@ def quick_ftrl(h: Harness):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
     from alink_tpu.operator.stream.onlinelearning.ftrl import (
+        _ftrl_sparse_chained_step_factory,
         _ftrl_sparse_staleness_step_factory, _ftrl_sparse_step_factory)
     dim, nnz, B, n_pool = 4_096, 16, 256, 4
     n_dev = h.chips
@@ -1392,6 +1882,8 @@ def quick_ftrl(h: Harness):
     for key, step in (
             ("strict", _ftrl_sparse_step_factory(
                 mesh, alpha=0.05, beta=1.0, l1=1e-5, l2=1e-5)),
+            ("chained", _ftrl_sparse_chained_step_factory(
+                mesh, alpha=0.05, beta=1.0, l1=1e-5, l2=1e-5, K=16)),
             ("stale", _ftrl_sparse_staleness_step_factory(
                 mesh, alpha=0.05, beta=1.0, l1=1e-5, l2=1e-5, K=32))):
         @jax.jit
@@ -1414,7 +1906,15 @@ def quick_ftrl(h: Harness):
         dt = h.delta(run, 3, reps=2)
         out[key] = B * n_pool * 3 / dt / h.chips
     return {"samples_per_sec_per_chip": round(out["stale"], 1),
-            "strict_samples_per_sec_per_chip": round(out["strict"], 1),
+            # strict headline = best strict-semantics kernel (the full
+            # row's rule): chained wins on issue-latency-bound backends,
+            # per-sample on compute-bound smoke rigs
+            "strict_samples_per_sec_per_chip":
+                round(max(out["chained"], out["strict"]), 1),
+            "strict_chained_samples_per_sec_per_chip":
+                round(out["chained"], 1),
+            "strict_persample_samples_per_sec_per_chip":
+                round(out["strict"], 1),
             "dispatch_gap_est_s": round(h.dispatch_gap(50), 6)}
 
 
@@ -1525,11 +2025,46 @@ def quick_ftrl_drain(h: Harness):
             "dt_s": round(dt, 3)}
 
 
+def quick_gbdt_hist(h: Harness):
+    """GBDT with the FUSED histogram kernel (ALINK_TPU_FUSED_HIST=xla) on
+    the measured path at smoke scale — without this row the gate is
+    blind to regressions in exactly the kernel the large-shape roofline
+    row (gbdt_adult_large) depends on."""
+    from alink_tpu.operator.common.tree.hist import FUSED_HIST_ENV
+    from alink_tpu.operator.common.tree.trainers import (TreeTrainParams,
+                                                         gbdt_train)
+    n, F, depth, n_bins = 8_000, 10, 5, 32
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, F).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    jrng = np.random.RandomState(5)
+    prev = os.environ.get(FUSED_HIST_ENV)
+    os.environ[FUSED_HIST_ENV] = "xla"
+    try:
+        def run(n_trees):
+            p = TreeTrainParams(num_trees=n_trees, max_depth=depth,
+                                n_bins=n_bins, learning_rate=0.3)
+            Xj = X + jrng.randn(1, F).astype(np.float32) * 1e-6
+            out = gbdt_train(Xj, y, p, False, h.env)
+            np.asarray(out[6])
+
+        span = 12
+        dt = h.delta(run, span, reps=2)
+    finally:
+        if prev is None:
+            os.environ.pop(FUSED_HIST_ENV, None)
+        else:
+            os.environ[FUSED_HIST_ENV] = prev
+    return {"samples_per_sec_per_chip": round(n * span / dt / h.chips, 1),
+            "dt_s": round(dt, 3)}
+
+
 QUICK_WORKLOADS = (("logreg_criteo", quick_logreg),
                    ("logreg_ckpt", quick_logreg_ckpt),
                    ("kmeans_iris", quick_kmeans),
                    ("ftrl_criteo", quick_ftrl),
                    ("ftrl_stream_drain", quick_ftrl_drain),
+                   ("gbdt_hist_fused", quick_gbdt_hist),
                    ("logreg_from_disk", quick_from_disk))
 
 
@@ -1563,7 +2098,9 @@ def main(argv=None):
                      ("ftrl_criteo", bench_ftrl),
                      ("logreg_from_disk", bench_logreg_from_disk),
                      ("gbdt_adult", bench_gbdt),
-                     ("als_movielens", bench_als))
+                     ("gbdt_adult_large", bench_gbdt_large),
+                     ("als_movielens", bench_als),
+                     ("als_movielens_large", bench_als_large))
     for name, fn in suite:
         r = None
         for attempt in (1, 2):
@@ -1586,7 +2123,8 @@ def main(argv=None):
     full_doc = {"workloads": workloads, "mode": mode,
                 # the rig's serial per-dispatch floor, measured once per
                 # capture so latency-bound rows can be read against it
-                "rig": {"dispatch_gap_est_s": round(h.dispatch_gap(), 6)}}
+                "rig": {"dispatch_gap_est_s": round(h.dispatch_gap(), 6),
+                        "baseline_fp": baseline_provenance_fp()}}
     if args.metrics_out:
         from alink_tpu.common.metrics import get_registry
         try:
@@ -1641,6 +2179,11 @@ def main(argv=None):
         "value": flag.get("samples_per_sec_per_chip", 0.0),
         "unit": "samples/sec/chip",
         "vs_baseline": flag.get("vs_baseline", 0.0),
+        # rig + pinned-record identity: rides every dump so
+        # bench_compare --baseline-provenance can refuse cross-rig AND
+        # same-rig-re-pinned comparisons (a re-measured baseline can
+        # then never silently inflate vs_baseline round-over-round)
+        "baseline_fp": baseline_provenance_fp(),
     }
     if args.quick:
         # quick dumps must be distinguishable: bench_compare warns when

@@ -48,8 +48,9 @@ class TestLoadAndCompare:
         a = _driver_dump(str(tmp_path / "a.json"), {"x": 100.0})
         b = _bare_dump(str(tmp_path / "b.json"), {"x": 50.0})
         # unmarked dumps (everything pre --quick) load as mode "full"
-        assert cli.load_workloads(a) == ({"x": 100.0}, "full")
-        assert cli.load_workloads(b) == ({"x": 50.0}, "full")
+        # with no baseline fingerprint (pre-r06)
+        assert cli.load_workloads(a) == ({"x": 100.0}, "full", None)
+        assert cli.load_workloads(b) == ({"x": 50.0}, "full", None)
 
     def test_quick_mode_marker_and_mismatch_warning(self, cli, tmp_path,
                                                     capsys):
@@ -58,7 +59,7 @@ class TestLoadAndCompare:
         with open(q, "w") as f:
             json.dump({"mode": "quick",
                        "workloads_sps_vs": {"x": [10.0, 1.0, 0.0]}}, f)
-        assert cli.load_workloads(q) == ({"x": 10.0}, "quick")
+        assert cli.load_workloads(q) == ({"x": 10.0}, "quick", None)
         # cross-mode diff: reported, but loudly flagged as fixture-size
         assert cli.main([a, q]) == 0
         err = capsys.readouterr().err

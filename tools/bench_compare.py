@@ -34,10 +34,12 @@ from typing import Dict, List, Optional, Tuple
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def load_workloads(path: str) -> Tuple[Dict[str, float], str]:
-    """``({workload: samples_per_sec_per_chip}, mode)`` from either file
-    shape; ``mode`` is ``"quick"`` for ``bench.py --quick`` dumps, else
-    ``"full"`` (pre-quick dumps carry no marker and are full)."""
+def load_workloads(path: str) -> Tuple[Dict[str, float], str, Optional[str]]:
+    """``({workload: samples_per_sec_per_chip}, mode, baseline_fp)`` from
+    either file shape; ``mode`` is ``"quick"`` for ``bench.py --quick``
+    dumps, else ``"full"`` (pre-quick dumps carry no marker and are
+    full). ``baseline_fp`` is the capture's rig/baseline fingerprint
+    (None on pre-r06 dumps)."""
     with open(path) as f:
         doc = json.load(f)
     if isinstance(doc, dict) and "parsed" in doc \
@@ -51,7 +53,11 @@ def load_workloads(path: str) -> Tuple[Dict[str, float], str]:
     for name, row in wl.items():
         sps = row[0] if isinstance(row, (list, tuple)) else row
         out[str(name)] = float(sps)
-    return out, str(doc.get("mode", "full"))
+    fp = doc.get("baseline_fp")
+    if fp is None and isinstance(doc.get("rig"), dict):
+        fp = doc["rig"].get("baseline_fp")
+    return out, str(doc.get("mode", "full")), \
+        (str(fp) if fp is not None else None)
 
 
 def newest_pair(directory: str) -> Tuple[str, str]:
@@ -135,6 +141,12 @@ def main(argv=None) -> int:
                          "more than PCT percent")
     ap.add_argument("--json", action="store_true",
                     help="emit the comparison as JSON")
+    ap.add_argument("--baseline-provenance", action="store_true",
+                    help="refuse (exit 3) to compare dumps whose "
+                         "baseline/rig fingerprints differ — a "
+                         "re-measured or cross-rig baseline can then "
+                         "never silently inflate vs_baseline; dumps "
+                         "without a fingerprint (pre-r06) warn instead")
     args = ap.parse_args(argv)
     if (args.old is None) != (args.new is None):
         ap.error("give both OLD and NEW, or neither (newest pair)")
@@ -147,12 +159,32 @@ def main(argv=None) -> int:
     else:
         old_path, new_path = args.old, args.new
     try:
-        old_wl, old_mode = load_workloads(old_path)
-        new_wl, new_mode = load_workloads(new_path)
+        old_wl, old_mode, old_fp = load_workloads(old_path)
+        new_wl, new_mode, new_fp = load_workloads(new_path)
         rows = compare(old_wl, new_wl)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"bench_compare.py: {e}", file=sys.stderr)
         return 1
+    if args.baseline_provenance:
+        if old_fp is not None and new_fp is not None:
+            if old_fp != new_fp:
+                print(f"bench_compare.py: REFUSING to compare — baseline "
+                      f"fingerprints differ ({old_fp} vs {new_fp}): the "
+                      f"dumps were captured against different rigs or a "
+                      f"re-pinned baseline, so vs_baseline deltas would "
+                      f"be provenance artifacts, not code changes "
+                      f"(re-run both captures on one rig, or drop "
+                      f"--baseline-provenance to diff anyway)",
+                      file=sys.stderr)
+                return 3
+        else:
+            missing = [p for p, fp in ((old_path, old_fp),
+                                       (new_path, new_fp)) if fp is None]
+            print(f"WARNING: --baseline-provenance: no baseline "
+                  f"fingerprint recorded in "
+                  f"{', '.join(os.path.basename(m) for m in missing)} "
+                  f"(pre-r06 capture?) — provenance not verifiable",
+                  file=sys.stderr)
     if old_mode != new_mode:
         # quick fixtures are a fraction of the full suite's — a cross-
         # mode delta is a fixture-size artifact, not a regression. Warn

@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# perf_gate.sh — the ONE perf-regression command the builder and CI both run
+# (ISSUE 6 satellite; workflow: docs/performance.md "Quick bench gate").
+#
+#   tools/perf_gate.sh            run `bench.py --quick` (chained-FTRL +
+#                                 fused-histogram kernels on the measured
+#                                 path), diff against the committed gate
+#                                 baseline with bench_compare --threshold
+#                                 and --baseline-provenance; exit != 0 on
+#                                 regression or provenance mismatch.
+#                                 First run (no baseline) promotes the
+#                                 fresh capture and exits 0.
+#   tools/perf_gate.sh --update   re-baseline after an accepted perf change
+#                                 (the diff of PERF_GATE_BASE shows it).
+#
+# env: PERF_GATE_THRESHOLD  regression gate percent (default 30 — quick
+#                           fixtures are small, so the bar is loose; the
+#                           full-suite captures are the publishable rows)
+#      PERF_GATE_BASE       baseline artifact (default BENCH_quick_base.json)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+BASE=${PERF_GATE_BASE:-BENCH_quick_base.json}
+NEW=BENCH_quick.json
+THRESH=${PERF_GATE_THRESHOLD:-30}
+
+if [ "${1:-}" = "--update" ]; then
+    python bench.py --quick --out "$BASE"
+    echo "perf_gate: baseline updated -> $BASE"
+    exit 0
+fi
+
+python bench.py --quick --out "$NEW"
+
+if [ ! -f "$BASE" ]; then
+    cp "$NEW" "$BASE"
+    echo "perf_gate: no baseline found; promoted $NEW -> $BASE (gate passes trivially this run)"
+    exit 0
+fi
+
+python tools/bench_compare.py "$BASE" "$NEW" --threshold "$THRESH" --baseline-provenance
