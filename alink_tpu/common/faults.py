@@ -35,7 +35,6 @@ cached per raw string so the hot-path cost is one dict lookup.
 
 from __future__ import annotations
 
-import os
 from typing import Dict, Optional, Tuple
 
 __all__ = ["FAULT_ENV", "FaultInjected", "fault_spec", "faults_armed",
@@ -90,8 +89,11 @@ def _parse(raw: str) -> Dict[str, int]:
 
 
 def fault_spec() -> Dict[str, int]:
-    """The active {site: threshold} map (empty when unset)."""
-    raw = os.environ.get(FAULT_ENV)
+    """The active {site: threshold} map (empty when unset). The raw
+    spec string is read through the flag registry (common/flags.py);
+    its ``site:index`` grammar stays here with its consumer."""
+    from .flags import flag_raw
+    raw = flag_raw(FAULT_ENV)
     return _parse(raw) if raw else {}
 
 

@@ -1,0 +1,442 @@
+"""Central declarative registry of every ``ALINK_*`` environment flag.
+
+Six PRs in, every feature (metrics, tracing, health, donation,
+checkpointing, fused kernels) folded its own ``ALINK_TPU_*`` flag into
+the program-cache key, the FTRL step lru keys, and the checkpoint
+signatures *by hand*, and each site re-invented its own env parsing.
+That is the "combinatorial staleness trap" of ROADMAP item 5: a new flag
+that changes a traced program but misses a key fold silently serves a
+stale compiled program.
+
+This module is the single source of truth the rest of the codebase —
+and the ``tools/lint`` static analyzer — cross-check against:
+
+  * **one parser per kind** — the ``0/false/off/no`` falsy convention
+    (the ``env_flag`` contract from ``common/metrics.py``) now applies
+    to every boolean flag, integer/float flags treat a set-but-empty
+    value as unset, and mode flags normalize their choices in one place;
+  * **declared key interaction** — every flag states either which cache
+    keys it folds into (``folds_into``: ``program_cache`` /
+    ``checkpoint_signature`` / ``step_lru``) or WHY no fold is needed
+    (``key_neutral``, a human-readable justification). Registration
+    refuses a flag that declares neither: "I didn't think about
+    staleness" is not a valid state.
+  * **machine-checkable metadata** — ``tools/lint``'s ENV-KEY-FOLD rule
+    walks every env read reachable from a program/step factory and
+    fails the build when the flag's declaration does not cover that
+    factory's key dimension; ``tools/gen_docs.py`` renders the
+    reference tables in ``docs/performance.md`` / ``docs/observability
+    .md`` from the same entries, so the docs cannot drift either.
+
+Deliberately **zero package dependencies** (pure stdlib): the registry
+is imported by ``common/metrics.py`` (the bottom of the import graph)
+and loaded standalone by ``tools/lint`` via ``importlib`` without
+pulling in jax.
+
+This registry is the first concrete step toward the ROADMAP-item-5
+ExecutionPlan: the flag dimension of the future plan object already
+lives here, declaratively; mesh/partition specs and the donation map
+join it when item 1 lands.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "PROGRAM_CACHE", "CHECKPOINT_SIGNATURE", "STEP_LRU", "KEY_DIMENSIONS",
+    "Flag", "FlagRegistry", "FLAGS", "env_flag", "flag_value", "flag_raw",
+    "parse_bool",
+]
+
+# -- cache-key dimensions a flag can fold into ------------------------------
+# ``program_cache``        — the engine's compiled-program cache key
+#                            (engine/comqueue.py ckey) and the tree
+#                            trainers' set_program_key tuples;
+# ``checkpoint_signature``  — recovery.program_signature / the FTRL
+#                            ck_signature dicts a resume must match;
+# ``step_lru``              — the functools.lru_cache keys of the FTRL
+#                            step factories (ftrl.py).
+PROGRAM_CACHE = "program_cache"
+CHECKPOINT_SIGNATURE = "checkpoint_signature"
+STEP_LRU = "step_lru"
+KEY_DIMENSIONS = frozenset({PROGRAM_CACHE, CHECKPOINT_SIGNATURE, STEP_LRU})
+
+_FALSY = frozenset({"", "0", "false", "off", "no"})
+_UNSET = object()
+
+
+def parse_bool(raw: str) -> bool:
+    """The one boolean semantics: ``0/false/off/no`` (any case,
+    surrounding whitespace ignored) -> False; anything else -> True."""
+    return raw.strip().lower() not in _FALSY
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Boolean env flag: unset -> ``default``; otherwise
+    :func:`parse_bool`. Works for undeclared names too (tests);
+    declared flags should agree with their registered default —
+    :meth:`FlagRegistry.value` enforces that path."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return parse_bool(v)
+
+
+def _parse_int(raw: str) -> int:
+    return int(raw.strip())
+
+
+def _parse_float(raw: str) -> float:
+    return float(raw.strip())
+
+
+def _parse_str(raw: str) -> str:
+    return raw
+
+
+_KIND_PARSERS: Dict[str, Callable[[str], Any]] = {
+    "bool": parse_bool,
+    "int": _parse_int,
+    "float": _parse_float,
+    "str": _parse_str,
+    "mode": _parse_str,     # overridden per flag with a normalizer
+}
+
+_KINDS = tuple(_KIND_PARSERS)
+
+
+@dataclass(frozen=True)
+class Flag:
+    """One declared environment flag.
+
+    ``folds_into``  — key dimensions the flag's value is folded into;
+    ``key_neutral`` — justification why NO fold is needed (the flag can
+                      never make a cached compiled program / snapshot
+                      stale). Exactly one of the two must be non-empty.
+    ``accessor``    — dotted path of the canonical read helper call
+                      sites should use (documentation + lint hint).
+    ``section``     — which generated doc table the flag belongs to
+                      (``performance`` / ``observability`` /
+                      ``durability`` / ``debug`` / ``io`` / ``bench``).
+    ``tolerant``    — parse failures return the default instead of
+                      raising (the ``ALINK_TPU_TRACE_BUFFER`` contract).
+    """
+    name: str
+    kind: str
+    default: Any
+    description: str
+    section: str
+    folds_into: frozenset = frozenset()
+    key_neutral: str = ""
+    accessor: str = ""
+    parser: Optional[Callable[[str], Any]] = None
+    clamp: Optional[Callable[[Any], Any]] = None
+    tolerant: bool = False
+
+    def parse(self, raw: str, default: Any = _UNSET) -> Any:
+        if self.kind == "bool":
+            return parse_bool(raw)
+        fn = self.parser or _KIND_PARSERS[self.kind]
+        try:
+            v = fn(raw)
+        except (TypeError, ValueError):
+            if self.tolerant:
+                # a call-site default override must win on the fallback
+                # path too, or flag_value(name, d) ignores d exactly
+                # when the env value is junk
+                return self.default if default is _UNSET else default
+            raise
+        return self.clamp(v) if self.clamp is not None else v
+
+    def read(self, default: Any = _UNSET) -> Any:
+        """The flag's current value: live env read, declared default
+        when unset (non-bool kinds also treat a set-but-EMPTY value as
+        unset — ``ALINK_TPU_STREAM_PREFETCH=`` must not crash int())."""
+        dflt = self.default if default is _UNSET else default
+        raw = os.environ.get(self.name)
+        if raw is None or (raw == "" and self.kind != "bool"):
+            return dflt
+        if self.kind == "bool":
+            return parse_bool(raw)
+        return self.parse(raw, dflt)
+
+    @property
+    def folds_label(self) -> str:
+        """Doc-table cell: the folded key dimensions, or an em-dash."""
+        if self.folds_into:
+            return ", ".join(sorted(self.folds_into))
+        return "—"
+
+
+class FlagRegistry:
+    """Validating container for :class:`Flag` declarations."""
+
+    def __init__(self):
+        self._flags: Dict[str, Flag] = {}
+
+    def register(self, name: str, kind: str, default: Any, description: str,
+                 section: str, **kw) -> Flag:
+        if not name.startswith("ALINK_"):
+            raise ValueError(f"flag {name!r} must carry the ALINK_ prefix")
+        if name in self._flags:
+            raise ValueError(f"flag {name!r} registered twice")
+        if kind not in _KINDS:
+            raise ValueError(f"flag {name!r}: unknown kind {kind!r}")
+        flag = Flag(name=name, kind=kind, default=default,
+                    description=description, section=section, **kw)
+        if not flag.folds_into.issubset(KEY_DIMENSIONS):
+            raise ValueError(
+                f"flag {name!r}: folds_into {set(flag.folds_into)} not a "
+                f"subset of {set(KEY_DIMENSIONS)}")
+        # the core discipline: every flag must either fold into a cache
+        # key or explain why it can never stale one — silence is refused
+        if bool(flag.folds_into) == bool(flag.key_neutral):
+            raise ValueError(
+                f"flag {name!r} must declare exactly one of folds_into= "
+                f"(which cache keys it rides) or key_neutral= (why no "
+                f"fold is needed)")
+        self._flags[name] = flag
+        return flag
+
+    # -- lookups -----------------------------------------------------------
+    def __contains__(self, name: str) -> bool:
+        return name in self._flags
+
+    def __iter__(self):
+        return iter(self._flags.values())
+
+    def get(self, name: str) -> Optional[Flag]:
+        return self._flags.get(name)
+
+    def names(self) -> List[str]:
+        return sorted(self._flags)
+
+    def _require(self, name: str) -> Flag:
+        flag = self._flags.get(name)
+        if flag is None:
+            raise KeyError(
+                f"env flag {name!r} is not declared in "
+                f"alink_tpu/common/flags.py — register it (with its "
+                f"folds_into= or key_neutral= declaration) before use")
+        return flag
+
+    def value(self, name: str, default: Any = _UNSET) -> Any:
+        """The declared flag's parsed live value (``default=`` overrides
+        the registered default for call sites that carry their own)."""
+        return self._require(name).read(default)
+
+    def raw(self, name: str) -> Optional[str]:
+        """The raw env string of a declared flag (``None`` when unset)
+        — for flags whose spec grammar lives with its consumer
+        (``ALINK_TPU_FAULT_INJECT``)."""
+        self._require(name)
+        return os.environ.get(name)
+
+    def folding_into(self, dimension: str) -> Tuple[Flag, ...]:
+        if dimension not in KEY_DIMENSIONS:
+            raise ValueError(f"unknown key dimension {dimension!r}")
+        return tuple(f for f in self if dimension in f.folds_into)
+
+    # -- doc generation (tools/gen_docs.py) --------------------------------
+    def doc_rows(self, sections: Optional[Iterable[str]] = None
+                 ) -> List[Dict[str, str]]:
+        """Rows for the generated env-flag reference tables: name,
+        default, what it gates, which keys it folds into (or the
+        key-neutral justification)."""
+        want = None if sections is None else set(sections)
+        rows = []
+        for f in sorted(self, key=lambda f: f.name):
+            if want is not None and f.section not in want:
+                continue
+            dflt = f.default
+            if f.kind == "bool":
+                dflt = "on" if dflt else "off"
+            elif dflt in (None, ""):
+                dflt = "unset"
+            rows.append({
+                "name": f.name, "default": str(dflt), "kind": f.kind,
+                "section": f.section, "description": f.description,
+                "folds": f.folds_label,
+                "key_note": f.key_neutral or
+                            f"folds into: {f.folds_label}",
+            })
+        return rows
+
+
+def _fused_hist_parse(raw: str) -> str:
+    """Normalize ``ALINK_TPU_FUSED_HIST``: falsy -> "off"; "pallas" ->
+    "pallas" (backend gating — TPU or interpret mode — stays with
+    ``operator/common/tree/hist.fused_hist_mode``); any other truthy
+    value -> "xla"."""
+    v = raw.strip().lower()
+    if v in _FALSY:
+        return "off"
+    if v == "pallas":
+        return "pallas"
+    return "xla"
+
+
+FLAGS = FlagRegistry()
+
+# -- observability ----------------------------------------------------------
+FLAGS.register(
+    "ALINK_TPU_METRICS", "bool", True,
+    "master switch for every MetricsRegistry producer (comqueue, "
+    "collectives, batch ops, streams)", "observability",
+    key_neutral="host-side registry updates only; compiled HLO is "
+                "byte-identical on/off (tests/test_metrics.py)",
+    accessor="alink_tpu.common.metrics.metrics_enabled")
+FLAGS.register(
+    "ALINK_TPU_STEP_LOG", "bool", False,
+    "per-superstep jax.debug.print from inside compiled programs",
+    "observability",
+    folds_into=frozenset({PROGRAM_CACHE}),
+    accessor="alink_tpu.common.profiling.step_log_enabled")
+FLAGS.register(
+    "ALINK_TPU_TRACE", "bool", False,
+    "structured span tracer (flight recorder) + lazy XLA cost analysis",
+    "observability",
+    key_neutral="host-side span recording and a lazy post-hoc lowering; "
+                "lowered HLO byte-identical on/off (tests/test_tracing.py)",
+    accessor="alink_tpu.common.tracing.tracing_enabled")
+FLAGS.register(
+    "ALINK_TPU_TRACE_BUFFER", "int", 65536,
+    "flight-recorder capacity in events", "observability",
+    key_neutral="sizes the host-side ring buffer; never read at trace time",
+    clamp=lambda n: max(1, n), tolerant=True,
+    accessor="alink_tpu.common.tracing._buffer_capacity")
+FLAGS.register(
+    "ALINK_TPU_HEALTH", "bool", True,
+    "in-program training-health probe channel (stacked carry series)",
+    "observability",
+    folds_into=frozenset({PROGRAM_CACHE, CHECKPOINT_SIGNATURE}),
+    accessor="alink_tpu.common.health.health_enabled")
+
+# -- performance ------------------------------------------------------------
+FLAGS.register(
+    "ALINK_TPU_DONATE", "bool", True,
+    "buffer donation of the engine chunk-loop carry and the FTRL (z, n) "
+    "state into compiled programs", "performance",
+    folds_into=frozenset({PROGRAM_CACHE, STEP_LRU}),
+    accessor="alink_tpu.engine.comqueue.donation_enabled")
+FLAGS.register(
+    "ALINK_TPU_STREAM_PREFETCH", "int", 2,
+    "stream prefetch channel depth; 0 disables (inline iteration)",
+    "performance",
+    key_neutral="host pipelining only; FIFO order is preserved exactly "
+                "(tests/test_stream.py)",
+    clamp=lambda n: max(0, n),
+    accessor="alink_tpu.operator.stream.prefetch.prefetch_depth")
+FLAGS.register(
+    "ALINK_TPU_STREAM_WORKERS", "int", 1,
+    "width of the ordered stream encode pool (prefetch_map)",
+    "performance",
+    key_neutral="ordered pool with serial upstream; drain results are "
+                "byte-identical to workers=1 (tests/test_overlap.py)",
+    clamp=lambda n: max(1, n),
+    accessor="alink_tpu.operator.stream.prefetch.stream_workers")
+FLAGS.register(
+    "ALINK_TPU_FB_ONEHOT_BYTES", "float", 6e9,
+    "HBM budget for precomputing field-block one-hot design factors "
+    "(<= 0 disables)", "performance",
+    key_neutral="toggling the precompute changes the partitioned-input "
+                "NAME SET, which already rides the program-cache key")
+FLAGS.register(
+    "ALINK_TPU_FUSED_HIST", "mode", "off",
+    "fused tree-histogram kernel: off | xla | pallas", "performance",
+    folds_into=frozenset({PROGRAM_CACHE}),
+    parser=_fused_hist_parse,
+    accessor="alink_tpu.operator.common.tree.hist.fused_hist_mode")
+FLAGS.register(
+    "ALINK_TPU_PALLAS_INTERPRET", "bool", False,
+    "run Pallas kernels in interpret mode off-TPU (tests/CI)",
+    "performance",
+    key_neutral="only shifts the RESOLVED fused-hist mode, and the "
+                "resolved mode is what folds into the program-cache key")
+
+# -- durability -------------------------------------------------------------
+FLAGS.register(
+    "ALINK_TPU_ASYNC_SNAPSHOT", "bool", True,
+    "background checkpoint writer (off = strictly synchronous path)",
+    "durability",
+    key_neutral="on-disk artifacts and kill-and-resume results are "
+                "bitwise-identical to the sync path (tests/test_overlap.py)",
+    accessor="alink_tpu.engine.recovery.async_snapshot_enabled")
+FLAGS.register(
+    "ALINK_TPU_FAULT_INJECT", "str", "",
+    "deterministic kill injection at durability sites "
+    "(site:index[;site:index...] spec)", "durability",
+    key_neutral="host-side raise at superstep/batch/save boundaries; "
+                "never enters a traced program",
+    accessor="alink_tpu.common.faults.fault_spec")
+
+# -- debug ------------------------------------------------------------------
+FLAGS.register(
+    "ALINK_VERIFY_PROGRAM_CACHE", "bool", False,
+    "program-cache debug guard: re-trace on every hit and compare jaxprs",
+    "debug",
+    key_neutral="debug-only guard; bypasses the stage-digest memo and "
+                "re-traces on hits — strictly more conservative than off")
+FLAGS.register(
+    "ALINK_NO_NATIVE", "bool", False,
+    "disable the ctypes native helper library (pure-Python fallbacks)",
+    "debug",
+    key_neutral="selects host-side ctypes vs numpy implementations; no "
+                "compiled XLA program involved")
+
+# -- io ---------------------------------------------------------------------
+FLAGS.register(
+    "ALINK_DIRECT_READER_POLICY", "str", "memory",
+    "DirectReader bridge policy: memory | db (the generic "
+    "ALINK_<PROPERTY> env fallback of DirectReaderPropertiesStore)", "io",
+    key_neutral="host-side IO bridge selection; unreachable from any "
+                "program/step factory")
+
+# -- bench knobs (read by bench.py, outside the analyzed package) -----------
+FLAGS.register(
+    "ALINK_TPU_DISKBENCH_ROWS", "int", 1000000,
+    "row count for the from-disk ingest benchmark", "bench",
+    key_neutral="bench workload sizing; read only by bench.py")
+FLAGS.register(
+    "ALINK_TPU_DISK_COMMIT", "bool", True,
+    "commit parsed disk shards to device during pipelined ingest "
+    "(0 restores the host-array path)", "bench",
+    key_neutral="changes where parsed shards land (host vs device), not "
+                "any compiled program; parity asserted by the bench row")
+FLAGS.register(
+    "ALINK_TPU_DISK_GROUPS", "int", 4,
+    "async device-transfer groups for the from-disk ingest leg", "bench",
+    key_neutral="host-side transfer batching only",
+    clamp=lambda n: max(1, n))
+FLAGS.register(
+    "ALINK_TPU_REPIN_BASELINE", "bool", False,
+    "re-measure the pinned compiled CPU baseline (BASELINE_compiled.json)",
+    "bench",
+    key_neutral="bench provenance control; read only by bench.py")
+FLAGS.register(
+    "ALINK_TPU_GBDT_LARGE_ROWS", "int", 488420,
+    "row count for the gbdt_adult_large roofline row", "bench",
+    key_neutral="bench workload sizing; read only by bench.py")
+FLAGS.register(
+    "ALINK_TPU_GBDT_LARGE_HIST", "mode", "xla",
+    "fused-hist mode forced for the large GBDT roofline row", "bench",
+    key_neutral="bench sets ALINK_TPU_FUSED_HIST from it, and THAT flag "
+                "folds into the program-cache key",
+    parser=_fused_hist_parse)
+FLAGS.register(
+    "ALINK_TPU_ALS_LARGE_NNZ", "int", 10000000,
+    "ratings count for the als_movielens_large roofline row", "bench",
+    key_neutral="bench workload sizing; read only by bench.py")
+
+
+def flag_value(name: str, default: Any = _UNSET) -> Any:
+    """Module-level convenience for :meth:`FlagRegistry.value`."""
+    return FLAGS.value(name, default)
+
+
+def flag_raw(name: str) -> Optional[str]:
+    """Module-level convenience for :meth:`FlagRegistry.raw`."""
+    return FLAGS.raw(name)

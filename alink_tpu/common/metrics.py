@@ -49,18 +49,13 @@ __all__ = [
     "env_flag", "DEFAULT_BUCKETS",
 ]
 
-_FALSY = frozenset({"", "0", "false", "off", "no"})
-
-
-def env_flag(name: str, default: bool = False) -> bool:
-    """Boolean env flag: unset -> ``default``; ``0/false/off/no`` (any
-    case) -> False; anything else -> True. The one parser every
-    ``ALINK_TPU_*`` on/off switch goes through, so "``=0`` disables"
-    holds everywhere (it did not for ``ALINK_TPU_STEP_LOG``)."""
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    return v.strip().lower() not in _FALSY
+# the one boolean parser every ``ALINK_TPU_*`` on/off switch goes
+# through, so "``=0`` disables" holds everywhere (it did not for
+# ``ALINK_TPU_STEP_LOG``). The implementation — and the declarative
+# registry of every flag with its cache-key fold metadata — lives in
+# ``common/flags.py``; re-exported here because this module is the
+# historical import point for every instrumented producer.
+from .flags import _FALSY, env_flag  # noqa: F401  (re-export)
 
 
 def metrics_enabled() -> bool:

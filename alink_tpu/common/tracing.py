@@ -80,14 +80,11 @@ def tracing_enabled() -> bool:
 
 
 def _buffer_capacity() -> int:
-    raw = os.environ.get(TRACE_BUFFER_ENV)
-    if not raw:
-        return DEFAULT_BUFFER_EVENTS
-    try:
-        n = int(raw)
-    except ValueError:
-        return DEFAULT_BUFFER_EVENTS
-    return max(1, n)
+    # registry-declared (common/flags.py): tolerant int parse, clamped
+    # to >= 1, default DEFAULT_BUFFER_EVENTS — exactly the historical
+    # semantics, now shared with the generated docs table
+    from .flags import flag_value
+    return flag_value(TRACE_BUFFER_ENV, DEFAULT_BUFFER_EVENTS)
 
 
 # The current span rides in a ContextVar, NOT a thread-local: nesting must

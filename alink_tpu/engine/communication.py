@@ -82,6 +82,82 @@ def record_collective(kind: str, name: str, per_worker_bytes: int,
         reg.inc("alink_collective_logical_bytes_total", logical, lbl)
 
 
+def record_manifest(manifest: Sequence[CollectiveRecord],
+                    times: int = 1) -> None:
+    """Charge a memoized trace-time manifest to the metrics registry.
+
+    Collectives record at TRACE time, so inside a jit-cached program the
+    records fire once per COMPILE, not once per call. The engine fixes
+    this for comqueue programs by multiplying the per-superstep manifest
+    by the executed superstep count; callers that invoke cached programs
+    outside the engine (the FTRL drain loop) capture the program's
+    manifest once (:func:`collecting` around an AOT ``.lower``) and
+    replay it here per invocation, so ``alink_collective_calls_total``
+    counts executed micro-batches rather than compiles."""
+    if not manifest or not metrics_enabled():
+        return
+    reg = get_registry()
+    for kind, _name, logical in manifest:
+        lbl = {"collective": kind}
+        reg.inc("alink_collective_calls_total", times, lbl)
+        reg.inc("alink_collective_logical_bytes_total",
+                int(logical) * int(times), lbl)
+
+
+# -- manifest-recording raw-collective wrappers -----------------------------
+# The collective manifest only saw traffic routed through the stage
+# classes above (and ctx.all_reduce_sum); raw ``lax.psum``/... calls in
+# operator code ran real inter-chip traffic the accounting, the scaling
+# evidence, and the planned ROADMAP-item-1 psum fusion could not see.
+# These wrappers are the sanctioned call form outside this module — the
+# alink-lint COLLECTIVE-SITE rule rejects raw ``lax`` collectives
+# anywhere else. Each wrapper records at TRACE time (once per traced
+# call site — a site inside a scan body records once per trace, and the
+# engine multiplies per-superstep manifests by the executed superstep
+# count; loops that drive jit-cached programs outside the engine replay
+# the captured manifest per invocation via record_manifest) and lowers
+# to exactly the raw ``lax`` op: zero HLO change.
+
+def manifest_psum(x, axis_name, *, name: str = "<psum>",
+                  num_workers: int = 1):
+    """``lax.psum`` + manifest record (kind AllReduce)."""
+    record_collective("AllReduce", name, payload_nbytes(x), num_workers)
+    return jax.lax.psum(x, axis_name)
+
+
+def manifest_pmax(x, axis_name, *, name: str = "<pmax>",
+                  num_workers: int = 1):
+    """``lax.pmax`` + manifest record (kind AllReduce)."""
+    record_collective("AllReduce", name, payload_nbytes(x), num_workers)
+    return jax.lax.pmax(x, axis_name)
+
+
+def manifest_pmin(x, axis_name, *, name: str = "<pmin>",
+                  num_workers: int = 1):
+    """``lax.pmin`` + manifest record (kind AllReduce)."""
+    record_collective("AllReduce", name, payload_nbytes(x), num_workers)
+    return jax.lax.pmin(x, axis_name)
+
+
+def manifest_all_gather(x, axis_name, *, axis: int = 0, tiled: bool = False,
+                        name: str = "<all_gather>", num_workers: int = 1):
+    """``lax.all_gather`` + manifest record (kind AllGather; bytes are
+    the pre-gather shard payload × workers, like the AllGather stage)."""
+    record_collective("AllGather", name, payload_nbytes(x), num_workers)
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def manifest_psum_scatter(x, axis_name, *, scatter_dimension: int = 0,
+                          tiled: bool = False,
+                          name: str = "<psum_scatter>",
+                          num_workers: int = 1):
+    """``lax.psum_scatter`` + manifest record (kind ReduceScatter)."""
+    record_collective("ReduceScatter", name, payload_nbytes(x), num_workers)
+    return jax.lax.psum_scatter(x, axis_name,
+                                scatter_dimension=scatter_dimension,
+                                tiled=tiled)
+
+
 class CommunicateFunction:
     """Marker base (reference comqueue/CommunicateFunction.java)."""
 

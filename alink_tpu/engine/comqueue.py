@@ -259,10 +259,13 @@ def _freeze_closure_value(v, depth):
             getattr(type(v), "__qualname__", type(v).__name__))
 
 
-# one-time flag for the devarray-in-closure warning below (module-level:
-# the silent-staleness class it flags is a process-wide modeling error,
-# and a warning per stage per exec would be noise)
-_DEVARRAY_CELL_WARNED = [False]
+# dedup keys for the devarray-in-closure warning below: one warning per
+# (stage, cell) pair — per-exec repeats would be noise, but a SECOND
+# offending stage (or a second cell of the same stage) is a distinct
+# bug and must not be muted by the first (the historical once-per-
+# process flag did exactly that). Runtime twin of the alink-lint
+# TRACED-CAPTURE rule, so the two diagnostics agree on name and unit.
+_DEVARRAY_CELL_WARNED: set = set()
 
 
 def _contains_devarray(v, depth=3) -> bool:
@@ -293,19 +296,26 @@ def _contains_devarray(v, depth=3) -> bool:
     return False
 
 
-def _warn_devarray_cell(fn_name: str, cell_name: str) -> None:
+def _warn_devarray_cell(fn_name: str, cell_name: str, key=None) -> None:
     """The structural cache guard tokenizes device arrays by shape/dtype
     ONLY (hashing content would round-trip device memory per exec), so a
     stage closure holding a jax.Array whose CONTENT changes between
     execs would silently re-run the stale cached program — the content
     is baked into the trace as a constant (ADVICE round 5,
-    comqueue.py:144). Warn ONCE per process: data belongs in
-    partitioned/broadcast inputs, not closures."""
-    if _DEVARRAY_CELL_WARNED[0]:
+    comqueue.py:144). Warn once per (stage, cell): data belongs in
+    partitioned/broadcast inputs, not closures. This is the runtime
+    twin of the static TRACED-CAPTURE rule (``python -m tools.lint``) —
+    same rule name, same per-(stage, cell) unit. ``key`` carries the
+    caller's dedup identity (module + qualname): two DISTINCT defs that
+    merely share a nested name like ``step`` are two distinct bugs and
+    must both warn."""
+    key = key or (fn_name, cell_name)
+    if key in _DEVARRAY_CELL_WARNED:
         return
-    _DEVARRAY_CELL_WARNED[0] = True
+    _DEVARRAY_CELL_WARNED.add(key)
     warnings.warn(
-        f"comqueue stage {fn_name!r}: closure variable {cell_name!r} "
+        f"TRACED-CAPTURE: comqueue stage {fn_name!r}: closure variable "
+        f"{cell_name!r} "
         f"captures a device array (jax.Array). The program cache "
         f"tokenizes device arrays by shape/dtype only, so if its CONTENT "
         f"changes between execs a stale compiled program would be reused "
@@ -367,7 +377,10 @@ def _callable_digest(fn, depth=4):
                 cells.append((name, ("opaque", "unbound_cell")))
                 continue
             if _contains_devarray(v):
-                _warn_devarray_cell(code.co_name, name)
+                _warn_devarray_cell(
+                    code.co_name, name,
+                    key=(getattr(fn, "__module__", ""),
+                         getattr(fn, "__qualname__", code.co_name), name))
             cells.append((name, _freeze_closure_value(v, depth)))
     return (code.co_name, h.hexdigest(), tuple(cells), defaults)
 

@@ -45,7 +45,11 @@ def _build() -> Optional[str]:
 def get_lib() -> Optional[ctypes.CDLL]:
     """The loaded native library, or None when unavailable/disabled."""
     global _lib, _tried
-    if os.environ.get("ALINK_NO_NATIVE"):
+    # registry-declared boolean (common/flags.py): ALINK_NO_NATIVE=0
+    # now means "native allowed" like every other ALINK_* boolean (the
+    # old raw-truthiness read treated "0" as disable)
+    from ..common.flags import env_flag
+    if env_flag("ALINK_NO_NATIVE"):
         return None
     with _lock:
         if _tried:

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 
 from ....common.mlenv import MLEnvironment, MLEnvironmentFactory
 from ....engine import AllReduce, IterativeComQueue
+from ....engine.communication import manifest_all_gather
 
 
 def kmeans_plus_plus_init(X: np.ndarray, k: int, seed: int,
@@ -145,8 +146,10 @@ def kmeans_parallel_init(X: np.ndarray, k: int, seed: int = 0,
         keys = jnp.where(d2 > 0, jnp.log(jnp.maximum(d2, 1e-30)) + g, -jnp.inf)
         kv, ki = jax.lax.top_k(keys, l_loc)
         pts = Xb[ki]                                        # (l_loc, d)
-        gk = jax.lax.all_gather(kv, ctx.AXIS).reshape(-1)   # (nw*l_loc,)
-        gp = jax.lax.all_gather(pts, ctx.AXIS).reshape(-1, d)
+        gk = manifest_all_gather(kv, ctx.AXIS, name="kmpp_keys",
+                                 num_workers=ctx.num_task).reshape(-1)
+        gp = manifest_all_gather(pts, ctx.AXIS, name="kmpp_cands",
+                                 num_workers=ctx.num_task).reshape(-1, d)
         gv, gi = jax.lax.top_k(gk, l_glob)
         sel = gp[gi]
         valid = jnp.isfinite(gv)

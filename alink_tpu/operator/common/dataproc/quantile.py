@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from ....common.mlenv import MLEnvironment
 from ....engine import IterativeComQueue
+from ....engine.communication import manifest_pmax, manifest_pmin
 
 # n*F at or above this: quantile/bin on device (one sharded pass) instead of
 # per-column host numpy — shared by tree binning (tree/hist.py) and
@@ -55,8 +56,10 @@ def distributed_quantiles(X: np.ndarray, probs: np.ndarray,
         valid = (msk[:, None] > 0) & ~jnp.isnan(Xb)
         big = jnp.where(valid, Xb, -jnp.inf).max(0)
         small = jnp.where(valid, Xb, jnp.inf).min(0)
-        mx = jax.lax.pmax(big, ctx.AXIS)
-        mn = jax.lax.pmin(small, ctx.AXIS)
+        mx = manifest_pmax(big, ctx.AXIS, name="quantile_max",
+                           num_workers=ctx.num_task)
+        mn = manifest_pmin(small, ctx.AXIS, name="quantile_min",
+                           num_workers=ctx.num_task)
         span = jnp.maximum(mx - mn, 1e-300)
         b = jnp.clip(((Xb - mn) / span * fine_bins).astype(jnp.int32),
                      0, fine_bins - 1)

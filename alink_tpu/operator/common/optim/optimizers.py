@@ -453,7 +453,6 @@ def _fb_precompute_ok(obj, data) -> bool:
     are data-constant, so building them once and reusing them across every
     pass and iteration saves a write+read of the full one-hot per pass
     (Criteo-shape superstep ~15 ms -> ~8 ms on v5e)."""
-    import os
     meta = getattr(obj, "fb_meta", None)
     if meta is None or "fb_idx" not in data:
         return False
@@ -461,7 +460,11 @@ def _fb_precompute_ok(obj, data) -> bool:
         # the factors are built committed to this process's device; the
         # global-mesh jit cannot auto-reshard host-local committed arrays
         return False
-    budget = float(os.environ.get("ALINK_TPU_FB_ONEHOT_BYTES", 6e9))
+    # registry-declared (common/flags.py): key-neutral because toggling
+    # the precompute changes the partitioned-input NAME SET, which
+    # already rides the program-cache key
+    from ....common.flags import flag_value
+    budget = float(flag_value("ALINK_TPU_FB_ONEHOT_BYTES"))
     if budget <= 0:
         return False
     from ....ops.fieldblock import LO, _default_dtype

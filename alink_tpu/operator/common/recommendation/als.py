@@ -53,6 +53,8 @@ import jax.numpy as jnp
 
 from ....common.mlenv import MLEnvironment, MLEnvironmentFactory
 from ....engine import IterativeComQueue
+from ....engine.communication import (manifest_all_gather, manifest_psum,
+                                      manifest_psum_scatter)
 from ....ops.smallsolve import batched_spd_solve
 
 
@@ -236,14 +238,17 @@ def als_train(users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
             # the summed equations (the replicated-buffer escape hatch,
             # docs/parallelism.md); the solve below then runs on U/nw ids
             # per chip and only the solved factors are re-replicated.
-            A = jax.lax.psum_scatter(A, "d", scatter_dimension=0, tiled=True)
-            b = jax.lax.psum_scatter(b, "d", scatter_dimension=0, tiled=True)
-            cnt = jax.lax.psum_scatter(cnt, "d", scatter_dimension=0,
-                                       tiled=True)
+            A = manifest_psum_scatter(A, "d", scatter_dimension=0, tiled=True,
+                                      name="als_eq_A", num_workers=nw)
+            b = manifest_psum_scatter(b, "d", scatter_dimension=0, tiled=True,
+                                      name="als_eq_b", num_workers=nw)
+            cnt = manifest_psum_scatter(cnt, "d", scatter_dimension=0,
+                                        tiled=True, name="als_eq_cnt",
+                                        num_workers=nw)
         else:
-            A = jax.lax.psum(A, "d")
-            b = jax.lax.psum(b, "d")
-            cnt = jax.lax.psum(cnt, "d")
+            A = manifest_psum(A, "d", name="als_eq_A", num_workers=nw)
+            b = manifest_psum(b, "d", name="als_eq_b", num_workers=nw)
+            cnt = manifest_psum(cnt, "d", name="als_eq_cnt", num_workers=nw)
         A = A[:, unpack].reshape(A.shape[0], rank, rank)      # symmetrize
         A = A + lam * jnp.maximum(cnt, 1.0)[:, None, None] * eye
         # batched unrolled Gauss-Jordan: jnp.linalg.solve's batched LU
@@ -255,7 +260,9 @@ def als_train(users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
         if p.shard_solve:
             # factor all-gather (the north-star collective): every worker
             # needs the full matrix for the next half-sweep's gathers
-            sol = jax.lax.all_gather(sol, "d", axis=0, tiled=True)[:n_rows]
+            sol = manifest_all_gather(sol, "d", axis=0, tiled=True,
+                                      name="als_factors",
+                                      num_workers=nw)[:n_rows]
         return sol
 
     def step(ctx):
@@ -285,7 +292,8 @@ def als_train(users: np.ndarray, items: np.ndarray, ratings: np.ndarray,
         pred = (uf[bidsU[:, 0]] * if_[bidsU[:, 1]]).sum(-1)
         r = brwU[:, 0]
         w = brwU[:, 1]
-        se = jax.lax.psum(jnp.stack([(w * (pred - r) ** 2).sum(), w.sum()]), "d")
+        se = manifest_psum(jnp.stack([(w * (pred - r) ** 2).sum(), w.sum()]),
+                           "d", name="als_rmse", num_workers=nw)
         rmse = jnp.sqrt(se[0] / jnp.maximum(se[1], 1e-12)).astype(jnp.float32)
         ctx.put_obj("rmse_curve", jax.lax.dynamic_update_index_in_dim(
             ctx.get_obj("rmse_curve"), rmse, ctx.step_no - 1, 0))

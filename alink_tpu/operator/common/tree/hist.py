@@ -34,6 +34,7 @@ import jax.numpy as jnp
 # host-side quantile binning
 # ---------------------------------------------------------------------------
 
+from ....engine.communication import manifest_psum
 from ..dataproc.quantile import DEVICE_BINNING_MIN_CELLS as _DEVICE_BINNING_MIN_CELLS
 
 
@@ -214,7 +215,6 @@ def level_hist(binned, stats, node_id, n_nodes: int, n_bins: int,
 # collective set (one psum per level, after the histogram) is identical
 # in every mode.
 
-import os as _os
 import warnings as _warnings
 
 FUSED_HIST_ENV = "ALINK_TPU_FUSED_HIST"
@@ -227,16 +227,17 @@ def fused_hist_mode() -> str:
     ``ALINK_TPU_FUSED_HIST`` values: 0/off/false -> "off"; "pallas" ->
     the Pallas kernel when the backend can run it (TPU, or any backend
     with ``ALINK_TPU_PALLAS_INTERPRET=1``), else "xla"; anything truthy
-    else -> "xla"."""
-    v = _os.environ.get(FUSED_HIST_ENV, "0").strip().lower()
-    if v in ("", "0", "off", "false", "no"):
-        return "off"
-    if v == "pallas":
-        if (jax.default_backend() == "tpu"
-                or _os.environ.get("ALINK_TPU_PALLAS_INTERPRET")):
-            return "pallas"
+    else -> "xla". The raw value parses through the flag registry
+    (common/flags.py — which also declares the program-cache-key fold);
+    only the backend gating lives here. The RESOLVED mode is what the
+    tree trainers fold into their program keys, so the interpret flag
+    needs no fold of its own."""
+    from ....common.flags import env_flag, flag_value
+    v = flag_value(FUSED_HIST_ENV)
+    if v == "pallas" and not (jax.default_backend() == "tpu"
+                              or env_flag("ALINK_TPU_PALLAS_INTERPRET")):
         return "xla"
-    return "xla"
+    return v
 
 
 def _fused_hist_precompute(binned, stats, n_bins: int, onehot_dtype=None):
@@ -389,7 +390,7 @@ def _default_cat_order(hist):
 def build_tree(binned, stats, max_depth: int, n_bins: int,
                gain_fn, leaf_fn, min_samples_leaf: float = 1.0,
                min_gain: float = 1e-9, feature_mask=None, axis_name=None,
-               cat_feats=None, cat_order_fn=None):
+               cat_feats=None, cat_order_fn=None, num_workers: int = 1):
     """Grow one tree; returns
     (features, split_bins, split_masks, leaf_values, node_id, leaf_hist,
      importance).
@@ -446,7 +447,8 @@ def build_tree(binned, stats, max_depth: int, n_bins: int,
             hist = level_hist(binned, stats, node_id, n_nodes, n_bins,
                               use_onehot)
         if axis_name is not None:
-            hist = jax.lax.psum(hist, axis_name)
+            hist = manifest_psum(hist, axis_name, name="tree_hist",
+                                 num_workers=num_workers)
         cum = jnp.cumsum(hist, axis=2)
         total = cum[:, :, -1:, :]
         left = cum[:, :, :-1, :]                      # split "bin <= b"
@@ -499,7 +501,8 @@ def build_tree(binned, stats, max_depth: int, n_bins: int,
     n_leaves = 1 << max_depth
     leaf_hist = jnp.zeros((n_leaves, m), dt).at[node_id].add(stats)
     if axis_name is not None:
-        leaf_hist = jax.lax.psum(leaf_hist, axis_name)
+        leaf_hist = manifest_psum(leaf_hist, axis_name, name="tree_leaf_hist",
+                                  num_workers=num_workers)
     features = jnp.concatenate(feats_out)
     split_bins = jnp.concatenate(bins_out)
     split_masks = jnp.concatenate(masks_out, axis=0)

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 from ....common.mlenv import MLEnvironment, MLEnvironmentFactory
 from ....engine import IterativeComQueue
+from ....engine.communication import manifest_psum
 from .hist import (bin_data, build_tree, fused_hist_mode, gini_gain,
                    gini_leaf, make_bin_edges, make_xgb_gain, make_xgb_leaf,
                    tree_apply_binned, variance_gain, variance_leaf)
@@ -116,7 +117,7 @@ def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
         tf, tb, tm, tv, node_id, _, imp = build_tree(
             binned_l, stats, d, p.n_bins, gain_fn, leaf_fn,
             min_samples_leaf=float(p.min_samples_leaf), feature_mask=fmask,
-            axis_name="d", cat_feats=cat_mask,
+            axis_name="d", num_workers=ctx.num_task, cat_feats=cat_mask,
             cat_order_fn=lambda h_: jnp.where(
                 h_[..., 1] > 0, h_[..., 0] / (h_[..., 1] + p.reg_lambda),
                 jnp.inf))
@@ -131,7 +132,8 @@ def gbdt_train(X: np.ndarray, y: np.ndarray, p: TreeTrainParams,
             ctx.get_obj("trees_m"), tm, t, 0))
         ctx.put_obj("importance", ctx.get_obj("importance") + imp)
         ctx.put_obj("F", Fcur + p.learning_rate * tv[node_id].astype(dtype))
-        lw = jax.lax.psum(jnp.stack([loss, wl.sum()]), "d")
+        lw = manifest_psum(jnp.stack([loss, wl.sum()]), "d",
+                           name="gbdt_loss", num_workers=ctx.num_task)
         ctx.put_obj("loss_curve", jax.lax.dynamic_update_index_in_dim(
             ctx.get_obj("loss_curve"), lw[0] / jnp.maximum(lw[1], 1e-12), t, 0))
 
@@ -221,7 +223,7 @@ def forest_train(X: np.ndarray, y_stats: np.ndarray, p: TreeTrainParams,
         tf, tb, tm, tv, _, _, imp = build_tree(
             binned_l, stats, d, p.n_bins, gain_fn, leaf_fn,
             min_samples_leaf=float(p.min_samples_leaf), feature_mask=fmask,
-            axis_name=axis, cat_feats=cat_mask)
+            axis_name=axis, num_workers=ctx.num_task, cat_feats=cat_mask)
         t = ctx.step_no - 1
         ctx.put_obj("trees_f", jax.lax.dynamic_update_index_in_dim(
             ctx.get_obj("trees_f"), tf, t, 0))

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import functools
 import time
+import weakref
 from typing import List, Optional
 
 import numpy as np
@@ -88,6 +89,7 @@ def _ftrl_step_factory(mesh, alpha, beta, l1, l2, donate=False):
     import jax
     import jax.numpy as jnp
     from ....common.compat import shard_map
+    from ....engine.communication import manifest_psum
     from jax.sharding import PartitionSpec as P
 
     def weights(z, n):
@@ -98,7 +100,8 @@ def _ftrl_step_factory(mesh, alpha, beta, l1, l2, donate=False):
             z, n = carry
             x, yy = xy
             w = weights(z, n)
-            margin = jax.lax.psum(jnp.dot(x, w), "d")
+            margin = manifest_psum(jnp.dot(x, w), "d", name="ftrl_margin",
+                                   num_workers=mesh.size)
             p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margin, -35.0, 35.0)))
             g = (p - yy) * x
             sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / alpha
@@ -139,6 +142,7 @@ def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2, donate=False):
     import jax
     import jax.numpy as jnp
     from ....common.compat import shard_map
+    from ....engine.communication import manifest_psum
     from jax.sharding import PartitionSpec as P
 
     def weights(z, n):
@@ -191,7 +195,9 @@ def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2, donate=False):
                     nk = nk + jnp.matmul(
                         Mkj, dns[j], precision=jax.lax.Precision.HIGHEST)
                 wj = jnp.where(local[k], weights(zk, nk), 0.0)
-                margin = jax.lax.psum(jnp.sum(xv[k] * wj), "d")
+                margin = manifest_psum(jnp.sum(xv[k] * wj), "d",
+                                       name="ftrl_margin",
+                                       num_workers=mesh.size)
                 p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margin, -35.0, 35.0)))
                 g = (p - yy[k]) * xv[k]
                 sigma = (jnp.sqrt(nk + g * g) - jnp.sqrt(nk)) / alpha
@@ -255,6 +261,7 @@ def _ftrl_sparse_chained_step_factory(mesh, alpha, beta, l1, l2, K=16,
     import jax
     import jax.numpy as jnp
     from ....common.compat import shard_map
+    from ....engine.communication import manifest_psum
     from jax.sharding import PartitionSpec as P
 
     def weights(z, n):
@@ -293,7 +300,9 @@ def _ftrl_sparse_chained_step_factory(mesh, alpha, beta, l1, l2, K=16,
                 zk = zs[k] + corr[:, 0]
                 nk = ns[k] + corr[:, 1]
                 wk = jnp.where(local[k], weights(zk, nk), 0.0)
-                margin = jax.lax.psum(jnp.sum(xv[k] * wk), "d")
+                margin = manifest_psum(jnp.sum(xv[k] * wk), "d",
+                                       name="ftrl_margin",
+                                       num_workers=mesh.size)
                 p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margin, -35.0, 35.0)))
                 g = (p - yy[k]) * xv[k]
                 sigma = (jnp.sqrt(nk + g * g) - jnp.sqrt(nk)) / alpha
@@ -345,6 +354,7 @@ def _ftrl_sparse_staleness_step_factory(mesh, alpha, beta, l1, l2, K,
     import jax
     import jax.numpy as jnp
     from ....common.compat import shard_map
+    from ....engine.communication import manifest_psum
     from jax.sharding import PartitionSpec as P
 
     def weights(z, n):
@@ -370,7 +380,9 @@ def _ftrl_sparse_staleness_step_factory(mesh, alpha, beta, l1, l2, K,
             zj = jnp.where(local, s[..., 0], 0.0)
             nj = jnp.where(local, s[..., 1], 0.0)
             wj = jnp.where(local, weights(zj, nj), 0.0)
-            margins = jax.lax.psum((xv * wj).sum(-1), "d")
+            margins = manifest_psum((xv * wj).sum(-1), "d",
+                                    name="ftrl_margins",
+                                    num_workers=mesh.size)
             p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margins, -35.0, 35.0)))
             g = (p - yy)[:, None] * xv
             sigma = (jnp.sqrt(nj + g * g) - jnp.sqrt(nj)) / alpha
@@ -417,6 +429,7 @@ def _ftrl_sparse_batch_step_factory(mesh, alpha, beta, l1, l2,
     import jax
     import jax.numpy as jnp
     from ....common.compat import shard_map
+    from ....engine.communication import manifest_psum
     from jax.sharding import PartitionSpec as P
 
     def weights(z, n):
@@ -430,7 +443,9 @@ def _ftrl_sparse_batch_step_factory(mesh, alpha, beta, l1, l2,
         zj = jnp.where(local, z[li], 0.0)
         nj = jnp.where(local, n[li], 0.0)
         wj = jnp.where(local, weights(zj, nj), 0.0)
-        margins = jax.lax.psum((val * wj).sum(-1), "d")
+        margins = manifest_psum((val * wj).sum(-1), "d",
+                                name="ftrl_margins",
+                                num_workers=mesh.size)
         p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margins, -35.0, 35.0)))
         g = (p - y)[:, None] * val
         sigma = (jnp.sqrt(nj + g * g) - jnp.sqrt(nj)) / alpha
@@ -472,6 +487,7 @@ def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2,
     import jax
     import jax.numpy as jnp
     from ....common.compat import shard_map
+    from ....engine.communication import manifest_psum
     from jax.sharding import PartitionSpec as P
 
     from ....ops.fieldblock import FieldBlockMeta, fb_gather, fb_rmatvec
@@ -502,7 +518,9 @@ def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2,
         wj = fb_gather(idx_l, w, local_meta)
         # margins from the exact f32 per-slot gather — a separate fb_matvec
         # would redo the same one-hot pass with bf16 operand rounding
-        margins = jax.lax.psum((val_l * wj).sum(-1), "d")
+        margins = manifest_psum((val_l * wj).sum(-1), "d",
+                                name="ftrl_margins",
+                                num_workers=mesh.size)
         p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margins, -35.0, 35.0)))
         g = (p - y)[:, None] * val_l                        # (B, F_loc)
         sigma = (jnp.sqrt(nj + g * g) - jnp.sqrt(nj)) / alpha
@@ -561,6 +579,43 @@ def _pv_stats_fn():
     return jax.jit(stats)
 
 
+# Trace-time collective manifests, memoized per (step program, arg-shape
+# signature). The step programs are jit/lru-cached, so their
+# manifest_psum records fire once per COMPILE — without a replay, a
+# 10k-batch drain charges its margin AllReduce to the metrics registry
+# exactly once. Each program's manifest is captured from an AOT
+# ``.lower`` trace (no execution, so no donated-buffer hazard) and the
+# drain loop replays it per micro-batch via record_manifest. Weak keys:
+# a program evicted from its factory's lru drops its memo row too.
+_STEP_MANIFESTS = weakref.WeakKeyDictionary()
+
+
+def _step_manifest(step, args):
+    try:
+        per = _STEP_MANIFESTS.setdefault(step, {})
+    except TypeError:          # unweakrefable program object: skip the
+        return ()              # accounting rather than leak a strong ref
+    sig = tuple((getattr(a, "shape", None), str(getattr(a, "dtype", "")))
+                for a in args)
+    man = per.get(sig)
+    if man is None:
+        from ....engine.communication import collecting
+        cap = []
+        try:
+            with collecting(cap):
+                step.lower(*args)
+        except Exception as e:  # accounting must never break training —
+            cap = []            # but a muted metric must not be silent:
+            import warnings     # the empty manifest is memoized for good
+            warnings.warn(
+                f"FTRL collective accounting disabled for this step "
+                f"program (AOT lower failed: {e!r}); "
+                f"alink_collective_calls_total will under-count this "
+                f"drain", RuntimeWarning, stacklevel=2)
+        man = per[sig] = tuple(cap)
+    return man
+
+
 @functools.lru_cache(maxsize=64)
 def _ftrl_dense_batch_step_factory(mesh, alpha, beta, l1, l2,
                                    donate=False):
@@ -569,6 +624,7 @@ def _ftrl_dense_batch_step_factory(mesh, alpha, beta, l1, l2,
     import jax
     import jax.numpy as jnp
     from ....common.compat import shard_map
+    from ....engine.communication import manifest_psum
     from jax.sharding import PartitionSpec as P
 
     def weights(z, n):
@@ -576,7 +632,8 @@ def _ftrl_dense_batch_step_factory(mesh, alpha, beta, l1, l2,
 
     def shard_fn(X, y, z, n):
         w = weights(z, n)
-        margins = jax.lax.psum(X @ w, "d")
+        margins = manifest_psum(X @ w, "d", name="ftrl_margins",
+                                num_workers=mesh.size)
         p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margins, -35.0, 35.0)))
         g = (p - y)[:, None] * X                       # (B, shard)
         sigma = (jnp.sqrt(n[None, :] + g * g) - jnp.sqrt(n[None, :])) / alpha
@@ -1105,6 +1162,20 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
             mx = metrics_enabled()
             reg = get_registry() if mx else None
             m_lbl = {"op": "FtrlTrainStreamOp", "mode": update_mode}
+
+            def run_step(step, *args):
+                # per-micro-batch collective accounting (the programs
+                # are jit-cached; see _step_manifest). The execution is
+                # wrapped in a throwaway collector so a compile-time
+                # trace doesn't ALSO record directly — the replay is
+                # the single source of truth for this call.
+                if mx:
+                    from ....engine.communication import (collecting,
+                                                          record_manifest)
+                    record_manifest(_step_manifest(step, args))
+                    with collecting([]):
+                        return step(*args)
+                return step(*args)
             # ordered pool: workers=1 (default) is byte-for-byte the old
             # single-prefetch-thread drain; ALINK_TPU_STREAM_WORKERS=N
             # parallelizes the host encode N-wide with order preserved
@@ -1146,16 +1217,16 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                       mesh, meta, alpha, beta, l1, l2, fbv is not None,
                       donate=don)
                   if fbv is None:
-                      z, n, mg = step(fbi, y, z, n)
+                      z, n, mg = run_step(step, fbi, y, z, n)
                   else:
-                      z, n, mg = step(fbi, fbv, y, z, n)
+                      z, n, mg = run_step(step, fbi, fbv, y, z, n)
               elif enc[0] == "dense":
                   if layout is None:
                       layout = "std"
                       allow_fb[0] = False
                       z, n = alloc(layout)
                   _, X, y = enc
-                  z, n, mg = dense_step[0](X, y, z, n)
+                  z, n, mg = run_step(dense_step[0], X, y, z, n)
               else:
                   if layout is None:
                       layout = "std"
@@ -1181,7 +1252,7 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                       else:
                           sparse_step[0] = _ftrl_sparse_step_factory(
                               mesh, alpha, beta, l1, l2, donate=don)
-                  z, n, mg = sparse_step[0](idx, val, y, z, n)
+                  z, n, mg = run_step(sparse_step[0], idx, val, y, z, n)
               if mon_on:
                   # progressive validation on the device scalars; real
                   # rows only (padding rows would score as margin-0

@@ -44,7 +44,6 @@ visible in ``tools/run_report.py`` output.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
@@ -57,19 +56,19 @@ _SENTINEL = object()
 
 
 def prefetch_depth(default: int = 2) -> int:
-    v = os.environ.get("ALINK_TPU_STREAM_PREFETCH", "")
-    if v == "":
-        return default
-    return max(0, int(v))
+    """``ALINK_TPU_STREAM_PREFETCH`` via the flag registry
+    (common/flags.py): set-but-empty counts as unset, values clamp to
+    >= 0 — the historical semantics, one parser."""
+    from ...common.flags import flag_value
+    return flag_value("ALINK_TPU_STREAM_PREFETCH", default)
 
 
 def stream_workers(default: int = 1) -> int:
     """``ALINK_TPU_STREAM_WORKERS``: width of the :func:`prefetch_map`
-    encode pool. 1 (the default) is the exact single-thread behavior."""
-    v = os.environ.get("ALINK_TPU_STREAM_WORKERS", "")
-    if v == "":
-        return default
-    return max(1, int(v))
+    encode pool (registry-declared; clamps to >= 1). 1 (the default)
+    is the exact single-thread behavior."""
+    from ...common.flags import flag_value
+    return flag_value("ALINK_TPU_STREAM_WORKERS", default)
 
 
 class _Channel:

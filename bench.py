@@ -236,8 +236,9 @@ def pinned_ftrl_baseline(path: str = None):
                   f"measuring an in-memory baseline for this run and "
                   f"REFUSING to rewrite the file — restore it from git "
                   f"before the next capture", file=sys.stderr)
+    from alink_tpu.common.flags import env_flag as _env_flag
     rec = doc.get("rigs", {}).get(fp)
-    if rec is not None and not os.environ.get("ALINK_TPU_REPIN_BASELINE"):
+    if rec is not None and not _env_flag("ALINK_TPU_REPIN_BASELINE"):
         if rec.get("impl") == "numpy-interpreted" and _native_available():
             # the pin predates the native toolchain: dividing by the
             # ~30x-slower interpreted loop would inflate vs_baseline in
@@ -1251,7 +1252,8 @@ def bench_logreg_from_disk(h: Harness):
     from alink_tpu.operator.common.optim.optimizers import OptimParams, optimize
     from alink_tpu.ops.fieldblock import FieldBlockMeta
 
-    n_rows = int(os.environ.get("ALINK_TPU_DISKBENCH_ROWS", "1000000"))
+    from alink_tpu.common.flags import flag_value
+    n_rows = int(flag_value("ALINK_TPU_DISKBENCH_ROWS"))
     path = os.path.join(tempfile.gettempdir(),
                         f"alink_diskbench_{n_rows}_{N_FIELDS}.libsvm")
     fb_idx_true, y_true = make_ctr_fieldblock(n_rows, seed=42)
@@ -1322,11 +1324,15 @@ def bench_logreg_from_disk(h: Harness):
                 t3 = time.perf_counter()
             return (fb_i, lab), t1 - t0, t2 - t1, t3 - t2
 
-        commit = (os.environ.get("ALINK_TPU_DISK_COMMIT", "1") != "0"
+        from alink_tpu.common.flags import (env_flag as _env_flag,
+                                            flag_raw, flag_value)
+        commit = (_env_flag("ALINK_TPU_DISK_COMMIT", default=True)
                   and jax.process_count() == 1)
-        n_groups = max(1, int(os.environ.get("ALINK_TPU_DISK_GROUPS", "4")))
+        n_groups = int(flag_value("ALINK_TPU_DISK_GROUPS"))
         per_group = -(-n_shards // n_groups)
-        workers = int(os.environ.get("ALINK_TPU_STREAM_WORKERS", "0") or 0)
+        # bench-local contract (deliberately NOT the registry's >= 1
+        # clamp): unset/0 means auto-size to the core count
+        workers = int(flag_raw("ALINK_TPU_STREAM_WORKERS") or 0)
         if workers <= 0:
             workers = min(8, os.cpu_count() or 1)
         t0 = time.perf_counter()
@@ -1584,7 +1590,8 @@ def bench_gbdt_large(h: Harness):
     from alink_tpu.operator.common.tree.trainers import (TreeTrainParams,
                                                          gbdt_train)
 
-    n = int(os.environ.get("ALINK_TPU_GBDT_LARGE_ROWS", "488420"))
+    from alink_tpu.common.flags import flag_value
+    n = int(flag_value("ALINK_TPU_GBDT_LARGE_ROWS"))
     F, depth, n_bins = 14, 6, 64
     rng = np.random.RandomState(0)
     Xc = rng.randn(n, 6).astype(np.float32)
@@ -1594,11 +1601,11 @@ def bench_gbdt_large(h: Harness):
               - 0.6 * (Xd[:, 1] % 3) + 0.4 * Xc[:, 2])
     y = (margin + 0.3 * rng.randn(n) > 0).astype(np.float32)
     jrng = np.random.RandomState(5)
-    prev = os.environ.get(FUSED_HIST_ENV)
+    from alink_tpu.common.flags import flag_raw
+    prev = flag_raw(FUSED_HIST_ENV)
     # "pallas" on TPU backends that lower it; the XLA fused formulation
     # is the portable default
-    os.environ[FUSED_HIST_ENV] = os.environ.get(
-        "ALINK_TPU_GBDT_LARGE_HIST", "xla")
+    os.environ[FUSED_HIST_ENV] = str(flag_value("ALINK_TPU_GBDT_LARGE_HIST"))
     try:
         mode = fused_hist_mode()
 
@@ -1742,7 +1749,8 @@ def bench_als_large(h: Harness):
                                                               als_train)
 
     U, I, rank = 69_878, 10_677, 10          # MovieLens-10M shape
-    nnz = int(os.environ.get("ALINK_TPU_ALS_LARGE_NNZ", "10000000"))
+    from alink_tpu.common.flags import flag_value
+    nnz = int(flag_value("ALINK_TPU_ALS_LARGE_NNZ"))
     rng = np.random.RandomState(0)
     users = rng.randint(0, U, nnz).astype(np.int32)
     items = rng.randint(0, I, nnz).astype(np.int32)
@@ -1922,7 +1930,8 @@ def quick_from_disk(h: Harness):
     """The full logreg_from_disk pipeline (sharded read -> native parse
     -> fb encode -> train) on a small fixture: pipeline_vs_memory is the
     gate column the overlap work targets."""
-    prev = os.environ.get("ALINK_TPU_DISKBENCH_ROWS")
+    from alink_tpu.common.flags import flag_raw
+    prev = flag_raw("ALINK_TPU_DISKBENCH_ROWS")
     os.environ["ALINK_TPU_DISKBENCH_ROWS"] = prev or "30000"
     try:
         return bench_logreg_from_disk(h)
@@ -2038,7 +2047,8 @@ def quick_gbdt_hist(h: Harness):
     X = rng.randn(n, F).astype(np.float32)
     y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
     jrng = np.random.RandomState(5)
-    prev = os.environ.get(FUSED_HIST_ENV)
+    from alink_tpu.common.flags import flag_raw
+    prev = flag_raw(FUSED_HIST_ENV)
     os.environ[FUSED_HIST_ENV] = "xla"
     try:
         def run(n_trees):

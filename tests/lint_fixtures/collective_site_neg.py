@@ -1,0 +1,13 @@
+"""COLLECTIVE-SITE negative: collectives route through the
+manifest-recording wrappers (engine/communication.py), and a method
+merely NAMED psum on another object is not a collective."""
+from alink_tpu.engine.communication import manifest_psum
+
+
+def shard_fn(x, nw):
+    total = manifest_psum(x, "d", name="fixture", num_workers=nw)
+    return total
+
+
+def not_a_collective(accumulator, x):
+    return accumulator.psum(x)    # attribute psum NOT under lax

@@ -1,0 +1,21 @@
+"""COLLECTIVE-SITE positive: raw lax collectives outside the sanctioned
+communication module escape the collective manifest — under the plain
+spelling AND under import aliases."""
+import jax
+from jax import lax
+from jax import lax as jlax
+from jax.lax import ppermute as renamed_permute
+
+
+def shard_fn(x):
+    total = jax.lax.psum(x, "d")
+    gathered = lax.all_gather(x, "d")
+    return total, gathered
+
+
+def aliased(x):
+    # a module alias or a renamed function import is the same raw
+    # collective: it must not slip past the rule
+    m = jlax.pmax(x, "d")
+    p = renamed_permute(x, "d", [(0, 1)])
+    return m, p

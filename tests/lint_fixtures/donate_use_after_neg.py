@@ -1,0 +1,37 @@
+"""DONATE-USE-AFTER negative: the sanctioned idioms — donate-and-rebind
+in the same statement, and a fetch BEFORE the donating call."""
+import jax
+
+
+def _step_factory():
+    def fn(x, y, z):
+        return z + x * y
+
+    return jax.jit(fn, donate_argnums=(2,))
+
+
+def train_loop(xs, ys, z):
+    step = _step_factory()
+    for x, y in zip(xs, ys):
+        z = step(x, y, z)         # donated AND rebound: the idiom
+    return z
+
+
+def train_with_prefetch(x, y, z):
+    step = _step_factory()
+    before = z.sum()              # fetched before the donating call
+    z = step(x, y, z)
+    return z, before
+
+
+def train_loop_wrapped(xs, ys, z):
+    """Donate-and-rebind through a pass-through wrapper: still the
+    sanctioned idiom, not a finding."""
+    step = _step_factory()
+
+    def run_step(fn, *args):
+        return fn(*args)
+
+    for x, y in zip(xs, ys):
+        z = run_step(step, x, y, z)
+    return z

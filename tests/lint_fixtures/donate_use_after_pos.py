@@ -1,0 +1,31 @@
+"""DONATE-USE-AFTER positive: ``z`` is passed at a donate_argnums
+position and read again afterwards — the donated buffer is dead after
+the call ('Array has been deleted', or garbage on backends that skip
+the runtime check)."""
+import jax
+
+
+def _step_factory():
+    def fn(x, y, z):
+        return z + x * y
+
+    return jax.jit(fn, donate_argnums=(2,))
+
+
+def train(x, y, z):
+    step = _step_factory()
+    out = step(x, y, z)
+    return out + z.sum()          # read after donation: flagged
+
+
+def train_wrapped(x, y, z):
+    """Routing the step through a pass-through telemetry wrapper (the
+    FTRL drain's ``run_step`` shape) must not blind the rule: the
+    donated position shifts one right past the callable argument."""
+    step = _step_factory()
+
+    def run_step(fn, *args):
+        return fn(*args)
+
+    out = run_step(step, x, y, z)
+    return out + z.sum()          # read after wrapped donation: flagged

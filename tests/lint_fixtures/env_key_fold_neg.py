@@ -1,0 +1,13 @@
+"""ENV-KEY-FOLD negative: every env read reachable from the factory is
+either declared to fold into this factory's key dimension
+(ALINK_TPU_GOOD -> program_cache) or declared key-neutral
+(ALINK_TPU_NEUTRAL); constant-name indirection must resolve."""
+import os
+
+GOOD_ENV = "ALINK_TPU_GOOD"
+
+
+def make_program(stages):
+    folded = os.environ.get(GOOD_ENV)               # via module constant
+    neutral = os.environ.get("ALINK_TPU_NEUTRAL")   # key-neutral
+    return (stages, folded, neutral)

@@ -1,0 +1,19 @@
+"""ENV-KEY-FOLD positive: a program factory reads (a) a flag declared
+as folding only into step_lru — the wrong dimension for this factory —
+and (b) an undeclared flag, via a transitively-called helper."""
+import os
+
+UNDECLARED = "ALINK_TPU_UNDECLARED"
+
+
+def helper():
+    # undeclared flag, reached through the factory's call chain
+    return os.environ.get(UNDECLARED)
+
+
+def make_program(stages):
+    wrong_dim = os.environ.get("ALINK_TPU_BAD")     # declares step_lru only
+    extra = helper()
+    # os.getenv is the same read as os.environ.get and must not slip past
+    alt_spelling = os.getenv("ALINK_TPU_UNDECLARED_GETENV")
+    return (stages, wrong_dim, extra, alt_spelling)

@@ -1,0 +1,17 @@
+"""ENV-KEY-FOLD structural backstop: an lru_cache'd program factory
+nobody registered as a factory root. Reading a key-affecting flag from
+it must be flagged until the factory is registered with its key
+dimensions; a key-neutral read stays silent."""
+import functools
+import os
+
+
+@functools.lru_cache(maxsize=8)
+def _rogue_step_factory(mesh):
+    flip = os.environ.get("ALINK_TPU_GOOD")   # folds into program_cache
+    return (mesh, flip)
+
+
+@functools.lru_cache(maxsize=1)
+def _benign_cached_loader():
+    return os.environ.get("ALINK_TPU_NEUTRAL")   # key-neutral: fine

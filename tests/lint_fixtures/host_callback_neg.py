@@ -1,0 +1,11 @@
+"""HOST-CALLBACK-FREE negative: a compiled-path module with no host
+callbacks; plain host-side printing outside jax.debug is fine."""
+import jax.numpy as jnp
+
+
+def stage(ctx):
+    return jnp.sum(ctx)
+
+
+def report(result):
+    print("done", result)         # host code, not a jax callback

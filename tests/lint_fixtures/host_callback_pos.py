@@ -1,0 +1,16 @@
+"""HOST-CALLBACK-FREE positive: host callbacks inside a compiled-path
+module serialize the device on a host round trip — plain or aliased."""
+import jax
+from jax import debug as dbg
+from jax.experimental import io_callback
+
+
+def stage(ctx):
+    jax.debug.print("step {s}", s=ctx)
+    io_callback(print, None, ctx)
+    return ctx
+
+
+def stage_aliased(ctx):
+    dbg.print("aliased {s}", s=ctx)    # import alias, same callback
+    return ctx

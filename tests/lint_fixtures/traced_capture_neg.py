@@ -1,0 +1,19 @@
+"""TRACED-CAPTURE negative: the stage's only free names are an int
+constant (host scalar, hashable by the cache guard) and a dict that is
+never mutated after construction; the jitted fn captures nothing."""
+import jax
+
+SCALE = 4
+config = {"mode": "fast"}
+
+
+def stage(ctx):
+    return ctx * SCALE + len(config)
+
+
+def register(queue):
+    queue.add(stage)
+
+
+def make_step(fn):
+    return jax.jit(fn)

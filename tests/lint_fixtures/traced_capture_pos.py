@@ -1,0 +1,17 @@
+"""TRACED-CAPTURE positive: a comqueue stage captures (a) a module-level
+device array — content bakes into the trace, cache guard sees only
+shape/dtype — and (b) a mutable dict that the stage body itself mutates
+at trace time."""
+import jax.numpy as jnp
+
+dev = jnp.ones((3,))
+state = {}
+
+
+def stage(ctx):
+    state["calls"] = len(state)
+    return ctx + dev
+
+
+def register(queue):
+    queue.add(stage)

@@ -362,25 +362,54 @@ class TestProbeChannel:
         assert mon.series_names() == ["nonfinite.s", "step"]
         assert mon.healthy
 
-    def test_closure_devarray_warning_once(self, monkeypatch):
+    def test_closure_devarray_warning_per_stage_cell(self, monkeypatch):
         import jax.numpy as jnp
         import alink_tpu.engine.comqueue as cq
-        monkeypatch.setattr(cq, "_DEVARRAY_CELL_WARNED", [False])
+        monkeypatch.setattr(cq, "_DEVARRAY_CELL_WARNED", set())
         dev = jnp.ones((3,))
 
         def stage(ctx):
             ctx.put_obj("s", dev.sum())   # jax.Array baked via closure
 
+        # the warning names the lint rule AND the offending cell, so the
+        # runtime and static (tools/lint TRACED-CAPTURE) diagnostics agree
         with pytest.warns(RuntimeWarning,
-                          match="ALINK_VERIFY_PROGRAM_CACHE"):
+                          match=r"TRACED-CAPTURE.*'dev'"):
             cq._callable_digest(stage)
         import warnings as _w
         with _w.catch_warnings():
-            _w.simplefilter("error")      # second digest must NOT warn
+            _w.simplefilter("error")      # same (stage, cell): no repeat
             cq._callable_digest(stage)
+
+        def stage_b(ctx):                 # a SECOND offending stage is a
+            ctx.put_obj("t", dev * 2)     # distinct bug: it must warn too
+
+        with pytest.warns(RuntimeWarning, match="'stage_b'"):
+            cq._callable_digest(stage_b)
+
+        # two DISTINCT defs that share a nested name (the dominant
+        # `def step(ctx)` idiom) are two distinct bugs: dedup keys on
+        # module+qualname, not the bare code name, so both must warn
+        def factory_a():
+            def step(ctx):
+                ctx.put_obj("s", dev.sum())
+            return step
+
+        def factory_b():
+            def step(ctx):
+                ctx.put_obj("t", dev * 2)
+            return step
+
+        with pytest.warns(RuntimeWarning, match="'dev'"):
+            cq._callable_digest(factory_a())
+        with pytest.warns(RuntimeWarning, match="'dev'"):
+            cq._callable_digest(factory_b())
+        with _w.catch_warnings():
+            _w.simplefilter("error")      # same def re-instantiated: dedup
+            cq._callable_digest(factory_a())
         # host arrays and numpy scalars stay silent (np.float32 has a
         # () shape tuple + dtype but is host data, not a jax.Array)
-        monkeypatch.setattr(cq, "_DEVARRAY_CELL_WARNED", [False])
+        monkeypatch.setattr(cq, "_DEVARRAY_CELL_WARNED", set())
         host = np.ones((3,))
         tol = np.float32(1e-4)
 

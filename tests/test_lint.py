@@ -1,0 +1,563 @@
+"""alink-lint (tools/lint) + flag-registry (common/flags.py) tests.
+
+Three layers:
+
+1. **fixture self-tests** — one minimal positive and negative case per
+   rule under ``tests/lint_fixtures/`` (parsed, never imported), so each
+   rule's semantics are pinned independently of the real tree;
+2. **the tier-1 gate** — the analyzer runs over the whole ``alink_tpu``
+   package and must report ZERO non-baselined violations and no stale
+   baseline entries (exactly what ``python -m tools.lint --strict`` and
+   ``tools/perf_gate.sh`` enforce);
+3. **migration regression** — the registry migration must leave env-flag
+   semantics, program-cache keys and lowered HLO byte-identical to the
+   pre-migration ad-hoc parsers for a representative flag combination.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:                      # direct pytest invocation
+    sys.path.insert(0, REPO)
+
+from tools.lint.analyzer import (ModuleIndex, env_reads_in,      # noqa: E402
+                                 load_flag_registry, repo_root)
+from tools.lint.baseline import (Baseline, BaselineEntry,        # noqa: E402
+                                 BaselineError, load_baseline)
+from tools.lint.rules import (FactoryRoot, LintConfig,           # noqa: E402
+                              default_config, run_lint)
+from alink_tpu.common import flags as flagmod                    # noqa: E402
+from alink_tpu.common.flags import (FLAGS, Flag, FlagRegistry,   # noqa: E402
+                                    env_flag, flag_raw, flag_value,
+                                    parse_bool)
+
+FIXDIR = "tests/lint_fixtures"
+
+
+# ---------------------------------------------------------------------------
+# fixture harness
+# ---------------------------------------------------------------------------
+
+def _fixture_registry() -> FlagRegistry:
+    reg = FlagRegistry()
+    reg.register("ALINK_TPU_GOOD", "bool", False, "fixture flag", "debug",
+                 folds_into=frozenset({flagmod.PROGRAM_CACHE}))
+    reg.register("ALINK_TPU_NEUTRAL", "bool", False, "fixture flag", "debug",
+                 key_neutral="fixture: host-side only, never traced")
+    reg.register("ALINK_TPU_BAD", "bool", False, "fixture flag", "debug",
+                 folds_into=frozenset({flagmod.STEP_LRU}))
+    return reg
+
+
+def _fixture_config(*files: str, roots=(), allowed=(),
+                    compiled=()) -> LintConfig:
+    return LintConfig(
+        package_dirs=tuple(f"{FIXDIR}/{f}" for f in files),
+        factory_roots=tuple(roots),
+        collective_allowed=tuple(allowed),
+        compiled_path_globs=tuple(compiled),
+    )
+
+
+def _lint_fixture(files, **kw):
+    cfg = _fixture_config(*files, **kw)
+    index = ModuleIndex.build(REPO, cfg.package_dirs)
+    return run_lint(root=REPO, config=cfg, registry=_fixture_registry(),
+                    index=index)
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# per-rule fixture self-tests
+# ---------------------------------------------------------------------------
+
+class TestEnvKeyFoldFixtures:
+    ROOT = (FactoryRoot(f"{FIXDIR}/env_key_fold_pos.py", "make_program",
+                        frozenset({flagmod.PROGRAM_CACHE})),)
+    ROOT_NEG = (FactoryRoot(f"{FIXDIR}/env_key_fold_neg.py", "make_program",
+                            frozenset({flagmod.PROGRAM_CACHE})),)
+
+    def test_positive(self):
+        got = _lint_fixture(["env_key_fold_pos.py"], roots=self.ROOT)
+        assert _rules_of(got) == ["ENV-KEY-FOLD"]
+        by_flag = {f.ident for f in got}
+        # wrong-dimension declared flag, (constant-resolved) undeclared,
+        # and the os.getenv spelling of an undeclared read
+        assert by_flag == {"ALINK_TPU_BAD", "ALINK_TPU_UNDECLARED",
+                           "ALINK_TPU_UNDECLARED_GETENV"}
+        assert any("step_lru" in f.message for f in got)
+
+    def test_negative(self):
+        got = _lint_fixture(["env_key_fold_neg.py"], roots=self.ROOT_NEG)
+        assert got == []
+
+    def test_unregistered_factory_backstop(self):
+        """A NEW lru_cache'd factory nobody added to default_config()
+        must not silently escape the rule: a key-affecting env read
+        reachable from it is flagged until the factory is registered;
+        key-neutral reads stay silent."""
+        got = _lint_fixture(["env_key_fold_unreg.py"])
+        assert _rules_of(got) == ["ENV-KEY-FOLD"]
+        assert {f.ident for f in got} == {
+            "unregistered-factory:_rogue_step_factory"}
+        assert "register" in got[0].message
+        # once registered with the right key dimension, it is clean
+        root = (FactoryRoot(f"{FIXDIR}/env_key_fold_unreg.py",
+                            "_rogue_step_factory",
+                            frozenset({flagmod.PROGRAM_CACHE})),)
+        assert _lint_fixture(["env_key_fold_unreg.py"], roots=root) == []
+
+    def test_missing_root_is_reported_not_crashed(self):
+        bad = (FactoryRoot(f"{FIXDIR}/env_key_fold_neg.py", "nope",
+                           frozenset({flagmod.PROGRAM_CACHE})),)
+        got = _lint_fixture(["env_key_fold_neg.py"], roots=bad)
+        assert [f.rule for f in got] == ["ENV-KEY-FOLD"]
+        assert "missing-root" in got[0].ident
+
+
+class TestTracedCaptureFixtures:
+    def test_positive(self):
+        got = _lint_fixture(["traced_capture_pos.py"])
+        assert _rules_of(got) == ["TRACED-CAPTURE"]
+        idents = {f.ident for f in got}
+        assert "stage:dev" in idents       # device-array capture
+        assert "stage:state" in idents     # mutated mutable container
+
+    def test_negative(self):
+        got = _lint_fixture(["traced_capture_neg.py"])
+        assert got == []
+
+
+class TestDonateUseAfterFixtures:
+    def test_positive(self):
+        got = _lint_fixture(["donate_use_after_pos.py"])
+        assert set(_rules_of(got)) == {"DONATE-USE-AFTER"}
+        # direct call AND the pass-through-wrapper call (run_step shape)
+        assert sorted(f.ident for f in got) == ["train:z",
+                                                "train_wrapped:z"]
+        assert "donate_argnums" in got[0].message
+
+    def test_negative(self):
+        got = _lint_fixture(["donate_use_after_neg.py"])
+        assert got == []
+
+
+class TestCollectiveSiteFixtures:
+    def test_positive(self):
+        got = _lint_fixture(["collective_site_pos.py"])
+        assert _rules_of(got) == ["COLLECTIVE-SITE"]
+        assert {f.ident for f in got} == {"shard_fn:psum",
+                                          "shard_fn:all_gather",
+                                          "aliased:pmax",
+                                          "aliased:ppermute"}
+
+    def test_negative(self):
+        got = _lint_fixture(["collective_site_neg.py"])
+        assert got == []
+
+    def test_allowed_file_is_exempt(self):
+        got = _lint_fixture(["collective_site_pos.py"],
+                            allowed=(f"{FIXDIR}/collective_site_pos.py",))
+        assert got == []
+
+
+class TestHostCallbackFixtures:
+    GLOBS = (f"{FIXDIR}/host_callback_*",)
+
+    def test_positive(self):
+        got = _lint_fixture(["host_callback_pos.py"], compiled=self.GLOBS)
+        assert _rules_of(got) == ["HOST-CALLBACK-FREE"]
+        assert {f.ident for f in got} == {"stage:debug.print",
+                                          "stage:io_callback",
+                                          "stage_aliased:debug.print"}
+
+    def test_negative(self):
+        got = _lint_fixture(["host_callback_neg.py"], compiled=self.GLOBS)
+        assert got == []
+
+    def test_outside_compiled_path_is_fine(self):
+        got = _lint_fixture(["host_callback_pos.py"], compiled=())
+        assert got == []
+
+
+class TestParseError:
+    def test_broken_file_is_a_finding_not_a_traceback(self, tmp_path):
+        """The analyzer's "total" contract: a file that fails to parse
+        must surface as a PARSE-ERROR finding (the CLI's documented
+        exit-code contract), never an uncaught SyntaxError — the gate
+        would otherwise die with a traceback instead of a diagnostic."""
+        pkg = tmp_path / "alink_tpu"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text("def broken(:\n")
+        (pkg / "good.py").write_text("X = 1\n")
+        cfg = LintConfig(package_dirs=("alink_tpu",), factory_roots=(),
+                         collective_allowed=(), compiled_path_globs=())
+        got = run_lint(root=str(tmp_path), config=cfg,
+                       registry=_fixture_registry())
+        assert _rules_of(got) == ["PARSE-ERROR"]
+        (f,) = got
+        assert (f.file, f.line, f.ident) == ("alink_tpu/bad.py", 1, "syntax")
+        # the parseable sibling was still indexed
+        index = ModuleIndex.build(str(tmp_path), cfg.package_dirs)
+        assert "alink_tpu/good.py" in index.by_path
+        assert "alink_tpu/bad.py" not in index.by_path
+
+
+# ---------------------------------------------------------------------------
+# baseline machinery
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    def test_entry_consumes_matching_finding(self):
+        got = _lint_fixture(["collective_site_pos.py"])
+        bl = Baseline(path="<mem>", entries=[BaselineEntry(
+            "COLLECTIVE-SITE", f"{FIXDIR}/collective_site_pos.py",
+            "shard_fn:*", "fixture: glob idents keep baselines stable "
+                          "across reformatting")])
+        violations, baselined, stale = bl.split(got)
+        # the glob consumes only shard_fn's findings; the aliased-import
+        # ones stay live violations
+        assert len(baselined) == 2 and stale == []
+        assert {f.ident for f in violations} == {"aliased:pmax",
+                                                 "aliased:ppermute"}
+
+    def test_stale_entry_detected(self):
+        bl = Baseline(path="<mem>", entries=[BaselineEntry(
+            "COLLECTIVE-SITE", "gone.py", "x:psum",
+            "matched nothing on purpose for this test")])
+        violations, baselined, stale = bl.split([])
+        assert stale == bl.entries
+
+    def test_malformed_baseline_refused(self, tmp_path):
+        import json
+        p = tmp_path / "bl.json"
+        p.write_text(json.dumps({"entries": [
+            {"rule": "X", "file": "f.py", "ident": "i",
+             "justification": "too short"}]}))
+        with pytest.raises(BaselineError, match="explain WHY"):
+            load_baseline(str(p))
+        p.write_text(json.dumps({"entries": [
+            {"rule": "X", "file": "f.py"}]}))
+        with pytest.raises(BaselineError, match="missing"):
+            load_baseline(str(p))
+
+    def test_broken_json_baseline_is_exit_2_not_traceback(self, tmp_path):
+        """A mis-edited baseline (trailing comma, truncated file) must
+        surface as the documented exit-2 diagnostic, not a raw
+        json.JSONDecodeError traceback out of the tier-1/perf gate."""
+        from tools.lint.cli import main as lint_main
+        p = tmp_path / "bl.json"
+        p.write_text('{"entries": [,]}')
+        with pytest.raises(BaselineError, match="not valid JSON"):
+            load_baseline(str(p))
+        assert lint_main(["--strict", "--baseline", str(p)]) == 2
+        p.write_text('["not", "an", "object"]')
+        with pytest.raises(BaselineError, match="entries"):
+            load_baseline(str(p))
+
+    def test_broken_flags_py_is_exit_2_not_traceback(self, tmp_path,
+                                                     capsys):
+        """A syntax error (or a refused declaration) in the linted
+        tree's flags.py is a configuration error: documented exit 2
+        with a diagnostic, never an unhandled traceback out of the
+        perf gate."""
+        from tools.lint.cli import main as lint_main
+        root = tmp_path / "tree"
+        (root / "alink_tpu" / "common").mkdir(parents=True)
+        (root / "tools").mkdir()
+        (root / "alink_tpu" / "common" / "flags.py").write_text(
+            "def broken(:\n")
+        assert lint_main(["--strict", "--root", str(root)]) == 2
+        assert "flag registry" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the whole package must be clean
+# ---------------------------------------------------------------------------
+
+class TestWholePackage:
+    def test_zero_nonbaselined_violations(self):
+        """Exactly what ``python -m tools.lint --strict`` enforces in
+        tools/perf_gate.sh: every finding on the current tree is either
+        fixed or carries a written justification in
+        tools/lint_baseline.json — and no baseline entry outlives the
+        code it excuses."""
+        findings = run_lint(root=repo_root(), config=default_config(),
+                            registry=load_flag_registry())
+        baseline = load_baseline()
+        violations, baselined, stale = baseline.split(findings)
+        assert violations == [], "\n".join(f.render() for f in violations)
+        assert stale == [], [e.ident for e in stale]
+
+    def test_every_alink_env_read_in_package_is_declared(self):
+        """Repo-wide (not just factory-reachable): every ALINK_* env
+        read inside alink_tpu/ resolves to a literal/constant name that
+        is declared in the registry — no flag can exist outside it."""
+        cfg = default_config()
+        index = ModuleIndex.build(repo_root(), cfg.package_dirs)
+        registry = load_flag_registry()
+        undeclared = []
+        for mod in index.by_path.values():
+            if mod.path in cfg.env_read_exempt:
+                continue
+            for read in env_reads_in(mod.tree, mod, index):
+                if read.name.startswith("ALINK_") \
+                        and registry.get(read.name) is None:
+                    undeclared.append((mod.path, read.line, read.name))
+        assert undeclared == []
+
+    def test_cli_strict_exits_zero(self):
+        from tools.lint.cli import main
+        assert main(["--strict"]) == 0
+
+    def test_cli_json_shape(self, capsys):
+        import json
+        from tools.lint.cli import main
+        assert main(["--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert set(doc) == {"violations", "baselined", "stale_baseline"}
+        assert doc["violations"] == []
+
+
+# ---------------------------------------------------------------------------
+# registry semantics + migration byte-identity regression
+# ---------------------------------------------------------------------------
+
+class TestRegistryValidation:
+    def test_every_flag_declares_fold_or_neutral(self):
+        for f in FLAGS:
+            assert bool(f.folds_into) != bool(f.key_neutral), f.name
+
+    def test_duplicate_refused(self):
+        reg = _fixture_registry()
+        with pytest.raises(ValueError, match="twice"):
+            reg.register("ALINK_TPU_GOOD", "bool", False, "dup", "debug",
+                         key_neutral="fixture justification text")
+
+    def test_silent_on_staleness_refused(self):
+        reg = FlagRegistry()
+        with pytest.raises(ValueError, match="exactly one"):
+            reg.register("ALINK_TPU_X", "bool", False, "d", "debug")
+        with pytest.raises(ValueError, match="exactly one"):
+            reg.register("ALINK_TPU_X", "bool", False, "d", "debug",
+                         folds_into=frozenset({flagmod.PROGRAM_CACHE}),
+                         key_neutral="both is as bad as neither")
+
+    def test_bad_dimension_and_prefix_refused(self):
+        reg = FlagRegistry()
+        with pytest.raises(ValueError, match="not a subset"):
+            reg.register("ALINK_TPU_X", "bool", False, "d", "debug",
+                         folds_into=frozenset({"nope"}))
+        with pytest.raises(ValueError, match="ALINK_ prefix"):
+            reg.register("OTHER_FLAG", "bool", False, "d", "debug",
+                         key_neutral="prefix check fires first here")
+
+    def test_undeclared_read_refused(self):
+        with pytest.raises(KeyError, match="not declared"):
+            flag_value("ALINK_TPU_NOT_A_FLAG")
+        with pytest.raises(KeyError, match="not declared"):
+            flag_raw("ALINK_TPU_NOT_A_FLAG")
+
+    def test_tolerant_fallback_respects_callsite_default(self, monkeypatch):
+        """An unparseable value on a tolerant flag falls back to the
+        CALL-SITE default when one is given, not the registered one."""
+        monkeypatch.setenv("ALINK_TPU_TRACE_BUFFER", "junk")
+        assert flag_value("ALINK_TPU_TRACE_BUFFER") == 65536
+        assert flag_value("ALINK_TPU_TRACE_BUFFER", 1024) == 1024
+
+
+class TestMigrationByteIdentity:
+    """The registry migration must be a pure refactor: same parsed
+    values as every pre-migration ad-hoc parser, same program-cache
+    keys, same lowered HLO."""
+
+    # the pre-migration parsers, copied verbatim from the r06 tree
+    @staticmethod
+    def _legacy_env_flag(env, name, default=False):
+        v = env.get(name)
+        if v is None:
+            return default
+        return v.strip().lower() not in {"", "0", "false", "off", "no"}
+
+    @staticmethod
+    def _legacy_trace_buffer(env):
+        raw = env.get("ALINK_TPU_TRACE_BUFFER")
+        if not raw:
+            return 65536
+        try:
+            n = int(raw)
+        except ValueError:
+            return 65536
+        return max(1, n)
+
+    @staticmethod
+    def _legacy_prefetch_depth(env, default=2):
+        v = env.get("ALINK_TPU_STREAM_PREFETCH", "")
+        if v == "":
+            return default
+        return max(0, int(v))
+
+    @staticmethod
+    def _legacy_stream_workers(env, default=1):
+        v = env.get("ALINK_TPU_STREAM_WORKERS", "")
+        if v == "":
+            return default
+        return max(1, int(v))
+
+    BOOL_RAWS = [None, "", "0", "1", "false", "False", " OFF ", "no",
+                 "yes", "on", "2", "junk"]
+
+    def test_bool_semantics_identical(self, monkeypatch):
+        for flag in ("ALINK_TPU_METRICS", "ALINK_TPU_DONATE",
+                     "ALINK_TPU_HEALTH", "ALINK_TPU_STEP_LOG",
+                     "ALINK_TPU_TRACE", "ALINK_TPU_ASYNC_SNAPSHOT"):
+            default = FLAGS.get(flag).default
+            for raw in self.BOOL_RAWS:
+                if raw is None:
+                    monkeypatch.delenv(flag, raising=False)
+                else:
+                    monkeypatch.setenv(flag, raw)
+                env = {} if raw is None else {flag: raw}
+                assert flag_value(flag) == \
+                    self._legacy_env_flag(env, flag, default), (flag, raw)
+                assert env_flag(flag, default) == \
+                    self._legacy_env_flag(env, flag, default), (flag, raw)
+            monkeypatch.delenv(flag, raising=False)
+
+    INT_NAMES = ("ALINK_TPU_TRACE_BUFFER", "ALINK_TPU_STREAM_PREFETCH",
+                 "ALINK_TPU_STREAM_WORKERS")
+
+    def test_int_semantics_identical(self, monkeypatch):
+        for raw in (None, "", "0", "7", "-3", "junk"):
+            for name in self.INT_NAMES:
+                if raw is None:
+                    monkeypatch.delenv(name, raising=False)
+                else:
+                    monkeypatch.setenv(name, raw)
+            env = {} if raw is None else {n: raw for n in self.INT_NAMES}
+            # tolerant buffer flag: junk -> default (legacy semantics)
+            assert flag_value("ALINK_TPU_TRACE_BUFFER") == \
+                self._legacy_trace_buffer(env)
+            if raw == "junk":     # strict int flags raised pre-migration too
+                with pytest.raises(ValueError):
+                    flag_value("ALINK_TPU_STREAM_PREFETCH")
+            else:
+                assert flag_value("ALINK_TPU_STREAM_PREFETCH") == \
+                    self._legacy_prefetch_depth(env)
+                assert flag_value("ALINK_TPU_STREAM_WORKERS") == \
+                    self._legacy_stream_workers(env)
+        for name in self.INT_NAMES:
+            monkeypatch.delenv(name, raising=False)
+
+    def test_fused_hist_mode_semantics_identical(self, monkeypatch):
+        legacy = {None: "off", "": "off", "0": "off", "off": "off",
+                  "false": "off", "pallas": "pallas", "1": "xla",
+                  "xla": "xla", "anything": "xla"}
+        for raw, want in legacy.items():
+            if raw is None:
+                monkeypatch.delenv("ALINK_TPU_FUSED_HIST", raising=False)
+            else:
+                monkeypatch.setenv("ALINK_TPU_FUSED_HIST", raw)
+            assert flag_value("ALINK_TPU_FUSED_HIST") == want, raw
+        monkeypatch.delenv("ALINK_TPU_FUSED_HIST", raising=False)
+
+    def test_accessor_functions_route_through_registry(self, monkeypatch):
+        """The canonical accessors (the ones compiled-path code calls)
+        agree with the registry on the unified falsy convention."""
+        from alink_tpu.common.health import health_enabled
+        from alink_tpu.common.metrics import metrics_enabled
+        from alink_tpu.common.tracing import _buffer_capacity
+        from alink_tpu.engine.comqueue import donation_enabled
+        from alink_tpu.operator.stream.prefetch import (prefetch_depth,
+                                                        stream_workers)
+        monkeypatch.setenv("ALINK_TPU_HEALTH", "OFF")
+        monkeypatch.setenv("ALINK_TPU_METRICS", "No")
+        monkeypatch.setenv("ALINK_TPU_DONATE", "0")
+        monkeypatch.setenv("ALINK_TPU_TRACE_BUFFER", "-5")
+        monkeypatch.setenv("ALINK_TPU_STREAM_PREFETCH", "")
+        monkeypatch.setenv("ALINK_TPU_STREAM_WORKERS", "0")
+        assert health_enabled() is False
+        assert metrics_enabled() is False
+        assert donation_enabled() is False
+        assert _buffer_capacity() == 1          # legacy max(1, n) clamp
+        assert prefetch_depth() == 2            # set-but-empty == unset
+        assert stream_workers() == 1            # legacy max(1, n) clamp
+
+    def test_fault_spec_reads_through_registry(self, monkeypatch):
+        from alink_tpu.common.faults import fault_spec
+        monkeypatch.setenv("ALINK_TPU_FAULT_INJECT", "ftrl.batch:3")
+        assert fault_spec() == {"ftrl.batch": 3}
+        monkeypatch.delenv("ALINK_TPU_FAULT_INJECT", raising=False)
+        assert fault_spec() == {}
+
+    def test_program_cache_key_and_hlo_identical(self, monkeypatch):
+        """For the default flag combination, explicitly setting every
+        key-folded flag to its registered default must produce the SAME
+        program-cache key and byte-identical lowered HLO as leaving the
+        environment unset — the registry parse path adds nothing to the
+        key contents."""
+        import jax.numpy as jnp
+        import alink_tpu.engine.comqueue as cq
+        from alink_tpu.engine.communication import AllReduce
+        from alink_tpu.engine.comqueue import IterativeComQueue
+
+        X = np.arange(16.0).reshape(8, 2)
+
+        def stage(ctx):
+            if ctx.is_init_step:
+                ctx.put_obj("s", jnp.zeros(()))
+            ctx.put_obj("s", ctx.get_obj("X").sum())
+
+        def build(key):
+            return (IterativeComQueue(max_iter=3)
+                    .init_with_partitioned_data("X", X)
+                    .add(stage).add(AllReduce("s"))
+                    .set_program_key(key))
+
+        for name in ("ALINK_TPU_STEP_LOG", "ALINK_TPU_HEALTH",
+                     "ALINK_TPU_DONATE"):
+            monkeypatch.delenv(name, raising=False)
+        key = "lint_migration_identity"
+        hlo_unset = build(key).lowered().as_text()
+        build(key).exec()
+        ck_unset = [k for k in cq._PROGRAM_CACHE if k and k[0] == key]
+
+        monkeypatch.setenv("ALINK_TPU_STEP_LOG", "0")   # registered defaults
+        monkeypatch.setenv("ALINK_TPU_HEALTH", "1")
+        monkeypatch.setenv("ALINK_TPU_DONATE", "1")
+        hlo_set = build(key).lowered().as_text()
+        build(key).exec()
+        ck_set = [k for k in cq._PROGRAM_CACHE if k and k[0] == key]
+
+        assert hlo_set == hlo_unset                     # byte-identical
+        assert ck_set == ck_unset                       # same cache key set
+        # and the flag slots carry the documented defaults
+        # (ckey layout: ..., step_log, probes_on, donate, parts, bcast)
+        (ck,) = set(ck_unset)
+        assert (False, True, True) == (ck[7], ck[8], ck[9])
+
+
+class TestGeneratedDocs:
+    def test_flag_tables_current(self):
+        """docs/performance.md + docs/observability.md flag tables match
+        the registry (regenerate with python tools/gen_docs.py --flags)."""
+        from tools.gen_docs import gen_flag_tables
+        assert gen_flag_tables(check=True)
+
+    def test_doc_rows_cover_all_sections(self):
+        rows = FLAGS.doc_rows()
+        assert {r["section"] for r in rows} == {
+            "observability", "performance", "durability", "debug", "io",
+            "bench"}
+        by_name = {r["name"]: r for r in rows}
+        assert by_name["ALINK_TPU_DONATE"]["folds"] == \
+            "program_cache, step_lru"
+        assert "key-neutral" not in by_name["ALINK_TPU_DONATE"]["key_note"]
+        assert by_name["ALINK_TPU_METRICS"]["folds"] == "—"

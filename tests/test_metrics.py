@@ -466,6 +466,44 @@ class TestOperatorTelemetry:
         fam = reg.histogram("alink_stream_batch_seconds")
         assert any(l == lbl and s.count == n // bs for l, s in fam.series())
 
+    def test_ftrl_collectives_charged_per_micro_batch(self, fresh_registry):
+        """The FTRL step programs are jit-cached, so their margin-psum
+        manifest records fire once per COMPILE; the drain loop must
+        replay each program's captured manifest per micro-batch, or a
+        long drain under-counts its AllReduce traffic by the batch
+        count (communication.record_manifest / ftrl._step_manifest)."""
+        from alink_tpu.common.mtable import MTable
+        from alink_tpu.operator.batch.source import MemSourceBatchOp
+        from alink_tpu.operator.batch.classification import (
+            LogisticRegressionTrainBatchOp)
+        from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+        from alink_tpu.operator.stream.onlinelearning.ftrl import (
+            FtrlTrainStreamOp)
+
+        rng = np.random.RandomState(3)
+        n, bs = 96, 16
+        X = rng.randn(n, 3)
+        y = (X @ np.array([1.0, -1.0, 0.5]) > 0).astype(np.int64)
+        table = MTable({"f0": X[:, 0], "f1": X[:, 1], "f2": X[:, 2],
+                        "label": y})
+        warm = LogisticRegressionTrainBatchOp(
+            feature_cols=["f0", "f1", "f2"], label_col="label",
+            max_iter=2).link_from(MemSourceBatchOp(table.first_n(32)))
+        warm.get_output_table()          # force the warm train NOW: its
+        reg = fresh_registry             # engine collectives must not
+        ar = {"collective": "AllReduce"}  # pollute the drain's delta
+        base = reg.value("alink_collective_calls_total", ar)
+        ftrl = FtrlTrainStreamOp(
+            warm, label_col="label", feature_cols=["f0", "f1", "f2"],
+            alpha=0.5, time_interval=1e9).link_from(
+            MemSourceStreamOp(table, batch_size=bs))
+        assert len(list(ftrl.micro_batches())) >= 1
+        # ONE margin AllReduce site per step program, executed once per
+        # micro-batch: calls count executed batches, not compiles
+        assert reg.value("alink_collective_calls_total", ar) - base \
+            == n // bs
+        assert reg.value("alink_collective_logical_bytes_total", ar) > 0
+
     def test_operator_paths_respect_guard(self, fresh_registry, monkeypatch):
         monkeypatch.setenv("ALINK_TPU_METRICS", "off")
         from alink_tpu.common.mtable import MTable

@@ -1,11 +1,21 @@
-"""Generate docs/operators.md — the per-operator API reference.
+"""Generate docs/operators.md and the env-flag reference tables.
 
 The reference ships per-operator docs for every op (docs/cn + docs/en, 359
 files each); here one generated markdown reference covers the whole flat
 namespace: class name, defining module, first docstring paragraph, and the
 parameter table (name, type, default, description) from the Params system.
 
-Usage:  python tools/gen_docs.py          # rewrites docs/operators.md
+The env-flag tables in ``docs/performance.md`` and
+``docs/observability.md`` render from the declarative registry in
+``alink_tpu/common/flags.py`` (name, default, what it gates, which cache
+keys it folds into), between ``BEGIN/END GENERATED FLAG TABLE`` markers
+— the docs cannot drift from the registry, and a new flag shows up in
+the docs by being declared, the same declaration ``tools/lint``'s
+ENV-KEY-FOLD rule cross-checks.
+
+Usage:  python tools/gen_docs.py            # rewrite operators.md + flag tables
+        python tools/gen_docs.py --flags    # flag tables only (no jax import)
+        python tools/gen_docs.py --check    # exit 1 if any flag table is stale
 """
 
 from __future__ import annotations
@@ -15,9 +25,6 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import alink_tpu  # noqa: E402
-from alink_tpu.common.params import ParamInfo  # noqa: E402
 
 OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "docs", "operators.md")
@@ -53,6 +60,7 @@ def _first_paragraph(doc: str) -> str:
 
 
 def _param_rows(cls) -> list:
+    from alink_tpu.common.params import ParamInfo
     infos = getattr(cls, "_PARAM_INFOS", None) or {}
     rows = []
     for name, pi in sorted(infos.items()):
@@ -75,7 +83,91 @@ def _section_for(module: str) -> str:
     return best[1] if best else "Other"
 
 
-def main() -> None:
+# ---------------------------------------------------------------------------
+# env-flag reference tables (from the FlagRegistry, no jax import)
+# ---------------------------------------------------------------------------
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLAGS_BEGIN = ("<!-- BEGIN GENERATED FLAG TABLE (tools/gen_docs.py — "
+               "edit alink_tpu/common/flags.py instead) -->")
+FLAGS_END = "<!-- END GENERATED FLAG TABLE -->"
+
+# doc file -> registry sections rendered into its marked block
+FLAG_TABLE_TARGETS = {
+    os.path.join("docs", "performance.md"):
+        ("performance", "durability", "debug", "io", "bench"),
+    os.path.join("docs", "observability.md"):
+        ("observability",),
+}
+
+
+def _load_registry():
+    """The FLAGS registry, standalone (stdlib-only module — no jax).
+
+    Resolved through the ``tools.lint`` package (the repo root is already
+    on ``sys.path``) so the analyzer module is never bound a second time
+    under a bare top-level ``lint`` name."""
+    from tools.lint.analyzer import load_flag_registry
+    return load_flag_registry()
+
+
+def flag_table_md(registry, sections) -> str:
+    """One markdown table: name, default, what it gates, key folds."""
+    lines = [
+        "| flag | type | default | folds into cache keys | effect |",
+        "|---|---|---|---|---|",
+    ]
+    for r in registry.doc_rows(sections):
+        desc = r["description"].replace("|", "\\|")
+        folds = r["folds"]
+        if folds == "—":
+            folds = "— (key-neutral)"
+        lines.append(f"| `{r['name']}` | {r['kind']} | `{r['default']}` "
+                     f"| {folds} | {desc} |")
+    lines.append("")
+    lines.append("Key-neutral flags carry a written justification in "
+                 "`alink_tpu/common/flags.py` for WHY no cache-key fold "
+                 "is needed; `python -m tools.lint` (ENV-KEY-FOLD) "
+                 "cross-checks both claims against the code.")
+    return "\n".join(lines)
+
+
+def _spliced(text: str, table: str, path: str) -> str:
+    try:
+        head, rest = text.split(FLAGS_BEGIN, 1)
+        _, tail = rest.split(FLAGS_END, 1)
+    except ValueError:
+        raise SystemExit(
+            f"{path}: missing {FLAGS_BEGIN!r}/{FLAGS_END!r} markers")
+    return head + FLAGS_BEGIN + "\n" + table + "\n" + FLAGS_END + tail
+
+
+def gen_flag_tables(check: bool = False) -> bool:
+    """Rewrite (or with ``check=True`` just diff) every marked flag
+    table. Returns True when all tables were already current."""
+    registry = _load_registry()
+    current = True
+    for rel, sections in FLAG_TABLE_TARGETS.items():
+        path = os.path.join(_ROOT, rel)
+        with open(path) as f:
+            text = f.read()
+        want = _spliced(text, flag_table_md(registry, sections), rel)
+        if want != text:
+            current = False
+            if check:
+                print(f"{rel}: flag table is STALE — run "
+                      f"python tools/gen_docs.py --flags")
+            else:
+                with open(path, "w") as f:
+                    f.write(want)
+                print(f"wrote {rel}: flag table ({len(sections)} sections)")
+        elif not check:
+            print(f"{rel}: flag table already current")
+    return current
+
+
+def gen_operators() -> None:
+    import alink_tpu
     exports = alink_tpu._collect_exports()
     sections = collections.defaultdict(list)
     for name, cls in sorted(exports.items()):
@@ -115,5 +207,21 @@ def main() -> None:
           f"{sum(len(v) for v in sections.values())} entries")
 
 
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--flags", action="store_true",
+                    help="regenerate only the env-flag tables")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if any flag table is stale (CI mode)")
+    args = ap.parse_args(argv)
+    if args.check:
+        return 0 if gen_flag_tables(check=True) else 1
+    gen_flag_tables()
+    if not args.flags:
+        gen_operators()
+    return 0
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

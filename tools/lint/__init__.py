@@ -1,0 +1,53 @@
+"""alink-lint — compiled-program invariant analyzer for ``alink_tpu/``.
+
+Every feature PR so far guarded its compiled-program invariants
+("flag-off HLO byte-identical", "no host callbacks in compiled
+programs", "collectives only via the manifest", "every env flag that
+changes a trace folds into the cache key") with per-feature runtime
+tests and reviewer vigilance. This package makes those invariants
+**machine-checked on every run of the tier-1 suite**, anchored by the
+declarative flag registry in ``alink_tpu/common/flags.py``.
+
+Five rules (see ``tools/lint/rules.py`` for the precise semantics):
+
+  ENV-KEY-FOLD       an env read reachable from a program/step factory
+                     whose flag is not declared (in the registry) as
+                     folding into that factory's cache-key dimension
+                     and not declared key-neutral — the exact staleness
+                     class PRs 4-6 each re-plumbed by hand;
+  TRACED-CAPTURE     closure cells or globals captured by traced
+                     functions (comqueue stage bodies, jitted/shard_map
+                     callables) that hold device arrays or mutated
+                     host containers — today only a runtime
+                     RuntimeWarning in ``engine/comqueue.py``;
+  DONATE-USE-AFTER   a name passed at a ``donate_argnums`` position and
+                     read again before being rebound — the bug class
+                     ``tests/test_overlap.py`` can only catch per-site;
+  COLLECTIVE-SITE    raw ``lax.psum``/``all_gather``/... outside
+                     ``engine/communication.py``, which silently escape
+                     the collective manifest;
+  HOST-CALLBACK-FREE ``io_callback``/``pure_callback``/
+                     ``jax.debug.print`` inside compiled-path modules.
+
+Pure ``ast`` — the analyzer never imports the analyzed package (and so
+never imports jax); the flag registry is loaded standalone from its
+file via importlib, which works because ``common/flags.py`` is
+deliberately stdlib-only.
+
+CLI:  ``python -m tools.lint [--strict] [--json] [--baseline FILE]``
+Baseline workflow: a true positive that is *intentional* gets an entry
+in ``tools/lint_baseline.json`` with a non-empty ``justification``
+string; ``--strict`` additionally fails on stale (unmatched) baseline
+entries so the allowlist can only shrink with the code.
+"""
+
+from .analyzer import (Finding, ModuleIndex, load_flag_registry,
+                       repo_root)
+from .rules import LintConfig, default_config, run_lint
+from .baseline import Baseline, BaselineError, load_baseline
+
+__all__ = [
+    "Finding", "ModuleIndex", "LintConfig", "Baseline", "BaselineError",
+    "default_config", "run_lint", "load_baseline", "load_flag_registry",
+    "repo_root",
+]

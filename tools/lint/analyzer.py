@@ -1,0 +1,472 @@
+"""AST index, import resolution, and the pragmatic reachability engine
+the alink-lint rules share.
+
+Design constraints:
+
+  * **never import the analyzed code** — everything is ``ast``; the
+    flag registry (the one piece of *data* the rules need) is loaded
+    standalone from ``alink_tpu/common/flags.py`` via importlib, which
+    is safe because that module is deliberately stdlib-only;
+  * **total** — unresolvable names/calls degrade to "skip", never to a
+    crash: the analyzer runs in the tier-1 gate, so a parse-level
+    surprise must surface as a finding or a skip, not a traceback;
+  * **over-approximate reachability** — scanning a function scans its
+    whole lexical subtree (nested defs included) and follows calls it
+    can resolve by name (same module, ``self.``-methods, and
+    ``from``/``import`` targets inside the package). Dynamic dispatch
+    (``stage.calc``) is out of reach by construction; the rules that
+    care (TRACED-CAPTURE) find stage bodies at their registration
+    sites (``.add(fn)``, ``jax.jit(fn)``, ``shard_map(fn)``) instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import importlib.util
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_BUILTINS = frozenset(dir(builtins))
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_flag_registry(path: Optional[str] = None):
+    """The :data:`FLAGS` registry, loaded standalone (no alink_tpu /
+    jax import) from ``alink_tpu/common/flags.py``."""
+    if path is None:
+        path = os.path.join(repo_root(), "alink_tpu", "common", "flags.py")
+    spec = importlib.util.spec_from_file_location("_alink_lint_flags", path)
+    mod = importlib.util.module_from_spec(spec)
+    # dataclasses resolves string annotations through sys.modules at
+    # class-creation time — the module must be registered before exec
+    import sys
+    sys.modules[spec.name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.modules.pop(spec.name, None)
+    return mod.FLAGS
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation. ``ident`` is the stable baseline-matching
+    token (never a line number, so baselines survive reformatting)."""
+    rule: str
+    file: str          # repo-relative posix path
+    line: int
+    ident: str
+    message: str
+    flag: str = ""
+
+    @property
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.file, self.ident)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} [{self.ident}] " \
+               f"{self.message}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "ident": self.ident, "flag": self.flag,
+                "message": self.message}
+
+
+# ---------------------------------------------------------------------------
+# module index
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FunctionInfo:
+    qualname: str                  # "fn" | "Class.method"
+    node: ast.AST                  # FunctionDef / AsyncFunctionDef
+    module: "ModuleInfo" = field(repr=False, default=None)
+
+
+@dataclass
+class ModuleInfo:
+    path: str                      # repo-relative posix
+    modname: str                   # "alink_tpu.engine.comqueue"
+    tree: ast.Module
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    # local binding name -> fully qualified target ("jax.numpy",
+    # "alink_tpu.common.metrics.env_flag", ...)
+    imports: Dict[str, str] = field(default_factory=dict)
+    classes: Dict[str, ast.ClassDef] = field(default_factory=dict)
+    # module-level NAME = "string literal" constants (FAULT_ENV = "...")
+    str_constants: Dict[str, str] = field(default_factory=dict)
+
+
+def _module_name(relpath: str) -> str:
+    mod = relpath[:-3] if relpath.endswith(".py") else relpath
+    mod = mod.replace("/", ".").replace("\\", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def _resolve_relative(modname: str, level: int, target: Optional[str],
+                      is_package: bool) -> str:
+    """Absolute module for a ``from ...x import y`` node."""
+    parts = modname.split(".")
+    # a non-package module's level-1 import resolves to its parent pkg
+    cut = len(parts) - (level - (1 if is_package else 0))
+    base = parts[:max(cut, 0)]
+    if target:
+        base = base + target.split(".")
+    return ".".join(base)
+
+
+class ModuleIndex:
+    """Parsed ``*.py`` files under one or more roots, with per-module
+    function tables and import maps."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleInfo] = {}      # modname -> info
+        self.by_path: Dict[str, ModuleInfo] = {}      # relpath -> info
+        # files that failed to parse, surfaced as PARSE-ERROR findings
+        # by run_lint — the analyzer's "total" contract: a broken file
+        # in the gate must be a diagnostic, never a traceback
+        self.parse_errors: List[Finding] = []
+
+    @classmethod
+    def build(cls, root: str, package_dirs: Sequence[str]) -> "ModuleIndex":
+        idx = cls()
+        for pkg in package_dirs:
+            base = os.path.join(root, pkg)
+            if os.path.isfile(base) and base.endswith(".py"):
+                idx.add_file(root, os.path.relpath(base, root))
+                continue
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"
+                               and not d.startswith(".")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                        idx.add_file(root, rel)
+        return idx
+
+    def add_file(self, root: str, relpath: str) -> Optional[ModuleInfo]:
+        relpath = relpath.replace(os.sep, "/")
+        with open(os.path.join(root, relpath), "r") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=relpath)
+        except (SyntaxError, ValueError) as e:
+            line = getattr(e, "lineno", None) or 1
+            self.parse_errors.append(Finding(
+                "PARSE-ERROR", relpath, line, "syntax",
+                f"file does not parse ({e.msg if isinstance(e, SyntaxError) else e}) — "
+                f"no rule can analyze it"))
+            return None
+        info = ModuleInfo(path=relpath, modname=_module_name(relpath),
+                          tree=tree)
+        is_pkg = relpath.endswith("__init__.py")
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    info.imports[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+                    if a.asname:
+                        info.imports[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    base = _resolve_relative(info.modname, node.level,
+                                             node.module, is_pkg)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    info.imports[a.asname or a.name] = \
+                        f"{base}.{a.name}" if base else a.name
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                    node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        info.str_constants[t.id] = node.value.value
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                    node.target, ast.Name) and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                    node.value.value, str):
+                info.str_constants[node.target.id] = node.value.value
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.functions[node.name] = FunctionInfo(node.name, node, info)
+            elif isinstance(node, ast.ClassDef):
+                info.classes[node.name] = node
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        q = f"{node.name}.{sub.name}"
+                        info.functions[q] = FunctionInfo(q, sub, info)
+        self.modules[info.modname] = info
+        self.by_path[relpath] = info
+        return info
+
+    # -- resolution --------------------------------------------------------
+    def resolve_symbol(self, fq: str) -> Optional[FunctionInfo]:
+        """``alink_tpu.engine.recovery.drive`` -> FunctionInfo, by the
+        longest known module prefix."""
+        parts = fq.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = self.modules.get(".".join(parts[:cut]))
+            if mod is not None:
+                qual = ".".join(parts[cut:])
+                return mod.functions.get(qual)
+        return None
+
+    def resolve_call(self, call: ast.Call, mod: ModuleInfo,
+                     cls_name: str = "") -> Optional[FunctionInfo]:
+        """Best-effort: Name() in same module / imported; self.m();
+        imported_module.fn()."""
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            got = mod.functions.get(fn.id)
+            if got is not None:
+                return got
+            fq = mod.imports.get(fn.id)
+            if fq is not None:
+                return self.resolve_symbol(fq)
+            return None
+        if isinstance(fn, ast.Attribute):
+            v = fn.value
+            if isinstance(v, ast.Name):
+                if v.id in ("self", "cls") and cls_name:
+                    return mod.functions.get(f"{cls_name}.{fn.attr}")
+                fq = mod.imports.get(v.id)
+                if fq is not None:
+                    return self.resolve_symbol(f"{fq}.{fn.attr}")
+        return None
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """"jax.lax.psum" for an Attribute/Name chain; "" when not a plain
+    chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+@dataclass(frozen=True)
+class EnvRead:
+    """One env-var read site."""
+    name: str            # flag name, or "<dynamic>"
+    line: int
+    how: str             # "os.environ" | "env_flag" | "flag_value" | ...
+
+
+_FLAG_READERS = {
+    # resolved fq name -> takes flag name as first positional arg
+    "alink_tpu.common.flags.env_flag",
+    "alink_tpu.common.flags.flag_value",
+    "alink_tpu.common.flags.flag_raw",
+    "alink_tpu.common.metrics.env_flag",
+}
+
+
+def _env_name_arg(node: ast.AST, mod: ModuleInfo,
+                  index: Optional["ModuleIndex"]) -> Optional[str]:
+    """The flag name of an env-read argument: a string literal, a
+    module-level string constant (``FAULT_ENV``), or a constant imported
+    from another indexed module."""
+    got = const_str(node)
+    if got is not None:
+        return got
+    if isinstance(node, ast.Name):
+        got = mod.str_constants.get(node.id)
+        if got is not None:
+            return got
+        fq = mod.imports.get(node.id)
+        if fq is not None and index is not None and "." in fq:
+            owner, attr = fq.rsplit(".", 1)
+            src = index.modules.get(owner)
+            if src is not None:
+                return src.str_constants.get(attr)
+    return None
+
+
+def env_reads_in(node: ast.AST, mod: ModuleInfo,
+                 index: Optional[ModuleIndex] = None) -> List[EnvRead]:
+    """Every env read lexically inside ``node``: ``os.environ`` get/
+    subscript/contains, plus calls to the registry accessors
+    (``env_flag``/``flag_value``/``flag_raw``) resolved through the
+    module's imports. Name arguments resolve through module-level
+    string constants (``FAULT_ENV = "..."``) before degrading to
+    ``<dynamic>``."""
+    reads: List[EnvRead] = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            dn = dotted_name(n.func)
+            if dn.endswith("environ.get") and "environ" in dn:
+                nm = _env_name_arg(n.args[0], mod, index) if n.args else None
+                reads.append(EnvRead(nm or "<dynamic>", n.lineno,
+                                     "os.environ"))
+                continue
+            # os.getenv — the standard alternative spelling, under any
+            # import alias (import os as _o / from os import getenv)
+            parts = dn.split(".")
+            if parts[-1] == "getenv" and (
+                    (len(parts) == 1
+                     and mod.imports.get(dn) == "os.getenv")
+                    or (len(parts) > 1
+                        and mod.imports.get(parts[0]) == "os")):
+                nm = _env_name_arg(n.args[0], mod, index) if n.args else None
+                reads.append(EnvRead(nm or "<dynamic>", n.lineno,
+                                     "os.getenv"))
+                continue
+            # env_flag("X") / flag_value("X") / flag_raw("X"), under
+            # whatever local alias the import bound
+            target = None
+            if isinstance(n.func, ast.Name):
+                target = mod.imports.get(n.func.id)
+                if target is None and n.func.id in ("env_flag",
+                                                    "flag_value",
+                                                    "flag_raw"):
+                    target = f"alink_tpu.common.flags.{n.func.id}"
+            elif isinstance(n.func, ast.Attribute):
+                base = dotted_name(n.func.value)
+                if base:
+                    root_alias = base.split(".")[0]
+                    fq_base = mod.imports.get(root_alias)
+                    if fq_base:
+                        target = fq_base + base[len(root_alias):] \
+                            + "." + n.func.attr
+            if target in _FLAG_READERS or (
+                    target and (target.endswith(".env_flag")
+                                or target.endswith(".flag_value")
+                                or target.endswith(".flag_raw"))
+                    and target.startswith("alink_tpu.")):
+                nm = _env_name_arg(n.args[0], mod, index) if n.args else None
+                reads.append(EnvRead(nm or "<dynamic>", n.lineno,
+                                     target.rsplit(".", 1)[-1]))
+        elif isinstance(n, ast.Subscript):
+            if dotted_name(n.value).endswith("environ"):
+                nm = None if isinstance(n.slice, ast.Tuple) \
+                    else _env_name_arg(n.slice, mod, index)
+                if isinstance(n.ctx, ast.Load):
+                    reads.append(EnvRead(nm or "<dynamic>", n.lineno,
+                                         "os.environ"))
+    return reads
+
+
+@dataclass
+class Reached:
+    """One function reached from a factory root, with the call chain."""
+    fn: FunctionInfo
+    chain: Tuple[str, ...]
+
+
+def reachable_functions(index: ModuleIndex, root: FunctionInfo,
+                        max_depth: int = 10) -> List[Reached]:
+    """Transitive closure of name-resolvable calls starting at ``root``
+    (the root itself included). Each function's whole lexical subtree
+    counts as scanned, so nested defs ride along for free."""
+    seen: Set[Tuple[str, str]] = set()
+    out: List[Reached] = []
+    stack: List[Tuple[FunctionInfo, Tuple[str, ...], int]] = [
+        (root, (root.qualname,), 0)]
+    while stack:
+        fi, chain, depth = stack.pop()
+        key = (fi.module.modname, fi.qualname)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(Reached(fi, chain))
+        if depth >= max_depth:
+            continue
+        cls = fi.qualname.split(".")[0] if "." in fi.qualname else ""
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Call):
+                got = index.resolve_call(n, fi.module, cls_name=cls)
+                if got is not None:
+                    stack.append((got, chain + (got.qualname,), depth + 1))
+    return out
+
+
+# -- scope / capture analysis (TRACED-CAPTURE, DONATE-USE-AFTER) ------------
+
+def bound_names(fnode: ast.AST) -> Set[str]:
+    """Every name BOUND anywhere in ``fnode``'s subtree: params (of any
+    nested def/lambda too), assignment/for/with/except targets,
+    imports, def/class names, comprehension targets."""
+    bound: Set[str] = set()
+    outward: Set[str] = set()      # global/nonlocal declarations
+    for n in ast.walk(fnode):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            a = n.args
+            for p in (list(a.posonlyargs) + list(a.args)
+                      + list(a.kwonlyargs)):
+                bound.add(p.arg)
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+            if not isinstance(n, ast.Lambda):
+                bound.add(n.name)
+        elif isinstance(n, ast.ClassDef):
+            bound.add(n.name)
+        elif isinstance(n, ast.Name) and isinstance(
+                n.ctx, (ast.Store, ast.Del)):
+            bound.add(n.id)
+        elif isinstance(n, (ast.Import, ast.ImportFrom)):
+            for al in n.names:
+                bound.add((al.asname or al.name).split(".")[0])
+        elif isinstance(n, ast.ExceptHandler) and n.name:
+            bound.add(n.name)
+        elif isinstance(n, (ast.Global, ast.Nonlocal)):
+            # declared names bind OUTSIDE this scope
+            outward.update(n.names)
+    return bound - outward
+
+
+def free_names(fnode: ast.AST) -> Set[str]:
+    """Names loaded in ``fnode``'s subtree but bound nowhere inside it
+    (and not builtins) — closure captures or module globals."""
+    bound = bound_names(fnode)
+    free: Set[str] = set()
+    for n in ast.walk(fnode):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            if n.id not in bound and n.id not in _BUILTINS:
+                free.add(n.id)
+    return free
+
+
+def iter_statements(body: Iterable[ast.stmt]):
+    """Flatten a statement list in source order, descending into
+    compound statements' bodies (If/For/While/With/Try) but NOT into
+    nested function/class definitions."""
+    for stmt in body:
+        yield stmt
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                continue
+            if isinstance(sub, ast.stmt):
+                yield from iter_statements([sub])
+            elif isinstance(sub, ast.ExceptHandler):
+                yield from iter_statements(sub.body)

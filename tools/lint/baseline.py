@@ -1,0 +1,105 @@
+"""Baseline allowlist for alink-lint.
+
+A true positive that is *intentional* — a documented semantics
+decision, a pre-registry collective site, a flag-gated debug callback —
+gets an entry here instead of a code change. The contract:
+
+  * every entry MUST carry a non-empty ``justification`` string: the
+    baseline is a list of explained exceptions, not a mute button;
+  * entries match findings by ``(rule, file, ident)`` where ``ident``
+    supports ``fnmatch`` globs (``"shard_fn:psum"``, ``"*:psum"``), so
+    they survive reformatting — line numbers never appear;
+  * ``--strict`` fails on entries that matched NOTHING: the allowlist
+    can only shrink with the code, never silently outlive it.
+
+Workflow for an intentional exception (docs/performance.md "alink-lint"):
+
+  1. run ``python -m tools.lint`` and copy the finding's
+     ``file`` / ``ident`` pair;
+  2. add ``{"rule": ..., "file": ..., "ident": ..., "justification":
+     "<why this is safe, with the test/doc that proves it>"}`` to
+     ``tools/lint_baseline.json``;
+  3. re-run with ``--strict`` — it must exit 0 with your entry consumed
+     (listed under ``baselined``) and no stale entries.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .analyzer import Finding, repo_root
+
+
+class BaselineError(ValueError):
+    """A malformed baseline file (missing fields, empty justification)."""
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    file: str
+    ident: str
+    justification: str
+    hits: int = 0
+
+    def matches(self, f: Finding) -> bool:
+        return (f.rule == self.rule and f.file == self.file
+                and fnmatch.fnmatchcase(f.ident, self.ident))
+
+
+@dataclass
+class Baseline:
+    path: str
+    entries: List[BaselineEntry] = field(default_factory=list)
+
+    def split(self, findings: Sequence[Finding]
+              ) -> Tuple[List[Finding], List[Finding], List[BaselineEntry]]:
+        """(violations, baselined, stale_entries)."""
+        violations: List[Finding] = []
+        baselined: List[Finding] = []
+        for f in findings:
+            hit = next((e for e in self.entries if e.matches(f)), None)
+            if hit is None:
+                violations.append(f)
+            else:
+                hit.hits += 1
+                baselined.append(f)
+        stale = [e for e in self.entries if e.hits == 0]
+        return violations, baselined, stale
+
+
+def load_baseline(path: Optional[str] = None) -> Baseline:
+    if path is None:
+        path = os.path.join(repo_root(), "tools", "lint_baseline.json")
+    if not os.path.exists(path):
+        return Baseline(path=path)
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            raise BaselineError(f"{path}: not valid JSON ({e})") from e
+    if not isinstance(doc, dict) or not isinstance(doc.get("entries", []), list):
+        raise BaselineError(
+            f"{path}: expected an object with an \"entries\" list")
+    entries: List[BaselineEntry] = []
+    for i, raw in enumerate(doc.get("entries", [])):
+        missing = [k for k in ("rule", "file", "ident", "justification")
+                   if not raw.get(k)]
+        if missing:
+            raise BaselineError(
+                f"{path}: entry #{i} is missing/empty {missing} — every "
+                f"baseline entry needs rule, file, ident and a non-empty "
+                f"justification")
+        if len(str(raw["justification"]).strip()) < 20:
+            raise BaselineError(
+                f"{path}: entry #{i} ({raw['rule']} {raw['ident']}): the "
+                f"justification must actually explain WHY the exception "
+                f"is safe (got {raw['justification']!r})")
+        entries.append(BaselineEntry(rule=raw["rule"], file=raw["file"],
+                                     ident=raw["ident"],
+                                     justification=raw["justification"]))
+    return Baseline(path=path, entries=entries)

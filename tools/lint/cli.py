@@ -1,0 +1,94 @@
+"""``python -m tools.lint`` — the alink-lint CLI.
+
+Exit codes:
+  0  clean (or report-only mode)
+  1  non-baselined violations (with ``--strict``), or stale baseline
+     entries (``--strict`` only)
+  2  configuration/baseline errors (malformed baseline, missing root)
+
+``--json`` emits a machine-readable report (findings + baselined +
+stale) for CI artifacts; the tier-1 test and ``tools/perf_gate.sh``
+both run ``python -m tools.lint --strict``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .analyzer import load_flag_registry, repo_root
+from .baseline import BaselineError, load_baseline
+from .rules import default_config, run_lint
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="alink-lint: compiled-program invariant analyzer "
+                    "(ENV-KEY-FOLD, TRACED-CAPTURE, DONATE-USE-AFTER, "
+                    "COLLECTIVE-SITE, HOST-CALLBACK-FREE)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any non-baselined violation or stale "
+                         "baseline entry (the tier-1/CI mode)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable JSON report on stdout")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline allowlist (default "
+                         "tools/lint_baseline.json)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: two levels up from this file)")
+    args = ap.parse_args(argv)
+
+    root = args.root or repo_root()
+    # registry and baseline belong to the TREE being linted: a --root
+    # pointed at another checkout must use that checkout's flags.py /
+    # lint_baseline.json, not this tool's own
+    try:
+        registry = load_flag_registry(
+            os.path.join(root, "alink_tpu", "common", "flags.py"))
+    except (OSError, SyntaxError, ValueError) as e:
+        # a broken flags.py (unreadable, syntax error, or a declaration
+        # FlagRegistry.register refuses) is a configuration error of
+        # the linted tree, not a crash of the linter
+        print(f"alink-lint: cannot load the target tree's flag "
+              f"registry: {e}", file=sys.stderr)
+        return 2
+    try:
+        baseline = load_baseline(
+            args.baseline
+            or os.path.join(root, "tools", "lint_baseline.json"))
+    except BaselineError as e:
+        print(f"alink-lint: {e}", file=sys.stderr)
+        return 2
+    findings = run_lint(root=root, config=default_config(),
+                        registry=registry)
+    violations, baselined, stale = baseline.split(findings)
+
+    if args.as_json:
+        print(json.dumps({
+            "violations": [f.to_json() for f in violations],
+            "baselined": [f.to_json() for f in baselined],
+            "stale_baseline": [
+                {"rule": e.rule, "file": e.file, "ident": e.ident}
+                for e in stale],
+        }, indent=2))
+    else:
+        for f in violations:
+            print(f.render())
+        if baselined:
+            print(f"alink-lint: {len(baselined)} finding(s) baselined "
+                  f"with justification ({baseline.path})")
+        for e in stale:
+            print(f"alink-lint: STALE baseline entry {e.rule} {e.file} "
+                  f"[{e.ident}] matched nothing — remove it")
+        if not violations:
+            print(f"alink-lint: clean "
+                  f"({len(findings)} finding(s) total, all baselined)"
+                  if findings else "alink-lint: clean (0 findings)")
+
+    # report-only by default; --strict is the gate (tier-1, perf_gate)
+    if args.strict and (violations or stale):
+        return 1
+    return 0

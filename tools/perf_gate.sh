@@ -20,6 +20,13 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# static gate first (ISSUE 7): the compiled-program invariant analyzer.
+# Cheap (pure AST, no jax), and a staleness/collective/callback violation
+# should fail the gate before any benchmark spends minutes measuring a
+# program that is structurally wrong. Intentional exceptions live in
+# tools/lint_baseline.json with written justifications.
+python -m tools.lint --strict
+
 BASE=${PERF_GATE_BASE:-BENCH_quick_base.json}
 NEW=BENCH_quick.json
 THRESH=${PERF_GATE_THRESHOLD:-30}
