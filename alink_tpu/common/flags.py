@@ -309,6 +309,30 @@ FLAGS.register(
     clamp=lambda n: max(1, n), tolerant=True,
     accessor="alink_tpu.common.tracing._buffer_capacity")
 FLAGS.register(
+    "ALINK_TPU_PROFILE", "bool", False,
+    "measured device profiling: capture windows, timing-harness "
+    "attribution, live-HBM accounting (common/profiling2.py)",
+    "observability",
+    key_neutral="host-side timing marks, live-array walks and xprof "
+                "capture only; lowered HLO and program-cache keys are "
+                "byte-identical on/off (tests/test_profiling2.py)",
+    accessor="alink_tpu.common.profiling2.profile_enabled")
+FLAGS.register(
+    "ALINK_TPU_PROFILE_DIR", "str", "",
+    "artifact directory for captured jax.profiler traces "
+    "(bench.py --run-dir points it at the run directory)",
+    "observability",
+    key_neutral="output path for host-side capture artifacts; never "
+                "read inside a traced program",
+    accessor="alink_tpu.common.profiling2.profile_dir")
+FLAGS.register(
+    "ALINK_TPU_PROFILE_XPROF", "bool", False,
+    "arm bounded jax.profiler capture windows (one per scope) when "
+    "profiling is on and a profile dir is set", "observability",
+    key_neutral="host-side profiler start/stop around already-compiled "
+                "program executions; compiled programs unchanged",
+    accessor="alink_tpu.common.profiling2.xprof_enabled")
+FLAGS.register(
     "ALINK_TPU_HEALTH", "bool", True,
     "in-program training-health probe channel (stacked carry series)",
     "observability",

@@ -1,0 +1,821 @@
+"""Measured device profiling — Layer 3 of the observability stack.
+
+Every ``bound:`` label the bench has published so far is a *projection*:
+static XLA cost analysis divided by wall clock (``common/tracing.py``
+cost gauges), or a hand-derived flops/bytes model (``bench.mfu``).
+Nothing measured where the wall time actually goes — host dispatch vs
+H2D/D2H transfer vs device compute vs collective — or where HBM
+actually sits. This module is that missing measured layer:
+
+  * **capture windows** — the engine opens a :func:`profile_window`
+    around each compiled-program execution (``comqueue.exec`` single
+    path, ``comqueue.chunk`` in checkpointed runs, the FTRL stream
+    drain) and marks the host-observable phase splits into it:
+    ``dispatch`` (time the compiled call held the host thread),
+    ``device`` (time a blocking sync waited on device work),
+    ``transfer`` (H2D input ship / D2H result fetch), ``collective``
+    (from a parsed device trace; the timing harness cannot separate it
+    from device compute and reports 0 with the source marked).
+  * **timing-harness attribution** — the fallback that works on every
+    rig: per-program ``block_until_ready`` deltas plus the phase marks
+    above, aggregated per (workload, scope, bucket). The residual of a
+    measured wall not covered by any mark is the ``host`` bucket
+    (encode/IO/python).
+  * **programmatic xprof capture** — with ``ALINK_TPU_PROFILE_XPROF=1``
+    and a profile directory, the first window of each scope also runs a
+    ``jax.profiler`` trace into ``<dir>/xprof/<scope>-<n>`` (under a
+    bench workload, the first *measured* window — warmup/compile
+    windows never spend the per-scope capture budget);
+    :func:`parse_xprof_trace` ingests the captured
+    ``*.trace.json.gz`` and attributes device-lane time across
+    compute / transfer / collective buckets (rigs whose trace carries
+    only host lanes — e.g. CPU smoke rigs without the TensorBoard
+    profiler device plugin — parse to ``None`` and the timing harness
+    stands alone, which is exactly the fallback contract).
+  * **live HBM accounting** — :func:`hbm_snapshot` walks
+    ``jax.live_arrays()`` (non-deleted buffers only) at superstep-chunk
+    and stream-snapshot boundaries, exports
+    ``alink_hbm_live_bytes{scope=...}`` gauges and keeps last/max per
+    scope; :func:`donation_probe` *measures* that buffer donation
+    (PR 5) actually halves resident state: it steps a jitted carry
+    update with and without ``donate_argnums`` while holding the
+    pre-step buffer (the engine's snapshot pattern) and compares peak
+    live bytes.
+
+Everything here is host-side: no compiled program changes shape, no op
+is added, nothing folds into a cache key (``ALINK_TPU_PROFILE`` is
+registry-declared key-neutral and ``tests/test_profiling2.py`` pins
+lowered-HLO byte-identity and program-cache hits across the toggle).
+The only behavioral change under the flag is an extra blocking
+``block_until_ready`` per profiled window — timing, never values.
+
+Flags (``common/flags.py``):
+
+  * ``ALINK_TPU_PROFILE``       — default off. Master switch.
+  * ``ALINK_TPU_PROFILE_DIR``   — artifact directory for xprof captures
+    (``bench.py --run-dir`` points it at the run directory).
+  * ``ALINK_TPU_PROFILE_XPROF`` — default off. Arm ``jax.profiler``
+    capture windows (bounded: one per scope) — host-profiler tracing
+    can slow Python-heavy sections by orders of magnitude, so it never
+    runs implicitly.
+
+Consumers: ``bench.py`` rewrites each workload row's ``bound:`` to the
+measured classification (static one preserved as ``bound_static``) and
+attaches the attribution under ``profile``; ``tools/doctor.py`` merges
+the exported profile with the metrics dump and bench rows into a
+per-workload verdict with a top-3 "what to fix" list.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import gzip
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from .flags import flag_value
+
+__all__ = [
+    "PROFILE_ENV", "PROFILE_DIR_ENV", "PROFILE_XPROF_ENV",
+    "PROFILE_FORMAT", "BUCKETS",
+    "profile_enabled", "profile_dir", "xprof_enabled",
+    "ProfileCollector", "get_profiler", "set_profiler",
+    "profile_window", "open_window", "mark", "hbm_snapshot",
+    "live_hbm_bytes", "measured_region", "workload",
+    "parse_xprof_trace", "measured_bound", "donation_probe",
+]
+
+PROFILE_ENV = "ALINK_TPU_PROFILE"
+PROFILE_DIR_ENV = "ALINK_TPU_PROFILE_DIR"
+PROFILE_XPROF_ENV = "ALINK_TPU_PROFILE_XPROF"
+
+PROFILE_FORMAT = "alink_tpu_profile_v1"
+
+# the four measured buckets (host residual is derived, never marked)
+BUCKETS = ("dispatch", "transfer", "device", "collective")
+
+# at most this many xprof captures per scope per collector — profiler
+# host tracing is 10-100x overhead on Python-heavy sections, so capture
+# must be a bounded probe, not a mode
+_XPROF_CAP_PER_SCOPE = 1
+
+
+def profile_enabled() -> bool:
+    """``ALINK_TPU_PROFILE`` switch (default off), read live."""
+    return flag_value(PROFILE_ENV, False)
+
+
+def profile_dir() -> str:
+    """``ALINK_TPU_PROFILE_DIR`` — xprof capture root ('' = no capture)."""
+    return flag_value(PROFILE_DIR_ENV, "")
+
+
+def xprof_enabled() -> bool:
+    """``ALINK_TPU_PROFILE_XPROF`` — arm jax.profiler capture windows."""
+    return flag_value(PROFILE_XPROF_ENV, False)
+
+
+def live_hbm_bytes() -> int:
+    """Bytes held by live (non-deleted) jax arrays right now — the
+    resident device state a ``jax.device_memory_profile`` would also
+    see, without the pprof round trip. Donated/deleted buffers are
+    excluded (their Python handle survives but the buffer is gone)."""
+    import jax
+    total = 0
+    for a in jax.live_arrays():
+        try:
+            if not a.is_deleted():
+                total += a.nbytes
+        except Exception:       # pragma: no cover - exotic array types
+            pass
+    return total
+
+
+class _NullWindow:
+    """Shared no-op window when profiling is off — the hot-path cost is
+    one env read at window creation and attribute no-ops per mark."""
+
+    __slots__ = ()
+    on = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **kw):
+        return self
+
+    def dispatch(self, seconds, n=1):
+        pass
+
+    def device(self, seconds):
+        pass
+
+    def transfer(self, seconds, nbytes=0):
+        pass
+
+    def collective(self, seconds, calls=0):
+        pass
+
+    def close(self):
+        pass
+
+
+_NULL_WINDOW = _NullWindow()
+
+
+class _Window:
+    """One capture window: phase marks land in the collector keyed by
+    the workload/scope captured at open. Usable as a context manager or
+    via explicit :meth:`close` (generator drains must not hold a
+    ``with`` across ``yield``). Thread-safe: prefetch threads mark into
+    the same window object the consumer opened."""
+
+    __slots__ = ("scope", "label", "workload", "_col", "_t0",
+                 "_capture_dir", "_closed")
+
+    @property
+    def on(self) -> bool:
+        return True
+
+    def __init__(self, collector: "ProfileCollector", scope: str,
+                 label: Optional[str], capture: bool):
+        self.scope = scope
+        self.label = label
+        self._col = collector
+        self.workload = collector.current_workload()
+        self._t0 = time.perf_counter()
+        self._closed = False
+        self._capture_dir = (collector._maybe_start_capture(scope)
+                             if capture else None)
+
+    def set(self, **kw):
+        if "label" in kw:
+            self.label = kw["label"]
+        return self
+
+    def dispatch(self, seconds, n=1):
+        self._col._mark(self.workload, self.scope, "dispatch", seconds, n=n)
+
+    def device(self, seconds):
+        self._col._mark(self.workload, self.scope, "device", seconds)
+
+    def transfer(self, seconds, nbytes=0):
+        self._col._mark(self.workload, self.scope, "transfer", seconds,
+                        nbytes=nbytes)
+
+    def collective(self, seconds, calls=0):
+        self._col._mark(self.workload, self.scope, "collective", seconds,
+                        n=calls)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        wall = time.perf_counter() - self._t0
+        cap = self._capture_dir
+        if cap is not None:
+            self._col._stop_capture(cap, self.workload, self.scope, wall)
+        self._col._record_window(self.workload, self.scope, self.label, wall)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class ProfileCollector:
+    """Thread-safe accumulator for measured-profiling data.
+
+    Aggregates phase marks per (workload, scope, bucket, measured-flag)
+    — bounded by the instrumentation-site x workload product, never by
+    run length — plus per-(workload, scope) window wall stats, HBM
+    snapshots (last/max per scope), xprof capture records and the
+    donation probe result. ``export(path)`` writes the
+    ``alink_tpu_profile_v1`` JSON artifact ``tools/doctor.py`` reads.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (workload, scope, bucket, measured) -> [seconds, n, nbytes]
+        self._marks: Dict[Tuple, List[float]] = {}
+        # (workload, scope) -> [windows, wall_s]
+        self._windows: Dict[Tuple, List[float]] = {}
+        # (workload, scope) -> [count, last_bytes, max_bytes]
+        self._hbm: Dict[Tuple, List[float]] = {}
+        self._workload: Optional[str] = None
+        self._measured_depth = 0
+        # workload -> measured-region wall seconds
+        self._measured_wall: Dict[Optional[str], float] = {}
+        self._captures: List[Dict[str, Any]] = []
+        self._capture_counts: Dict[str, int] = {}
+        self._capture_active = False
+        self._capture_error: Optional[str] = None
+        self._donation: Optional[Dict[str, Any]] = None
+
+    # -- workload / measured-region context ------------------------------
+    def current_workload(self) -> Optional[str]:
+        return self._workload
+
+    @contextlib.contextmanager
+    def workload(self, name: str) -> Iterator[None]:
+        """Scope every mark/window/snapshot recorded inside to one named
+        workload (the bench sets it per suite row; workloads run
+        serially, so one process-wide slot is the right model)."""
+        with self._lock:
+            prev, self._workload = self._workload, str(name)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._workload = prev
+
+    @contextlib.contextmanager
+    def measured_region(self) -> Iterator[None]:
+        """Tag marks recorded inside as belonging to a *timed* span (the
+        bench's measured endpoints). Attribution for a workload row uses
+        measured marks only, so warmup compiles never pollute the
+        steady-state fractions. Regions may nest; wall is charged to the
+        outermost region only."""
+        t0 = time.perf_counter()
+        with self._lock:
+            self._measured_depth += 1
+            outer = self._measured_depth == 1
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._measured_depth -= 1
+                if outer:
+                    key = self._workload
+                    self._measured_wall[key] = \
+                        self._measured_wall.get(key, 0.0) + dt
+
+    # -- recording ---------------------------------------------------------
+    def _mark(self, workload, scope: str, bucket: str, seconds: float,
+              n: int = 1, nbytes: int = 0):
+        with self._lock:
+            measured = self._measured_depth > 0
+            key = (workload, scope, bucket, measured)
+            acc = self._marks.get(key)
+            if acc is None:
+                acc = self._marks[key] = [0.0, 0, 0]
+            acc[0] += float(seconds)
+            acc[1] += int(n)
+            acc[2] += int(nbytes)
+
+    def _record_window(self, workload, scope: str, label, wall_s: float):
+        with self._lock:
+            key = (workload, scope)
+            acc = self._windows.get(key)
+            if acc is None:
+                acc = self._windows[key] = [0, 0.0]
+            acc[0] += 1
+            acc[1] += wall_s
+
+    def hbm_snapshot(self, scope: str) -> Optional[int]:
+        """Record the live device-buffer bytes under ``scope`` (and the
+        ``alink_hbm_live_bytes{scope=}`` gauge). No-op (returns None)
+        when profiling is off."""
+        if not profile_enabled():
+            return None
+        nbytes = live_hbm_bytes()
+        with self._lock:
+            key = (self._workload, scope)
+            acc = self._hbm.get(key)
+            if acc is None:
+                acc = self._hbm[key] = [0, 0, 0]
+            acc[0] += 1
+            acc[1] = nbytes
+            acc[2] = max(acc[2], nbytes)
+        from .metrics import get_registry, metrics_enabled
+        if metrics_enabled():
+            get_registry().set_gauge("alink_hbm_live_bytes", nbytes,
+                                     {"scope": scope})
+        return nbytes
+
+    def record_donation(self, result: Dict[str, Any]) -> None:
+        with self._lock:
+            self._donation = dict(result)
+
+    def discard_workload(self, name: Optional[str]) -> None:
+        """Drop everything recorded for one workload — the bench calls
+        this before retrying a failed row so the aborted attempt's marks
+        and measured wall never double into the published attribution."""
+        with self._lock:
+            self._marks = {k: v for k, v in self._marks.items()
+                           if k[0] != name}
+            self._windows = {k: v for k, v in self._windows.items()
+                             if k[0] != name}
+            self._hbm = {k: v for k, v in self._hbm.items()
+                         if k[0] != name}
+            self._measured_wall.pop(name, None)
+            # give back the per-scope capture budget the aborted
+            # attempt spent, so the retry can take its own capture
+            for c in self._captures:
+                if c["workload"] == name:
+                    s = c["scope"]
+                    self._capture_counts[s] = max(
+                        0, self._capture_counts.get(s, 0) - 1)
+            self._captures = [c for c in self._captures
+                              if c["workload"] != name]
+
+    # -- xprof capture -----------------------------------------------------
+    def _maybe_start_capture(self, scope: str) -> Optional[str]:
+        """Start a jax.profiler trace for this window if armed and the
+        per-scope budget allows; returns the capture dir (the stop
+        token) or None. Never raises — a broken/busy profiler degrades
+        to harness-only attribution with the error recorded once."""
+        root = profile_dir()
+        if not root or not xprof_enabled():
+            return None
+        with self._lock:
+            # bench context (a named workload is active): spend the
+            # per-scope budget on a MEASURED window only — the first
+            # window of a scope is otherwise the warmup/compile call,
+            # and a trace of compile time is not the workload's
+            # steady-state. Standalone users (no workload set) capture
+            # on the first window, budget unchanged.
+            if self._workload is not None and self._measured_depth == 0:
+                return None
+            if self._capture_active or self._capture_error is not None:
+                return None
+            n = self._capture_counts.get(scope, 0)
+            if n >= _XPROF_CAP_PER_SCOPE:
+                return None
+            self._capture_counts[scope] = n + 1
+            self._capture_active = True
+        cap = os.path.join(root, "xprof",
+                           f"{scope.replace('/', '_')}-{n}")
+        try:
+            os.makedirs(cap, exist_ok=True)
+            import jax
+            jax.profiler.start_trace(cap)
+            return cap
+        except Exception as e:
+            with self._lock:
+                self._capture_active = False
+                self._capture_error = f"{type(e).__name__}: {e}"
+            return None
+
+    def _stop_capture(self, cap: str, workload, scope: str, wall_s: float):
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:       # pragma: no cover - stop_trace raced
+            with self._lock:
+                self._capture_error = f"{type(e).__name__}: {e}"
+        finally:
+            with self._lock:
+                self._capture_active = False
+        parsed = parse_xprof_trace(cap)
+        with self._lock:
+            self._captures.append({
+                "workload": workload, "scope": scope, "dir": cap,
+                "window_wall_s": round(wall_s, 6), "parsed": parsed})
+
+    # -- reading -----------------------------------------------------------
+    def workload_attribution(self, name: Optional[str]
+                             ) -> Optional[Dict[str, Any]]:
+        """Measured attribution for one workload: the four bucket sums
+        over *measured* marks, the measured wall, and the derived host
+        residual. None when nothing measured was recorded."""
+        with self._lock:
+            wall = self._measured_wall.get(name, 0.0)
+            sums = {b: 0.0 for b in BUCKETS}
+            counts = {b: 0 for b in BUCKETS}
+            nbytes = 0
+            found = False
+            device_scopes = set()
+            for (wl, scope, bucket, measured), acc in self._marks.items():
+                if wl != name or not measured:
+                    continue
+                found = True
+                sums[bucket] += acc[0]
+                counts[bucket] += acc[1]
+                if bucket == "transfer":
+                    nbytes += acc[2]
+                if bucket == "device" and acc[0] > 0:
+                    device_scopes.add(scope)
+        if not found and wall <= 0.0:
+            return None
+        attributed = sum(sums.values())
+        host = max(wall - attributed, 0.0)
+        out = {f"{b}_s": round(sums[b], 6) for b in BUCKETS}
+        out["host_s"] = round(host, 6)
+        out["measured_wall_s"] = round(wall, 6)
+        out["dispatch_calls"] = counts["dispatch"]
+        out["transfer_bytes"] = nbytes
+        # which program legs the device time came from: a per-sample
+        # cost model only normalizes honestly against a SINGLE leg's
+        # device time (consumers skip the compute/hbm split otherwise)
+        out["device_scopes"] = sorted(device_scopes)
+        # xprof capture for this workload, if any parsed to device lanes
+        xp = None
+        with self._lock:
+            for c in self._captures:
+                if c["workload"] == name and c.get("parsed"):
+                    xp = c["parsed"]
+                    break
+        out["source"] = "xprof+timing-harness" if xp else "timing-harness"
+        if xp:
+            out["xprof"] = xp
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        """The full collector state as plain JSON-ready dicts."""
+        with self._lock:
+            marks = [
+                {"workload": wl, "scope": scope, "bucket": bucket,
+                 "measured": measured, "seconds": round(acc[0], 6),
+                 "n": acc[1], "nbytes": acc[2]}
+                for (wl, scope, bucket, measured), acc
+                in sorted(self._marks.items(),
+                          key=lambda kv: (str(kv[0][0]), kv[0][1],
+                                          kv[0][2], kv[0][3]))]
+            windows = [
+                {"workload": wl, "scope": scope, "count": int(acc[0]),
+                 "wall_s": round(acc[1], 6)}
+                for (wl, scope), acc in sorted(
+                    self._windows.items(),
+                    key=lambda kv: (str(kv[0][0]), kv[0][1]))]
+            hbm = [
+                {"workload": wl, "scope": scope, "count": int(acc[0]),
+                 "last_bytes": int(acc[1]), "max_bytes": int(acc[2])}
+                for (wl, scope), acc in sorted(
+                    self._hbm.items(),
+                    key=lambda kv: (str(kv[0][0]), kv[0][1]))]
+            names = sorted({str(wl) for wl in self._measured_wall
+                            if wl is not None}
+                           | {str(k[0]) for k in self._marks
+                              if k[0] is not None})
+            captures = [dict(c) for c in self._captures]
+            err = self._capture_error
+            donation = dict(self._donation) if self._donation else None
+        workloads = {}
+        for n in names:
+            attr = self.workload_attribution(n)
+            if attr is not None:
+                workloads[n] = attr
+        doc = {"format": PROFILE_FORMAT, "enabled": profile_enabled(),
+               "workloads": workloads, "marks": marks, "windows": windows,
+               "hbm": hbm, "captures": captures}
+        if err:
+            doc["capture_error"] = err
+        if donation:
+            doc["donation"] = donation
+        return doc
+
+    def export(self, path: str) -> str:
+        """Write the profile artifact (atomic publish); returns path."""
+        doc = self.summary()
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+    def reset(self) -> None:
+        with self._lock:
+            self._marks.clear()
+            self._windows.clear()
+            self._hbm.clear()
+            self._measured_wall.clear()
+            self._captures.clear()
+            self._capture_counts.clear()
+            self._capture_error = None
+            self._donation = None
+
+
+# -- the process-wide collector ---------------------------------------------
+
+_default_collector: Optional[ProfileCollector] = None
+_default_lock = threading.Lock()
+
+
+def get_profiler() -> ProfileCollector:
+    """The collector every instrumented site reports into."""
+    global _default_collector
+    if _default_collector is None:
+        with _default_lock:
+            if _default_collector is None:
+                _default_collector = ProfileCollector()
+    return _default_collector
+
+
+def set_profiler(collector: ProfileCollector) -> ProfileCollector:
+    """Swap the process-wide collector (per-run isolation, tests)."""
+    global _default_collector
+    with _default_lock:
+        prev = _default_collector if _default_collector is not None \
+            else ProfileCollector()
+        _default_collector = collector
+    return prev
+
+
+# -- instrumentation helpers (the call-site API) ----------------------------
+
+def profile_window(scope: str, label: Optional[str] = None,
+                   capture: bool = False):
+    """A capture window on the process collector, or the shared no-op
+    when ``ALINK_TPU_PROFILE`` is off. Use as a context manager."""
+    if not profile_enabled():
+        return _NULL_WINDOW
+    return _Window(get_profiler(), scope, label, capture)
+
+
+def open_window(scope: str, label: Optional[str] = None,
+                capture: bool = False):
+    """Like :func:`profile_window` but for call sites that must close
+    explicitly (generator drains — an open ``with`` must not cross a
+    ``yield``). Call ``.close()`` in a ``finally``."""
+    return profile_window(scope, label=label, capture=capture)
+
+
+def mark(scope: str, bucket: str, seconds: float, n: int = 1,
+         nbytes: int = 0) -> None:
+    """A windowless phase mark (e.g. a result fetch outside any engine
+    window); no-op when profiling is off."""
+    if not profile_enabled():
+        return
+    if bucket not in BUCKETS:
+        raise ValueError(f"unknown profile bucket {bucket!r}; "
+                         f"expected one of {BUCKETS}")
+    col = get_profiler()
+    col._mark(col.current_workload(), scope, bucket, seconds,
+              n=n, nbytes=nbytes)
+
+
+def hbm_snapshot(scope: str) -> Optional[int]:
+    """Module-level convenience for
+    :meth:`ProfileCollector.hbm_snapshot` (no-op when off)."""
+    if not profile_enabled():
+        return None
+    return get_profiler().hbm_snapshot(scope)
+
+
+def measured_region():
+    """Module-level convenience: the process collector's measured-region
+    context (a real no-op context when profiling is off)."""
+    if not profile_enabled():
+        return contextlib.nullcontext()
+    return get_profiler().measured_region()
+
+
+def workload(name: str):
+    """Module-level convenience: scope recording to one workload."""
+    if not profile_enabled():
+        return contextlib.nullcontext()
+    return get_profiler().workload(name)
+
+
+# -- xprof trace parser -----------------------------------------------------
+
+_COLLECTIVE_TOKENS = ("all-reduce", "allreduce", "all-gather", "allgather",
+                      "reduce-scatter", "reducescatter", "all-to-all",
+                      "alltoall", "collective", "psum", "ncclallreduce")
+_TRANSFER_TOKENS = ("copy", "memcpy", "h2d", "d2h", "infeed", "outfeed",
+                    "transferto", "transferfrom", "device_transfer")
+
+
+def _classify_event(name: str) -> str:
+    low = name.lower()
+    for t in _COLLECTIVE_TOKENS:
+        if t in low:
+            return "collective"
+    for t in _TRANSFER_TOKENS:
+        if t in low:
+            return "transfer"
+    return "device"
+
+
+def _is_device_pid(pname: str) -> bool:
+    low = pname.lower()
+    if "/host:" in low:
+        return False
+    return ("/device:" in low or low.startswith(("tpu", "gpu"))
+            or "xla" in low and "op" not in low)
+
+
+def parse_xprof_trace(path: str) -> Optional[Dict[str, Any]]:
+    """Ingest a captured ``jax.profiler`` trace and attribute device-lane
+    time across compute / transfer / collective buckets.
+
+    ``path`` is a trace file (``*.trace.json[.gz]``) or a directory to
+    search recursively (the ``plugins/profile/<ts>/`` layout the
+    profiler writes). Returns ``None`` when no parseable trace exists or
+    the trace carries no device lanes (host-only rigs — the TensorBoard
+    device plugin unavailable) — the caller falls back to the timing
+    harness, per the module contract. Never raises on malformed files.
+    """
+    files: List[str] = []
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, "**", "*.trace.json*"),
+                                 recursive=True))
+    elif os.path.exists(path):
+        files = [path]
+    if not files:
+        return None
+    sums = {"device": 0.0, "transfer": 0.0, "collective": 0.0}
+    t_min, t_max = None, None
+    n_events = 0
+    lanes: set = set()
+    for fp in files:
+        try:
+            if fp.endswith(".gz"):
+                with gzip.open(fp, "rt") as f:
+                    doc = json.load(f)
+            else:
+                with open(fp) as f:
+                    doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        events = doc.get("traceEvents") if isinstance(doc, dict) else None
+        if not isinstance(events, list):
+            continue
+        pid_names: Dict[Any, str] = {}
+        for ev in events:
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                pid_names[ev.get("pid")] = str(
+                    (ev.get("args") or {}).get("name", ""))
+        device_pids = {pid for pid, nm in pid_names.items()
+                       if _is_device_pid(nm)}
+        if not device_pids:
+            continue
+        for ev in events:
+            if ev.get("ph") != "X" or ev.get("pid") not in device_pids:
+                continue
+            try:
+                ts = float(ev.get("ts", 0.0))
+                dur = float(ev.get("dur", 0.0))
+            except (TypeError, ValueError):
+                continue
+            bucket = _classify_event(str(ev.get("name", "")))
+            sums[bucket] += dur / 1e6
+            n_events += 1
+            lanes.add(pid_names.get(ev.get("pid"), "?"))
+            t_min = ts if t_min is None else min(t_min, ts)
+            t_max = (ts + dur) if t_max is None else max(t_max, ts + dur)
+    if n_events == 0:
+        return None
+    wall = (t_max - t_min) / 1e6 if t_min is not None else 0.0
+    busy = sum(sums.values())
+    return {"device_s": round(sums["device"], 6),
+            "transfer_s": round(sums["transfer"], 6),
+            "collective_s": round(sums["collective"], 6),
+            "busy_s": round(busy, 6),
+            "wall_s": round(wall, 6),
+            "dispatch_s": round(max(wall - busy, 0.0), 6),
+            "events": n_events,
+            "lanes": sorted(lanes)}
+
+
+# -- measured bound classification ------------------------------------------
+
+def measured_bound(attr: Dict[str, Any],
+                   flops_per_sample: Optional[float] = None,
+                   bytes_per_sample: Optional[float] = None,
+                   samples_per_sec_per_chip: Optional[float] = None,
+                   peak_tflops: Optional[float] = None,
+                   peak_hbm_gbps: Optional[float] = None
+                   ) -> Tuple[str, Dict[str, float]]:
+    """Classify the binding roof from a *measured* attribution.
+
+    Vocabulary matches the static labels (``bench.mfu``): ``latency``
+    (host dispatch dominates), ``link`` (transfer dominates),
+    ``collective``, ``host`` (unattributed host work dominates —
+    encode/IO/python), and for device-dominated windows ``compute`` vs
+    ``hbm`` by which roof percentage is higher when a per-sample
+    flops/bytes model and throughput are supplied — else the honest
+    ``device`` (the harness cannot split compute from memory without a
+    cost model). Returns ``(bound, fractions)``.
+    """
+    wall = attr.get("measured_wall_s") or 0.0
+    parts = {"dispatch": attr.get("dispatch_s", 0.0),
+             "transfer": attr.get("transfer_s", 0.0),
+             "device": attr.get("device_s", 0.0),
+             "collective": attr.get("collective_s", 0.0),
+             "host": attr.get("host_s", 0.0)}
+    total = max(wall, sum(parts.values()), 1e-12)
+    fracs = {k: v / total for k, v in parts.items()}
+    dominant = max(fracs, key=lambda k: fracs[k])
+    if dominant == "dispatch":
+        return "latency", fracs
+    if dominant == "transfer":
+        return "link", fracs
+    if dominant == "collective":
+        return "collective", fracs
+    if dominant == "host":
+        return "host", fracs
+    # device-dominated: split compute vs hbm on DEVICE-time throughput
+    if (flops_per_sample and bytes_per_sample
+            and samples_per_sec_per_chip and fracs["device"] > 0
+            and peak_tflops and peak_hbm_gbps):
+        sps_dev = samples_per_sec_per_chip / fracs["device"]
+        pf = 100.0 * sps_dev * flops_per_sample / (peak_tflops * 1e12)
+        ph = 100.0 * sps_dev * bytes_per_sample / (peak_hbm_gbps * 1e9)
+        return ("compute" if pf >= ph else "hbm"), fracs
+    return "device", fracs
+
+
+# -- measured donation verification -----------------------------------------
+
+def donation_probe(state_bytes: int = 8 << 20, steps: int = 3
+                   ) -> Dict[str, Any]:
+    """MEASURE that buffer donation halves resident carry state.
+
+    Steps a jitted ``carry + 1`` update ``steps`` times, holding the
+    pre-step buffer across each call exactly like the engine's snapshot
+    path holds the boundary carry while the donated ``cont`` program
+    consumes it. With ``donate_argnums`` the consumed input's buffer is
+    freed (``is_deleted``), so peak live bytes stay ~1x the state; the
+    undonated twin keeps input + output alive — ~2x. Returns the two
+    peaks, their ratio and ``verified`` (ratio <= 0.75). Works on every
+    backend: jax frees donated inputs at the Python layer even where
+    the runtime skips the aliasing optimization (host platforms)."""
+    import jax
+    import numpy as np
+
+    n = max(int(state_bytes) // 4, 1)
+
+    def peak_live(donate: bool) -> int:
+        fn = jax.jit(lambda s: s + 1.0,
+                     donate_argnums=(0,) if donate else ())
+        state = jax.device_put(np.zeros(n, np.float32))
+        jax.block_until_ready(state)
+        base = live_hbm_bytes() - state.nbytes
+        peak = 0
+        for _ in range(int(steps)):
+            out = fn(state)
+            jax.block_until_ready(out)
+            # pre-step buffer still referenced HERE (the snapshot-path
+            # pattern); donation freed it anyway
+            peak = max(peak, live_hbm_bytes() - base)
+            state = out
+        del state, out
+        return peak
+
+    donated = peak_live(True)
+    undonated = peak_live(False)
+    ratio = donated / undonated if undonated else float("nan")
+    result = {"state_bytes": int(n * 4), "steps": int(steps),
+              "donated_peak_bytes": int(donated),
+              "undonated_peak_bytes": int(undonated),
+              "ratio": round(ratio, 4),
+              "verified": bool(ratio <= 0.75),
+              "note": "peak live (non-deleted) buffer bytes while the "
+                      "pre-step carry is still referenced, the engine "
+                      "snapshot-path pattern"}
+    if profile_enabled():
+        get_profiler().record_donation(result)
+    return result

@@ -42,6 +42,8 @@ import numpy as np
 
 from ..common.health import health_enabled
 from ..common.mlenv import MLEnvironment, MLEnvironmentFactory
+from ..common.profiling2 import (hbm_snapshot, mark as profile_mark,
+                                 profile_enabled, profile_window)
 from ..common.tracing import trace_instant, trace_span, tracing_enabled
 from .context import ComContext
 from .communication import CommunicateFunction
@@ -477,7 +479,18 @@ def _fetch_tree(tree):
     leaf flipped read-only (the memo contract above)."""
     import jax
     from ..common.compat import device_get_tree
-    return jax.tree_util.tree_map(_readonly, device_get_tree(tree))
+    if not profile_enabled():
+        return jax.tree_util.tree_map(_readonly, device_get_tree(tree))
+    # measured-profiling D2H mark: result fetches are the transfer leg
+    # of the workload attribution. The fetch itself is unchanged (same
+    # one batched device_get; leaves stay read-only — memo contract).
+    t0 = time.perf_counter()
+    got = device_get_tree(tree)
+    dt = time.perf_counter() - t0
+    nbytes = sum(getattr(leaf, "nbytes", 0)
+                 for leaf in jax.tree_util.tree_leaves(got))
+    profile_mark("comqueue.fetch", "transfer", dt, nbytes=int(nbytes))
+    return jax.tree_util.tree_map(_readonly, got)
 
 
 class ComQueueResult:
@@ -776,6 +789,11 @@ class IterativeComQueue:
 
         parts: Dict[str, Any] = {}
         totals: Dict[str, int] = {}
+        # measured-profiling transfer mark (ALINK_TPU_PROFILE): the
+        # prepare phase is host padding + the H2D input ship — charged
+        # to the transfer bucket of the workload attribution. Host-side
+        # wall clock only; the compiled program is untouched.
+        _prep_t0 = time.perf_counter()
         with _ENGINE_TIMER.span("comqueue.prepare"):
             for k, arr in self._partitioned.items():
                 if isinstance(arr, jax.Array):
@@ -802,6 +820,9 @@ class IterativeComQueue:
                      for k, v in self._broadcast.items()}
             for k, n in totals.items():
                 bcast[f"__total_{k}"] = jnp.asarray(n, jnp.int32)
+        if not lower_only:
+            profile_mark("comqueue.prepare", "transfer",
+                         time.perf_counter() - _prep_t0)
 
         from ..common.profiling import log_superstep, named_stage
         from .communication import collecting
@@ -1084,7 +1105,22 @@ class IterativeComQueue:
         exec_t0 = time.perf_counter()
         with _ENGINE_TIMER.span("comqueue.execute",
                                 labels={"program": cache_status}):
-            stacked = compiled(parts, bcast)
+            # measured-profiling window (ALINK_TPU_PROFILE): dispatch =
+            # time the compiled call held the host thread (includes
+            # trace+compile on a cache miss — the label says which);
+            # device = time an explicit block_until_ready waited on the
+            # program. The extra sync only exists under the flag and
+            # changes timing, never values or compiled HLO.
+            with profile_window("comqueue.exec", label=cache_status,
+                                capture=True) as pw:
+                _pt0 = time.perf_counter()
+                stacked = compiled(parts, bcast)
+                pw.dispatch(time.perf_counter() - _pt0)
+                if pw.on:
+                    _pt1 = time.perf_counter()
+                    jax.block_until_ready(stacked)
+                    pw.device(time.perf_counter() - _pt1)
+        hbm_snapshot("comqueue.exec")
         if jax.process_count() > 1:
             # multi-host session: leaves span non-addressable devices —
             # gather every worker's shard to every host before fetching

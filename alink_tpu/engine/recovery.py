@@ -52,6 +52,7 @@ import numpy as np
 from ..common.checkpoint import load_latest_validated, save_checkpoint
 from ..common.faults import maybe_crash
 from ..common.metrics import env_flag, get_registry, metrics_enabled
+from ..common.profiling2 import hbm_snapshot, profile_window
 from ..common.tracing import trace_instant, trace_span
 
 __all__ = ["CheckpointConfig", "program_signature", "resume_state", "drive",
@@ -316,12 +317,26 @@ def drive(config: CheckpointConfig, *,
         superstep.sync) is what lets a trace answer 'which chunk of
         which exec was slow' — the aggregate metrics cannot."""
         with trace_span("comqueue.chunk", cat="engine") as sp:
-            out = fn(*args, jnp.asarray(limit, jnp.int32))
-            # the device work materializes at this host fetch — timed as
-            # its own phase span so dispatch vs sync split is visible
-            with trace_span("superstep.sync", cat="engine"):
-                step, stop = boundary(out)
+            # measured-profiling window (ALINK_TPU_PROFILE): dispatch =
+            # time the chunk call held the host thread; device = the
+            # boundary sync that flushes it. Host wall clock only — the
+            # chunk program is untouched.
+            with profile_window("comqueue.chunk", capture=True) as pw:
+                _pt0 = time.perf_counter()
+                out = fn(*args, jnp.asarray(limit, jnp.int32))
+                pw.dispatch(time.perf_counter() - _pt0)
+                # the device work materializes at this host fetch — timed
+                # as its own phase span so dispatch vs sync split is
+                # visible
+                with trace_span("superstep.sync", cat="engine"):
+                    _pt1 = time.perf_counter()
+                    step, stop = boundary(out)
+                    pw.device(time.perf_counter() - _pt1)
             sp.set(from_step=from_step, limit=limit, step=step)
+        # superstep-chunk boundary: the live-HBM accounting point (the
+        # carry, any writer-held snapshot copy, and the inputs are all
+        # resident here — the donation savings show up in this gauge)
+        hbm_snapshot("comqueue.chunk")
         return out, step, stop
 
     writer = _SnapshotWriter(config, signature, on_snapshot) \

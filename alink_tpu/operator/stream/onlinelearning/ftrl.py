@@ -37,6 +37,8 @@ from ....common.faults import maybe_crash
 from ....common.metrics import get_registry, metrics_enabled
 from ....common.mtable import MTable
 from ....common.params import InValidator, ParamInfo, Params, RangeValidator
+from ....common.profiling2 import (hbm_snapshot, mark as profile_mark,
+                                   open_window)
 from ....common.tracing import trace_complete, trace_instant
 from ....common.types import AlinkTypes, TableSchema
 from ....params.shared import (HasFeatureCols, HasLabelCol, HasPredictionCol,
@@ -811,7 +813,15 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
             # sharded weights serialized a link round trip per shard on
             # tunneled backends). weights_fn reads the LIVE state and
             # never donates, so (z, n) survive for the next micro-batch.
+            _pt0 = time.perf_counter()
             w_full = np.asarray(jax.device_get(weights_fn(z_host, n_host)))
+            # measured-profiling device mark (ALINK_TPU_PROFILE): on
+            # deferred backends the drain's queued device work
+            # materializes at this fetch, so its wall is the drain's
+            # block-until-ready delta, not a pure transfer
+            profile_mark("ftrl.snapshot", "device",
+                         time.perf_counter() - _pt0)
+            hbm_snapshot("ftrl.snapshot")
             if mon_on and batch is not None:
                 # weight drift vs the PREVIOUS emitted snapshot — the
                 # 'model silently walked away' detector. Reuses the host
@@ -1065,7 +1075,14 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 if enc[0] == "sparse":
                     with width_lock:
                         width_cell[0] = max(width_cell[0], enc[4])
-                return (t, mt, put_replicated(enc), batch_size)
+                # measured-profiling transfer mark: the H2D micro-batch
+                # ship (runs on the prefetch thread; the collector is
+                # thread-safe and workloads run serially)
+                _pt0 = time.perf_counter()
+                shipped = put_replicated(enc)
+                profile_mark("ftrl.encode", "transfer",
+                             time.perf_counter() - _pt0)
+                return (t, mt, shipped, batch_size)
 
             from ..prefetch import prefetch_map
 
@@ -1115,7 +1132,11 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                     meta["fb_S"] = int(fb_S)
                     meta["fb_num_fields"] = int(fb_meta.num_fields)
                     meta["fb_field_size"] = int(fb_meta.field_size)
+                _pt0 = time.perf_counter()
                 zh, nh = jax.device_get([z, n])
+                profile_mark("ftrl.checkpoint", "device",
+                             time.perf_counter() - _pt0)
+                hbm_snapshot("ftrl.checkpoint")
                 save_checkpoint(ck_dir, b_done,
                                 {"z": np.asarray(zh), "n": np.asarray(nh)},
                                 meta=meta, scope="ftrl", keep_last=ck_keep)
@@ -1169,13 +1190,21 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 # wrapped in a throwaway collector so a compile-time
                 # trace doesn't ALSO record directly — the replay is
                 # the single source of truth for this call.
-                if mx:
-                    from ....engine.communication import (collecting,
-                                                          record_manifest)
-                    record_manifest(_step_manifest(step, args))
-                    with collecting([]):
-                        return step(*args)
-                return step(*args)
+                # measured-profiling dispatch mark: the time the step
+                # dispatch held the consumer thread (device work is
+                # async; it materializes at the snapshot fetch)
+                _pt0 = time.perf_counter()
+                try:
+                    if mx:
+                        from ....engine.communication import (
+                            collecting, record_manifest)
+                        record_manifest(_step_manifest(step, args))
+                        with collecting([]):
+                            return step(*args)
+                    return step(*args)
+                finally:
+                    profile_mark("ftrl.drain", "dispatch",
+                                 time.perf_counter() - _pt0)
             # ordered pool: workers=1 (default) is byte-for-byte the old
             # single-prefetch-thread drain; ALINK_TPU_STREAM_WORKERS=N
             # parallelizes the host encode N-wide with order preserved
@@ -1318,7 +1347,17 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 flush_pv()
             yield (next_emit if next_emit is not None else interval, snap)
 
-        self._stream_fn = gen
+        def gen_profiled():
+            # drain-level capture window (ALINK_TPU_PROFILE): wall of
+            # the whole drain + the xprof capture scope. Opened/closed
+            # manually — a `with` must not be held across the yields
+            _pw = open_window("ftrl.drain", capture=True)
+            try:
+                yield from gen()
+            finally:
+                _pw.close()
+
+        self._stream_fn = gen_profiled
         return self
 
 

@@ -355,6 +355,24 @@ def _device_concat(*parts):
     return _DEVICE_CONCAT(*parts)
 
 
+def _kernel_loop(scope, n, step_once, fetch):
+    """Run ``n`` kernel dispatches plus the one flushing fetch, with
+    measured-profiling dispatch/device marks (``ALINK_TPU_PROFILE``) —
+    the raw-jit bench kernels never enter the instrumented engine, so
+    without these marks their wall time would read as unattributed host
+    work. No-op overhead when the flag is off: two perf_counter calls
+    per ~100 ms dispatch."""
+    from alink_tpu.common.profiling2 import profile_window
+    with profile_window(scope) as pw:
+        for _ in range(n):
+            t0 = time.perf_counter()
+            step_once()
+            pw.dispatch(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        fetch()
+        pw.device(time.perf_counter() - t0)
+
+
 class Harness:
     def __init__(self):
         import tempfile
@@ -410,8 +428,13 @@ class Harness:
 
     @staticmethod
     def _time(run, n):
+        # the ONE timed entry of delta(): marks recorded inside count as
+        # steady-state for the measured-profiling attribution (warmup
+        # compiles stay outside) — a no-op context without ALINK_TPU_PROFILE
+        from alink_tpu.common.profiling2 import measured_region
         t0 = time.perf_counter()
-        run(n)
+        with measured_region():
+            run(n)
         return time.perf_counter() - t0
 
     @staticmethod
@@ -728,12 +751,14 @@ def bench_ftrl(h: Harness):
         return z, nacc
 
     def run(n_pools):
-        z = jax.device_put(zrng.randn(dim_pad) * 1e-8, shard)
-        nacc = jax.device_put(np.zeros(dim_pad), shard)
-        for _ in range(n_pools):
-            z, nacc = strict_pool(sp_idx, sp_val, sp_y, z, nacc)
-        np.asarray(z)
-        return z, nacc
+        st = [jax.device_put(zrng.randn(dim_pad) * 1e-8, shard),
+              jax.device_put(np.zeros(dim_pad), shard)]
+
+        def step_once():
+            st[0], st[1] = strict_pool(sp_idx, sp_val, sp_y, st[0], st[1])
+        _kernel_loop("ftrl.kernel", n_pools, step_once,
+                     lambda: np.asarray(st[0]))
+        return st[0], st[1]
 
     K = 8                                    # 8 pools = 192 batches
     dt = h.delta(run, K)
@@ -763,11 +788,14 @@ def bench_ftrl(h: Harness):
             return z, nacc
 
         def run_chain(n_pools, chain_pool=chain_pool):
-            z = jax.device_put(zrng.randn(dim_pad) * 1e-8, shard)
-            nacc = jax.device_put(np.zeros(dim_pad), shard)
-            for _ in range(n_pools):
-                z, nacc = chain_pool(sp_idx, sp_val, sp_y, z, nacc)
-            np.asarray(z)
+            st = [jax.device_put(zrng.randn(dim_pad) * 1e-8, shard),
+                  jax.device_put(np.zeros(dim_pad), shard)]
+
+            def step_once():
+                st[0], st[1] = chain_pool(sp_idx, sp_val, sp_y,
+                                          st[0], st[1])
+            _kernel_loop("ftrl.kernel", n_pools, step_once,
+                         lambda: np.asarray(st[0]))
 
         dt_c = h.delta(run_chain, K)
         chained[CHAIN_K] = B * len(pool) * K / dt_c / h.chips
@@ -804,11 +832,13 @@ def bench_ftrl(h: Harness):
         return z, nacc
 
     def run_stale(n_pools):
-        z = jax.device_put(zrng.randn(dim_pad) * 1e-8, shard)
-        nacc = jax.device_put(np.zeros(dim_pad), shard)
-        for _ in range(n_pools):
-            z, nacc = stale_pool(sp_idx, sp_val, sp_y, z, nacc)
-        np.asarray(z)
+        st = [jax.device_put(zrng.randn(dim_pad) * 1e-8, shard),
+              jax.device_put(np.zeros(dim_pad), shard)]
+
+        def step_once():
+            st[0], st[1] = stale_pool(sp_idx, sp_val, sp_y, st[0], st[1])
+        _kernel_loop("ftrl.kernel", n_pools, step_once,
+                     lambda: np.asarray(st[0]))
 
     Ks = 16
     dt_stale = h.delta(run_stale, Ks)
@@ -1101,9 +1131,11 @@ def bench_ftrl(h: Harness):
         assert rows > 0
         return last_auc
 
+    from alink_tpu.common.profiling2 import measured_region
     drain_stream()                           # warm compiles
     t0 = time.perf_counter()
-    drain_stream()
+    with measured_region():
+        drain_stream()
     stream_e2e_s = time.perf_counter() - t0
     stream_e2e_sps = n_stream / stream_e2e_s / h.chips
     t0 = time.perf_counter()
@@ -1406,11 +1438,15 @@ def bench_logreg_from_disk(h: Harness):
     # median of the PAIRED ratios next to the median absolute times.
     fb16_true = fb_idx_true.astype(np.int16)   # same encode as the disk leg
     y32_true = y_true.astype(np.float32)
+    from alink_tpu.common.profiling2 import measured_region
     tot_ts, mem_ts, ratios, splits = [], [], [], []
     for _ in range(3):
+        # only the PIPELINE leg is the workload's measured region (the
+        # in-memory twin is a reference, not the reported rate)
         t0 = time.perf_counter()
-        fb, labels, split = load_from_disk()
-        train(fb, labels)
+        with measured_region():
+            fb, labels, split = load_from_disk()
+            train(fb, labels)
         t_pipe = time.perf_counter() - t0
         t0 = time.perf_counter()
         train(fb16_true, y32_true)
@@ -1905,11 +1941,13 @@ def quick_ftrl(h: Harness):
             return z, nacc
 
         def run(n_pools, pool_fn=pool_fn):
-            z = jax.device_put(zrng.randn(dim_pad) * 1e-8, shard)
-            nacc = jax.device_put(np.zeros(dim_pad), shard)
-            for _ in range(n_pools):
-                z, nacc = pool_fn(sp_idx, sp_val, sp_y, z, nacc)
-            np.asarray(z)
+            st = [jax.device_put(zrng.randn(dim_pad) * 1e-8, shard),
+                  jax.device_put(np.zeros(dim_pad), shard)]
+
+            def step_once():
+                st[0], st[1] = pool_fn(sp_idx, sp_val, sp_y, st[0], st[1])
+            _kernel_loop("ftrl.kernel", n_pools, step_once,
+                         lambda: np.asarray(st[0]))
 
         dt = h.delta(run, 3, reps=2)
         out[key] = B * n_pool * 3 / dt / h.chips
@@ -1969,12 +2007,14 @@ def quick_logreg_ckpt(h: Harness):
         np.asarray(coef)
 
     base = tempfile.mkdtemp(prefix="alink_quick_ckpt_")
+    from alink_tpu.common.profiling2 import measured_region
     try:
         fit(os.path.join(base, "warm"))       # compile outside the timing
         ts = []
         for i in range(3):
             t0 = time.perf_counter()
-            fit(os.path.join(base, f"r{i}"))
+            with measured_region():
+                fit(os.path.join(base, f"r{i}"))
             ts.append(time.perf_counter() - t0)
         dt = sorted(ts)[1]
     finally:
@@ -2023,11 +2063,13 @@ def quick_ftrl_drain(h: Harness):
         for _ in ftrl.micro_batches():
             pass
 
+    from alink_tpu.common.profiling2 import measured_region
     drain()                                   # warm compiles
     ts = []
     for _ in range(3):
         t0 = time.perf_counter()
-        drain()
+        with measured_region():
+            drain()
         ts.append(time.perf_counter() - t0)
     dt = sorted(ts)[1]
     return {"samples_per_sec_per_chip": round(n_stream / dt / h.chips, 1),
@@ -2080,8 +2122,62 @@ QUICK_WORKLOADS = (("logreg_criteo", quick_logreg),
 
 # ---------------------------------------------------------------------------
 
+def _annotate_profile(row, name):
+    """Attach the measured-profiling attribution to one workload row
+    (``ALINK_TPU_PROFILE``): dispatch/transfer/device/collective seconds
+    + fractions under ``profile``, and the MEASURED ``bound:``
+    classification — the static projection is preserved as
+    ``bound_static`` (rows without a static label gain only the
+    measured one). No-op without the flag or when nothing measured was
+    recorded for the workload."""
+    from alink_tpu.common.profiling2 import (get_profiler, measured_bound,
+                                             profile_enabled)
+    if not profile_enabled() or not isinstance(row, dict) or "error" in row:
+        return row
+    attr = get_profiler().workload_attribution(name)
+    if attr is None:
+        return row
+    # the compute-vs-hbm refinement normalizes the row's headline rate
+    # by the DEVICE share — only honest when that device time came from
+    # one program leg (multi-leg rows like full ftrl merge kernels +
+    # drain; their split would be cross-leg, so keep the aggregate
+    # dominant-bucket label instead)
+    one_leg = len(attr.get("device_scopes") or ()) <= 1
+    bound, fracs = measured_bound(
+        attr,
+        flops_per_sample=row.get("flops_per_sample") if one_leg else None,
+        bytes_per_sample=row.get("hbm_bytes_per_sample"),
+        samples_per_sec_per_chip=row.get("samples_per_sec_per_chip"),
+        peak_tflops=PEAK_TFLOPS, peak_hbm_gbps=PEAK_HBM_GBPS)
+    prof = dict(attr)
+    prof["fractions"] = {k: round(v, 4) for k, v in fracs.items()}
+    prof["bound_measured"] = bound
+    if "bound" in row:
+        row["bound_static"] = row["bound"]
+    row["bound"] = bound
+    row["profile"] = prof
+    return row
+
+
+def _resolve_run_dir(path):
+    """The ``--run-dir`` contract: a fresh path is used as-is (callers
+    pick the name, e.g. mktemp); an existing non-empty directory gets a
+    timestamped subdirectory so repeated captures never clobber each
+    other's artifacts."""
+    path = os.path.abspath(path)
+    if os.path.exists(path) and not os.path.isdir(path):
+        raise SystemExit(f"bench.py: --run-dir {path}: exists and is "
+                         f"not a directory")
+    if os.path.isdir(path) and os.listdir(path):
+        path = os.path.join(
+            path, time.strftime("run-%Y%m%d-%H%M%SZ", time.gmtime()))
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
 def main(argv=None):
     import argparse
+    import sys
     ap = argparse.ArgumentParser(description="alink_tpu benchmark suite")
     ap.add_argument("--metrics-out", default=None, metavar="PATH",
                     help="dump the runtime MetricsRegistry (JSONL) to PATH "
@@ -2098,8 +2194,27 @@ def main(argv=None):
                     help="write the final combined JSON line to PATH too "
                          "(--quick default: BENCH_quick.json; pass "
                          "distinct paths for the before/after gate pair)")
+    ap.add_argument("--run-dir", default=None, metavar="DIR",
+                    help="write every capture artifact (bench.json, "
+                         "metrics.jsonl, profile.json, trace.jsonl, xprof "
+                         "captures) under one directory instead of "
+                         "scattering top-level files; an existing "
+                         "non-empty DIR gets a timestamped subdirectory. "
+                         "tools/run_report.py and tools/doctor.py accept "
+                         "the directory directly")
     args = ap.parse_args(argv)
+    from alink_tpu.common.flags import flag_raw
+    from alink_tpu.common.profiling2 import (donation_probe, get_profiler,
+                                             profile_enabled, workload)
+    run_dir = _resolve_run_dir(args.run_dir) if args.run_dir else None
+    if run_dir and profile_enabled() and not flag_raw("ALINK_TPU_PROFILE_DIR"):
+        # xprof captures (if armed) land with the other run artifacts
+        os.environ["ALINK_TPU_PROFILE_DIR"] = run_dir
     h = Harness()
+    if profile_enabled():
+        # measured donation verification, once per capture: the doctor's
+        # HBM section renders it (the PR-5 claim, measured not asserted)
+        donation_probe()
     workloads = {}
     suite = QUICK_WORKLOADS if args.quick else (
                      ("logreg_criteo", bench_logreg),
@@ -2115,13 +2230,18 @@ def main(argv=None):
         r = None
         for attempt in (1, 2):
             try:
-                r = fn(h)
+                with workload(name):
+                    r = fn(h)
                 break
             except Exception as e:  # pragma: no cover - keep the bench robust
                 # the tunneled device service occasionally drops a request
-                # (e.g. "response body closed") — one retry absorbs it
+                # (e.g. "response body closed") — one retry absorbs it.
+                # The aborted attempt's measured marks/wall must not
+                # double into the retry's attribution
+                if profile_enabled():
+                    get_profiler().discard_workload(name)
                 r = {"error": f"{type(e).__name__}: {e}"}
-        workloads[name] = r
+        workloads[name] = _annotate_profile(r, name)
         print(json.dumps({"workload": name, **r}), flush=True)
 
     # runtime-emitted telemetry: the registry was filled by the engine /
@@ -2132,9 +2252,14 @@ def main(argv=None):
     mode = "quick" if args.quick else "full"
     full_doc = {"workloads": workloads, "mode": mode,
                 # the rig's serial per-dispatch floor, measured once per
-                # capture so latency-bound rows can be read against it
+                # capture so latency-bound rows can be read against it —
+                # plus the chip roofs, so tools/doctor.py can compute
+                # measured achieved-vs-roof without re-importing bench
                 "rig": {"dispatch_gap_est_s": round(h.dispatch_gap(), 6),
-                        "baseline_fp": baseline_provenance_fp()}}
+                        "baseline_fp": baseline_provenance_fp(),
+                        "peak_tflops": PEAK_TFLOPS,
+                        "peak_hbm_gbps": PEAK_HBM_GBPS,
+                        "profile": profile_enabled()}}
     if args.metrics_out:
         from alink_tpu.common.metrics import get_registry
         try:
@@ -2207,12 +2332,33 @@ def main(argv=None):
         line = json.dumps(head)
     print(line)
     out_path = args.out or ("BENCH_quick.json" if args.quick else None)
+    bench_doc = {**head, "workloads_sps_vs": compact,
+                 "workloads": workloads, "rig": full_doc["rig"]}
+    if not args.quick:
+        bench_doc["mode"] = "full"
     if out_path:
         # the gate artifact: the combined final-line object (the shape
         # tools/bench_compare.py reads) plus the per-workload detail
         with open(out_path, "w") as f:
-            json.dump({**head, "workloads_sps_vs": compact,
-                       "workloads": workloads, "rig": full_doc["rig"]}, f)
+            json.dump(bench_doc, f)
+    if run_dir:
+        # artifact hygiene (--run-dir): every capture product under one
+        # directory — bench json, metrics dump, measured profile, host
+        # trace (when armed) — the shape run_report.py/doctor.py accept
+        with open(os.path.join(run_dir, "bench.json"), "w") as f:
+            json.dump(bench_doc, f)
+        try:
+            from alink_tpu.common.metrics import get_registry
+            get_registry().dump(os.path.join(run_dir, "metrics.jsonl"))
+        except OSError as e:  # pragma: no cover - disk trouble
+            print(f"WARNING: could not write metrics.jsonl: {e}",
+                  file=sys.stderr)
+        if profile_enabled():
+            get_profiler().export(os.path.join(run_dir, "profile.json"))
+        from alink_tpu.common.tracing import get_tracer, tracing_enabled
+        if tracing_enabled():
+            get_tracer().export_jsonl(os.path.join(run_dir, "trace.jsonl"))
+        print(f"run artifacts: {run_dir}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,430 @@
+"""Measured device profiling (common/profiling2.py) — Layer 3.
+
+The contract under test: with ``ALINK_TPU_PROFILE`` OFF nothing changes
+(lowered HLO byte-identical, program-cache keys untouched — toggling
+the flag must HIT the cache, not recompile); with it ON the collector
+attributes measured wall time across dispatch/transfer/device/
+collective buckets, honors the read-only ``ComQueueResult`` memo
+contract, measures live HBM at boundaries, verifies donation by
+measurement, and the xprof parser ingests device-lane traces (host-only
+traces fall back to the timing harness, returning None).
+"""
+
+import gzip
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from alink_tpu.common import profiling2 as p2
+from alink_tpu.common.profiling2 import (ProfileCollector, donation_probe,
+                                         measured_bound, parse_xprof_trace,
+                                         profile_window, set_profiler)
+
+
+@pytest.fixture
+def collector(monkeypatch):
+    """A fresh process collector with the flag ON (restored after)."""
+    monkeypatch.setenv("ALINK_TPU_PROFILE", "1")
+    monkeypatch.delenv("ALINK_TPU_PROFILE_DIR", raising=False)
+    monkeypatch.delenv("ALINK_TPU_PROFILE_XPROF", raising=False)
+    col = ProfileCollector()
+    prev = set_profiler(col)
+    yield col
+    set_profiler(prev)
+
+
+def _queue(env, n=16, max_iter=3, key=("p2test",)):
+    from alink_tpu.engine import AllReduce, IterativeComQueue
+
+    def stage(ctx):
+        import jax.numpy as jnp
+        if ctx.is_init_step:
+            ctx.put_obj("acc", jnp.zeros(4))
+        ctx.put_obj("acc", ctx.get_obj("acc") + ctx.get_obj("xs").sum(0))
+
+    return (IterativeComQueue(env=env, max_iter=max_iter)
+            .init_with_partitioned_data("xs", np.ones((n, 4), np.float32))
+            .add(stage).add(AllReduce("acc"))
+            .set_program_key(key))
+
+
+def _env():
+    from alink_tpu.common.mlenv import MLEnvironmentFactory
+    return MLEnvironmentFactory.get_default()
+
+
+class TestOffPathInvariance:
+    def test_lowered_hlo_byte_identical_on_off(self, monkeypatch):
+        monkeypatch.delenv("ALINK_TPU_PROFILE", raising=False)
+        off = _queue(_env()).lowered().as_text()
+        monkeypatch.setenv("ALINK_TPU_PROFILE", "1")
+        on = _queue(_env()).lowered().as_text()
+        assert off == on
+
+    def test_toggling_flag_hits_program_cache(self, collector, monkeypatch):
+        """The flag must NOT ride the program-cache key: an exec with
+        profiling on reuses the program compiled with it off."""
+        from alink_tpu.engine.comqueue import (clear_program_cache,
+                                               program_cache_stats)
+        clear_program_cache()
+        monkeypatch.delenv("ALINK_TPU_PROFILE", raising=False)
+        key = ("p2cache", time.time())   # unique per test run
+        _queue(_env(), key=key).exec()
+        s0 = program_cache_stats()
+        monkeypatch.setenv("ALINK_TPU_PROFILE", "1")
+        _queue(_env(), key=key).exec()
+        s1 = program_cache_stats()
+        assert s1["misses"] == s0["misses"]
+        assert s1["hits"] == s0["hits"] + 1
+
+    def test_capture_window_adds_zero_compiled_ops(self, collector):
+        """An exec under an armed profile window lowers to the same HLO
+        a bare exec does (the window wraps the already-compiled call)."""
+        txt_profiled = _queue(_env()).lowered().as_text()
+        os.environ.pop("ALINK_TPU_PROFILE", None)
+        try:
+            txt_plain = _queue(_env()).lowered().as_text()
+        finally:
+            os.environ["ALINK_TPU_PROFILE"] = "1"
+        assert txt_profiled == txt_plain
+
+
+class TestCollector:
+    def test_marks_aggregate_and_measured_filtering(self, collector):
+        with collector.workload("wl"):
+            # unmeasured (warmup) mark — must NOT reach the attribution
+            with profile_window("scope.a") as w:
+                w.dispatch(5.0)
+            with collector.measured_region():
+                with profile_window("scope.a") as w:
+                    w.dispatch(0.2, n=2)
+                    w.device(0.1)
+                    w.transfer(0.05, nbytes=123)
+                    w.collective(0.01, calls=3)
+        attr = collector.workload_attribution("wl")
+        assert attr["dispatch_s"] == pytest.approx(0.2)
+        assert attr["device_s"] == pytest.approx(0.1)
+        assert attr["transfer_s"] == pytest.approx(0.05)
+        assert attr["collective_s"] == pytest.approx(0.01)
+        assert attr["dispatch_calls"] == 2
+        assert attr["transfer_bytes"] == 123
+        assert attr["measured_wall_s"] > 0
+        assert attr["source"] == "timing-harness"
+
+    def test_host_residual_is_wall_minus_marks(self, collector):
+        with collector.workload("wl2"):
+            with collector.measured_region():
+                time.sleep(0.05)
+                with profile_window("s") as w:
+                    w.dispatch(0.01)
+        attr = collector.workload_attribution("wl2")
+        assert attr["host_s"] >= 0.03
+        assert attr["host_s"] <= attr["measured_wall_s"]
+
+    def test_unknown_workload_returns_none(self, collector):
+        assert collector.workload_attribution("nope") is None
+
+    def test_device_scopes_listed_per_leg(self, collector):
+        """Attribution names which legs the device time came from —
+        consumers gate the compute/hbm split on a single leg."""
+        with collector.workload("wl"):
+            with collector.measured_region():
+                with profile_window("leg.a") as w:
+                    w.device(0.1)
+                with profile_window("leg.b") as w:
+                    w.device(0.2)
+                with profile_window("leg.c") as w:
+                    w.dispatch(0.1)        # no device mark: not a leg
+        attr = collector.workload_attribution("wl")
+        assert attr["device_scopes"] == ["leg.a", "leg.b"]
+
+    def test_discard_workload_drops_aborted_attempt(self, collector):
+        """The bench retry path: a failed attempt's marks, wall and HBM
+        snapshots must not double into the retry's attribution."""
+        with collector.workload("wl"):
+            with collector.measured_region():
+                with profile_window("s") as w:
+                    w.dispatch(5.0)       # the aborted attempt
+            collector.hbm_snapshot("boundary")
+        # an aborted xprof capture's per-scope budget is given back too
+        with collector._lock:
+            collector._captures.append(
+                {"workload": "wl", "scope": "s", "dir": "/x",
+                 "window_wall_s": 0.1, "parsed": None})
+            collector._capture_counts["s"] = 1
+        collector.discard_workload("wl")
+        assert collector.workload_attribution("wl") is None
+        assert collector.summary()["hbm"] == []
+        assert collector.summary()["captures"] == []
+        assert collector._capture_counts.get("s", 0) == 0
+        with collector.workload("wl"):
+            with collector.measured_region():
+                with profile_window("s") as w:
+                    w.dispatch(0.25)      # the retry
+        attr = collector.workload_attribution("wl")
+        assert attr["dispatch_s"] == pytest.approx(0.25)
+
+    def test_export_round_trips(self, collector, tmp_path):
+        with collector.workload("wl"):
+            with collector.measured_region():
+                with profile_window("s") as w:
+                    w.dispatch(0.1)
+            collector.hbm_snapshot("boundary")
+        p = str(tmp_path / "profile.json")
+        collector.export(p)
+        doc = json.load(open(p))
+        assert doc["format"] == p2.PROFILE_FORMAT
+        assert "wl" in doc["workloads"]
+        assert doc["hbm"] and doc["hbm"][0]["scope"] == "boundary"
+
+    def test_off_flag_is_null_window(self, monkeypatch):
+        monkeypatch.delenv("ALINK_TPU_PROFILE", raising=False)
+        w = profile_window("s")
+        assert w.on is False
+        with w as ww:
+            ww.dispatch(1.0)       # discards
+        assert p2.hbm_snapshot("x") is None
+
+    def test_mark_rejects_unknown_bucket(self, collector):
+        with pytest.raises(ValueError):
+            p2.mark("s", "frobnicate", 1.0)
+
+
+class TestMeasuredBound:
+    def _attr(self, **kw):
+        base = {"dispatch_s": 0.0, "transfer_s": 0.0, "device_s": 0.0,
+                "collective_s": 0.0, "host_s": 0.0,
+                "measured_wall_s": 1.0}
+        base.update(kw)
+        return base
+
+    def test_dispatch_dominant_is_latency(self):
+        b, fr = measured_bound(self._attr(dispatch_s=0.8, device_s=0.2))
+        assert b == "latency" and fr["dispatch"] == pytest.approx(0.8)
+
+    def test_transfer_dominant_is_link(self):
+        assert measured_bound(self._attr(transfer_s=0.9))[0] == "link"
+
+    def test_host_dominant_is_host(self):
+        assert measured_bound(self._attr(host_s=0.9))[0] == "host"
+
+    def test_collective_dominant(self):
+        assert measured_bound(
+            self._attr(collective_s=0.9))[0] == "collective"
+
+    def test_device_without_model_is_device(self):
+        assert measured_bound(self._attr(device_s=0.9))[0] == "device"
+
+    def test_device_with_model_splits_compute_vs_hbm(self):
+        attr = self._attr(device_s=0.9)
+        # compute-heavy: huge flops per sample, tiny bytes
+        b, _ = measured_bound(attr, flops_per_sample=1e9,
+                              bytes_per_sample=1.0,
+                              samples_per_sec_per_chip=1e6,
+                              peak_tflops=197.0, peak_hbm_gbps=819.0)
+        assert b == "compute"
+        # byte-heavy: the reverse
+        b, _ = measured_bound(attr, flops_per_sample=1.0,
+                              bytes_per_sample=1e6,
+                              samples_per_sec_per_chip=1e6,
+                              peak_tflops=197.0, peak_hbm_gbps=819.0)
+        assert b == "hbm"
+
+
+def _write_chrome_trace(path, events, pid_names):
+    doc = {"traceEvents": (
+        [{"ph": "M", "name": "process_name", "pid": pid,
+          "args": {"name": nm}} for pid, nm in pid_names.items()]
+        + events)}
+    with gzip.open(path, "wt") as f:
+        json.dump(doc, f)
+
+
+class TestXprofParser:
+    def test_device_lane_attribution(self, tmp_path):
+        p = str(tmp_path / "x.trace.json.gz")
+        _write_chrome_trace(p, [
+            {"ph": "X", "pid": 2, "tid": 1, "name": "fusion.42",
+             "ts": 0.0, "dur": 2_000_000.0},
+            {"ph": "X", "pid": 2, "tid": 1, "name": "all-reduce.1",
+             "ts": 2_000_000.0, "dur": 500_000.0},
+            {"ph": "X", "pid": 2, "tid": 1, "name": "copy-start.3",
+             "ts": 2_500_000.0, "dur": 250_000.0},
+            # host lane noise that must be ignored
+            {"ph": "X", "pid": 9, "tid": 7, "name": "python_call",
+             "ts": 0.0, "dur": 9_000_000.0},
+        ], {2: "/device:TPU:0", 9: "/host:CPU"})
+        got = parse_xprof_trace(p)
+        assert got["device_s"] == pytest.approx(2.0)
+        assert got["collective_s"] == pytest.approx(0.5)
+        assert got["transfer_s"] == pytest.approx(0.25)
+        assert got["busy_s"] == pytest.approx(2.75)
+        assert got["events"] == 3
+        assert got["lanes"] == ["/device:TPU:0"]
+
+    def test_host_only_trace_returns_none(self, tmp_path):
+        """CPU rigs (no TensorBoard device plugin lanes) must fall back
+        to the timing harness — the parser says so by returning None."""
+        p = str(tmp_path / "h.trace.json.gz")
+        _write_chrome_trace(p, [
+            {"ph": "X", "pid": 9, "tid": 7, "name": "python_call",
+             "ts": 0.0, "dur": 100.0}], {9: "/host:CPU"})
+        assert parse_xprof_trace(p) is None
+
+    def test_directory_search_and_malformed_tolerance(self, tmp_path):
+        d = tmp_path / "plugins" / "profile" / "2026_01_01"
+        d.mkdir(parents=True)
+        with open(d / "broken.trace.json", "w") as f:
+            f.write("{not json")
+        _write_chrome_trace(str(d / "ok.trace.json.gz"), [
+            {"ph": "X", "pid": 2, "tid": 1, "name": "fusion.1",
+             "ts": 0.0, "dur": 1_000_000.0}], {2: "/device:TPU:0"})
+        got = parse_xprof_trace(str(tmp_path))
+        assert got and got["device_s"] == pytest.approx(1.0)
+
+    def test_missing_path_returns_none(self, tmp_path):
+        assert parse_xprof_trace(str(tmp_path / "nope")) is None
+
+
+class TestXprofCapture:
+    def test_capture_bounded_one_per_scope(self, collector, monkeypatch,
+                                           tmp_path):
+        monkeypatch.setenv("ALINK_TPU_PROFILE_DIR", str(tmp_path))
+        monkeypatch.setenv("ALINK_TPU_PROFILE_XPROF", "1")
+        import jax
+        import jax.numpy as jnp
+        for _ in range(2):
+            with profile_window("cap.scope", capture=True):
+                jax.block_until_ready(jnp.ones(8) + 1)
+        caps = collector.summary()["captures"]
+        assert len(caps) == 1                     # per-scope cap honored
+        capdir = caps[0]["dir"]
+        assert os.path.isdir(capdir)
+        files = [f for _, _, fs in os.walk(capdir) for f in fs]
+        assert files, "profiler capture produced no files"
+        # this rig's trace is host-lane-only -> harness fallback
+        attrs = collector.workload_attribution(None)
+        assert caps[0]["parsed"] is None or "busy_s" in caps[0]["parsed"]
+        assert attrs is None or "source" in attrs
+
+    def test_capture_without_dir_is_skipped(self, collector, monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_PROFILE_XPROF", "1")
+        with profile_window("nodir.scope", capture=True):
+            pass
+        assert collector.summary()["captures"] == []
+
+    def test_bench_warmup_window_never_spends_the_budget(
+            self, collector, monkeypatch, tmp_path):
+        """Under a named workload (the bench), only MEASURED windows
+        capture — the first window of a scope is the warmup/compile
+        call, and a trace of compile time is not steady state."""
+        monkeypatch.setenv("ALINK_TPU_PROFILE_DIR", str(tmp_path))
+        monkeypatch.setenv("ALINK_TPU_PROFILE_XPROF", "1")
+        import jax
+        import jax.numpy as jnp
+        with collector.workload("wl"):
+            with profile_window("warm.scope", capture=True):   # warmup
+                jax.block_until_ready(jnp.ones(4) + 1)
+            assert collector.summary()["captures"] == []
+            with collector.measured_region():
+                with profile_window("warm.scope", capture=True):
+                    jax.block_until_ready(jnp.ones(4) + 1)
+        caps = collector.summary()["captures"]
+        assert len(caps) == 1 and caps[0]["workload"] == "wl"
+
+
+class TestHbmAndDonation:
+    def test_live_bytes_counts_nondeleted(self):
+        import jax
+        x = jax.device_put(np.zeros(1024, np.float32))
+        jax.block_until_ready(x)
+        assert p2.live_hbm_bytes() >= x.nbytes
+
+    def test_hbm_snapshot_records_and_gauges(self, collector, monkeypatch):
+        from alink_tpu.common.metrics import MetricsRegistry, set_registry
+        reg = MetricsRegistry()
+        prev = set_registry(reg)
+        try:
+            with collector.workload("wl"):
+                got = collector.hbm_snapshot("chunk.boundary")
+            assert got is not None and got >= 0
+            assert reg.value("alink_hbm_live_bytes",
+                             {"scope": "chunk.boundary"}) == got
+        finally:
+            set_registry(prev)
+
+    def test_donation_probe_verifies_halving(self, collector):
+        """THE measured PR-5 claim: a donated carry update holds ~half
+        the resident state of the undonated twin while the pre-step
+        buffer is still referenced."""
+        got = donation_probe(state_bytes=1 << 20, steps=2)
+        assert got["verified"] is True
+        assert got["ratio"] <= 0.75
+        assert got["donated_peak_bytes"] < got["undonated_peak_bytes"]
+        # recorded on the collector for the profile artifact
+        assert collector.summary()["donation"]["verified"] is True
+
+
+class TestEngineIntegration:
+    def test_exec_attribution_and_memo_contract(self, collector):
+        """A profiled exec records dispatch/device marks and an HBM
+        snapshot — and the ComQueueResult read-only memo contract
+        survives: fetched arrays stay read-only and identity-stable."""
+        with collector.workload("engine_wl"):
+            with collector.measured_region():
+                res = _queue(_env(), key=("p2eng", time.time())).exec()
+            a = res.shards("acc")
+            collector.hbm_snapshot("after.fetch")
+            b = res.shards("acc")
+        assert a is b                       # memoized, not re-fetched
+        assert not a.flags.writeable
+        with pytest.raises(ValueError):
+            a[0] = 0.0
+        attr = collector.workload_attribution("engine_wl")
+        assert attr["dispatch_s"] > 0
+        assert attr["device_s"] >= 0
+        hbm = collector.summary()["hbm"]
+        scopes = {r["scope"] for r in hbm}
+        assert "comqueue.exec" in scopes
+
+    def test_chunked_exec_records_chunk_marks(self, collector, tmp_path):
+        with collector.workload("ckpt_wl"):
+            with collector.measured_region():
+                q = _queue(_env(), max_iter=4,
+                           key=("p2chunk", time.time()))
+                q.set_checkpoint(str(tmp_path / "ck"), every=2)
+                q.exec()
+        marks = collector.summary()["marks"]
+        chunk = [m for m in marks if m["scope"] == "comqueue.chunk"
+                 and m["measured"]]
+        assert any(m["bucket"] == "dispatch" for m in chunk)
+        assert any(m["bucket"] == "device" for m in chunk)
+        scopes = {r["scope"] for r in collector.summary()["hbm"]}
+        assert "comqueue.chunk" in scopes
+
+    def test_results_identical_with_profiling(self, monkeypatch):
+        """Profiling must never perturb computed values."""
+        monkeypatch.delenv("ALINK_TPU_PROFILE", raising=False)
+        key = ("p2val", time.time())
+        r_off = _queue(_env(), key=key).exec().get("acc").copy()
+        monkeypatch.setenv("ALINK_TPU_PROFILE", "1")
+        col = ProfileCollector()
+        prev = set_profiler(col)
+        try:
+            r_on = _queue(_env(), key=key).exec().get("acc").copy()
+        finally:
+            set_profiler(prev)
+        np.testing.assert_array_equal(r_off, r_on)
+
+
+class TestFlagsRegistered:
+    def test_profile_flags_declared(self):
+        from alink_tpu.common.flags import FLAGS
+        for name in ("ALINK_TPU_PROFILE", "ALINK_TPU_PROFILE_DIR",
+                     "ALINK_TPU_PROFILE_XPROF"):
+            f = FLAGS.get(name)
+            assert f is not None, name
+            assert f.key_neutral, f"{name} must justify key-neutrality"

@@ -138,3 +138,32 @@ class TestRunReportCli:
         assert mod.main([p]) == 0
         out = capsys.readouterr().out
         assert "(none)" in out
+
+    def test_run_dir_accepted_with_sibling_artifacts(self, tmp_path,
+                                                     capsys):
+        """A bench.py --run-dir directory is a valid report target: the
+        metrics dump inside is the report, a sibling trace.jsonl
+        auto-attaches, and a profile.json earns a pointer at
+        tools/doctor.py (the profile has its own renderer)."""
+        d = tmp_path / "run"
+        d.mkdir()
+        _populated_registry().dump(str(d / "metrics.jsonl"))
+        tr = Tracer()
+        with tr.span("comqueue.exec", cat="engine"):
+            pass
+        tr.export_jsonl(str(d / "trace.jsonl"))
+        with open(d / "profile.json", "w") as f:
+            json.dump({"format": "alink_tpu_profile_v1"}, f)
+        mod = _load_run_report()
+        assert mod.main([str(d)]) == 0
+        out = capsys.readouterr().out
+        assert "== Run summary ==" in out
+        assert "== Trace summary ==" in out          # auto-attached
+        assert "tools/doctor.py" in out              # profile pointer
+
+    def test_run_dir_without_metrics_exits_1(self, tmp_path, capsys):
+        d = tmp_path / "empty_dir"
+        d.mkdir()
+        mod = _load_run_report()
+        assert mod.main([str(d)]) == 1
+        assert "no metrics.jsonl" in capsys.readouterr().err

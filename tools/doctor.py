@@ -1,0 +1,433 @@
+#!/usr/bin/env python
+"""Perf doctor — one per-workload verdict from the measured artifacts.
+
+Merges a bench capture (``bench.py --quick``/full, ideally under
+``ALINK_TPU_PROFILE=1``), the exported measured profile
+(``common/profiling2.py``), and optionally the metrics dump into one
+diagnosis per workload:
+
+  * the MEASURED wall-time attribution (host dispatch / H2D-D2H
+    transfer / device compute / collective / unattributed host) and the
+    measured ``bound:`` classification next to the static projection
+    (``bound_static``);
+  * measured achieved FLOP/s and bytes/s against the rig roof,
+    device-time-normalized (what the kernels sustain while the device
+    is actually busy, not diluted by dispatch gaps);
+  * a top-3 "what to fix" attribution ranked by wall-share;
+  * a live-HBM section: ``alink_hbm_live_bytes`` boundary snapshots plus
+    the measured donation verification (does buffer donation actually
+    halve resident carry state on this rig — PR 5's claim, measured).
+
+Usage:
+    python tools/doctor.py --run-dir DIR            # bench.py --run-dir output
+    python tools/doctor.py --bench BENCH_quick.json [--profile PROFILE.json]
+                           [--metrics METRICS.jsonl]
+    ... [--json]
+
+Exit codes: 0 — artifacts parsed and verdicts rendered; 1 — no usable
+input. The doctor never gates (that is bench_compare --threshold's job);
+it explains.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# default chip roofs when neither the bench rig section nor the CLI
+# provides them (v5e: bf16 MXU peak / HBM stream) — keep in sync with
+# bench.PEAK_TFLOPS / PEAK_HBM_GBPS
+DEFAULT_PEAK_TFLOPS = 197.0
+DEFAULT_PEAK_HBM_GBPS = 819.0
+
+_BAR = "█"
+_BUCKET_ORDER = ("dispatch", "device", "transfer", "collective", "host")
+_BUCKET_LABELS = {"dispatch": "host dispatch", "device": "device compute",
+                  "transfer": "transfer (H2D/D2H)", "collective":
+                  "collective", "host": "host/other (unattributed)"}
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{int(n):,} B"
+        n /= 1024.0
+    return f"{n:,.1f} TiB"
+
+
+def load_json(path: str) -> Any:
+    with open(path) as f:
+        return json.load(f)
+
+
+def load_bench(path: str) -> Dict[str, Any]:
+    """A bench dump in any of its shapes (driver ``{"parsed": ...}``
+    wrapper, ``--out``/``--run-dir`` object). Returns the inner doc."""
+    doc = load_json(path)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a bench dump")
+    return doc
+
+
+def _metrics_summary(path: str) -> Dict[str, Any]:
+    """The handful of registry aggregates the verdict cites (program
+    cache, collectives, live-HBM gauges) from a MetricsRegistry dump."""
+    out: Dict[str, Any] = {"cache": {}, "collectives": {}, "hbm_gauges": {}}
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+            name = rec.get("name")
+            labels = rec.get("labels") or {}
+            if name == "alink_comqueue_program_cache_total":
+                out["cache"][labels.get("result", "?")] = rec.get("value", 0)
+            elif name == "alink_collective_calls_total":
+                out["collectives"][labels.get("collective", "?")] = \
+                    rec.get("value", 0)
+            elif name == "alink_hbm_live_bytes":
+                out["hbm_gauges"][labels.get("scope", "?")] = \
+                    rec.get("value", 0)
+    return out
+
+
+def _workload_entries(bench: Optional[Dict[str, Any]],
+                      profile: Optional[Dict[str, Any]]
+                      ) -> List[Tuple[str, Dict[str, Any], Dict[str, Any]]]:
+    """(name, bench_row, attribution) per workload. Bench rows carry
+    the attribution under ``profile`` when the capture ran profiled;
+    the profile artifact fills in workloads the bench did not annotate
+    (or stands alone when no bench dump is given)."""
+    rows: Dict[str, Dict[str, Any]] = {}
+    if bench:
+        wl = bench.get("workloads")
+        if isinstance(wl, dict):
+            rows = {k: v for k, v in wl.items() if isinstance(v, dict)}
+    prof_wl = (profile or {}).get("workloads") or {}
+    names = list(rows) + [n for n in prof_wl if n not in rows]
+    out = []
+    for name in names:
+        row = rows.get(name, {})
+        attr = row.get("profile") or prof_wl.get(name)
+        if attr:
+            out.append((name, row, attr))
+    return out
+
+
+def _fractions(attr: Dict[str, Any]) -> Dict[str, float]:
+    fr = attr.get("fractions")
+    if isinstance(fr, dict) and fr:
+        return {k: float(fr.get(k, 0.0)) for k in _BUCKET_ORDER}
+    wall = attr.get("measured_wall_s") or 0.0
+    parts = {k: float(attr.get(f"{k}_s", 0.0)) for k in _BUCKET_ORDER}
+    total = max(wall, sum(parts.values()), 1e-12)
+    return {k: v / total for k, v in parts.items()}
+
+
+def _achieved(row: Dict[str, Any], attr: Dict[str, Any],
+              fr: Dict[str, float],
+              peak_tflops: float, peak_hbm_gbps: float
+              ) -> Optional[Dict[str, float]]:
+    """Device-time-normalized achieved rates: what the kernels sustain
+    while the device is busy. Needs the row's per-sample cost model and
+    throughput; None otherwise (the harness cannot invent a model) —
+    and None when the attribution's device time merges more than one
+    program leg (the headline rate describes a single leg, so the
+    normalization would be cross-leg)."""
+    fps = row.get("flops_per_sample")
+    bps = row.get("hbm_bytes_per_sample")
+    sps = row.get("samples_per_sec_per_chip")
+    dev = fr.get("device", 0.0)
+    if len(attr.get("device_scopes") or ()) > 1:
+        return None
+    if not (fps and sps) or dev <= 0.0:
+        return None
+    sps_dev = sps / dev
+    out = {"flops_per_s": sps_dev * fps,
+           "pct_peak_flops": 100.0 * sps_dev * fps / (peak_tflops * 1e12)}
+    if bps:
+        out["bytes_per_s"] = sps_dev * bps
+        out["pct_peak_hbm"] = 100.0 * sps_dev * bps / (peak_hbm_gbps * 1e9)
+    return out
+
+
+def _fixes(name: str, attr: Dict[str, Any], fr: Dict[str, float],
+           row: Dict[str, Any], rig: Dict[str, Any],
+           ach: Optional[Dict[str, float]]) -> List[str]:
+    """Top-3 what-to-fix, ranked by the wall share each one attacks."""
+    cands: List[Tuple[float, str]] = []
+    gap = rig.get("dispatch_gap_est_s") or row.get("dispatch_gap_est_s")
+    disp = fr.get("dispatch", 0.0)
+    if disp >= 0.15:
+        tail = (f" (rig floor ~{gap * 1e3:.0f} ms/dispatch)"
+                if gap else "")
+        cands.append((disp, f"{disp:.0%} of measured wall is host "
+                            f"dispatch{tail}: batch more supersteps/"
+                            f"micro-batches per compiled call (chunked "
+                            f"scans, larger checkpoint_every, fused "
+                            f"pools)"))
+    host = fr.get("host", 0.0)
+    if host >= 0.15:
+        cands.append((host, f"{host:.0%} is unattributed host work "
+                            f"(encode/IO/python): widen "
+                            f"ALINK_TPU_STREAM_WORKERS, keep the "
+                            f"prefetch channel fed, move parsing off "
+                            f"the consumer thread"))
+    xfer = fr.get("transfer", 0.0)
+    if xfer >= 0.10:
+        cands.append((xfer, f"{xfer:.0%} is H2D/D2H transfer: keep "
+                            f"state device-resident, batch host "
+                            f"fetches (device_get trees), donate "
+                            f"buffers (ALINK_TPU_DONATE)"))
+    coll = fr.get("collective", 0.0)
+    if coll >= 0.10:
+        cands.append((coll, f"{coll:.0%} is collective time: fuse "
+                            f"per-superstep all-reduces into one psum "
+                            f"payload"))
+    dev = fr.get("device", 0.0)
+    if dev >= 0.15:
+        if ach is not None:
+            roof = max(ach.get("pct_peak_flops", 0.0),
+                       ach.get("pct_peak_hbm", 0.0))
+            if roof < 15.0:
+                cands.append((dev, f"device-busy {dev:.0%} but only "
+                                   f"{roof:.1f}% of the chip roof: fuse "
+                                   f"kernels (ALINK_TPU_FUSED_HIST, "
+                                   f"Pallas) or grow the shapes"))
+            else:
+                cands.append((dev * 0.5,
+                              f"device compute at {roof:.0f}% of the "
+                              f"roof — scale out or reduce work; this "
+                              f"workload is near the hardware limit"))
+        else:
+            legs = attr.get("device_scopes") or ()
+            if len(legs) > 1:
+                cands.append((dev, f"device-busy {dev:.0%} merged from "
+                                   f"{len(legs)} program legs "
+                                   f"({', '.join(legs)}): the per-sample "
+                                   f"model cannot normalize cross-leg — "
+                                   f"profile the legs as separate rows "
+                                   f"to split compute from HBM"))
+            else:
+                cands.append((dev, f"device-busy {dev:.0%} with no "
+                                   f"per-sample cost model on the row: "
+                                   f"add flops/bytes accounting "
+                                   f"(bench.mfu) to split compute from "
+                                   f"HBM"))
+    cands.sort(key=lambda c: -c[0])
+    return [c[1] for c in cands[:3]]
+
+
+def diagnose(bench: Optional[Dict[str, Any]],
+             profile: Optional[Dict[str, Any]],
+             metrics: Optional[Dict[str, Any]],
+             peak_tflops: float, peak_hbm_gbps: float) -> Dict[str, Any]:
+    """The machine-shaped verdict document (--json emits it)."""
+    rig = (bench or {}).get("rig") or {}
+    peak_tflops = rig.get("peak_tflops") or peak_tflops
+    peak_hbm_gbps = rig.get("peak_hbm_gbps") or peak_hbm_gbps
+    verdicts = []
+    for name, row, attr in _workload_entries(bench, profile):
+        fr = _fractions(attr)
+        ach = _achieved(row, attr, fr, peak_tflops, peak_hbm_gbps)
+        bound = (attr.get("bound_measured") or row.get("bound")
+                 or max(fr, key=lambda k: fr[k]))
+        v = {"workload": name, "bound": bound,
+             "bound_static": row.get("bound_static"),
+             "source": attr.get("source", "timing-harness"),
+             "measured_wall_s": attr.get("measured_wall_s"),
+             "buckets": {k: attr.get(f"{k}_s") for k in _BUCKET_ORDER
+                         if attr.get(f"{k}_s") is not None},
+             "fractions": {k: round(fr[k], 4) for k in _BUCKET_ORDER},
+             "fixes": _fixes(name, attr, fr, row, rig, ach)}
+        if ach:
+            v["achieved_device_time"] = {
+                k: round(val, 4) for k, val in ach.items()}
+        if attr.get("xprof"):
+            v["xprof"] = attr["xprof"]
+        verdicts.append(v)
+    doc: Dict[str, Any] = {
+        "format": "alink_tpu_doctor_v1",
+        "rig": {"dispatch_gap_est_s": rig.get("dispatch_gap_est_s"),
+                "peak_tflops": peak_tflops,
+                "peak_hbm_gbps": peak_hbm_gbps,
+                "baseline_fp": rig.get("baseline_fp")},
+        "workloads": verdicts,
+    }
+    if profile:
+        doc["hbm"] = profile.get("hbm") or []
+        if profile.get("donation"):
+            doc["donation"] = profile["donation"]
+        if profile.get("capture_error"):
+            doc["capture_error"] = profile["capture_error"]
+    if metrics:
+        doc["metrics"] = metrics
+    return doc
+
+
+def render(doc: Dict[str, Any]) -> str:
+    out: List[str] = []
+    rig = doc.get("rig") or {}
+    out.append("== perf doctor ==")
+    gap = rig.get("dispatch_gap_est_s")
+    out.append(f"  rig: dispatch floor "
+               f"{'%.1f ms/call' % (gap * 1e3) if gap else 'n/a'}, roofs "
+               f"{rig.get('peak_tflops')} TFLOP/s peak, "
+               f"{rig.get('peak_hbm_gbps')} GB/s HBM")
+    for v in doc.get("workloads", []):
+        out.append(f"\n== workload: {v['workload']} ==")
+        static = v.get("bound_static")
+        out.append(f"  bound: {v['bound']} (measured"
+                   + (f"; static: {static}" if static else "")
+                   + f")   source: {v.get('source')}")
+        wall = v.get("measured_wall_s")
+        if wall:
+            out.append(f"  measured wall {wall:.3f} s")
+        rows = []
+        for k in _BUCKET_ORDER:
+            sec = (v.get("buckets") or {}).get(k)
+            frac = (v.get("fractions") or {}).get(k, 0.0)
+            if sec is None and frac == 0.0:
+                continue
+            bar = _BAR * int(round(frac * 20))
+            rows.append((_BUCKET_LABELS[k],
+                         f"{sec:.3f}" if sec is not None else "-",
+                         f"{frac:6.1%}", bar))
+        if rows:
+            w = max(len(r[0]) for r in rows)
+            out.append(f"  {'bucket'.ljust(w)}  seconds   share")
+            for lbl, sec, frac, bar in rows:
+                out.append(f"  {lbl.ljust(w)}  {sec:>7}  {frac}  {bar}")
+        ach = v.get("achieved_device_time")
+        if ach:
+            line = (f"  achieved (device-time): "
+                    f"{ach['flops_per_s'] / 1e12:.4f} TFLOP/s "
+                    f"({ach['pct_peak_flops']:.2f}% of roof)")
+            if "bytes_per_s" in ach:
+                line += (f", {ach['bytes_per_s'] / 1e9:.3f} GB/s "
+                         f"({ach['pct_peak_hbm']:.2f}% of HBM roof)")
+            out.append(line)
+        xp = v.get("xprof")
+        if xp:
+            out.append(f"  xprof: device busy {xp.get('busy_s')}s over "
+                       f"{xp.get('events')} events on "
+                       f"{', '.join(xp.get('lanes', []))}")
+        for i, fx in enumerate(v.get("fixes") or [], 1):
+            out.append(f"  fix {i}: {fx}")
+    hbm = doc.get("hbm")
+    if hbm is not None:
+        out.append("\n== HBM (live device buffers) ==")
+        if hbm:
+            w = max(len(f"{r.get('workload')}/{r['scope']}") for r in hbm)
+            out.append(f"  {'scope'.ljust(w)}  snapshots       last        max")
+            for r in hbm:
+                key = f"{r.get('workload')}/{r['scope']}"
+                out.append(f"  {key.ljust(w)}  {r['count']:9,}  "
+                           f"{_fmt_bytes(r['last_bytes']):>9}  "
+                           f"{_fmt_bytes(r['max_bytes']):>9}")
+        else:
+            out.append("  (no boundary snapshots recorded)")
+        don = doc.get("donation")
+        if don:
+            verdict = "VERIFIED" if don.get("verified") else "NOT VERIFIED"
+            out.append(f"  donation: {verdict} — donated run holds "
+                       f"{don.get('ratio')}x the undonated resident "
+                       f"state ({_fmt_bytes(don['donated_peak_bytes'])} "
+                       f"vs {_fmt_bytes(don['undonated_peak_bytes'])}, "
+                       f"state {_fmt_bytes(don['state_bytes'])})")
+        else:
+            out.append("  donation: not measured (run bench under "
+                       "ALINK_TPU_PROFILE=1)")
+    met = doc.get("metrics")
+    if met:
+        out.append("\n== run metrics ==")
+        cache = met.get("cache") or {}
+        if cache:
+            hits = cache.get("hit", 0)
+            miss = cache.get("miss", 0)
+            rate = f"{100.0 * hits / (hits + miss):.0f}%" \
+                if hits + miss else "n/a"
+            out.append(f"  program cache: {int(hits)} hits / "
+                       f"{int(miss)} misses ({rate} hit rate)")
+        col = met.get("collectives") or {}
+        if col:
+            out.append("  collective calls: " + ", ".join(
+                f"{k}={int(n):,}" for k, n in sorted(col.items())))
+    if doc.get("capture_error"):
+        out.append(f"\nNOTE: xprof capture degraded "
+                   f"({doc['capture_error']}); attribution is "
+                   f"timing-harness only")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="doctor.py", description=__doc__.splitlines()[0])
+    ap.add_argument("--run-dir", metavar="DIR",
+                    help="a bench.py --run-dir directory (bench.json / "
+                         "profile.json / metrics.jsonl inside)")
+    ap.add_argument("--bench", metavar="PATH",
+                    help="a BENCH_*.json / bench.json dump")
+    ap.add_argument("--profile", metavar="PATH",
+                    help="an alink_tpu_profile_v1 JSON "
+                         "(ProfileCollector.export)")
+    ap.add_argument("--metrics", metavar="PATH",
+                    help="a MetricsRegistry.dump() JSONL")
+    ap.add_argument("--peak-tflops", type=float,
+                    default=DEFAULT_PEAK_TFLOPS)
+    ap.add_argument("--peak-hbm-gbps", type=float,
+                    default=DEFAULT_PEAK_HBM_GBPS)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict document as JSON")
+    args = ap.parse_args(argv)
+    bench_path, profile_path, metrics_path = \
+        args.bench, args.profile, args.metrics
+    if args.run_dir:
+        d = args.run_dir
+        if not os.path.isdir(d):
+            print(f"doctor.py: {d}: not a directory", file=sys.stderr)
+            return 1
+        bench_path = bench_path or _first_existing(d, "bench.json")
+        profile_path = profile_path or _first_existing(d, "profile.json")
+        metrics_path = metrics_path or _first_existing(d, "metrics.jsonl")
+    if not bench_path and not profile_path:
+        print("doctor.py: need --run-dir, --bench or --profile "
+              "(nothing to diagnose)", file=sys.stderr)
+        return 1
+    try:
+        bench = load_bench(bench_path) if bench_path else None
+        profile = load_json(profile_path) if profile_path else None
+        metrics = _metrics_summary(metrics_path) if metrics_path else None
+    except (OSError, ValueError) as e:
+        print(f"doctor.py: {e}", file=sys.stderr)
+        return 1
+    doc = diagnose(bench, profile, metrics,
+                   args.peak_tflops, args.peak_hbm_gbps)
+    if not doc["workloads"] and not doc.get("hbm"):
+        print("doctor.py: no profiled workloads found — was the capture "
+              "run with ALINK_TPU_PROFILE=1?", file=sys.stderr)
+        # still render what exists (e.g. a bench without profile rows)
+    if args.json:
+        json.dump(doc, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        print(render(doc))
+    return 0
+
+
+def _first_existing(d: str, name: str) -> Optional[str]:
+    p = os.path.join(d, name)
+    return p if os.path.exists(p) else None
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

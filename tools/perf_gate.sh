@@ -31,18 +31,45 @@ BASE=${PERF_GATE_BASE:-BENCH_quick_base.json}
 NEW=BENCH_quick.json
 THRESH=${PERF_GATE_THRESHOLD:-30}
 
+# the gate bench runs PROFILED (ALINK_TPU_PROFILE=1) into a throwaway
+# run dir: the measured-profiling path (ISSUE 8) is on the gate's hot
+# path, and the doctor smoke below fails the gate if its artifacts ever
+# stop parsing. Harness marks cost ~2 perf_counter calls per dispatch;
+# xprof capture stays off (ALINK_TPU_PROFILE_XPROF unset), so the gate
+# numbers are unchanged within noise — baselines recorded by --update
+# use the same command, keeping the comparison symmetric.
+RUNDIR=$(mktemp -d -t alink_perf_gate.XXXXXX)
+trap 'rm -rf "$RUNDIR"' EXIT
+
 if [ "${1:-}" = "--update" ]; then
-    python bench.py --quick --out "$BASE"
+    ALINK_TPU_PROFILE=1 python bench.py --quick --out "$BASE" --run-dir "$RUNDIR"
     echo "perf_gate: baseline updated -> $BASE"
     exit 0
 fi
 
-python bench.py --quick --out "$NEW"
+ALINK_TPU_PROFILE=1 python bench.py --quick --out "$NEW" --run-dir "$RUNDIR"
+
+# doctor smoke: the measured artifacts must parse and render (exit 0) —
+# the profile path cannot rot silently behind its default-off flag
+python tools/doctor.py --run-dir "$RUNDIR" > /dev/null
+echo "perf_gate: doctor parsed the profiled run artifacts ($RUNDIR)"
 
 if [ ! -f "$BASE" ]; then
     cp "$NEW" "$BASE"
     echo "perf_gate: no baseline found; promoted $NEW -> $BASE (gate passes trivially this run)"
     exit 0
+fi
+
+# the baseline must have been captured profiled too (rig.profile=true in
+# the dump) — a pre-profiled-gate baseline makes the comparison
+# asymmetric (the new run pays the harness's block_until_ready + marks,
+# the old one didn't) and bench_compare's provenance fingerprint cannot
+# see that; say so loudly instead of failing mysteriously at the gate
+if ! grep -q '"profile": true' "$BASE"; then
+    echo "perf_gate: WARNING: baseline $BASE was captured WITHOUT" >&2
+    echo "  ALINK_TPU_PROFILE=1 (pre-profiled-gate); deltas include" >&2
+    echo "  profiling overhead asymmetrically — refresh it with:" >&2
+    echo "  tools/perf_gate.sh --update" >&2
 fi
 
 python tools/bench_compare.py "$BASE" "$NEW" --threshold "$THRESH" --baseline-provenance

@@ -227,6 +227,25 @@ def main(argv=None) -> int:
                          "HealthMonitor.save_report() JSON from the "
                          "same run")
     args = ap.parse_args(argv)
+    if os.path.isdir(args.report):
+        # a bench.py --run-dir artifact directory: the metrics dump is
+        # the report; sibling trace/health artifacts auto-attach unless
+        # explicitly given. The measured profile has its own renderer
+        # (tools/doctor.py) — point at it instead of half-rendering.
+        d = args.report
+        args.report = os.path.join(d, "metrics.jsonl")
+        if not os.path.exists(args.report):
+            print(f"run_report.py: {d}: no metrics.jsonl inside "
+                  f"(not a bench --run-dir directory?)", file=sys.stderr)
+            return 1
+        for attr, fname in (("trace", "trace.jsonl"),
+                            ("health", "health.json")):
+            p = os.path.join(d, fname)
+            if getattr(args, attr) is None and os.path.exists(p):
+                setattr(args, attr, p)
+        if not args.prom and os.path.exists(os.path.join(d, "profile.json")):
+            print(f"(measured profile present — render it with: "
+                  f"python tools/doctor.py --run-dir {d})")
     reg = MetricsRegistry.load(args.report)
     if args.prom:
         sys.stdout.write(reg.render_text())
