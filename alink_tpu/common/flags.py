@@ -341,6 +341,24 @@ FLAGS.register(
 
 # -- performance ------------------------------------------------------------
 FLAGS.register(
+    "ALINK_TPU_FUSE_COLLECTIVES", "bool", False,
+    "trace-time collective fusion: coalesce same-superstep, same-reduction "
+    "manifest_psum/pmax/pmin/all_gather payloads into one flattened, "
+    "offset-sliced collective per (op, dtype) lane", "performance",
+    folds_into=frozenset({PROGRAM_CACHE, CHECKPOINT_SIGNATURE}),
+    accessor="alink_tpu.engine.communication.fusion_enabled")
+FLAGS.register(
+    "ALINK_TPU_MESH_DEVICES", "int", 0,
+    "device count for the default session mesh (0 = all of jax.devices()); "
+    "on CPU rigs, request host-platform virtual devices BEFORE the jax "
+    "backend initializes (measured multi-device execution on 1-chip rigs)",
+    "performance",
+    key_neutral="selects the session MESH, and the mesh object itself "
+                "already rides every program-cache and step-lru key (a "
+                "different mesh can never serve a stale program)",
+    clamp=lambda n: max(0, n),
+    accessor="alink_tpu.common.mlenv.mesh_device_request")
+FLAGS.register(
     "ALINK_TPU_DONATE", "bool", True,
     "buffer donation of the engine chunk-loop carry and the FTRL (z, n) "
     "state into compiled programs", "performance",

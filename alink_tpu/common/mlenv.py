@@ -22,6 +22,57 @@ import numpy as np
 from .lazy import LazyObjectsManager
 
 
+def mesh_device_request() -> int:
+    """``ALINK_TPU_MESH_DEVICES`` (default 0 = all of ``jax.devices()``):
+    how many devices the default session mesh should span. On CPU rigs
+    this is the knob that turns the historical 1-device virtual axis into
+    a real ≥4-device host-platform mesh (measured multi-device execution,
+    SCALING_r06) — set it before the first jax backend touch so
+    :func:`ensure_host_platform_devices` can still widen the platform."""
+    from .flags import flag_value
+    return int(flag_value("ALINK_TPU_MESH_DEVICES"))
+
+
+def _jax_backend_initialized() -> bool:
+    """Best-effort: has any jax backend already been instantiated? XLA
+    flags latch at backend init, so widening the host platform is only
+    possible before this returns True."""
+    import sys
+    xb = sys.modules.get("jax._src.xla_bridge")
+    if xb is None:
+        return False
+    try:
+        backends = getattr(xb, "_backends", None)
+    except Exception:           # unknown internals: assume initialized
+        return True
+    if backends is None:        # attribute renamed/missing: conservative
+        return True
+    return bool(backends)       # present-but-empty dict = not initialized
+
+
+def ensure_host_platform_devices(n: int) -> bool:
+    """Arrange for >= ``n`` devices on a CPU rig by forcing the XLA host
+    platform device count BEFORE the backend initializes (the bootenv
+    mechanism, in-process). Returns True when the flag could be set (or
+    enough devices already exist); False when the backend already latched
+    with fewer devices — callers then respawn a fresh interpreter with
+    ``bootenv.cpu_mesh_env(n)`` (tools/scaling_evidence.py does)."""
+    if _jax_backend_initialized():
+        import jax
+        return len(jax.devices()) >= n
+    flags = os.environ.get("XLA_FLAGS", "")
+    import re
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is not None:
+        # caller already chose a count; respect it — but report honestly
+        # whether it satisfies the request (a smaller pinned count means
+        # the caller must respawn, exactly like the initialized case)
+        return int(m.group(1)) >= n
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={int(n)}").strip()
+    return True
+
+
 class MLEnvironment:
     """One session: device mesh + lazy-objects manager + RNG seed stream."""
 
@@ -30,7 +81,24 @@ class MLEnvironment:
         import jax
 
         if devices is None:
+            req = mesh_device_request()
+            if req > 0:
+                # widen the CPU host platform before the backend latches
+                # (no-op on TPU or once a backend exists)
+                ensure_host_platform_devices(req)
             devices = jax.devices()
+            if req > 0:
+                if len(devices) < req:
+                    raise ValueError(
+                        f"ALINK_TPU_MESH_DEVICES={req} but only "
+                        f"{len(devices)} devices are available and the "
+                        f"host platform could not be widened (jax backend "
+                        f"already initialized, or XLA_FLAGS already pins a "
+                        f"smaller device count); set "
+                        f"XLA_FLAGS=--xla_force_host_platform_device_count="
+                        f"{req} before the first jax use, or respawn via "
+                        f"bootenv.cpu_mesh_env({req})")
+                devices = devices[:req]
         n = len(devices)
         if parallelism is None:
             parallelism = max(1, n // model_parallelism)

@@ -777,6 +777,13 @@ class IterativeComQueue:
         # the non-donated one's even though the HLO ops are identical, so
         # a toggle must recompile, never alias-through a cached entry
         donate = donation_enabled()
+        # collective-fusion switch (ALINK_TPU_FUSE_COLLECTIVES), latched
+        # per run. Rides the program-cache key AND the checkpoint
+        # signature: the fused program's collective set is structurally
+        # different HLO (N lane payloads -> one flattened psum), even
+        # though training results are bitwise-identical
+        from .communication import fusion_enabled, fusing, resolve_deferred
+        fuse = fusion_enabled()
         # per-superstep collective capture (trace-time; see communication
         # .collecting), keyed by the traced input signature: jax.jit keeps
         # a shape-keyed trace cache underneath each compiled entry, so one
@@ -850,16 +857,29 @@ class IterativeComQueue:
             entries = per["init" if init_pass else "body"]
             entries.clear()
             with collecting(entries):
-                for s in stages:
-                    # name each compiled stage (the reference .name()s every
-                    # dataflow stage for the Flink UI, BaseComQueue.java:172-195)
-                    with named_stage(getattr(s, "__name__", type(s).__name__)):
-                        s.calc(ctx)
-                if criterion is not None:
-                    stop = criterion(ctx)
-                    ctx.put_obj("__stop", jnp.asarray(stop, bool).reshape(()))
-                else:
-                    ctx.put_obj("__stop", jnp.asarray(False))
+                # fusion scope (no-op when the flag is off): manifest
+                # wrappers defer their reductions; the first USE of any
+                # deferred value flushes all independent pending payloads
+                # as one flattened collective, and the scope exit flushes
+                # whatever was never read inside this superstep
+                with fusing(enabled=fuse):
+                    for s in stages:
+                        # name each compiled stage (the reference .name()s
+                        # every dataflow stage for the Flink UI,
+                        # BaseComQueue.java:172-195)
+                        with named_stage(getattr(s, "__name__",
+                                                 type(s).__name__)):
+                            s.calc(ctx)
+                    if criterion is not None:
+                        stop = criterion(ctx)
+                        ctx.put_obj("__stop",
+                                    jnp.asarray(stop, bool).reshape(()))
+                    else:
+                        ctx.put_obj("__stop", jnp.asarray(False))
+            if fuse:
+                # deferred proxies must never reach the while_loop carry
+                for k in list(ctx.carry):
+                    ctx.carry[k] = resolve_deferred(ctx.carry[k])
             log_superstep(ctx.step_no, task=ctx.task_id,
                           stop=ctx.get_obj("__stop"))
             return ctx.carry
@@ -967,7 +987,8 @@ class IterativeComQueue:
             ckey = (self._program_key, stages_dig,
                     mesh, nw, max_iter, seed,
                     criterion is not None, step_log_enabled(), probes_on,
-                    donate, tuple(sorted(parts)), tuple(sorted(bcast)))
+                    donate, fuse, tuple(sorted(parts)),
+                    tuple(sorted(bcast)))
 
         if self._ckpt is not None:
             # -- durable chunked execution (engine/recovery.py) -----------
@@ -1028,7 +1049,7 @@ class IterativeComQueue:
                 num_workers=nw, max_iter=max_iter, seed=seed,
                 part_sig=part_sig, bcast_names=tuple(sorted(bcast)),
                 stages_digest=stages_dig, data_token=data_token,
-                probes_on=probes_on)
+                probes_on=probes_on, fuse_collectives=fuse)
             resumed = recovery.resume_state(ck, signature)
             on_snapshot = None
             if self._health is not None and probes_on:
@@ -1203,17 +1224,16 @@ class IterativeComQueue:
             # supersteps (the body is TRACED even for runs whose criterion
             # stops at step 1, so it must not be charged for supersteps it
             # never ran)
-            counts = []
+            # charge the captured manifests through the ONE fused-aware
+            # replay helper (records are 3-tuples, or 4-tuples carrying
+            # fused-group membership — communication.record_manifest)
             if per is not None:
-                counts = ([(e, init_runs) for e in per["init"]]
-                          + [(e, executed - init_runs) for e in per["body"]])
-            for (kind, _buf, nbytes), times in counts:
-                if times <= 0:
-                    continue
-                lbl = {"collective": kind}
-                reg.inc("alink_collective_calls_total", times, lbl)
-                reg.inc("alink_collective_logical_bytes_total",
-                        times * nbytes, lbl)
+                from .communication import record_manifest
+                if init_runs > 0:
+                    record_manifest(per["init"], times=init_runs)
+                if executed - init_runs > 0:
+                    record_manifest(per["body"],
+                                    times=executed - init_runs)
             if cost is not None:
                 # XLA's static cost model for this program (ALINK_TPU_TRACE
                 # runs only — _maybe_cost). The step_count fetch above

@@ -146,10 +146,16 @@ def kmeans_parallel_init(X: np.ndarray, k: int, seed: int = 0,
         keys = jnp.where(d2 > 0, jnp.log(jnp.maximum(d2, 1e-30)) + g, -jnp.inf)
         kv, ki = jax.lax.top_k(keys, l_loc)
         pts = Xb[ki]                                        # (l_loc, d)
+        # register BOTH gathers before either is consumed: under
+        # ALINK_TPU_FUSE_COLLECTIVES the pair coalesces into one
+        # all-gather (the jnp.asarray coercion materializes the deferred
+        # results at user level — lax.top_k must never see a raw proxy)
         gk = manifest_all_gather(kv, ctx.AXIS, name="kmpp_keys",
-                                 num_workers=ctx.num_task).reshape(-1)
+                                 num_workers=ctx.num_task)
         gp = manifest_all_gather(pts, ctx.AXIS, name="kmpp_cands",
-                                 num_workers=ctx.num_task).reshape(-1, d)
+                                 num_workers=ctx.num_task)
+        gk = jnp.asarray(gk).reshape(-1)
+        gp = jnp.asarray(gp).reshape(-1, d)
         gv, gi = jax.lax.top_k(gk, l_glob)
         sel = gp[gi]
         valid = jnp.isfinite(gv)

@@ -447,8 +447,12 @@ def build_tree(binned, stats, max_depth: int, n_bins: int,
             hist = level_hist(binned, stats, node_id, n_nodes, n_bins,
                               use_onehot)
         if axis_name is not None:
-            hist = manifest_psum(hist, axis_name, name="tree_hist",
-                                 num_workers=num_workers)
+            # asarray materializes immediately: the per-level histogram
+            # psums are dependency-ordered (level L's node assignment
+            # needs level L-1's split), so there is nothing to fuse with
+            hist = jnp.asarray(manifest_psum(hist, axis_name,
+                                             name="tree_hist",
+                                             num_workers=num_workers))
         cum = jnp.cumsum(hist, axis=2)
         total = cum[:, :, -1:, :]
         left = cum[:, :, :-1, :]                      # split "bin <= b"
@@ -501,8 +505,9 @@ def build_tree(binned, stats, max_depth: int, n_bins: int,
     n_leaves = 1 << max_depth
     leaf_hist = jnp.zeros((n_leaves, m), dt).at[node_id].add(stats)
     if axis_name is not None:
-        leaf_hist = manifest_psum(leaf_hist, axis_name, name="tree_leaf_hist",
-                                  num_workers=num_workers)
+        leaf_hist = jnp.asarray(manifest_psum(leaf_hist, axis_name,
+                                              name="tree_leaf_hist",
+                                              num_workers=num_workers))
     features = jnp.concatenate(feats_out)
     split_bins = jnp.concatenate(bins_out)
     split_masks = jnp.concatenate(masks_out, axis=0)

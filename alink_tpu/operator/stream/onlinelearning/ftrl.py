@@ -52,6 +52,17 @@ from ...common.linear.mapper import LinearModelMapper
 from ..core import merge_timed
 
 
+def ftrl_state_rules():
+    """Partition rules for the FTRL model state (io/sharding.py
+    match_partition_rules): the accumulated (z, n) vectors are sharded
+    over the mesh feature axis 'd' — the device analogue of the
+    reference splitting the coefficient range across workers
+    (getSplitInfo, FtrlTrainStreamOp.java:74-87); anything else (labels,
+    batch tensors) replicates."""
+    from jax.sharding import PartitionSpec as P
+    return ((r"^(z|n)$", P("d")),)
+
+
 def _ftrl_weights(z, n, alpha, beta, l1, l2):
     """w from the accumulated (z, n) state — the FTRL-proximal closed form
     (one copy shared by the dense program, the sparse program, and the
@@ -784,6 +795,17 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
             # CONDITIONALLY so pre-existing snapshots of the other modes
             # keep their exact signature and stay resumable
             ck_signature["chunk_size"] = chunk_size
+        from ....engine.communication import fusion_enabled
+        if update_mode == "chained" and fusion_enabled():
+            # ALINK_TPU_FUSE_COLLECTIVES folds into the chained-mode
+            # signature only: today every FTRL margin psum is dependency-
+            # forced to a single collective (programs are byte-identical
+            # under the flag), but the chained kernel is the one whose
+            # collision association is f32-round-sensitive — any future
+            # fused-margin chunking changes it, so chained resumes refuse
+            # across the flag conservatively. Conditional, so existing
+            # snapshots of all modes stay resumable with the flag off.
+            ck_signature["fuse_collectives"] = True
         allow_fb = [True]    # cleared once the state commits to std layout
         sparse_step = [None]                # built lazily (sparse input only)
         # (z, n) buffer donation (ALINK_TPU_DONATE, default on): every
@@ -953,7 +975,16 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
 
-            feat_shard = NamedSharding(mesh, P("d"))
+            from ....io.sharding import state_sharding
+
+            # declarative state placement: (z, n) feature-sharded across
+            # the mesh via the partition rules (io/sharding.py) — one
+            # choke point instead of per-site NamedSharding literals
+            def state_put(z_arr, n_arr):
+                sh = state_sharding(mesh, ftrl_state_rules(),
+                                    {"z": z_arr, "n": n_arr})
+                return (jax.device_put(z_arr, sh["z"]),
+                        jax.device_put(n_arr, sh["n"]))
             scale = beta / alpha + l2   # z = -w*(beta/alpha + l2) at n=0:
             # the warm start encodes the initial weights into z
 
@@ -970,8 +1001,7 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                     z0[fb_S:fb_S + dim - 1] = -coef[1:] * scale
                 else:
                     z0[:dim] = -coef * scale
-                return (jax.device_put(z0, feat_shard),
-                        jax.device_put(np.zeros(dim_state), feat_shard))
+                return state_put(z0, np.zeros(dim_state))
 
             def fb_to_std_state(z_fb, n_fb):
                 """Exact fb -> std state translation: the fb layout is
@@ -988,8 +1018,7 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 else:
                     z0[:dim] = zh[:dim]
                     n0[:dim] = nh[:dim]
-                return (jax.device_put(z0, feat_shard),
-                        jax.device_put(n0, feat_shard))
+                return state_put(z0, n0)
 
             rep_shard = NamedSharding(mesh, P())
 
@@ -1115,8 +1144,7 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                                              int(_meta["fb_field_size"]))
                 else:
                     allow_fb[0] = False
-                z = jax.device_put(_payload["z"], feat_shard)
-                n = jax.device_put(_payload["n"], feat_shard)
+                z, n = state_put(_payload["z"], _payload["n"])
 
             def save_state():
                 # ONE batched host fetch of (z, n) per checkpoint

@@ -27,6 +27,13 @@ cd "$(dirname "$0")/.."
 # tools/lint_baseline.json with written justifications.
 python -m tools.lint --strict
 
+# >=4-device fusion smoke (ISSUE 9): one fresh 4-virtual-device child
+# runs kmeans + Newton fused (ALINK_TPU_FUSE_COLLECTIVES=1) and unfused,
+# asserting bitwise-identical results and the compiled all-reduce count
+# drop (2 -> 1 per superstep) — the sharded/fused path cannot rot on
+# CPU-only rigs even though the default bench leg runs 1-device.
+python tools/scaling_evidence.py --smoke
+
 BASE=${PERF_GATE_BASE:-BENCH_quick_base.json}
 NEW=BENCH_quick.json
 THRESH=${PERF_GATE_THRESHOLD:-30}
