@@ -399,6 +399,71 @@ FLAGS.register(
     key_neutral="only shifts the RESOLVED fused-hist mode, and the "
                 "resolved mode is what folds into the program-cache key")
 
+# -- serving ----------------------------------------------------------------
+# The compiled serving tier's program cache keys on (model signature,
+# encoding kind, shape bucket, encoded shapes/dtypes) — everything that
+# can change a compiled serving program is IN the key, so every serving
+# flag below is key-neutral by construction. tools/lint's ENV-KEY-FOLD
+# rule checks the serving factory root against these declarations.
+FLAGS.register(
+    "ALINK_TPU_SERVE_COMPILED", "bool", False,
+    "route ModelMapStreamOp (stream predict twins) through the compiled "
+    "serving path (CompiledPredictor); off = the exact host mapper path",
+    "serving",
+    key_neutral="selects HOST scoring implementation only: flag off runs "
+                "no compiled program at all, flag on keys every program "
+                "on (model signature, bucket, shapes) — a toggle can "
+                "never reuse a stale compiled program",
+    accessor="alink_tpu.serving.predictor.serve_compiled_enabled")
+FLAGS.register(
+    "ALINK_TPU_SERVE_BUCKETS", "str", "",
+    "serving shape-bucket set, comma-separated batch sizes "
+    "(unset = 1,8,32,128,512); requests pad to the smallest covering "
+    "bucket", "serving",
+    key_neutral="selects WHICH bucket a request pads to; the bucket "
+                "itself rides every serving program-cache key, so a "
+                "different bucket set compiles new programs but can "
+                "never reuse a stale one",
+    accessor="alink_tpu.serving.predictor.serve_buckets")
+FLAGS.register(
+    "ALINK_TPU_SERVE_WINDOW_MS", "float", 2.0,
+    "micro-batcher latency budget: max milliseconds the serving loop "
+    "holds a batch below ALINK_TPU_SERVE_MIN_FILL rows waiting for "
+    "stragglers (inert at the default min-fill of 1 — adaptive "
+    "dispatch)", "serving",
+    key_neutral="host-side batch-assembly scheduling only; never read "
+                "at trace time",
+    clamp=lambda v: max(0.0, v),
+    accessor="alink_tpu.serving.predictor.serve_window_s")
+FLAGS.register(
+    "ALINK_TPU_SERVE_MIN_FILL", "int", 1,
+    "micro-batcher fill target in rows: batches below it wait up to "
+    "ALINK_TPU_SERVE_WINDOW_MS before dispatching (1 = dispatch the "
+    "moment the queue drains — latency over occupancy)", "serving",
+    key_neutral="host-side batch-assembly scheduling only; never read "
+                "at trace time",
+    clamp=lambda n: max(1, n),
+    accessor="alink_tpu.serving.predictor.serve_min_fill")
+FLAGS.register(
+    "ALINK_TPU_SERVE_QUEUE", "int", 1024,
+    "admission-control bound of the serving request channel (a full "
+    "queue blocks submitters — backpressure)", "serving",
+    key_neutral="host-side admission control on the request channel; "
+                "never read at trace time",
+    clamp=lambda n: max(1, n),
+    accessor="alink_tpu.serving.predictor.serve_queue_depth")
+FLAGS.register(
+    "ALINK_TPU_SERVE_SWAP", "mode", "double",
+    "hot model-swap mode: double (standby slot prepared off the serving "
+    "loop, atomic flip) | sync (flip waits for device residency)",
+    "serving",
+    key_neutral="host-side model-slot management; the model signature "
+                "rides every serving program-cache key, so neither mode "
+                "can serve a stale program",
+    parser=lambda raw: ("sync" if raw.strip().lower() == "sync"
+                        else "double"),
+    accessor="alink_tpu.serving.predictor.serve_swap_mode")
+
 # -- durability -------------------------------------------------------------
 FLAGS.register(
     "ALINK_TPU_ASYNC_SNAPSHOT", "bool", True,

@@ -69,6 +69,18 @@ class Mapper(WithParams):
         one = MTable([row], self.data_schema)
         return self.map_table(one).row(0)
 
+    def serving_kernel(self):
+        """The mapper's compiled-serving contract, or ``None``.
+
+        Mappers whose scoring splits into (host encode -> pure device
+        score -> host decode) return a
+        :class:`alink_tpu.serving.predictor.ServingKernel`, which the
+        serving tier lowers into per-(model signature, shape bucket)
+        jitted programs. ``None`` (the default) keeps the mapper on the
+        host path — ``CompiledPredictor.for_mapper`` falls back
+        gracefully."""
+        return None
+
 
 class ModelMapper(Mapper):
     """Mapper initialized from model rows (reference ModelMapper.loadModel,

@@ -23,6 +23,44 @@ def _sigmoid(x):
     return 1.0 / (1.0 + np.exp(-np.clip(x, -500, 500)))
 
 
+# serving-kernel scan chunk (see LinearModelMapper.serving_kernel): the
+# feature axis pads to a multiple of this and reduces CHUNK terms per
+# scan step in strict left-to-right order
+_SERVE_CHUNK = 8
+
+
+def _seq_chunk_sum(terms, axis: int):
+    """Sum ``terms`` over ``axis`` in a FIXED left-to-right order
+    (chunked ``lax.scan`` of elementwise adds): unlike ``jnp.sum`` /
+    ``@``, the float rounding cannot depend on the other dimensions'
+    sizes, which is what makes serving buckets numerical no-ops. The
+    reduced extent must be a multiple of ``_SERVE_CHUNK`` (encode pads
+    it)."""
+    import jax
+    import jax.numpy as jnp
+    t = jnp.moveaxis(terms, axis, 0)
+    ext = t.shape[0]
+    acc0 = jnp.zeros(t.shape[1:], t.dtype)
+    if ext <= 16 * _SERVE_CHUNK:
+        # small extents unroll in-trace: same strict order, none of the
+        # scan loop's per-step dispatch overhead (the serial bucket-1
+        # program's latency lives here)
+        acc = acc0
+        for j in range(ext):
+            acc = acc + t[j]
+        return acc
+    m = ext // _SERVE_CHUNK
+    t = t.reshape((m, _SERVE_CHUNK) + t.shape[1:])
+
+    def body(acc, chunk):
+        for k in range(_SERVE_CHUNK):
+            acc = acc + chunk[k]
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, acc0, t)
+    return acc
+
+
 class LinearModelMapper(ModelMapper):
     def __init__(self, model_schema, data_schema, params=None, **kwargs):
         super().__init__(model_schema, data_schema, params, **kwargs)
@@ -57,6 +95,129 @@ class LinearModelMapper(ModelMapper):
     def predict_scores(self, data: MTable) -> np.ndarray:
         return self._scores(data)
 
+    # ------------------------------------------------------------------
+    def serving_kernel(self):
+        """Compiled-serving contract (serving/predictor.py): host
+        encode -> pure jittable score -> host decode via :meth:`_finish`.
+
+        The device kernels accumulate the per-row dot product with a
+        chunked ``lax.scan`` over the FEATURE axis (strict left-to-right
+        order, elementwise vector ops only), so the reduction order is
+        independent of the batch leading dimension — a plain ``X @ w``
+        lets XLA pick a shape-dependent tiling, and the same row served
+        at bucket 1 vs bucket 128 would round differently in the last
+        ulp. This is what makes the serving tier's padding/bucketing a
+        bitwise no-op (tests/test_serving.py pins it); against the numpy
+        mapper path, labels are exact and scores match to ~1e-15
+        relative (BLAS orders its own reduction). The kernel signature
+        carries the model GEOMETRY only — weights are program
+        arguments, so hot-swapping same-shaped models reuses every
+        compiled program."""
+        m = self.model
+        if m is None:
+            raise RuntimeError(
+                "load_model must be called before serving_kernel")
+        import jax
+        from ....serving.predictor import ServingKernel
+        ship_dt = np.float64 if jax.config.jax_enable_x64 else np.float32
+        softmax = m.linear_model_type == LinearModelType.Softmax
+        coef = np.asarray(m.coef, ship_dt)
+        if softmax:
+            k = len(m.label_values)
+            W = coef.reshape(k - 1, -1)
+            if m.has_intercept:
+                b, Wf = W[:, 0], W[:, 1:]
+            else:
+                b, Wf = np.zeros(k - 1, ship_dt), W
+            model_arrays = (np.ascontiguousarray(Wf),
+                            np.ascontiguousarray(b))
+            dim = Wf.shape[1]
+        else:
+            if m.has_intercept:
+                b, wf = coef[0], coef[1:]
+            else:
+                b, wf = np.asarray(0.0, ship_dt), coef
+            model_arrays = (np.ascontiguousarray(wf),
+                            np.asarray(b, ship_dt))
+            dim = wf.shape[0]
+        signature = ("linear", str(m.linear_model_type), int(dim),
+                     bool(m.has_intercept), bool(softmax),
+                     len(m.label_values or ()), str(ship_dt.__name__))
+
+        # feature axis padded to the scan chunk so every program scans
+        # whole chunks; the model arrays carry the padding ONCE
+        dim8 = -(-dim // _SERVE_CHUNK) * _SERVE_CHUNK
+
+        def encode(data: MTable, bucket: int):
+            design = extract_design(data, m.feature_names, m.vector_col,
+                                    ship_dt, vector_size=m.vector_size)
+            n = data.num_rows
+            if design["kind"] == "dense":
+                Xf = design["X"]
+                if Xf.shape[1] > dim:
+                    raise ValueError(
+                        f"request has {Xf.shape[1]} features, model has "
+                        f"{dim}")
+                X = np.zeros((bucket, dim8), ship_dt)
+                X[:n, :Xf.shape[1]] = Xf
+                return ("dense", (X,))
+            idx0, val0 = design["idx"], design["val"]
+            # pad width in steps of the chunk (the FTRL encode
+            # convention) so a few compiled widths cover drifting nnz
+            w0 = max(idx0.shape[1], 1)
+            width = -(-w0 // _SERVE_CHUNK) * _SERVE_CHUNK
+            idx = np.zeros((bucket, width), np.int32)
+            val = np.zeros((bucket, width), ship_dt)
+            idx[:n, :idx0.shape[1]] = idx0
+            val[:n, :val0.shape[1]] = val0
+            return ("sparse", (idx, val))
+
+        if softmax:
+            Wf8 = np.zeros((Wf.shape[0], dim8), ship_dt)
+            Wf8[:, :dim] = Wf
+            model_arrays = (Wf8, model_arrays[1])
+        else:
+            wf8 = np.zeros(dim8, ship_dt)
+            wf8[:dim] = model_arrays[0]
+            model_arrays = (wf8, model_arrays[1])
+
+        # version-independent pure functions of (model_arrays, batch):
+        # the predictor jit-caches them per (signature, kind, bucket,
+        # shapes) and later model versions reuse the compiled program.
+        # Every reduction goes through _seq_chunk_sum, never jnp.sum /
+        # @ — the bucket-invariance contract.
+        if softmax:
+            def _dense(mdl, X):
+                W, b = mdl     # W (K-1, dim8)
+                terms = X[:, :, None] * W.T[None, :, :]   # (n, dim8, K-1)
+                return _seq_chunk_sum(terms, axis=1) + b
+
+            def _sparse(mdl, idx, val):
+                W, b = mdl
+                terms = val[..., None] * W.T[idx]         # (n, w, K-1)
+                return _seq_chunk_sum(terms, axis=1) + b
+        else:
+            def _dense(mdl, X):
+                w, b = mdl
+                return _seq_chunk_sum(X * w[None, :], axis=1) + b
+
+            def _sparse(mdl, idx, val):
+                w, b = mdl
+                return _seq_chunk_sum(val * w[idx], axis=1) + b
+        device_fns = {"dense": _dense, "sparse": _sparse}
+
+        def decode(outputs, data: MTable) -> MTable:
+            scores = np.asarray(outputs[0])
+            if softmax:
+                scores = np.concatenate(
+                    [scores, np.zeros((scores.shape[0], 1), scores.dtype)],
+                    axis=1)
+            return self._finish(scores, data)
+
+        return ServingKernel(signature=signature, model_arrays=model_arrays,
+                             encode=encode, device_fns=device_fns,
+                             decode=decode)
+
     def get_output_schema(self) -> TableSchema:
         m = self.model
         pred_col = self.params._m.get("prediction_col", "pred")
@@ -74,10 +235,19 @@ class LinearModelMapper(ModelMapper):
         m = self.model
         if m is None:
             raise RuntimeError("load_model must be called before map_table")
+        return self._finish(self._scores(data), data)
+
+    def _finish(self, scores: np.ndarray, data: MTable) -> MTable:
+        """Scores -> output table (label pick, detail, column merge).
+
+        Split out of :meth:`map_table` so the serving tier
+        (``serving/predictor.py``) can decode DEVICE-computed scores
+        through the exact same host logic — predictions depend only on
+        the scores, whichever path produced them."""
+        m = self.model
         pred_col = self.params._m.get("prediction_col", "pred")
         detail_col = self.params._m.get("prediction_detail_col")
         reserved = self.params._m.get("reserved_cols")
-        scores = self._scores(data)
         out_cols, out_types = [], []
         details = None
         if m.linear_model_type in LinearModelType.IS_REGRESSION:

@@ -53,6 +53,10 @@ T = TypeVar("T")
 U = TypeVar("U")
 
 _SENTINEL = object()
+# timed-get miss marker (serving micro-batcher): distinct from the
+# end-of-stream sentinel so "nothing arrived within the latency budget"
+# and "the stream is over" stay distinguishable
+_EMPTY = object()
 
 
 def prefetch_depth(default: int = 2) -> int:
@@ -99,28 +103,65 @@ class _Channel:
                                      {"consumer": self._gauge_label})
 
     def put(self, item) -> bool:
-        """Enqueue; False when the consumer has stopped (drop the item)."""
+        """Enqueue; False when the consumer has stopped OR the channel
+        is already closed (a producer racing ``close()`` must not
+        strand an item no getter will ever see — the serving tier's
+        submit-vs-shutdown race)."""
         with self._not_full:
-            while not self._stopped and self._maxsize > 0 \
+            while not self._stopped and not self._closed \
+                    and self._maxsize > 0 \
                     and len(self._buf) >= self._maxsize:
                 self._not_full.wait()
-            if self._stopped:
+            if self._stopped or self._closed:
                 return False
             self._buf.append(item)
             self._gauge(len(self._buf))
             self._not_empty.notify()
             return True
 
-    def get(self):
+    def get(self, timeout: Optional[float] = None):
+        """Dequeue one item; blocks until an item, stop/close
+        (``_SENTINEL``) or — when ``timeout`` is given — the deadline
+        (``_EMPTY``). ``timeout=None`` is the historical behavior;
+        ``timeout=0`` polls without blocking (the micro-batcher's
+        "queue already holds a full batch" fast path)."""
+        deadline = None if timeout is None \
+            else time.monotonic() + max(0.0, timeout)
         with self._not_empty:
             while not self._buf:
                 if self._stopped or self._closed:
                     return _SENTINEL
-                self._not_empty.wait()
+                if deadline is None:
+                    self._not_empty.wait()
+                    continue
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return _EMPTY
+                self._not_empty.wait(remaining)
             item = self._buf.popleft()
             self._gauge(len(self._buf))
             self._not_full.notify()
             return item
+
+    def depth(self) -> int:
+        """Items currently buffered (the admission-control reading the
+        serving tier exports as ``alink_serve_queue_depth``)."""
+        with self._lock:
+            return len(self._buf)
+
+    def drain(self, max_items: int) -> list:
+        """Pop up to ``max_items`` buffered items under ONE lock
+        acquisition (never blocks; [] when empty). The serving
+        micro-batcher's bulk path — a per-item ``get`` would pay a
+        lock round trip per coalesced request."""
+        with self._lock:
+            k = min(int(max_items), len(self._buf))
+            if k <= 0:
+                return []
+            items = [self._buf.popleft() for _ in range(k)]
+            self._gauge(len(self._buf))
+            self._not_full.notify_all()
+            return items
 
     def close(self) -> None:
         """Producer end-of-stream: buffered items still DRAIN to getters;
@@ -129,6 +170,7 @@ class _Channel:
         with self._lock:
             self._closed = True
             self._not_empty.notify_all()
+            self._not_full.notify_all()   # blocked producers must re-check
 
     def stop(self) -> None:
         """Consumer abandonment: wake every blocked producer AND consumer

@@ -55,9 +55,23 @@ class ModelMapStreamOp(BaseStreamTransformOp):
         model_table = self._model_op.get_output_table()
         self._mapper = self.MAPPER_CLS(model_table.schema, in_schema, self.params)
         self._mapper.load_model(model_table)
+        # ALINK_TPU_SERVE_COMPILED (default off): route micro-batches
+        # through the compiled serving path — the same shape-bucketed
+        # jitted programs the PredictServer dispatches, so batch, stream
+        # and serving share ONE compiled scoring path. Flag off (or a
+        # mapper without a serving kernel) runs the exact host mapper
+        # code this class always ran.
+        self._predictor = None
+        from ....serving.predictor import (CompiledPredictor,
+                                           serve_compiled_enabled)
+        if serve_compiled_enabled():
+            self._predictor = CompiledPredictor.for_mapper(
+                self._mapper, name=type(self).__name__)
         return self._mapper.get_output_schema()
 
     def _transform(self, mt: MTable):
+        if self._predictor is not None:
+            return self._predictor.predict_table(mt)
         return self._mapper.map_table(mt)
 
     def link_from(self, *inputs) -> "ModelMapStreamOp":

@@ -1,0 +1,40 @@
+"""alink_tpu.serving — the compiled low-latency serving tier.
+
+The reference serves predictions through its Mapper/ModelMapper L6/L7
+layer (``LocalPredictor``, hot model-stream reload via
+``ModelMapperAdapter.loadModel`` — PAPER.md layer map). This package is
+that layer rebuilt TPU-first:
+
+* :class:`CompiledPredictor` — lowers a ModelMapper's scoring function
+  into per-model jitted programs keyed on (model signature, shape
+  bucket); requests pad to the smallest covering bucket so a handful of
+  compiled programs serve arbitrary request sizes, and padding rows are
+  proven numerical no-ops.
+* :class:`PredictServer` — the request micro-batcher: concurrent
+  single-row requests coalesce into bucket-sized device batches under a
+  latency budget, with admission control/backpressure on the
+  stop-aware condition-variable channel from ``operator/stream/
+  prefetch.py``.
+* hot model swap — :meth:`CompiledPredictor.swap_model` loads new
+  weights into the standby model slot (``device_put`` off the serving
+  loop) and atomically flips it active between dispatches; a
+  :class:`ModelStreamFeeder` taps a model-snapshot stream (the FTRL
+  trainer's output) and swaps per snapshot.
+* :class:`LoadGenerator` — the closed-loop load generator behind the
+  ``bench.py serve_*`` rows (QPS/chip, p50/p99, bucket-hit rate,
+  batch occupancy).
+
+See docs/serving.md for the bucket/padding contract, swap atomicity,
+admission control, and load-generator usage.
+"""
+
+from .predictor import (CompiledPredictor, ServingKernel,
+                        serve_buckets, serve_compiled_enabled)
+from .server import ModelStreamFeeder, PredictServer, RequestFuture
+from .loadgen import LoadGenerator, LoadReport, percentile, serial_qps
+
+__all__ = [
+    "CompiledPredictor", "ServingKernel", "PredictServer", "RequestFuture",
+    "ModelStreamFeeder", "LoadGenerator", "LoadReport", "percentile",
+    "serial_qps", "serve_buckets", "serve_compiled_enabled",
+]

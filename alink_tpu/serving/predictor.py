@@ -1,0 +1,392 @@
+"""CompiledPredictor — per-model jitted serving programs, shape-bucketed.
+
+The reference applies a model per row through ``ModelMapperAdapter.map``
+(common/mapper/ModelMapperAdapter.java:42-45); the mappers here are
+batched but HOST-side numpy. Serving traffic needs the score kernel on
+the device without paying one XLA compile per request size, so:
+
+* a :class:`ServingKernel` (built by the mapper, ``Mapper.
+  serving_kernel()``) splits model application into ``encode`` (host:
+  rows -> padded arrays), ``device_fn`` (pure jittable scoring) and
+  ``decode`` (host: device scores -> output table, the mapper's own
+  label/detail logic);
+* the predictor compiles ``device_fn`` once per **(model signature,
+  encoding kind, shape bucket)** — request batches pad with zero rows to
+  the smallest covering bucket from ``ALINK_TPU_SERVE_BUCKETS``, so a
+  handful of programs cover arbitrary request sizes and every program
+  is reused across requests AND across hot-swapped models of the same
+  geometry (weights are *arguments*, never baked into the trace);
+* padding rows are numerical no-ops: per-row scoring is row-independent,
+  so the real rows of a padded batch are bitwise-identical to the same
+  rows served unpadded (tests/test_serving.py pins it).
+
+Hot model swap is double-buffered: :meth:`CompiledPredictor.swap_model`
+builds the new model version — mapper load, kernel extraction,
+``device_put`` of the weights — entirely in the *standby* slot on the
+caller's thread, then flips the active-slot reference atomically.  A
+dispatch in flight keeps its own reference to the version it started
+with, so no request ever sees a torn model and a swap never blocks the
+serving loop.
+
+Cache-key discipline: the serving program cache keys on (model
+signature, kind, bucket, encoded shapes/dtypes) — everything that can
+change a compiled program is IN the key, so the ``ALINK_TPU_SERVE_*``
+flags are declared key-neutral in ``common/flags.py`` and alink-lint's
+ENV-KEY-FOLD rule checks this module as a factory root.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.metrics import get_registry, metrics_enabled
+from ..common.mtable import MTable
+from ..common.tracing import trace_complete, trace_span
+
+DEFAULT_BUCKETS = (1, 8, 32, 128, 512)
+
+
+def serve_compiled_enabled() -> bool:
+    """``ALINK_TPU_SERVE_COMPILED``: route the stream predict twins
+    (ModelMapStreamOp) through the compiled serving path. Default off —
+    the flag-off path runs the exact pre-serving host mapper code."""
+    from ..common.flags import flag_value
+    return flag_value("ALINK_TPU_SERVE_COMPILED", False)
+
+
+def serve_buckets(default: Sequence[int] = DEFAULT_BUCKETS) -> Tuple[int, ...]:
+    """``ALINK_TPU_SERVE_BUCKETS``: the shape-bucket set, sorted unique
+    positive ints (comma-separated). The registry parser normalizes;
+    this accessor returns the tuple call sites key programs on."""
+    from ..common.flags import flag_value
+    raw = flag_value("ALINK_TPU_SERVE_BUCKETS", "")
+    if not raw:
+        return tuple(default)
+    return _parse_buckets(raw) or tuple(default)
+
+
+def serve_window_s() -> float:
+    """``ALINK_TPU_SERVE_WINDOW_MS`` (batching latency budget) in
+    seconds."""
+    from ..common.flags import flag_value
+    return float(flag_value("ALINK_TPU_SERVE_WINDOW_MS", 2.0)) / 1e3
+
+
+def serve_min_fill() -> int:
+    """``ALINK_TPU_SERVE_MIN_FILL``: the micro-batcher's fill target —
+    batches below it are held up to the window for stragglers. The
+    default of 1 keeps pure adaptive dispatch."""
+    from ..common.flags import flag_value
+    return int(flag_value("ALINK_TPU_SERVE_MIN_FILL", 1))
+
+
+def serve_queue_depth() -> int:
+    """``ALINK_TPU_SERVE_QUEUE``: admission-control bound of the request
+    channel (requests beyond it block the submitter — backpressure)."""
+    from ..common.flags import flag_value
+    return int(flag_value("ALINK_TPU_SERVE_QUEUE", 1024))
+
+
+def serve_swap_mode() -> str:
+    """``ALINK_TPU_SERVE_SWAP``: ``double`` (default — standby slot
+    prepared off the serving loop, atomic flip) or ``sync`` (the flip
+    additionally blocks until the standby weights are device-resident;
+    debugging aid, serving loop still never blocks)."""
+    from ..common.flags import flag_value
+    return str(flag_value("ALINK_TPU_SERVE_SWAP", "double"))
+
+
+def _parse_buckets(raw: str) -> Tuple[int, ...]:
+    out = []
+    for part in str(raw).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        out.append(int(part))
+    return tuple(sorted({b for b in out if b > 0}))
+
+
+@dataclass
+class ServingKernel:
+    """One model's compiled-serving contract (built by the mapper).
+
+    ``signature``     — hashable PROGRAM identity: geometry/dtype/kind of
+                        the model, everything that shapes the traced
+                        computation EXCEPT the weight values. Two model
+                        versions with equal signatures share compiled
+                        programs (the hot-swap fast path).
+    ``model_arrays``  — the weights, a tuple of host arrays; the
+                        predictor ``device_put``s them once per model
+                        version and passes them as program arguments.
+    ``encode(mt, bucket)`` -> ``(kind, arrays)`` — host encode of a
+                        request table, padded with zero rows to
+                        ``bucket``; ``kind`` discriminates encodings
+                        (dense vs sparse) of the same model.
+    ``device_fns[kind](model_arrays, *arrays)`` — pure jittable scoring;
+                        outputs are arrays whose leading axis is rows.
+    ``decode(outputs, mt)`` — host decode of the REAL-row slice of the
+                        program outputs into the mapper's output table
+                        (the mapper's own label/detail logic).
+    """
+    signature: Tuple
+    model_arrays: Tuple[np.ndarray, ...]
+    encode: Callable[[MTable, int], Tuple[str, Tuple[np.ndarray, ...]]]
+    device_fns: Dict[str, Callable]
+    decode: Callable[[Tuple[np.ndarray, ...], MTable], MTable]
+
+
+def _merge_parts(parts):
+    """Concatenate chunk outputs column-wise in ONE pass — a pairwise
+    ``concat_rows`` fold re-copies the growing table per part, O(p^2)
+    data movement on the routed-stream hot path."""
+    first = parts[0]
+    cols = {}
+    for nm in first.col_names:
+        arrs = []
+        for p in parts:
+            c = p.col(nm)
+            if getattr(c, "__mtable_column__", False):
+                c = c.materialize()
+            arrs.append(c)
+        if any(a.dtype == object for a in arrs):
+            out = np.empty(sum(a.shape[0] for a in arrs), object)
+            off = 0
+            for a in arrs:
+                out[off:off + a.shape[0]] = a
+                off += a.shape[0]
+        else:
+            out = np.concatenate(arrs)
+        cols[nm] = out
+    return MTable(cols, first.schema)
+
+
+class _ModelVersion:
+    """One immutable model slot: kernel + device-resident weights."""
+
+    __slots__ = ("version", "kernel", "device_arrays", "mapper")
+
+    def __init__(self, version: int, kernel: ServingKernel, mapper=None):
+        import jax
+        self.version = version
+        self.kernel = kernel
+        self.mapper = mapper
+        # the weights land on device HERE — on the swapping thread, not
+        # the serving loop (the double-buffer contract)
+        self.device_arrays = tuple(jax.device_put(a)
+                                   for a in kernel.model_arrays)
+
+
+class CompiledPredictor:
+    """Shape-bucketed compiled model application with hot swap.
+
+    ``CompiledPredictor(mapper)`` takes a LOADED ModelMapper that
+    implements ``serving_kernel()``; :meth:`for_mapper` returns ``None``
+    instead of raising for mappers without a kernel (the stream-twin
+    routing falls back to the host path).
+    """
+
+    def __init__(self, mapper, buckets: Optional[Sequence[int]] = None,
+                 name: str = "serve"):
+        kernel = mapper.serving_kernel()
+        if kernel is None:
+            raise TypeError(
+                f"{type(mapper).__name__} does not provide a serving "
+                f"kernel; use CompiledPredictor.for_mapper() to fall "
+                f"back to the host mapper path")
+        self.name = name
+        self._buckets = tuple(sorted({int(b) for b in buckets if int(b) > 0})) \
+            if buckets else serve_buckets()
+        if not self._buckets:
+            raise ValueError("empty bucket set")
+        self._swap_lock = threading.Lock()
+        self._cache_lock = threading.Lock()
+        self._programs: Dict[Tuple, Callable] = {}
+        self._hits = 0
+        self._hits_reported = 0
+        self._misses = 0
+        self._versions = 0
+        # slot 0 = active. The standby slot is materialized per swap
+        # (a fresh _ModelVersion) and flipped in by ONE reference store,
+        # so readers racing a swap see either the old or the new version
+        # whole — never a mix.
+        self._active = self._make_version(kernel, mapper)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def for_mapper(cls, mapper, buckets: Optional[Sequence[int]] = None,
+                   name: str = "serve") -> Optional["CompiledPredictor"]:
+        """A predictor, or ``None`` when the mapper has no kernel."""
+        try:
+            kernel = mapper.serving_kernel()
+        except RuntimeError:
+            kernel = None
+        if kernel is None:
+            return None
+        return cls(mapper, buckets=buckets, name=name)
+
+    def _make_version(self, kernel: ServingKernel, mapper) -> _ModelVersion:
+        self._versions += 1
+        return _ModelVersion(self._versions, kernel, mapper)
+
+    # -- model hot swap -------------------------------------------------
+    def swap_model(self, model_table: MTable) -> int:
+        """Load ``model_table`` into the standby slot and flip it active.
+
+        Runs entirely on the caller's thread (the model-stream tap):
+        mapper construction, ``load_model``, kernel extraction and the
+        weight ``device_put`` all happen BEFORE the flip, which is one
+        atomic reference store. Returns the new version number.
+        Serialized across swappers; never blocks the serving loop."""
+        with self._swap_lock:
+            t0 = time.perf_counter()
+            with trace_span("serve.swap", cat="serve"):
+                base = self._active.mapper
+                mapper = type(base)(model_table.schema, base.data_schema,
+                                    base.params)
+                mapper.load_model(model_table)
+                standby = self._make_version(mapper.serving_kernel(), mapper)
+                if serve_swap_mode() == "sync":
+                    import jax
+                    jax.block_until_ready(standby.device_arrays)
+                self._active = standby     # the atomic flip
+            dt = time.perf_counter() - t0
+        if metrics_enabled():
+            reg = get_registry()
+            reg.inc("alink_serve_model_swaps_total", 1,
+                    {"predictor": self.name})
+            reg.observe("alink_serve_swap_seconds", dt,
+                        {"predictor": self.name})
+        return standby.version
+
+    @property
+    def model_version(self) -> int:
+        return self._active.version
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return self._buckets
+
+    # -- program cache --------------------------------------------------
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (requests larger than the top bucket are
+        served in top-bucket chunks)."""
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self._buckets[-1]
+
+    def _program(self, ver: _ModelVersion, kind: str, bucket: int,
+                 arrays: Tuple[np.ndarray, ...]) -> Callable:
+        """The compiled program for (model signature, kind, bucket) —
+        every dimension that shapes the trace is part of the key
+        (leading axes are the bucket itself; dtypes are fixed by the
+        kernel signature), so a cache hit can never serve a stale
+        program. The hit path is lock-free (GIL-atomic dict read + int
+        bump) — it runs per dispatched batch on the serving loop."""
+        key = (ver.kernel.signature, kind, bucket,
+               tuple(a.shape[1:] for a in arrays))
+        prog = self._programs.get(key)
+        if prog is not None:
+            self._hits += 1
+            return prog
+        import jax
+        with self._cache_lock:
+            prog = self._programs.get(key)
+            if prog is None:
+                self._misses += 1
+                prog = jax.jit(ver.kernel.device_fns[kind])
+                self._programs[key] = prog
+                if metrics_enabled():
+                    get_registry().inc("alink_serve_program_cache_total",
+                                       1, {"result": "miss",
+                                           "predictor": self.name})
+            else:
+                self._hits += 1
+        return prog
+
+    def cache_stats(self) -> Dict[str, int]:
+        self.flush_metrics()
+        with self._cache_lock:
+            return {"hits": self._hits, "misses": self._misses,
+                    "programs": len(self._programs)}
+
+    def flush_metrics(self) -> None:
+        """Push the (lock-free) hit counter delta into the registry —
+        per-hit registry updates would tax every dispatched batch, so
+        hits batch up and flush at stats/accounting boundaries."""
+        if not metrics_enabled():
+            return
+        with self._cache_lock:
+            delta = self._hits - self._hits_reported
+            self._hits_reported = self._hits
+        if delta > 0:
+            get_registry().inc("alink_serve_program_cache_total", delta,
+                               {"result": "hit", "predictor": self.name})
+
+    # -- prediction -----------------------------------------------------
+    def predict_table(self, data: MTable) -> MTable:
+        """Serve a whole request table through the bucketed programs.
+
+        Output is bitwise-identical for the real rows no matter which
+        bucket (or chunk split) served them — padding rows are zero and
+        per-row scoring is row-independent."""
+        n = data.num_rows
+        if n == 0:
+            return self._active.mapper.map_table(data)
+        top = self._buckets[-1]
+        if n <= top:
+            return self._predict_chunk(data)
+        parts = [self._predict_chunk(data.take_rows(np.arange(s, min(s + top, n))))
+                 for s in range(0, n, top)]
+        return _merge_parts(parts)
+
+    def _predict_chunk(self, data: MTable) -> MTable:
+        import jax
+        t0 = time.perf_counter()
+        ver = self._active           # one consistent model per dispatch
+        n = data.num_rows
+        bucket = self.bucket_for(n)
+        kind, arrays = ver.kernel.encode(data, bucket)
+        prog = self._program(ver, kind, bucket, arrays)
+        out = prog(ver.device_arrays, *arrays)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        # ONE batched host fetch, then slice the padding rows off
+        host = jax.device_get(list(out))
+        sliced = tuple(np.asarray(a)[:n] for a in host)
+        result = ver.kernel.decode(sliced, data)
+        trace_complete("serve.batch", time.perf_counter() - t0, cat="serve",
+                       args={"rows": n, "bucket": bucket,
+                             "model_version": ver.version})
+        if metrics_enabled():
+            reg = get_registry()
+            lbl = {"predictor": self.name}
+            reg.inc("alink_serve_batches_total", 1, lbl)
+            reg.observe("alink_serve_batch_occupancy", n / bucket, lbl)
+        return result
+
+    def predict_row(self, row: Tuple) -> Tuple:
+        """LocalPredictor-style single-row serving: the 1-row table trip
+        through the bucket-1 program (this is the serial-dispatch
+        baseline the micro-batcher is measured against)."""
+        one = MTable([row], self._active.mapper.data_schema)
+        return self.predict_table(one).row(0)
+
+    # -- parity helpers -------------------------------------------------
+    def host_reference(self, data: MTable) -> MTable:
+        """The active model applied through the HOST mapper path
+        (``map_table``) — the parity baseline of the compiled tier."""
+        return self._active.mapper.map_table(data)
+
+    @property
+    def output_schema(self):
+        return self._active.mapper.get_output_schema()
+
+    @property
+    def data_schema(self):
+        return self._active.mapper.data_schema

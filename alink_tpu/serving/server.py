@@ -1,0 +1,318 @@
+"""PredictServer — request micro-batching over the compiled predictor.
+
+The reference's serving story is per-row (``LocalPredictor.map``); at
+"millions of users" scale per-row device dispatch burns the chip on
+launch latency. The micro-batcher coalesces concurrent single-row
+requests into bucket-sized device batches under a latency budget:
+
+* requests enter through the stop-aware condition-variable channel from
+  ``operator/stream/prefetch.py`` (``_Channel``) — the bound IS the
+  admission control: a full queue blocks submitters (backpressure)
+  instead of growing latency unboundedly;
+* ONE serving-loop thread drains the channel: the first request of a
+  batch opens a ``ALINK_TPU_SERVE_WINDOW_MS`` window; the batch
+  dispatches when it reaches the top bucket size or the window closes,
+  whichever is first. A queue that already holds a full batch never
+  waits (the timed ``get(timeout=0)`` fast path);
+* each batch runs through :class:`~alink_tpu.serving.predictor.
+  CompiledPredictor` — one encode, one compiled program execution, one
+  fetch — and the per-request results fan back out through per-request
+  futures;
+* hot model swap: :meth:`PredictServer.swap_model` delegates to the
+  predictor's double-buffered slot flip ON THE CALLER'S THREAD; the
+  serving loop picks the new model up at its next dispatch without ever
+  blocking. :class:`ModelStreamFeeder` taps a model-snapshot stream
+  (e.g. ``FtrlTrainStreamOp``'s output — reference hot model-stream
+  reload, ``ModelMapperAdapter.loadModel``) and swaps per snapshot.
+
+Observability: ``serve.request``/``serve.batch``/``serve.swap`` tracer
+spans, and ``alink_serve_{requests_total,batch_occupancy,queue_depth,
+p99_seconds,model_swaps_total}`` metrics (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Tuple
+
+from ..common.metrics import get_registry, metrics_enabled
+from ..common.mtable import MTable
+from ..common.tracing import trace_complete, trace_instant
+from ..operator.stream.prefetch import _Channel, _EMPTY, _SENTINEL
+from .loadgen import percentile as _percentile
+from .predictor import (CompiledPredictor, serve_min_fill,
+                        serve_queue_depth, serve_window_s)
+
+_P99_RING = 4096        # rolling latency window behind the p99 gauge
+_P99_EVERY = 128        # gauge refresh cadence (requests)
+
+
+class RequestFuture:
+    """One in-flight request: the submitter blocks on :meth:`result`;
+    the serving loop delivers via :meth:`set_result`/``set_exception``.
+    Latency (submit -> delivery) is recorded as the ``serve.request``
+    span when the result lands."""
+
+    __slots__ = ("row", "_event", "_value", "_error", "submitted_at")
+
+    def __init__(self, row: Tuple):
+        self.row = row
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self.submitted_at = time.perf_counter()
+
+    def set_result(self, value) -> None:
+        self._value = value
+        self._event.set()
+
+    def set_exception(self, err: BaseException) -> None:
+        self._error = err
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("serving request timed out")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class PredictServer:
+    """Micro-batching serving front end over a :class:`CompiledPredictor`.
+
+    ``max_batch`` defaults to the predictor's top bucket; ``window_s``
+    and ``queue_depth`` default to their ``ALINK_TPU_SERVE_*`` flags.
+    """
+
+    def __init__(self, predictor: CompiledPredictor,
+                 max_batch: Optional[int] = None,
+                 window_s: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 min_fill: Optional[int] = None,
+                 name: str = "serve"):
+        self.predictor = predictor
+        self.name = name
+        self.max_batch = int(max_batch) if max_batch \
+            else predictor.buckets[-1]
+        self.window_s = serve_window_s() if window_s is None \
+            else float(window_s)
+        # adaptive batching: the loop dispatches as soon as the queue
+        # drains (batch = everything that arrived during the previous
+        # dispatch — size self-regulates to load, latency never waits
+        # on hypothetical arrivals). min_fill > 1 (the
+        # ALINK_TPU_SERVE_MIN_FILL flag) turns the latency budget on:
+        # the loop holds an under-filled batch up to window_s for
+        # stragglers (occupancy over latency).
+        self.min_fill = serve_min_fill() if min_fill is None \
+            else max(1, int(min_fill))
+        depth = serve_queue_depth() if queue_depth is None \
+            else int(queue_depth)
+        self._ch = _Channel(max(1, depth), gauge_label=name)
+        self._closed = threading.Event()
+        self._stats_lock = threading.Lock()
+        self._requests = 0
+        self._failed = 0
+        self._batches = 0
+        self._occupancy_sum = 0.0
+        self._latencies: deque = deque(maxlen=_P99_RING)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"alink-serve-{name}")
+        self._thread.start()
+
+    # -- submission (any thread) ----------------------------------------
+    def submit(self, row: Tuple) -> RequestFuture:
+        """Enqueue one request row; blocks when the admission queue is
+        full (backpressure). Raises after :meth:`close`."""
+        if self._closed.is_set():
+            raise RuntimeError(f"PredictServer {self.name!r} is closed")
+        fut = RequestFuture(tuple(row))
+        if not self._ch.put(fut):
+            raise RuntimeError(f"PredictServer {self.name!r} is closed")
+        return fut
+
+    def predict(self, row: Tuple, timeout: Optional[float] = None) -> Tuple:
+        """Synchronous single-request round trip."""
+        return self.submit(row).result(timeout)
+
+    def swap_model(self, model_table: MTable) -> int:
+        """Hot-swap the served model (double-buffered; see predictor)."""
+        return self.predictor.swap_model(model_table)
+
+    # -- the serving loop ------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            first = self._ch.get()
+            if first is _SENTINEL:
+                return
+            batch: List[RequestFuture] = [first]
+            deadline = None
+            closing = False
+            while len(batch) < self.max_batch:
+                got = self._ch.drain(self.max_batch - len(batch))
+                if got:
+                    batch.extend(got)
+                    continue
+                # queue drained: dispatch NOW unless the batch is under
+                # min_fill and latency budget remains
+                if len(batch) >= self.min_fill:
+                    break
+                if deadline is None:
+                    deadline = time.monotonic() + self.window_s
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                nxt = self._ch.get(timeout=remaining)
+                if nxt is _EMPTY:
+                    break
+                if nxt is _SENTINEL:
+                    closing = True
+                    break
+                batch.append(nxt)
+            self._serve(batch)
+            if closing:
+                return
+
+    def _serve(self, batch: List[RequestFuture]) -> None:
+        done_t = None
+        try:
+            data = MTable([f.row for f in batch],
+                          self.predictor.data_schema)
+            out = self.predictor.predict_table(data)
+            # vectorized fan-out: pull the output columns once, hand
+            # each future its row tuple (out.row(i) would re-resolve
+            # every column per request)
+            cols = [out.col(nm) for nm in out.col_names]
+            done_t = time.perf_counter()
+            for i, fut in enumerate(batch):
+                fut.set_result(tuple(c[i] for c in cols))
+        except BaseException as e:
+            done_t = done_t or time.perf_counter()
+            for fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+            with self._stats_lock:
+                self._failed += len(batch)
+        self._account(batch, done_t)
+
+    def _account(self, batch: List[RequestFuture], done_t: float) -> None:
+        n = len(batch)
+        occupancy = n / self.predictor.bucket_for(n)
+        lats = [done_t - f.submitted_at for f in batch]
+        with self._stats_lock:
+            self._requests += n
+            self._batches += 1
+            self._occupancy_sum += occupancy
+            self._latencies.extend(lats)
+            refresh = self._requests % _P99_EVERY < n
+            p99 = _percentile(list(self._latencies), 99.0) if refresh else None
+        for dt in lats:
+            trace_complete("serve.request", dt, cat="serve",
+                           args={"batch_rows": n})
+        if metrics_enabled():
+            reg = get_registry()
+            lbl = {"server": self.name}
+            reg.inc("alink_serve_requests_total", n, lbl)
+            reg.set_gauge("alink_serve_queue_depth", self._ch.depth(), lbl)
+            if p99 is not None:
+                reg.set_gauge("alink_serve_p99_seconds", p99, lbl)
+                self.predictor.flush_metrics()
+
+    # -- stats / shutdown -------------------------------------------------
+    def stats(self) -> dict:
+        """A point-in-time snapshot: request/batch counts, mean batch
+        occupancy, rolling p50/p99, program-cache hit rate."""
+        with self._stats_lock:
+            lats = list(self._latencies)
+            requests, failed = self._requests, self._failed
+            batches, occ = self._batches, self._occupancy_sum
+        cache = self.predictor.cache_stats()
+        looked = cache["hits"] + cache["misses"]
+        return {
+            "requests": requests, "failed": failed, "batches": batches,
+            "mean_batch_rows": (requests / batches) if batches else 0.0,
+            "mean_occupancy": (occ / batches) if batches else 0.0,
+            "p50_s": _percentile(lats, 50.0),
+            "p99_s": _percentile(lats, 99.0),
+            "bucket_hit_rate": (cache["hits"] / looked) if looked else 0.0,
+            "programs": cache["programs"],
+            "model_version": self.predictor.model_version,
+            "queue_depth": self._ch.depth(),
+        }
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop admitting, drain queued requests, join the loop."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        self._ch.close()
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "PredictServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class ModelStreamFeeder:
+    """Tap a model-snapshot stream into a server's hot-swap path.
+
+    Drains ``stream_op.timed_batches()`` on a background thread and
+    calls ``server.swap_model`` per snapshot — the serving-tier end of
+    the FTRL trainer's model stream (reference: ``FtrlPredictStreamOp``'s
+    CollectModel swap). Keeps every swapped model table (``versions``)
+    so a bench/test can re-validate responses against the exact model
+    set that was ever active."""
+
+    def __init__(self, server: PredictServer, stream_op,
+                 limit: Optional[int] = None,
+                 on_swap: Optional[Callable[[int, MTable], None]] = None):
+        self.server = server
+        self.stream_op = stream_op
+        self.limit = limit
+        self.on_swap = on_swap
+        self.versions: List[Tuple[int, MTable]] = []
+        self.error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="alink-serve-feeder")
+
+    def start(self) -> "ModelStreamFeeder":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        try:
+            for _t, model_table in self.stream_op.timed_batches():
+                version = self.server.swap_model(model_table)
+                self.versions.append((version, model_table))
+                trace_instant("serve.model_stream", cat="serve",
+                              args={"version": version})
+                if self.on_swap is not None:
+                    self.on_swap(version, model_table)
+                if self.limit is not None \
+                        and len(self.versions) >= self.limit:
+                    return
+        except BaseException as e:   # surfaced via join()
+            self.error = e
+
+    def join(self, timeout: Optional[float] = None) -> int:
+        """Wait for the stream to drain; returns the swap count. Raises
+        the feeder thread's error, if any — and refuses to return a
+        PARTIAL count: a feeder still swapping past the timeout would
+        silently invalidate any caller that snapshots ``versions``."""
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError(
+                f"ModelStreamFeeder still draining after {timeout}s "
+                f"({len(self.versions)} swaps so far); the model stream "
+                f"has not ended — the swap count and version set are "
+                f"incomplete")
+        if self.error is not None:
+            raise self.error
+        return len(self.versions)

@@ -2111,13 +2111,195 @@ def quick_gbdt_hist(h: Harness):
             "dt_s": round(dt, 3)}
 
 
+# ---------------------------------------------------------------------------
+# Serving tier (alink_tpu/serving): micro-batched compiled predict rows
+# ---------------------------------------------------------------------------
+
+def _serve_fixture(n_rows, dim, seed=0, with_detail=False):
+    """A trained dense-LR model + request table for the serving rows.
+
+    Dense vector features: the dense score kernel is the one whose
+    device scores are bitwise-identical to the host mapper path, so the
+    row's parity field is an exact check, not a tolerance."""
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.common.params import Params
+    from alink_tpu.common.vector import DenseVector
+    from alink_tpu.operator.batch.classification.linear import (
+        LogisticRegressionTrainBatchOp)
+    from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+    from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n_rows, dim)
+    y = (X @ rng.randn(dim) > 0).astype(np.int64)
+    vecs = np.empty(n_rows, object)
+    vecs[:] = [DenseVector(X[i]) for i in range(n_rows)]
+    tbl = MTable({"vec": vecs, "label": y}, "vec VECTOR, label LONG")
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=4).link_from(
+        MemSourceBatchOp(tbl.first_n(min(512, n_rows))))
+    data_schema = tbl.select(["vec"]).schema
+    pp = {"prediction_col": "pred", "vector_col": "vec"}
+    if with_detail:
+        pp["prediction_detail_col"] = "det"
+    mapper = LinearModelMapper(warm.get_output_table().schema, data_schema,
+                               Params(pp))
+    mapper.load_model(warm.get_output_table())
+    return tbl, warm, mapper, data_schema
+
+
+def _bench_serve_logreg(h: Harness, requests: int, serial_requests: int,
+                        n_rows: int = 2000, dim: int = 64):
+    """Micro-batched serving QPS vs the single-request serial-dispatch
+    baseline — BOTH legs run the same server machinery (queue, futures,
+    compiled predictor); the serial leg just caps max_batch at 1, so
+    the delta is exactly what request coalescing buys."""
+    from alink_tpu.serving import (CompiledPredictor, LoadGenerator,
+                                   PredictServer)
+    tbl, _warm, mapper, _schema = _serve_fixture(n_rows, dim)
+    req = tbl.select(["vec"])
+    pred = CompiledPredictor(mapper)
+    for b in pred.buckets:                    # compile outside the timing
+        pred.predict_table(req.first_n(min(b, n_rows)))
+    # bitwise parity: the compiled/bucketed path against the host mapper
+    sample = req.first_n(min(300, n_rows))
+    ref, got = mapper.map_table(sample), pred.predict_table(sample)
+    parity = "bitwise" if all(
+        all(a == b for a, b in zip(got.col(c), ref.col(c)))
+        for c in ref.col_names) else "MISMATCH"
+    rows = [req.row(i) for i in range(min(64, n_rows))]
+    t0 = time.perf_counter()
+    serial_srv = PredictServer(pred, max_batch=1, name="serve_serial")
+    slg = LoadGenerator(serial_srv.submit, rows, clients=1, pipeline=1)
+    slg.run(max(50, serial_requests // 4))            # warm the loop
+    from alink_tpu.common.profiling2 import measured_region
+    with measured_region():
+        srep = slg.run(serial_requests)
+    serial_srv.close()
+    srv = PredictServer(pred, name="serve")
+    lg = LoadGenerator(srv.submit, rows, clients=4, pipeline=32)
+    lg.run(max(100, requests // 8))                   # warm the loop
+    with measured_region():
+        rep = lg.run(requests)
+    stats = srv.stats()
+    srv.close()
+    dt = time.perf_counter() - t0
+    qps = rep.qps
+    return {
+        # serving is a single-replica tier: QPS/chip == QPS of one chip
+        "samples_per_sec_per_chip": round(qps, 1),
+        "qps_per_chip": round(qps, 1),
+        "serial_qps_per_chip": round(srep.qps, 1),
+        "speedup_vs_serial": round(qps / max(srep.qps, 1e-9), 1),
+        "p50_ms": round(rep.p50_s * 1e3, 3),
+        "p99_ms": round(rep.p99_s * 1e3, 3),
+        "serial_p50_ms": round(srep.p50_s * 1e3, 3),
+        "serial_p99_ms": round(srep.p99_s * 1e3, 3),
+        "bucket_hit_rate": round(stats["bucket_hit_rate"], 4),
+        "batch_occupancy": round(stats["mean_occupancy"], 4),
+        "mean_batch_rows": round(stats["mean_batch_rows"], 1),
+        "failed_requests": rep.failures + srep.failures + stats["failed"],
+        "compiled_programs": stats["programs"],
+        "parity": parity,
+        "bound": "serving-host",
+        "dt_s": round(dt, 3),
+    }
+
+
+def _bench_serve_hot_swap(h: Harness, requests_per_phase: int,
+                          n_rows: int = 3072, dim: int = 64,
+                          batch_rows: int = 128):
+    """Sustained serving across live FTRL model swaps: the trainer's
+    model-snapshot stream hot-swaps the served model (double-buffered
+    slot flip) while a closed-loop load runs; every response is
+    validated post-hoc against the exact set of models that was ever
+    active — a response matching NO version would be a torn model."""
+    from alink_tpu.common.params import Params
+    from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+    from alink_tpu.operator.stream.onlinelearning.ftrl import (
+        FtrlTrainStreamOp)
+    from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+    from alink_tpu.serving import (CompiledPredictor, LoadGenerator,
+                                   ModelStreamFeeder, PredictServer)
+    tbl, warm, mapper, data_schema = _serve_fixture(n_rows, dim, seed=7)
+    req = tbl.select(["vec"])
+    pred = CompiledPredictor(mapper)
+    for b in pred.buckets:
+        pred.predict_table(req.first_n(min(b, n_rows)))
+    srv = PredictServer(pred, name="serve_swap")
+    probe = req.row(0)        # one fixed probe row -> exact validation
+    src = MemSourceStreamOp(tbl, batch_size=batch_rows)
+    ftrl = FtrlTrainStreamOp(warm, vector_col="vec", label_col="label",
+                             alpha=0.1, update_mode="batch",
+                             time_interval=1.0).link_from(src)
+    lg = LoadGenerator(srv.submit, [probe], clients=4, pipeline=8,
+                       collect_responses=True)
+    t0 = time.perf_counter()
+    lg.run(max(100, requests_per_phase // 4))         # warm the loop
+    from alink_tpu.common.profiling2 import measured_region
+    with measured_region():
+        rep_before = lg.run(requests_per_phase)
+        feeder = ModelStreamFeeder(srv, ftrl).start()
+        rep_during = lg.run(2 * requests_per_phase)
+        swaps = feeder.join(timeout=120)
+        rep_after = lg.run(requests_per_phase)
+    stats = srv.stats()
+    srv.close()
+    dt = time.perf_counter() - t0
+    # torn-response check: HOST mappers per swapped version (bitwise-
+    # identical to the compiled dense path) give the legitimate set
+    expected = set()
+    for _v, mt in [(0, warm.get_output_table())] + feeder.versions:
+        m2 = LinearModelMapper(mt.schema, data_schema, mapper.params)
+        m2.load_model(mt)
+        expected.add(repr(m2.map_row(probe)))
+    observed = {repr(r) for phase in (rep_before, rep_during, rep_after)
+                for r in phase.responses}
+    torn = len(observed - expected)
+    failures = (rep_before.failures + rep_during.failures
+                + rep_after.failures + stats["failed"])
+    return {
+        "samples_per_sec_per_chip": round(rep_during.qps, 1),
+        "qps_per_chip": round(rep_during.qps, 1),
+        "model_swaps": swaps,
+        "failed_requests": failures,
+        "torn_responses": torn,
+        "p99_ms_before": round(rep_before.p99_s * 1e3, 3),
+        "p99_ms_during": round(rep_during.p99_s * 1e3, 3),
+        "p99_ms_after": round(rep_after.p99_s * 1e3, 3),
+        "p50_ms_during": round(rep_during.p50_s * 1e3, 3),
+        "bucket_hit_rate": round(stats["bucket_hit_rate"], 4),
+        "batch_occupancy": round(stats["mean_occupancy"], 4),
+        "bound": "serving-host",
+        "dt_s": round(dt, 3),
+    }
+
+
+def bench_serve_logreg(h: Harness):
+    return _bench_serve_logreg(h, requests=20_000, serial_requests=2_000)
+
+
+def bench_serve_hot_swap(h: Harness):
+    return _bench_serve_hot_swap(h, requests_per_phase=4_000,
+                                 n_rows=6_144, batch_rows=256)
+
+
+def quick_serve_logreg(h: Harness):
+    return _bench_serve_logreg(h, requests=6_000, serial_requests=600)
+
+
+def quick_serve_hot_swap(h: Harness):
+    return _bench_serve_hot_swap(h, requests_per_phase=1_500)
+
+
 QUICK_WORKLOADS = (("logreg_criteo", quick_logreg),
                    ("logreg_ckpt", quick_logreg_ckpt),
                    ("kmeans_iris", quick_kmeans),
                    ("ftrl_criteo", quick_ftrl),
                    ("ftrl_stream_drain", quick_ftrl_drain),
                    ("gbdt_hist_fused", quick_gbdt_hist),
-                   ("logreg_from_disk", quick_from_disk))
+                   ("logreg_from_disk", quick_from_disk),
+                   ("serve_logreg", quick_serve_logreg),
+                   ("serve_ftrl_hot_swap", quick_serve_hot_swap))
 
 
 # ---------------------------------------------------------------------------
@@ -2225,7 +2407,9 @@ def main(argv=None):
                      ("gbdt_adult", bench_gbdt),
                      ("gbdt_adult_large", bench_gbdt_large),
                      ("als_movielens", bench_als),
-                     ("als_movielens_large", bench_als_large))
+                     ("als_movielens_large", bench_als_large),
+                     ("serve_logreg", bench_serve_logreg),
+                     ("serve_ftrl_hot_swap", bench_serve_hot_swap))
     for name, fn in suite:
         r = None
         for attempt in (1, 2):
@@ -2309,6 +2493,13 @@ def main(argv=None):
             ftrl["batch_mode_samples_per_sec_per_chip"],
             ftrl.get("batch_mode_vs_baseline", 0.0),
             ftrl.get("batch_mode_pct_chip_peak_flops", 0.0)]
+    serve = workloads.get("serve_logreg", {})
+    if serve.get("p99_ms"):
+        # p99 as a RATE (1/p99) so bench_compare --threshold gates p99
+        # regressions exactly like throughput regressions (a p99
+        # increase reads as a rate drop)
+        compact["serve_logreg_p99inv"] = [
+            round(1e3 / serve["p99_ms"], 3), 0.0, 0.0]
     head = {
         "metric": "logreg_criteo_lbfgs_samples_per_sec_per_chip",
         "value": flag.get("samples_per_sec_per_chip", 0.0),

@@ -1,0 +1,163 @@
+"""mapper/base.py — Mapper/ModelMapper plumbing (ISSUE 10 satellite).
+
+The serving layer's base contracts: OutputColsHelper schema merging,
+param plumbing into mappers, the 1-row table trip behind ``map_row``,
+the ``serving_kernel`` opt-in hook, and the error paths (mapping before
+``load_model``, unknown columns, schema mismatches).
+"""
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.common.params import Params
+from alink_tpu.common.types import AlinkTypes, TableSchema
+from alink_tpu.mapper.base import Mapper, ModelMapper, OutputColsHelper
+
+
+SCHEMA = TableSchema(["a", "b", "s"], ["DOUBLE", "DOUBLE", "STRING"])
+
+
+def _table(n=5, seed=0):
+    rng = np.random.RandomState(seed)
+    return MTable({"a": rng.randn(n), "b": rng.randn(n),
+                   "s": np.asarray([f"r{i}" for i in range(n)], object)},
+                  SCHEMA)
+
+
+class _SumMapper(Mapper):
+    """a + b -> ``out_col`` (param-driven), reserved cols honored."""
+
+    def _helper(self):
+        out = self.params._m.get("output_col", "sum")
+        reserved = self.params._m.get("reserved_cols")
+        return OutputColsHelper(self.data_schema, [out], ["DOUBLE"],
+                                reserved)
+
+    def get_output_schema(self):
+        return self._helper().get_output_schema()
+
+    def map_table(self, data):
+        return self._helper().build_output(
+            data, [np.asarray(data.col("a")) + np.asarray(data.col("b"))])
+
+
+class TestOutputColsHelper:
+    def test_default_reserves_all_input_cols(self):
+        h = OutputColsHelper(SCHEMA, ["sum"], ["DOUBLE"])
+        out = h.get_output_schema()
+        assert out.names == ["a", "b", "s", "sum"]
+        assert out.types == ["DOUBLE", "DOUBLE", "STRING", "DOUBLE"]
+
+    def test_explicit_reserved_subset_and_order(self):
+        h = OutputColsHelper(SCHEMA, ["sum"], ["DOUBLE"],
+                             reserved_cols=["s", "a"])
+        assert h.get_output_schema().names == ["s", "a", "sum"]
+
+    def test_output_col_overwrites_same_named_input(self):
+        h = OutputColsHelper(SCHEMA, ["b"], ["STRING"])
+        out = h.get_output_schema()
+        # 'b' moves to the output position with the OUTPUT type
+        assert out.names == ["a", "s", "b"]
+        assert out.types == ["DOUBLE", "STRING", "STRING"]
+        t = _table(3)
+        res = h.build_output(t, [np.asarray(["x", "y", "z"], object)])
+        assert list(res.col("b")) == ["x", "y", "z"]
+        assert list(res.col("a")) == list(t.col("a"))
+
+    def test_build_output_missing_reserved_col_raises(self):
+        h = OutputColsHelper(SCHEMA, ["sum"], ["DOUBLE"])
+        bad = MTable({"a": np.zeros(2)}, TableSchema(["a"], ["DOUBLE"]))
+        with pytest.raises(KeyError):
+            h.build_output(bad, [np.zeros(2)])
+
+
+class TestMapper:
+    def test_param_plumbing_via_kwargs_params(self):
+        m1 = _SumMapper(SCHEMA, Params({"output_col": "total"}))
+        assert m1.get_output_schema().names[-1] == "total"
+        m2 = _SumMapper(SCHEMA, None)
+        assert m2.get_output_schema().names[-1] == "sum"
+        m3 = _SumMapper(SCHEMA, Params({"output_col": "t",
+                                        "reserved_cols": ["s"]}))
+        out = m3.map_table(_table(4))
+        assert out.col_names == ["s", "t"]
+        np.testing.assert_allclose(
+            out.col("t"),
+            np.asarray(_table(4).col("a")) + np.asarray(_table(4).col("b")))
+
+    def test_map_row_is_the_one_row_table_trip(self):
+        m = _SumMapper(SCHEMA, None)
+        t = _table(3)
+        row = t.row(1)
+        got = m.map_row(row)
+        want = m.map_table(t).row(1)
+        assert got == want
+        assert got[-1] == row[0] + row[1]
+
+    def test_base_interfaces_raise(self):
+        m = Mapper(SCHEMA, None)
+        with pytest.raises(NotImplementedError):
+            m.get_output_schema()
+        with pytest.raises(NotImplementedError):
+            m.map_table(_table(1))
+
+    def test_serving_kernel_defaults_to_none(self):
+        assert _SumMapper(SCHEMA, None).serving_kernel() is None
+
+
+class TestModelMapper:
+    def test_schemas_stored_and_load_model_abstract(self):
+        model_schema = TableSchema(["k", "v"], ["STRING", "STRING"])
+        mm = ModelMapper(model_schema, SCHEMA, None)
+        assert mm.model_schema is model_schema
+        assert mm.data_schema is SCHEMA
+        with pytest.raises(NotImplementedError):
+            mm.load_model(MTable({"k": np.asarray(["x"], object),
+                                  "v": np.asarray(["y"], object)}))
+
+    def test_linear_mapper_errors_before_load(self):
+        from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+        model_schema = TableSchema(["f0", "f1", "label"],
+                                   ["STRING", "LONG", "LONG"])
+        m = LinearModelMapper(model_schema, SCHEMA,
+                              Params({"prediction_col": "pred",
+                                      "feature_cols": ["a", "b"]}))
+        with pytest.raises(RuntimeError, match="load_model"):
+            m.map_table(_table(2))
+        with pytest.raises(RuntimeError, match="load_model"):
+            m.serving_kernel()
+
+    def test_linear_mapper_param_plumbing_end_to_end(self):
+        """prediction_col / reserved_cols / detail flow from Params into
+        the output schema, and map_row == map_table row (the 1-row
+        trip) on a real trained model."""
+        from alink_tpu.operator.batch.classification.linear import (
+            LogisticRegressionTrainBatchOp)
+        from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+        from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+        rng = np.random.RandomState(1)
+        n = 80
+        t = MTable({"a": rng.randn(n), "b": rng.randn(n),
+                    "y": (rng.randn(n) > 0).astype(np.int64)},
+                   "a DOUBLE, b DOUBLE, y LONG")
+        warm = LogisticRegressionTrainBatchOp(
+            feature_cols=["a", "b"], label_col="y",
+            max_iter=3).link_from(MemSourceBatchOp(t))
+        data_schema = t.select(["a", "b"]).schema
+        m = LinearModelMapper(
+            warm.get_output_table().schema, data_schema,
+            Params({"prediction_col": "klass",
+                    "prediction_detail_col": "probs",
+                    "reserved_cols": ["b"],
+                    "feature_cols": ["a", "b"]}))
+        m.load_model(warm.get_output_table())
+        out_schema = m.get_output_schema()
+        assert out_schema.names == ["b", "klass", "probs"]
+        data = t.select(["a", "b"])
+        out = m.map_table(data)
+        assert out.col_names == ["b", "klass", "probs"]
+        assert set(out.col("klass")) <= {0, 1}
+        # 1-row trip: map_row(row_i) == map_table(...).row(i)
+        for i in (0, 3):
+            assert m.map_row(data.row(i)) == out.row(i)

@@ -204,13 +204,24 @@ def _fmt(v: Optional[float]) -> str:
     return f"{v:,.0f}" if v is not None else "-"
 
 
+def _display_name(name: str) -> str:
+    """Serving rows measure requests, not samples: label them so the
+    shared rate column stays readable (``serve_* (qps)``); the p99inv
+    gate row is a reciprocal latency, called out explicitly."""
+    if name.endswith("_p99inv"):
+        return f"{name} (1/p99 s)"
+    if name.startswith("serve_"):
+        return f"{name} (qps)"
+    return name
+
+
 def render(hist: Dict[str, Any], regs: List[Dict[str, Any]]) -> str:
     labels = [r["label"] for r in hist["rounds"]]
     out = ["bench history (samples/sec/chip)"]
     names = list(hist["workloads"])
     if not names:
         return out[0] + "\n  (no workloads)"
-    wn = max(len("workload"), *(len(n) for n in names))
+    wn = max(len("workload"), *(len(_display_name(n)) for n in names))
     cols = [max(len(l), *(len(_fmt(hist["workloads"][n][i]))
                           for n in names))
             for i, l in enumerate(labels)]
@@ -229,8 +240,8 @@ def render(hist: Dict[str, Any], regs: List[Dict[str, Any]]) -> str:
             if (n, labels[i]) in flagged:
                 cell += "!"
             cells.append(cell.rjust(cols[i]))
-        out.append("  " + n.ljust(wn) + "  " + "  ".join(cells)
-                   + "  " + sparkline(vals))
+        out.append("  " + _display_name(n).ljust(wn) + "  "
+                   + "  ".join(cells) + "  " + sparkline(vals))
     if regs:
         out.append("")
         for r in regs:

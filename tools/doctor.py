@@ -76,8 +76,10 @@ def load_bench(path: str) -> Dict[str, Any]:
 
 def _metrics_summary(path: str) -> Dict[str, Any]:
     """The handful of registry aggregates the verdict cites (program
-    cache, collectives, live-HBM gauges) from a MetricsRegistry dump."""
-    out: Dict[str, Any] = {"cache": {}, "collectives": {}, "hbm_gauges": {}}
+    cache, collectives, live-HBM gauges, serving counters) from a
+    MetricsRegistry dump."""
+    out: Dict[str, Any] = {"cache": {}, "collectives": {}, "hbm_gauges": {},
+                           "serve": {}}
     with open(path) as f:
         for ln in f:
             ln = ln.strip()
@@ -97,6 +99,22 @@ def _metrics_summary(path: str) -> Dict[str, Any]:
             elif name == "alink_hbm_live_bytes":
                 out["hbm_gauges"][labels.get("scope", "?")] = \
                     rec.get("value", 0)
+            elif name == "alink_serve_requests_total":
+                out["serve"]["requests"] = out["serve"].get("requests", 0) \
+                    + rec.get("value", 0)
+            elif name == "alink_serve_model_swaps_total":
+                out["serve"]["swaps"] = out["serve"].get("swaps", 0) \
+                    + rec.get("value", 0)
+            elif name == "alink_serve_swap_seconds":
+                out["serve"]["swap_sum_s"] = out["serve"].get(
+                    "swap_sum_s", 0.0) + (rec.get("sum") or 0.0)
+                out["serve"]["swap_count"] = out["serve"].get(
+                    "swap_count", 0) + (rec.get("count") or 0)
+            elif name == "alink_serve_p99_seconds":
+                out["serve"]["p99_s"] = max(out["serve"].get("p99_s", 0.0),
+                                            rec.get("value", 0.0))
+    if not out["serve"]:
+        del out["serve"]
     return out
 
 
@@ -116,6 +134,12 @@ def _workload_entries(bench: Optional[Dict[str, Any]],
     names = list(rows) + [n for n in prof_wl if n not in rows]
     out = []
     for name in names:
+        if str(name).startswith("serve_"):
+            # serving rows get their own verdict section (loadgen-
+            # measured QPS/latency); the generic capture-window
+            # attribution sees only their host side and would render a
+            # misleading all-host bar
+            continue
         row = rows.get(name, {})
         attr = row.get("profile") or prof_wl.get(name)
         if attr:
@@ -227,6 +251,81 @@ def _fixes(name: str, attr: Dict[str, Any], fr: Dict[str, float],
     return [c[1] for c in cands[:3]]
 
 
+def _serve_verdicts(bench: Optional[Dict[str, Any]],
+                    metrics: Optional[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+    """Per-``serve_*``-row serving verdicts: the headline numbers plus
+    named fixes when batches run under-occupied, the bucket set misses,
+    swaps stall, or requests fail — the serving analogue of the
+    roofline fix ranking."""
+    rows = ((bench or {}).get("workloads") or {})
+    serve_met = (metrics or {}).get("serve") or {}
+    out: List[Dict[str, Any]] = []
+    for name, row in rows.items():
+        if not str(name).startswith("serve_") or not isinstance(row, dict):
+            continue
+        if "error" in row:
+            out.append({"workload": name, "error": row["error"]})
+            continue
+        fixes: List[str] = []
+        failed = int(row.get("failed_requests") or 0)
+        torn = int(row.get("torn_responses") or 0)
+        if failed or torn:
+            fixes.append(f"CRITICAL: {failed} failed / {torn} torn "
+                         f"responses — the tier dropped or corrupted "
+                         f"requests; check swap geometry (model "
+                         f"signature changes recompile mid-swap) and "
+                         f"server exceptions before trusting any other "
+                         f"number")
+        occ = row.get("batch_occupancy")
+        if occ is not None and occ < 0.5:
+            fixes.append(f"batches run under-occupied ({occ:.0%} of "
+                         f"bucket): requests are not coalescing — hold "
+                         f"under-filled batches (ALINK_TPU_SERVE_MIN_FILL "
+                         f"+ ALINK_TPU_SERVE_WINDOW_MS) or shrink "
+                         f"ALINK_TPU_SERVE_BUCKETS toward the observed "
+                         f"batch size (~{row.get('mean_batch_rows')})")
+        hit = row.get("bucket_hit_rate")
+        if hit is not None and hit < 0.9:
+            fixes.append(f"serving programs miss the cache {1 - hit:.0%} "
+                         f"of the time: request geometry is churning "
+                         f"(new buckets/widths keep compiling) — pin "
+                         f"ALINK_TPU_SERVE_BUCKETS / round request "
+                         f"widths")
+        speed = row.get("speedup_vs_serial")
+        if speed is not None and speed < 2.0:
+            fixes.append(f"micro-batching barely wins ({speed}x serial): "
+                         f"per-row host work dominates — move encode "
+                         f"cost out of the request path or grow the "
+                         f"model so the device amortization matters")
+        p99_s = (row.get("p99_ms") or row.get("p99_ms_during") or 0) / 1e3
+        swap_count = serve_met.get("swap_count") or 0
+        if swap_count and row.get("model_swaps"):
+            mean_swap = (serve_met.get("swap_sum_s") or 0.0) / swap_count
+            if p99_s and mean_swap > 5.0 * p99_s:
+                fixes.append(f"model swaps stall ({mean_swap * 1e3:.1f} "
+                             f"ms mean vs p99 {p99_s * 1e3:.1f} ms): "
+                             f"keep model geometry stable across "
+                             f"snapshots so swapped models reuse the "
+                             f"compiled programs, and keep device_put "
+                             f"on the feeder thread "
+                             f"(ALINK_TPU_SERVE_SWAP=double)")
+        v = {"workload": name,
+             "qps_per_chip": row.get("qps_per_chip")
+             or row.get("samples_per_sec_per_chip"),
+             "p50_ms": row.get("p50_ms") or row.get("p50_ms_during"),
+             "p99_ms": row.get("p99_ms") or row.get("p99_ms_during"),
+             "bucket_hit_rate": hit, "batch_occupancy": occ,
+             "failed_requests": failed, "fixes": fixes}
+        for k in ("speedup_vs_serial", "serial_qps_per_chip", "parity",
+                  "model_swaps", "torn_responses", "p99_ms_before",
+                  "p99_ms_during", "p99_ms_after"):
+            if row.get(k) is not None:
+                v[k] = row[k]
+        out.append(v)
+    return out
+
+
 def diagnose(bench: Optional[Dict[str, Any]],
              profile: Optional[Dict[str, Any]],
              metrics: Optional[Dict[str, Any]],
@@ -263,6 +362,9 @@ def diagnose(bench: Optional[Dict[str, Any]],
                 "baseline_fp": rig.get("baseline_fp")},
         "workloads": verdicts,
     }
+    serving = _serve_verdicts(bench, metrics)
+    if serving:
+        doc["serving"] = serving
     if profile:
         doc["hbm"] = profile.get("hbm") or []
         if profile.get("donation"):
@@ -323,6 +425,47 @@ def render(doc: Dict[str, Any]) -> str:
                        f"{', '.join(xp.get('lanes', []))}")
         for i, fx in enumerate(v.get("fixes") or [], 1):
             out.append(f"  fix {i}: {fx}")
+    for v in doc.get("serving", []):
+        out.append(f"\n== serving: {v['workload']} ==")
+        if v.get("error"):
+            out.append(f"  ERROR: {v['error']}")
+            continue
+        line = f"  {v.get('qps_per_chip'):,.0f} qps/chip" \
+            if v.get("qps_per_chip") else "  qps n/a"
+        if v.get("serial_qps_per_chip"):
+            line += (f" ({v.get('speedup_vs_serial')}x the "
+                     f"{v['serial_qps_per_chip']:,.0f} qps serial-"
+                     f"dispatch baseline)")
+        out.append(line)
+        lat = []
+        if v.get("p50_ms") is not None:
+            lat.append(f"p50 {v['p50_ms']} ms")
+        if v.get("p99_ms") is not None:
+            lat.append(f"p99 {v['p99_ms']} ms")
+        if v.get("p99_ms_before") is not None:
+            lat.append(f"p99 before/during/after swaps "
+                       f"{v['p99_ms_before']}/{v['p99_ms_during']}/"
+                       f"{v['p99_ms_after']} ms")
+        if lat:
+            out.append("  " + ", ".join(lat))
+        bits = []
+        if v.get("bucket_hit_rate") is not None:
+            bits.append(f"bucket-hit {v['bucket_hit_rate']:.1%}")
+        if v.get("batch_occupancy") is not None:
+            bits.append(f"occupancy {v['batch_occupancy']:.1%}")
+        if v.get("model_swaps") is not None:
+            bits.append(f"{v['model_swaps']} model swaps")
+        if v.get("torn_responses") is not None:
+            bits.append(f"{v['torn_responses']} torn")
+        bits.append(f"{v.get('failed_requests', 0)} failed")
+        if v.get("parity"):
+            bits.append(f"parity {v['parity']}")
+        out.append("  " + ", ".join(bits))
+        for i, fx in enumerate(v.get("fixes") or [], 1):
+            out.append(f"  fix {i}: {fx}")
+        if not v.get("fixes"):
+            out.append("  verdict: healthy — batches fill, programs "
+                       "cache-hit, no failed/torn requests")
     hbm = doc.get("hbm")
     if hbm is not None:
         out.append("\n== HBM (live device buffers) ==")

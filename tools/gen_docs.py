@@ -98,6 +98,8 @@ FLAG_TABLE_TARGETS = {
         ("performance", "durability", "debug", "io", "bench"),
     os.path.join("docs", "observability.md"):
         ("observability",),
+    os.path.join("docs", "serving.md"):
+        ("serving",),
 }
 
 

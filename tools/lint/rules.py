@@ -81,6 +81,13 @@ def default_config() -> LintConfig:
         # tree trainers: set_program_key callers (fused-hist fold)
         FactoryRoot(_TREES, "gbdt_train", frozenset({_PC})),
         FactoryRoot(_TREES, "forest_train", frozenset({_PC})),
+        # the serving tier's program factory: compiled programs key on
+        # (model signature, kind, bucket, shapes) — the ALINK_TPU_SERVE_*
+        # flags must therefore all be key-neutral, which this root checks
+        FactoryRoot("alink_tpu/serving/predictor.py",
+                    "CompiledPredictor._program", frozenset({_PC})),
+        FactoryRoot("alink_tpu/serving/predictor.py",
+                    "CompiledPredictor.predict_table", frozenset({_PC})),
     ]
     roots += [FactoryRoot(_FTRL, f, frozenset({_LRU}))
               for f in ftrl_factories]
@@ -99,6 +106,7 @@ def default_config() -> LintConfig:
             "alink_tpu/ops/*",
             "alink_tpu/operator/common/*",
             "alink_tpu/operator/stream/onlinelearning/*",
+            "alink_tpu/serving/*",
             "alink_tpu/common/profiling.py",
             "alink_tpu/common/health.py",
         ),

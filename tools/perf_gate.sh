@@ -61,6 +61,39 @@ ALINK_TPU_PROFILE=1 python bench.py --quick --out "$NEW" --run-dir "$RUNDIR"
 python tools/doctor.py --run-dir "$RUNDIR" > /dev/null
 echo "perf_gate: doctor parsed the profiled run artifacts ($RUNDIR)"
 
+# serve smoke (ISSUE 10): the quick suite's serving rows (micro-batcher
+# + one hot-swap storm under load) must be present and CLEAN — zero
+# failed and zero torn responses across the swaps. Throughput and p99
+# regressions gate through bench_compare below (the compact map carries
+# serve_logreg qps + serve_logreg_p99inv = 1/p99).
+python - "$NEW" <<'PY'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+wl = doc.get("workloads") or {}
+bad = []
+for name in ("serve_logreg", "serve_ftrl_hot_swap"):
+    row = wl.get(name)
+    if not isinstance(row, dict) or "error" in row:
+        bad.append(f"{name}: missing or errored ({(row or {}).get('error')})")
+        continue
+    if row.get("failed_requests"):
+        bad.append(f"{name}: {row['failed_requests']} failed requests")
+    if row.get("torn_responses"):
+        bad.append(f"{name}: {row['torn_responses']} TORN responses")
+    if name == "serve_ftrl_hot_swap" and (row.get("model_swaps") or 0) < 20:
+        bad.append(f"{name}: only {row.get('model_swaps')} model swaps "
+                   f"(need >= 20 under load)")
+    if name == "serve_logreg" and row.get("parity") != "bitwise":
+        bad.append(f"{name}: parity={row.get('parity')!r} (compiled path "
+                   f"diverged from the host mapper)")
+if bad:
+    print("perf_gate: serve smoke FAILED:", file=sys.stderr)
+    for b in bad:
+        print(f"  {b}", file=sys.stderr)
+    sys.exit(4)
+print("perf_gate: serve smoke clean (micro-batcher + hot swap under load)")
+PY
+
 if [ ! -f "$BASE" ]; then
     cp "$NEW" "$BASE"
     echo "perf_gate: no baseline found; promoted $NEW -> $BASE (gate passes trivially this run)"
