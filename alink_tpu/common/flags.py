@@ -453,6 +453,28 @@ FLAGS.register(
     clamp=lambda n: max(1, n),
     accessor="alink_tpu.serving.predictor.serve_queue_depth")
 FLAGS.register(
+    "ALINK_TPU_SERVE_SHARDED", "bool", False,
+    "compile serving bucket programs under the session mesh's partition "
+    "rules: feature-sharded model placement (io/sharding.py), one "
+    "manifest psum per dispatch; off = single-device programs", "serving",
+    key_neutral="the resolved sharded mode and the mesh's device "
+                "identity ride every serving program-cache key "
+                "(CompiledPredictor mesh fingerprint), so a toggle or a "
+                "mesh change compiles new programs but can never reuse "
+                "a stale one",
+    accessor="alink_tpu.serving.sharded.serve_sharded_enabled")
+FLAGS.register(
+    "ALINK_TPU_SERVE_REPLICAS", "int", 1,
+    "PredictServer serving-loop replica count (data-parallel dispatch "
+    "fan-out across the session mesh's chips); 0 = one replica per "
+    "mesh device; sharded predictors always run one loop", "serving",
+    key_neutral="host-side dispatch fan-out only: replicas pick WHICH "
+                "device executes a batch, and jax keys its per-device "
+                "executables on placement — the serving program cache "
+                "is device-independent host routing",
+    clamp=lambda n: max(0, n),
+    accessor="alink_tpu.serving.sharded.serve_replicas")
+FLAGS.register(
     "ALINK_TPU_SERVE_SWAP", "mode", "double",
     "hot model-swap mode: double (standby slot prepared off the serving "
     "loop, atomic flip) | sync (flip waits for device residency)",

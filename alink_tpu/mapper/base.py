@@ -93,3 +93,25 @@ class ModelMapper(Mapper):
 
     def load_model(self, model_table: MTable):  # pragma: no cover - interface
         raise NotImplementedError
+
+    def _pred_output_schema(self, label_type: str,
+                            regression: bool) -> TableSchema:
+        """The standard prediction-output contract: a prediction column
+        (DOUBLE for regression, the model's label type otherwise), an
+        optional STRING detail column for classifiers, reserved input
+        columns merged by :class:`OutputColsHelper`. One implementation
+        so a mapper's declared schema (the stream twins' ``_open``) can
+        never drift from what its emit path builds."""
+        from ..common.types import AlinkTypes
+        pred_col = self.params._m.get("prediction_col", "pred")
+        detail_col = self.params._m.get("prediction_detail_col")
+        reserved = self.params._m.get("reserved_cols")
+        if regression:
+            cols, types = [pred_col], [AlinkTypes.DOUBLE]
+        else:
+            cols, types = [pred_col], [label_type]
+            if detail_col:
+                cols.append(detail_col)
+                types.append(AlinkTypes.STRING)
+        return OutputColsHelper(self.data_schema, cols, types,
+                                reserved).get_output_schema()

@@ -209,11 +209,101 @@ class FmModelMapper(ModelMapper):
     def load_model(self, model_table: MTable):
         self.model = FmModelDataConverter().load_model(model_table)
 
+    def get_output_schema(self) -> TableSchema:
+        """Output schema without running the mapper — required by the
+        stream predict twins (`ModelMapStreamOp._open`); the batch path
+        never calls it, which is why the FM twin could not open."""
+        m = self.model
+        return self._pred_output_schema(
+            m.label_type if m else AlinkTypes.STRING,
+            bool(m is not None and m.is_regression))
+
     def map_table(self, data: MTable) -> MTable:
         m = self.model
         design = extract_design(data, m.feature_cols, m.vector_col, np.float64,
                                 vector_size=m.w.shape[0])
-        margin = fm_predict_margin(m.w0, m.w, m.V, design)
+        return self._finish(fm_predict_margin(m.w0, m.w, m.V, design), data)
+
+    def serving_kernel(self):
+        """Compiled-serving contract (serving/predictor.py) for FM: the
+        margin ``w0 + <w,x> + 1/2 sum_f((Vx)_f^2 - (V^2 x^2)_f)`` with
+        every feature/factor reduction a strict left-to-right
+        ``lax.scan`` over materialized terms (serving/sharded.py
+        ``scan_sum``) so the rounding cannot depend on the shape bucket —
+        padding is a bitwise no-op. Against the numpy mapper (BLAS
+        reduction order) labels are exact and margins match to ~1e-12
+        relative; weights (w0, w, V) are program ARGUMENTS, so
+        hot-swapped same-geometry FM models compile nothing."""
+        m = self.model
+        if m is None:
+            raise RuntimeError(
+                "load_model must be called before serving_kernel")
+        import jax
+
+        from ....serving.predictor import ServingKernel
+        from ....serving.sharded import SERVE_CHUNK
+        ship_dt = np.float64 if jax.config.jax_enable_x64 else np.float32
+        dim = int(m.w.shape[0])
+        k = int(m.V.shape[1])
+        dim8 = -(-dim // SERVE_CHUNK) * SERVE_CHUNK
+        w = np.zeros(dim8, ship_dt)
+        w[:dim] = np.asarray(m.w, ship_dt)
+        V = np.zeros((dim8, k), ship_dt)
+        V[:dim] = np.asarray(m.V, ship_dt)
+        model_arrays = (np.asarray(m.w0, ship_dt), w, V)
+        signature = ("fm", bool(m.is_regression), dim, k,
+                     str(ship_dt.__name__))
+
+        def encode(data: MTable, bucket: int):
+            design = extract_design(data, m.feature_cols, m.vector_col,
+                                    ship_dt, vector_size=dim)
+            n = data.num_rows
+            if design["kind"] == "dense":
+                Xf = design["X"]
+                X = np.zeros((bucket, dim8), ship_dt)
+                X[:n, :Xf.shape[1]] = Xf
+                return ("dense", (X,))
+            idx0, val0 = design["idx"], design["val"]
+            w0 = max(idx0.shape[1], 1)
+            width = -(-w0 // SERVE_CHUNK) * SERVE_CHUNK
+            idx = np.zeros((bucket, width), np.int32)
+            val = np.zeros((bucket, width), ship_dt)
+            idx[:n, :idx0.shape[1]] = idx0
+            val[:n, :val0.shape[1]] = val0
+            return ("sparse", (idx, val))
+
+        def _dense(mdl, X):
+            from ....serving.sharded import scan_sum
+            w0_, w_, V_ = mdl
+            lin = scan_sum(X * w_[None, :], axis=1)
+            s = scan_sum(X[:, :, None] * V_[None, :, :], axis=1)
+            sq = scan_sum((X * X)[:, :, None] * (V_ * V_)[None, :, :],
+                          axis=1)
+            return w0_ + lin + 0.5 * scan_sum(s * s - sq, axis=1)
+
+        def _sparse(mdl, idx, val):
+            from ....serving.sharded import scan_sum
+            w0_, w_, V_ = mdl
+            lin = scan_sum(val * w_[idx], axis=1)
+            s = scan_sum(val[..., None] * V_[idx], axis=1)
+            sq = scan_sum((val * val)[..., None] * (V_ * V_)[idx],
+                          axis=1)
+            return w0_ + lin + 0.5 * scan_sum(s * s - sq, axis=1)
+
+        def decode(outputs, data: MTable) -> MTable:
+            return self._finish(np.asarray(outputs[0], np.float64), data)
+
+        return ServingKernel(signature=signature, model_arrays=model_arrays,
+                             encode=encode,
+                             device_fns={"dense": _dense,
+                                         "sparse": _sparse},
+                             decode=decode)
+
+    def _finish(self, margin: np.ndarray, data: MTable) -> MTable:
+        """Margins -> output table (label pick, detail, column merge) —
+        split out of :meth:`map_table` so the serving tier decodes
+        DEVICE-computed margins through the exact same host logic."""
+        m = self.model
         pred_col = self.params._m.get("prediction_col", "pred")
         detail_col = self.params._m.get("prediction_detail_col")
         reserved = self.params._m.get("reserved_cols")

@@ -331,11 +331,39 @@ class TreeModelMapper(ModelMapper):
     def load_model(self, model_table: MTable):
         self.model = TreeModelDataConverter().load_model(model_table)
 
-    def map_table(self, data: MTable) -> MTable:
+    def get_output_schema(self) -> TableSchema:
+        """Output schema without running the mapper — what the stream
+        predict twins (`ModelMapStreamOp._open`) need; the batch path
+        derives it from `map_table`'s result and never noticed this was
+        missing, which kept every tree stream twin from opening."""
+        m = self.model
+        return self._pred_output_schema(
+            m.label_type if m else AlinkTypes.STRING,
+            bool(m is not None and m.is_regression))
+
+    def _model_width(self) -> int:
+        """The feature width the model's splits can address: column
+        count for feature_cols models, max split feature index + 1 for
+        vector models (the model stores no vector size). Encoding to at
+        least this width makes a batch's width independent of which
+        sparse vectors happen to be in it — absent vector entries read
+        as 0 instead of clamping the split's gather to a WRONG column
+        (device) or raising (host numpy)."""
+        m = self.model
+        if m.feature_cols:
+            return len(m.feature_cols)
+        return int(max(int(m.features.max()), 0)) + 1
+
+    def _encode_matrix(self, data: MTable, dtype=np.float64) -> np.ndarray:
+        """Request table -> raw feature-value matrix (categorical columns
+        ordinal-coded via the model vocabularies, OOV -> -1 which every
+        traversal routes right), always :meth:`_model_width` columns
+        wide. Shared by the host ``map_table`` path and the serving
+        kernel's encode so the two cannot diverge."""
         m = self.model
         if m.cat_cols:
             n = data.num_rows
-            X = np.empty((n, len(m.feature_cols)), np.float64)
+            X = np.empty((n, len(m.feature_cols)), dtype)
             for j, c in enumerate(m.feature_cols):
                 col = data.col(c)
                 if c in m.cat_vocabs:
@@ -343,19 +371,34 @@ class TreeModelMapper(ModelMapper):
                     X[:, j] = [lut.get(str(v), -1) for v in col]  # OOV -> right
                 else:
                     X[:, j] = np.asarray(col, np.float64)
-        else:
-            design = extract_design(data, m.feature_cols, m.vector_col,
-                                    np.float64)
-            X = design["X"] if design["kind"] == "dense" else None
-            if X is None:
-                from ....common.vector import SparseBatch
-                X = SparseBatch(design["idx"], design["val"],
-                                design["dim"]).to_dense(np.float64)
+            return X
+        width = self._model_width()
+        design = extract_design(data, m.feature_cols, m.vector_col,
+                                np.float64,
+                                vector_size=width if m.vector_col else None)
+        X = design["X"] if design["kind"] == "dense" else None
+        if X is None:
+            from ....common.vector import SparseBatch
+            X = SparseBatch(design["idx"], design["val"],
+                            design["dim"]).to_dense(np.float64)
+        if X.shape[1] < width:          # batch narrower than the splits
+            X = np.concatenate(
+                [X, np.zeros((X.shape[0], width - X.shape[1]), X.dtype)],
+                axis=1)
+        return np.asarray(X, dtype)
+
+    def _cat_mask(self) -> Optional[np.ndarray]:
+        m = self.model
+        return (np.asarray([c in set(m.cat_cols) for c in
+                            (m.feature_cols or [])], bool)
+                if m.cat_cols else None)
+
+    def map_table(self, data: MTable) -> MTable:
+        m = self.model
+        X = self._encode_matrix(data)
         T = m.features.shape[0]
         n = X.shape[0]
-        cat_mask = (np.asarray([c in set(m.cat_cols) for c in
-                                (m.feature_cols or [])], bool)
-                    if m.cat_cols else None)
+        cat_mask = self._cat_mask()
 
         def apply(t):
             return tree_apply_values(
@@ -385,6 +428,136 @@ class TreeModelMapper(ModelMapper):
             probs += m.leaf_values[t][apply(t)]
         probs /= np.maximum(probs.sum(1, keepdims=True), 1e-12)
         return self._emit(data, None, probs, m.labels)
+
+    def serving_kernel(self):
+        """Compiled-serving contract (serving/predictor.py) for the tree
+        family — the gathered leaf-index traversal: every level of every
+        tree is ONE batched gather of (feature, threshold[, split-mask])
+        at the current node frontier, ``node -> 2*node + go_right``, and
+        after ``max_depth`` levels the leaf values gather per tree and
+        accumulate in the HOST mapper's exact order (a ``lax.scan`` over
+        the tree axis whose xs are the already-rounded per-tree terms —
+        serving/sharded.py ``scan_sum``). On the f64 test mesh the device
+        scores are therefore bitwise-identical to the numpy traversal,
+        so labels AND detail strings match the host mapper exactly; the
+        per-row integer traversal makes bucket padding a bitwise no-op.
+        The kernel signature carries tree GEOMETRY only (T, depth, node
+        count, leaf arity, feature count) — weights (thresholds, leaf
+        values, base score) are program arguments, so hot-swapped
+        same-shaped forests reuse every compiled program."""
+        m = self.model
+        if m is None:
+            raise RuntimeError(
+                "load_model must be called before serving_kernel")
+        import jax
+
+        from ....serving.predictor import ServingKernel
+        ship_dt = np.float64 if jax.config.jax_enable_x64 else np.float32
+        T, nodes = m.features.shape
+        depth = int(m.max_depth)
+        n_class = (int(m.leaf_values.shape[2])
+                   if m.leaf_values.ndim == 3 else 0)
+        cat_mask = self._cat_mask()
+        has_masks = m.split_masks is not None and cat_mask is not None
+        n_bins = int(m.split_masks.shape[2]) if has_masks else 0
+        n_feat = int(len(m.feature_cols)) if m.feature_cols else None
+        gbdt = m.algo == "gbdt"
+
+        model_arrays = [np.asarray(m.features, np.int32),
+                        np.asarray(m.thresholds, ship_dt),
+                        np.asarray(m.leaf_values, ship_dt),
+                        np.asarray(m.base_score, ship_dt),
+                        np.asarray(m.learning_rate, ship_dt)]
+        if has_masks:
+            model_arrays.append(np.asarray(m.split_masks, bool))
+            model_arrays.append(np.asarray(cat_mask, bool))
+        model_arrays = tuple(model_arrays)
+        signature = ("tree", m.algo, bool(m.is_regression), T, depth,
+                     nodes, n_class, n_feat, has_masks, n_bins,
+                     str(ship_dt.__name__))
+
+        def encode(data: MTable, bucket: int):
+            Xf = self._encode_matrix(data, ship_dt)
+            X = np.zeros((bucket, Xf.shape[1]), ship_dt)
+            X[:data.num_rows] = Xf
+            return ("dense", (X,))
+
+        def _apply_all(mdl, X):
+            """(n, T) leaf indices — the vectorized device twin of the
+            host ``tree_apply_values`` descent."""
+            import jax.numpy as jnp
+            features, thresholds = mdl[0], mdl[1]
+            n = X.shape[0]
+            tr = jnp.arange(T)[None, :]
+            rows = jnp.arange(n)[:, None]
+            node = jnp.zeros((n, T), jnp.int32)
+            offset = 0
+            for _level in range(depth):
+                gi = offset + node
+                f = features[tr, gi]
+                thr = thresholds[tr, gi]
+                x = X[rows, jnp.maximum(f, 0)]
+                go_right = (f >= 0) & (x > thr)
+                if has_masks:
+                    masks, catm = mdl[5], mdl[6]
+                    code = jnp.round(x).astype(jnp.int32)
+                    in_left = jnp.where(
+                        code >= 0,
+                        masks[tr, gi, jnp.clip(code, 0, n_bins - 1)],
+                        False)
+                    is_cat = catm[jnp.maximum(f, 0)] & (f >= 0)
+                    go_right = jnp.where(is_cat, (f >= 0) & ~in_left,
+                                         go_right)
+                node = node * 2 + go_right
+                offset += 1 << _level
+            return node, tr
+
+        def _score(mdl, X):
+            from ....serving.sharded import scan_sum
+            leafs, base, lr = mdl[2], mdl[3], mdl[4]
+            node, tr = _apply_all(mdl, X)
+            if gbdt:
+                # host order: score = full(base); score += lr*leaf[t]
+                # per tree, left to right — the scan carry starts at
+                # base and adds the rounded lr*leaf terms, reproducing
+                # the numpy loop bitwise
+                return _gbdt_acc(base, lr * leafs[tr, node])
+            # rf/dt: per-tree leaf stats sum over the tree axis — (n,)
+            # regression / (n, k) classification; decode normalizes
+            return scan_sum(leafs[tr, node], axis=1)
+
+        def _gbdt_acc(base, terms):
+            """base + terms[0] + terms[1] + ... in the host loop's exact
+            association: the scan carry STARTS at base."""
+            import jax
+            import jax.numpy as jnp
+            t = jnp.moveaxis(terms, 1, 0)
+            acc0 = jnp.broadcast_to(base, (terms.shape[0],)).astype(
+                terms.dtype)
+
+            def body(acc, x):
+                return acc + x, None
+
+            acc, _ = jax.lax.scan(body, acc0, t)
+            return acc
+
+        def decode(outputs, data: MTable) -> MTable:
+            out = np.asarray(outputs[0], np.float64)
+            if gbdt:
+                if m.is_regression:
+                    return self._emit(data, out, None, None)
+                p_pos = 1.0 / (1.0 + np.exp(-np.clip(out, -500, 500)))
+                probs = np.stack([1 - p_pos, p_pos], axis=1)
+                return self._emit(data, None, probs, m.labels)
+            if m.is_regression:
+                return self._emit(data, out / T, None, None)
+            probs = out / np.maximum(out.sum(1, keepdims=True), 1e-12)
+            return self._emit(data, None, probs, m.labels)
+
+        return ServingKernel(signature=signature,
+                             model_arrays=model_arrays,
+                             encode=encode, device_fns={"dense": _score},
+                             decode=decode)
 
     def _emit(self, data, scores, probs, labels):
         m = self.model

@@ -23,42 +23,26 @@ def _sigmoid(x):
     return 1.0 / (1.0 + np.exp(-np.clip(x, -500, 500)))
 
 
-# serving-kernel scan chunk (see LinearModelMapper.serving_kernel): the
-# feature axis pads to a multiple of this and reduces CHUNK terms per
-# scan step in strict left-to-right order
-_SERVE_CHUNK = 8
+def _serve_chunk() -> int:
+    """The serving-kernel scan chunk — the feature axis pads to a
+    multiple of it and reduces CHUNK terms per scan step in strict
+    left-to-right order. Read from the one canonical definition
+    (``serving/sharded.py``; lazy so this module keeps zero import-time
+    serving dependencies)."""
+    from ....serving.sharded import SERVE_CHUNK
+    return SERVE_CHUNK
 
 
 def _seq_chunk_sum(terms, axis: int):
-    """Sum ``terms`` over ``axis`` in a FIXED left-to-right order
-    (chunked ``lax.scan`` of elementwise adds): unlike ``jnp.sum`` /
-    ``@``, the float rounding cannot depend on the other dimensions'
-    sizes, which is what makes serving buckets numerical no-ops. The
-    reduced extent must be a multiple of ``_SERVE_CHUNK`` (encode pads
-    it)."""
-    import jax
-    import jax.numpy as jnp
-    t = jnp.moveaxis(terms, axis, 0)
-    ext = t.shape[0]
-    acc0 = jnp.zeros(t.shape[1:], t.dtype)
-    if ext <= 16 * _SERVE_CHUNK:
-        # small extents unroll in-trace: same strict order, none of the
-        # scan loop's per-step dispatch overhead (the serial bucket-1
-        # program's latency lives here)
-        acc = acc0
-        for j in range(ext):
-            acc = acc + t[j]
-        return acc
-    m = ext // _SERVE_CHUNK
-    t = t.reshape((m, _SERVE_CHUNK) + t.shape[1:])
-
-    def body(acc, chunk):
-        for k in range(_SERVE_CHUNK):
-            acc = acc + chunk[k]
-        return acc, None
-
-    acc, _ = jax.lax.scan(body, acc0, t)
-    return acc
+    """Sum ``terms`` over ``axis`` in a FIXED left-to-right order —
+    the canonical serving reduction (``serving/sharded.py
+    seq_chunk_sum``): unlike ``jnp.sum`` / ``@``, the float rounding
+    cannot depend on the other dimensions' sizes, which is what makes
+    serving buckets numerical no-ops. The reduced extent must be a
+    multiple of the serve chunk beyond the unroll threshold (encode
+    pads it)."""
+    from ....serving.sharded import seq_chunk_sum
+    return seq_chunk_sum(terms, axis)
 
 
 class LinearModelMapper(ModelMapper):
@@ -145,8 +129,18 @@ class LinearModelMapper(ModelMapper):
                      len(m.label_values or ()), str(ship_dt.__name__))
 
         # feature axis padded to the scan chunk so every program scans
-        # whole chunks; the model arrays carry the padding ONCE
-        dim8 = -(-dim // _SERVE_CHUNK) * _SERVE_CHUNK
+        # whole chunks; the model arrays carry the padding ONCE. The
+        # binary/regression kernels pad further, to a whole number of
+        # reduction LANES (serving/sharded.py LANE_PAD), so the SAME
+        # encode feeds the mesh-sharded program — every lane is then a
+        # whole number of chunks on exactly one shard. Zero-padding the
+        # tail of a strict left-to-right sum is bitwise-neutral.
+        chunk = _serve_chunk()
+        if softmax:
+            dim8 = -(-dim // chunk) * chunk
+        else:
+            from ....serving.sharded import LANE_PAD
+            dim8 = -(-dim // LANE_PAD) * LANE_PAD
 
         def encode(data: MTable, bucket: int):
             design = extract_design(data, m.feature_names, m.vector_col,
@@ -165,7 +159,7 @@ class LinearModelMapper(ModelMapper):
             # pad width in steps of the chunk (the FTRL encode
             # convention) so a few compiled widths cover drifting nnz
             w0 = max(idx0.shape[1], 1)
-            width = -(-w0 // _SERVE_CHUNK) * _SERVE_CHUNK
+            width = -(-w0 // chunk) * chunk
             idx = np.zeros((bucket, width), np.int32)
             val = np.zeros((bucket, width), ship_dt)
             idx[:n, :idx0.shape[1]] = idx0
@@ -214,9 +208,29 @@ class LinearModelMapper(ModelMapper):
                     axis=1)
             return self._finish(scores, data)
 
+        if softmax:
+            # the softmax kernel serves single-device (or replicated)
+            # only; a sharding request records a fallback and runs the
+            # unsharded programs
+            return ServingKernel(signature=signature,
+                                 model_arrays=model_arrays,
+                                 encode=encode, device_fns=device_fns,
+                                 decode=decode)
+
+        # multi-chip serving (ISSUE 11): the weight vector shards over
+        # the mesh feature axis 'd' under the io/sharding.py partition
+        # rules — the serving-side twin of the FTRL trainer's (z, n)
+        # placement — and the sharded score programs cross shards with
+        # ONE manifest psum per dispatch (serving/sharded.py).
+        from ....serving.sharded import (linear_input_specs,
+                                         linear_partition_rules,
+                                         make_linear_device_fns)
         return ServingKernel(signature=signature, model_arrays=model_arrays,
                              encode=encode, device_fns=device_fns,
-                             decode=decode)
+                             decode=decode, model_names=("w", "b"),
+                             partition_rules=linear_partition_rules(),
+                             input_specs=linear_input_specs,
+                             make_sharded_fns=make_linear_device_fns)
 
     def get_output_schema(self) -> TableSchema:
         m = self.model

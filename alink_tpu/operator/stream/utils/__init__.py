@@ -71,7 +71,19 @@ class ModelMapStreamOp(BaseStreamTransformOp):
 
     def _transform(self, mt: MTable):
         if self._predictor is not None:
-            return self._predictor.predict_table(mt)
+            try:
+                return self._predictor.predict_table(mt)
+            except ValueError as e:
+                # a kernel refusing the request geometry (e.g. more
+                # features than the model) must not kill the stream —
+                # THIS batch falls back to the host mapper, RECORDED
+                # (alink_serve_fallback_total per batch + one
+                # RuntimeWarning per mapper); the predictor stays, so
+                # one malformed batch never downgrades the rest of the
+                # stream to the host path
+                from ....serving.predictor import record_serve_fallback
+                record_serve_fallback(type(self._mapper).__name__,
+                                      "geometry-refused", str(e))
         return self._mapper.map_table(mt)
 
     def link_from(self, *inputs) -> "ModelMapStreamOp":

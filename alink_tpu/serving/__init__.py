@@ -23,6 +23,12 @@ that layer rebuilt TPU-first:
 * :class:`LoadGenerator` — the closed-loop load generator behind the
   ``bench.py serve_*`` rows (QPS/chip, p50/p99, bucket-hit rate,
   batch occupancy).
+* multi-chip serving (``sharded.py``) — ``ALINK_TPU_SERVE_SHARDED``
+  compiles the bucket programs under the session mesh's partition
+  rules (feature-sharded model state placed by ``io/sharding.py``,
+  one manifest psum per dispatch, bitwise-identical answers at every
+  mesh size); ``ALINK_TPU_SERVE_REPLICAS`` fans ``PredictServer``
+  batches across the chips as independent single-device replicas.
 
 See docs/serving.md for the bucket/padding contract, swap atomicity,
 admission control, and load-generator usage.
@@ -32,9 +38,11 @@ from .predictor import (CompiledPredictor, ServingKernel,
                         serve_buckets, serve_compiled_enabled)
 from .server import ModelStreamFeeder, PredictServer, RequestFuture
 from .loadgen import LoadGenerator, LoadReport, percentile, serial_qps
+from .sharded import serve_replicas, serve_sharded_enabled, serving_mesh
 
 __all__ = [
     "CompiledPredictor", "ServingKernel", "PredictServer", "RequestFuture",
     "ModelStreamFeeder", "LoadGenerator", "LoadReport", "percentile",
     "serial_qps", "serve_buckets", "serve_compiled_enabled",
+    "serve_replicas", "serve_sharded_enabled", "serving_mesh",
 ]

@@ -29,26 +29,79 @@ with, so no request ever sees a torn model and a swap never blocks the
 serving loop.
 
 Cache-key discipline: the serving program cache keys on (model
-signature, kind, bucket, encoded shapes/dtypes) — everything that can
-change a compiled program is IN the key, so the ``ALINK_TPU_SERVE_*``
-flags are declared key-neutral in ``common/flags.py`` and alink-lint's
-ENV-KEY-FOLD rule checks this module as a factory root.
+signature, kind, bucket, encoded shapes/dtypes, mesh fingerprint) —
+everything that can change a compiled program is IN the key (the mesh
+fingerprint covers sharded-vs-single-device AND the device set), so the
+``ALINK_TPU_SERVE_*`` flags are declared key-neutral in
+``common/flags.py`` and alink-lint's ENV-KEY-FOLD rule checks this
+module as a factory root.
+
+Multi-chip serving (ISSUE 11) lives in :mod:`alink_tpu.serving.sharded`:
+``sharded=True`` compiles the bucket programs under the session mesh's
+partition rules and places model arrays by their kernel-declared rules;
+``ensure_replicas`` pins per-replica single-device placements for the
+server's replica fan-out.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass
-from typing import Callable, Dict, Optional, Sequence, Tuple
+import warnings
+from dataclasses import dataclass, replace
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..common.metrics import get_registry, metrics_enabled
 from ..common.mtable import MTable
 from ..common.tracing import trace_complete, trace_span
+from .sharded import (SERVE_LANES, mesh_fingerprint,
+                      serve_sharded_enabled, serving_mesh)
 
 DEFAULT_BUCKETS = (1, 8, 32, 128, 512)
+
+# -- fallback observability (ISSUE 11 satellite) ----------------------------
+# The host-mapper fallback used to be SILENT: a mapper without a
+# serving kernel (or a predictor that cannot satisfy a sharding
+# request) just quietly served off-device and the fleet-scale numbers
+# looked mysteriously flat. Every fallback now records a once-per-
+# (mapper, reason) RuntimeWarning plus a labelled counter.
+_fallback_lock = threading.Lock()
+_fallback_seen: set = set()
+
+
+def record_serve_fallback(mapper_name: str, reason: str,
+                          detail: str = "") -> None:
+    """Record one serving-tier fallback: ``alink_serve_fallback_total
+    {mapper=, reason=}`` always, plus ONE RuntimeWarning per
+    (mapper, reason) pair per process.
+
+    ``reason`` must be a SMALL ENUM of stable strings — it is a metric
+    label, and data-dependent text (exception messages carry request
+    widths etc.) would mint a new time series per distinct value.
+    Request-specific context goes in ``detail``, which reaches only the
+    warning text."""
+    if metrics_enabled():
+        get_registry().inc("alink_serve_fallback_total", 1,
+                           {"mapper": mapper_name, "reason": reason})
+    key = (mapper_name, reason)
+    with _fallback_lock:
+        if key in _fallback_seen:
+            return
+        _fallback_seen.add(key)
+    warnings.warn(
+        f"serving falls back to the host mapper path for {mapper_name}: "
+        f"{reason}{' (' + detail + ')' if detail else ''} (recorded as "
+        f"alink_serve_fallback_total{{mapper={mapper_name!r},"
+        f"reason={reason!r}}}; this warning fires once per "
+        f"mapper+reason)", RuntimeWarning, stacklevel=3)
+
+
+def _reset_fallback_warnings() -> None:
+    """Test hook: re-arm the once-per-(mapper, reason) warnings."""
+    with _fallback_lock:
+        _fallback_seen.clear()
 
 
 def serve_compiled_enabled() -> bool:
@@ -138,6 +191,24 @@ class ServingKernel:
     encode: Callable[[MTable, int], Tuple[str, Tuple[np.ndarray, ...]]]
     device_fns: Dict[str, Callable]
     decode: Callable[[Tuple[np.ndarray, ...], MTable], MTable]
+    # -- multi-chip serving (optional; ISSUE 11) ------------------------
+    # ``model_names``       — one name per model array, matched against
+    #                         ``partition_rules`` (the io/sharding.py
+    #                         match_partition_rules idiom) to place the
+    #                         model on the serving mesh;
+    # ``partition_rules``   — ((regex, PartitionSpec), ...); unmatched
+    #                         names replicate (default P());
+    # ``input_specs(kind)`` — PartitionSpecs of the ENCODED request
+    #                         arrays under the mesh;
+    # ``make_sharded_fns(mesh)`` -> {kind: fn} — mesh-sharded twins of
+    #                         ``device_fns`` (shard_map + manifest
+    #                         collectives). ``None`` = the kernel cannot
+    #                         shard; a sharding request falls back
+    #                         (recorded) to single-device programs.
+    model_names: Tuple[str, ...] = ()
+    partition_rules: Tuple = ()
+    input_specs: Optional[Callable[[str], Tuple]] = None
+    make_sharded_fns: Optional[Callable] = None
 
 
 def _merge_parts(parts):
@@ -166,19 +237,51 @@ def _merge_parts(parts):
 
 
 class _ModelVersion:
-    """One immutable model slot: kernel + device-resident weights."""
+    """One immutable model slot: kernel + device-resident weights.
 
-    __slots__ = ("version", "kernel", "device_arrays", "mapper")
+    ``shardings`` (multi-chip serving) places each model array with its
+    matched ``NamedSharding`` — host arrays ``device_put`` STRAIGHT into
+    their mesh placement (no replicated staging copy), and arrays that
+    are already device-resident with the right sharding pass through
+    without a host round trip (the FTRL in-place swap path).
+    ``devices`` (replica dispatch) materializes one placement per
+    replica device instead."""
 
-    def __init__(self, version: int, kernel: ServingKernel, mapper=None):
+    __slots__ = ("version", "kernel", "mapper", "_placements")
+
+    def __init__(self, version: int, kernel: ServingKernel, mapper=None,
+                 shardings: Optional[Tuple] = None,
+                 devices: Tuple = (None,)):
         import jax
         self.version = version
         self.kernel = kernel
         self.mapper = mapper
         # the weights land on device HERE — on the swapping thread, not
         # the serving loop (the double-buffer contract)
-        self.device_arrays = tuple(jax.device_put(a)
-                                   for a in kernel.model_arrays)
+        if shardings is not None:
+            self._placements = (tuple(
+                jax.device_put(a, s)
+                for a, s in zip(kernel.model_arrays, shardings)),)
+        else:
+            self._placements = tuple(
+                tuple(jax.device_put(a) if d is None
+                      else jax.device_put(a, d)
+                      for a in kernel.model_arrays)
+                for d in devices)
+
+    def arrays_for(self, replica: int = 0) -> Tuple:
+        return self._placements[replica % len(self._placements)]
+
+    def block_until_ready(self) -> None:
+        """Wait for EVERY placement (all replicas / all shards) — the
+        sync-swap contract covers each replica's device copy, not just
+        slot 0's."""
+        import jax
+        jax.block_until_ready([a for p in self._placements for a in p])
+
+    @property
+    def device_arrays(self) -> Tuple:
+        return self._placements[0]
 
 
 class CompiledPredictor:
@@ -191,7 +294,8 @@ class CompiledPredictor:
     """
 
     def __init__(self, mapper, buckets: Optional[Sequence[int]] = None,
-                 name: str = "serve"):
+                 name: str = "serve", sharded: Optional[bool] = None,
+                 mesh=None, replica_devices: Optional[Sequence] = None):
         kernel = mapper.serving_kernel()
         if kernel is None:
             raise TypeError(
@@ -203,9 +307,39 @@ class CompiledPredictor:
             if buckets else serve_buckets()
         if not self._buckets:
             raise ValueError("empty bucket set")
+        # -- multi-chip resolution (ISSUE 11): sharded bucket programs
+        # span the serving mesh; replica dispatch pins per-replica
+        # single-device placements. Mutually exclusive by construction
+        # (a sharded program already uses every chip).
+        self._sharded = serve_sharded_enabled() if sharded is None \
+            else bool(sharded)
+        self._mesh = None
+        if self._sharded:
+            if kernel.make_sharded_fns is None:
+                record_serve_fallback(type(mapper).__name__,
+                                      "no-sharded-kernel")
+                self._sharded = False
+            else:
+                m = mesh if mesh is not None else serving_mesh()
+                n = int(m.devices.size)
+                if SERVE_LANES % n:
+                    record_serve_fallback(
+                        type(mapper).__name__, "mesh-indivisible",
+                        f"{n} devices vs {SERVE_LANES} lanes")
+                    self._sharded = False
+                else:
+                    self._mesh = m
+        self._mesh_fp = mesh_fingerprint(self._mesh)
+        if self._sharded and replica_devices:
+            raise ValueError("sharded serving programs span the mesh; "
+                             "replica_devices does not compose with "
+                             "sharded=True")
+        self._replica_devices: Tuple = tuple(replica_devices) \
+            if replica_devices else (None,)
+        self._sharded_fns: Dict[Tuple, Dict[str, Callable]] = {}
         self._swap_lock = threading.Lock()
         self._cache_lock = threading.Lock()
-        self._programs: Dict[Tuple, Callable] = {}
+        self._programs: Dict[Tuple, Tuple[Callable, Tuple]] = {}
         self._hits = 0
         self._hits_reported = 0
         self._misses = 0
@@ -219,19 +353,76 @@ class CompiledPredictor:
     # ------------------------------------------------------------------
     @classmethod
     def for_mapper(cls, mapper, buckets: Optional[Sequence[int]] = None,
-                   name: str = "serve") -> Optional["CompiledPredictor"]:
-        """A predictor, or ``None`` when the mapper has no kernel."""
+                   name: str = "serve", **kw) -> Optional["CompiledPredictor"]:
+        """A predictor, or ``None`` when the mapper has no kernel — and
+        the fallback is RECORDED (``alink_serve_fallback_total`` + one
+        RuntimeWarning per mapper+reason), never silent."""
         try:
             kernel = mapper.serving_kernel()
-        except RuntimeError:
+            reason, detail = "no-serving-kernel", ""
+        except RuntimeError as e:
             kernel = None
+            reason, detail = "kernel-error", str(e)
         if kernel is None:
+            record_serve_fallback(type(mapper).__name__, reason, detail)
             return None
-        return cls(mapper, buckets=buckets, name=name)
+        return cls(mapper, buckets=buckets, name=name, **kw)
+
+    def _ver_sharded(self, kernel: ServingKernel) -> bool:
+        """Does THIS kernel run sharded on this predictor? A hot swap
+        can hand a sharded predictor a kernel that cannot shard (e.g. a
+        softmax model swapped into a binary slot) — that version serves
+        through single-device programs (fallback recorded in
+        :meth:`_make_version`) instead of crashing every dispatch."""
+        return self._sharded and kernel.make_sharded_fns is not None
+
+    def _model_shardings(self, kernel: ServingKernel) -> Optional[Tuple]:
+        """NamedShardings of the model arrays under the partition rules
+        (None when unsharded): the ``io/sharding.py`` placement path —
+        ``match_partition_rules`` over the kernel's named arrays, every
+        unmatched name replicated."""
+        if not self._ver_sharded(kernel):
+            return None
+        from jax.sharding import PartitionSpec as P
+
+        from ..io.sharding import state_sharding
+        names = kernel.model_names or tuple(
+            f"a{i}" for i in range(len(kernel.model_arrays)))
+        named = dict(zip(names, kernel.model_arrays))
+        sh = state_sharding(self._mesh, kernel.partition_rules, named,
+                            default=P())
+        return tuple(sh[n] for n in names)
 
     def _make_version(self, kernel: ServingKernel, mapper) -> _ModelVersion:
         self._versions += 1
-        return _ModelVersion(self._versions, kernel, mapper)
+        if self._sharded and kernel.make_sharded_fns is None:
+            record_serve_fallback(type(mapper).__name__,
+                                  "no-sharded-kernel (swapped model "
+                                  "serves single-device)")
+        return _ModelVersion(self._versions, kernel, mapper,
+                             shardings=self._model_shardings(kernel),
+                             devices=self._replica_devices)
+
+    # -- replica dispatch (ISSUE 11) ------------------------------------
+    def ensure_replicas(self, devices: Sequence) -> None:
+        """Materialize per-replica model placements (one device per
+        replica) — called by :class:`~alink_tpu.serving.server.
+        PredictServer` before it spawns replica loops. Re-places the
+        ACTIVE version; later swaps inherit the device list."""
+        devices = tuple(devices)
+        if not devices or self._sharded:
+            return
+        with self._swap_lock:
+            if devices == self._replica_devices:
+                return
+            self._replica_devices = devices
+            cur = self._active
+            self._active = _ModelVersion(cur.version, cur.kernel,
+                                         cur.mapper, devices=devices)
+
+    @property
+    def replica_devices(self) -> Tuple:
+        return self._replica_devices
 
     # -- model hot swap -------------------------------------------------
     def swap_model(self, model_table: MTable) -> int:
@@ -251,8 +442,55 @@ class CompiledPredictor:
                 mapper.load_model(model_table)
                 standby = self._make_version(mapper.serving_kernel(), mapper)
                 if serve_swap_mode() == "sync":
-                    import jax
-                    jax.block_until_ready(standby.device_arrays)
+                    standby.block_until_ready()
+                self._active = standby     # the atomic flip
+            dt = time.perf_counter() - t0
+        if metrics_enabled():
+            reg = get_registry()
+            reg.inc("alink_serve_model_swaps_total", 1,
+                    {"predictor": self.name})
+            reg.observe("alink_serve_swap_seconds", dt,
+                        {"predictor": self.name})
+        return standby.version
+
+    def swap_weights(self, model_arrays: Sequence) -> int:
+        """Same-geometry in-place weight swap: install ``model_arrays``
+        (host or device arrays, matching the ACTIVE kernel's shapes)
+        as a new model version WITHOUT reloading a model table.
+
+        This is the no-gather-to-host leg of multi-chip serving: a
+        feature-sharded producer (the FTRL trainer's (z, n)-derived
+        weights) hands arrays that are already in — or go straight
+        into — their mesh placement; ``jax.device_put`` with the
+        matched ``NamedSharding`` is a no-op for correctly-placed
+        device arrays. The mapper's host-side decode state (labels,
+        detail schema) is geometry, not weights, so it carries over.
+        The flip is the same atomic reference store as
+        :meth:`swap_model`."""
+        with self._swap_lock:
+            t0 = time.perf_counter()
+            with trace_span("serve.swap", cat="serve",
+                            args={"mode": "weights"}):
+                base = self._active
+                arrays = tuple(model_arrays)
+                if len(arrays) != len(base.kernel.model_arrays):
+                    raise ValueError(
+                        f"swap_weights got {len(arrays)} arrays; the "
+                        f"active kernel has "
+                        f"{len(base.kernel.model_arrays)}")
+                for a, old in zip(arrays, base.kernel.model_arrays):
+                    if tuple(a.shape) != tuple(old.shape) \
+                            or np.dtype(a.dtype) != np.dtype(old.dtype):
+                        raise ValueError(
+                            f"swap_weights geometry mismatch: "
+                            f"{tuple(a.shape)}/{np.dtype(a.dtype)} vs "
+                            f"{tuple(old.shape)}/{np.dtype(old.dtype)} — "
+                            f"a different geometry must go through "
+                            f"swap_model (new signature, new programs)")
+                kernel = replace(base.kernel, model_arrays=arrays)
+                standby = self._make_version(kernel, base.mapper)
+                if serve_swap_mode() == "sync":
+                    standby.block_until_ready()
                 self._active = standby     # the atomic flip
             dt = time.perf_counter() - t0
         if metrics_enabled():
@@ -268,6 +506,14 @@ class CompiledPredictor:
         return self._active.version
 
     @property
+    def sharded(self) -> bool:
+        return self._sharded
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
     def buckets(self) -> Tuple[int, ...]:
         return self._buckets
 
@@ -280,34 +526,98 @@ class CompiledPredictor:
                 return b
         return self._buckets[-1]
 
+    def _sharded_fn(self, kernel: ServingKernel, kind: str) -> Callable:
+        """The mesh-sharded device fn for ``kind`` — built once per
+        (kernel signature, mesh) via the kernel's ``make_sharded_fns``
+        factory and shared by every bucket program and model version of
+        that geometry. Callers hold ``_cache_lock``."""
+        fkey = (kernel.signature, self._mesh_fp)
+        fns = self._sharded_fns.get(fkey)
+        if fns is None:
+            fns = self._sharded_fns[fkey] = kernel.make_sharded_fns(
+                self._mesh)
+        return fns[kind]
+
+    def _place_inputs(self, ver: _ModelVersion, kind: str,
+                      arrays: Tuple[np.ndarray, ...], replica: int
+                      ) -> Tuple:
+        """Encoded request arrays -> device: under sharding each input
+        lands with its kernel-declared PartitionSpec (the feature axis
+        of the dense design matrix shards alongside the weights); under
+        replica dispatch each lands on the replica's device; otherwise
+        the arrays pass through and jit commits them (the historical
+        single-device path)."""
+        if self._ver_sharded(ver.kernel) \
+                and ver.kernel.input_specs is not None:
+            import jax
+            from jax.sharding import NamedSharding
+            specs = ver.kernel.input_specs(kind)
+            return tuple(jax.device_put(a, NamedSharding(self._mesh, s))
+                         for a, s in zip(arrays, specs))
+        dev = self._replica_devices[replica % len(self._replica_devices)]
+        if dev is not None:
+            import jax
+            return tuple(jax.device_put(a, dev) for a in arrays)
+        return arrays
+
     def _program(self, ver: _ModelVersion, kind: str, bucket: int,
-                 arrays: Tuple[np.ndarray, ...]) -> Callable:
-        """The compiled program for (model signature, kind, bucket) —
-        every dimension that shapes the trace is part of the key
+                 arrays: Tuple, call_args: Tuple
+                 ) -> Tuple[Callable, Tuple]:
+        """The compiled program for (model signature, kind, bucket,
+        mesh) — every dimension that shapes the trace is part of the key
         (leading axes are the bucket itself; dtypes are fixed by the
-        kernel signature), so a cache hit can never serve a stale
-        program. The hit path is lock-free (GIL-atomic dict read + int
-        bump) — it runs per dispatched batch on the serving loop."""
+        kernel signature; the mesh fingerprint covers sharded-vs-single-
+        device and the device set), so a cache hit can never serve a
+        stale program. The hit path is lock-free (GIL-atomic dict read +
+        int bump) — it runs per dispatched batch on the serving loop.
+
+        Returns ``(program, manifest)``: sharded programs additionally
+        carry their trace-time collective manifest, captured ONCE via an
+        AOT ``lower`` inside :func:`~alink_tpu.engine.communication.
+        collecting` and replayed per dispatch by the caller — serving
+        traffic shows up in the collective manifest/metrics exactly like
+        training traffic."""
+        sharded = self._ver_sharded(ver.kernel)
         key = (ver.kernel.signature, kind, bucket,
-               tuple(a.shape[1:] for a in arrays))
-        prog = self._programs.get(key)
-        if prog is not None:
+               tuple(a.shape[1:] for a in arrays),
+               self._mesh_fp if sharded else None)
+        entry = self._programs.get(key)
+        if entry is not None:
             self._hits += 1
-            return prog
+            return entry
         import jax
         with self._cache_lock:
-            prog = self._programs.get(key)
-            if prog is None:
+            entry = self._programs.get(key)
+            if entry is None:
                 self._misses += 1
-                prog = jax.jit(ver.kernel.device_fns[kind])
-                self._programs[key] = prog
+                if sharded:
+                    fn = self._sharded_fn(ver.kernel, kind)
+                else:
+                    fn = ver.kernel.device_fns[kind]
+                prog = jax.jit(fn)
+                manifest: Tuple = ()
+                if sharded:
+                    from ..engine.communication import collecting
+                    cap: List = []
+                    try:
+                        with collecting(cap):
+                            prog.lower(ver.arrays_for(0), *call_args)
+                    except Exception as e:  # accounting must never
+                        cap = []            # break serving — but say so
+                        warnings.warn(
+                            f"serving collective accounting disabled "
+                            f"for program {key[:3]} (AOT lower failed: "
+                            f"{e!r})", RuntimeWarning)
+                    manifest = tuple(cap)
+                entry = (prog, manifest)
+                self._programs[key] = entry
                 if metrics_enabled():
                     get_registry().inc("alink_serve_program_cache_total",
                                        1, {"result": "miss",
                                            "predictor": self.name})
             else:
                 self._hits += 1
-        return prog
+        return entry
 
     def cache_stats(self) -> Dict[str, int]:
         self.flush_metrics()
@@ -329,31 +639,45 @@ class CompiledPredictor:
                                {"result": "hit", "predictor": self.name})
 
     # -- prediction -----------------------------------------------------
-    def predict_table(self, data: MTable) -> MTable:
+    def predict_table(self, data: MTable, replica: int = 0) -> MTable:
         """Serve a whole request table through the bucketed programs.
 
         Output is bitwise-identical for the real rows no matter which
         bucket (or chunk split) served them — padding rows are zero and
-        per-row scoring is row-independent."""
+        per-row scoring is row-independent. ``replica`` selects the
+        replica-dispatch device placement (0 = default)."""
         n = data.num_rows
         if n == 0:
             return self._active.mapper.map_table(data)
         top = self._buckets[-1]
         if n <= top:
-            return self._predict_chunk(data)
-        parts = [self._predict_chunk(data.take_rows(np.arange(s, min(s + top, n))))
+            return self._predict_chunk(data, replica)
+        parts = [self._predict_chunk(
+                     data.take_rows(np.arange(s, min(s + top, n))), replica)
                  for s in range(0, n, top)]
         return _merge_parts(parts)
 
-    def _predict_chunk(self, data: MTable) -> MTable:
+    def _predict_chunk(self, data: MTable, replica: int = 0) -> MTable:
         import jax
         t0 = time.perf_counter()
         ver = self._active           # one consistent model per dispatch
         n = data.num_rows
         bucket = self.bucket_for(n)
         kind, arrays = ver.kernel.encode(data, bucket)
-        prog = self._program(ver, kind, bucket, arrays)
-        out = prog(ver.device_arrays, *arrays)
+        placed = self._place_inputs(ver, kind, arrays, replica)
+        prog, manifest = self._program(ver, kind, bucket, arrays, placed)
+        if manifest:
+            from ..engine.communication import collecting, record_manifest
+            record_manifest(manifest)
+            # the replayed manifest is the ONLY accounting: should the
+            # call retrace (jax version didn't warm the call cache from
+            # the AOT lower), its trace-time records land in a discarded
+            # sink instead of double-charging the registry — the FTRL
+            # drain's collecting([]) idiom
+            with collecting([]):
+                out = prog(ver.arrays_for(replica), *placed)
+        else:
+            out = prog(ver.arrays_for(replica), *placed)
         if not isinstance(out, (tuple, list)):
             out = (out,)
         # ONE batched host fetch, then slice the padding rows off

@@ -95,6 +95,7 @@ class PredictServer:
                  window_s: Optional[float] = None,
                  queue_depth: Optional[int] = None,
                  min_fill: Optional[int] = None,
+                 replicas: Optional[int] = None,
                  name: str = "serve"):
         self.predictor = predictor
         self.name = name
@@ -121,9 +122,40 @@ class PredictServer:
         self._batches = 0
         self._occupancy_sum = 0.0
         self._latencies: deque = deque(maxlen=_P99_RING)
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name=f"alink-serve-{name}")
-        self._thread.start()
+        # -- replica dispatch (ISSUE 11): R serving loops drain the ONE
+        # admission channel and fan bucket batches out across the
+        # session mesh's chips (one single-device model placement per
+        # replica). ALINK_TPU_SERVE_REPLICAS=0 means one replica per
+        # mesh device; a SHARDED predictor already spans every chip
+        # with one program, so it always runs one loop.
+        self.replicas = self._resolve_replicas(replicas)
+        self._threads = []
+        for i in range(self.replicas):
+            th = threading.Thread(
+                target=self._loop, args=(i,), daemon=True,
+                name=(f"alink-serve-{name}" if self.replicas == 1
+                      else f"alink-serve-{name}-r{i}"))
+            self._threads.append(th)
+            th.start()
+
+    def _resolve_replicas(self, replicas: Optional[int]) -> int:
+        from .sharded import serve_replicas
+        r = serve_replicas() if replicas is None else int(replicas)
+        if self.predictor.sharded:
+            return 1            # the sharded program spans the mesh
+        if r == 1:
+            return 1            # the historical single loop
+        # replicas fan out over the SESSION-mesh chips — 0 means one
+        # per chip, an explicit count cycles the same device list (never
+        # chips the session was configured to exclude)
+        from ..common.mlenv import MLEnvironmentFactory
+        devices = list(
+            MLEnvironmentFactory.get_default().mesh.devices.reshape(-1))
+        if r == 0:
+            r = len(devices)
+        self.predictor.ensure_replicas(
+            [devices[i % len(devices)] for i in range(r)])
+        return max(1, r)
 
     # -- submission (any thread) ----------------------------------------
     def submit(self, row: Tuple) -> RequestFuture:
@@ -144,8 +176,8 @@ class PredictServer:
         """Hot-swap the served model (double-buffered; see predictor)."""
         return self.predictor.swap_model(model_table)
 
-    # -- the serving loop ------------------------------------------------
-    def _loop(self) -> None:
+    # -- the serving loop (one per replica) -------------------------------
+    def _loop(self, replica: int = 0) -> None:
         while True:
             first = self._ch.get()
             if first is _SENTINEL:
@@ -174,16 +206,16 @@ class PredictServer:
                     closing = True
                     break
                 batch.append(nxt)
-            self._serve(batch)
+            self._serve(batch, replica)
             if closing:
                 return
 
-    def _serve(self, batch: List[RequestFuture]) -> None:
+    def _serve(self, batch: List[RequestFuture], replica: int = 0) -> None:
         done_t = None
         try:
             data = MTable([f.row for f in batch],
                           self.predictor.data_schema)
-            out = self.predictor.predict_table(data)
+            out = self.predictor.predict_table(data, replica=replica)
             # vectorized fan-out: pull the output columns once, hand
             # each future its row tuple (out.row(i) would re-resolve
             # every column per request)
@@ -246,12 +278,14 @@ class PredictServer:
         }
 
     def close(self, timeout: float = 10.0) -> None:
-        """Stop admitting, drain queued requests, join the loop."""
+        """Stop admitting, drain queued requests, join the loop(s)."""
         if self._closed.is_set():
             return
         self._closed.set()
         self._ch.close()
-        self._thread.join(timeout)
+        deadline = time.monotonic() + timeout
+        for th in self._threads:
+            th.join(max(0.0, deadline - time.monotonic()))
 
     def __enter__(self) -> "PredictServer":
         return self

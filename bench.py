@@ -2274,6 +2274,27 @@ def _bench_serve_hot_swap(h: Harness, requests_per_phase: int,
     }
 
 
+def _bench_serve_sharded(h: Harness, requests: int, swaps: int,
+                         devices=(1, 4, 8)):
+    """Multi-chip serving (ISSUE 11): the sharded bucket programs at
+    REAL 1/4/8-device host-platform meshes. Device counts latch at
+    backend init, so each mesh size runs in a fresh child interpreter
+    (tools/serve_shard_bench.py, the scaling_evidence mechanism); the
+    row carries QPS/chip per mesh size, measured cross-mesh BITWISE
+    parity (probe digests), and swap-storm integrity on the
+    feature-sharded model."""
+    import tools.serve_shard_bench as ssb
+    return ssb.measure(devices, requests, swaps)
+
+
+def bench_serve_sharded(h: Harness):
+    return _bench_serve_sharded(h, requests=4_000, swaps=12)
+
+
+def quick_serve_sharded(h: Harness):
+    return _bench_serve_sharded(h, requests=1_000, swaps=8)
+
+
 def bench_serve_logreg(h: Harness):
     return _bench_serve_logreg(h, requests=20_000, serial_requests=2_000)
 
@@ -2299,7 +2320,8 @@ QUICK_WORKLOADS = (("logreg_criteo", quick_logreg),
                    ("gbdt_hist_fused", quick_gbdt_hist),
                    ("logreg_from_disk", quick_from_disk),
                    ("serve_logreg", quick_serve_logreg),
-                   ("serve_ftrl_hot_swap", quick_serve_hot_swap))
+                   ("serve_ftrl_hot_swap", quick_serve_hot_swap),
+                   ("serve_logreg_sharded", quick_serve_sharded))
 
 
 # ---------------------------------------------------------------------------
@@ -2409,7 +2431,8 @@ def main(argv=None):
                      ("als_movielens", bench_als),
                      ("als_movielens_large", bench_als_large),
                      ("serve_logreg", bench_serve_logreg),
-                     ("serve_ftrl_hot_swap", bench_serve_hot_swap))
+                     ("serve_ftrl_hot_swap", bench_serve_hot_swap),
+                     ("serve_logreg_sharded", bench_serve_sharded))
     for name, fn in suite:
         r = None
         for attempt in (1, 2):

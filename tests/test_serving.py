@@ -336,11 +336,11 @@ class TestPredictServer:
             boom = {"n": 0}
             orig = CompiledPredictor.predict_table
 
-            def flaky(self, data):
+            def flaky(self, data, **kw):
                 boom["n"] += 1
                 if boom["n"] == 1:
                     raise RuntimeError("injected serve failure")
-                return orig(self, data)
+                return orig(self, data, **kw)
             monkeypatch.setattr(CompiledPredictor, "predict_table", flaky)
             row = dense["tbl"].select(["vec"]).row(0)
             with pytest.raises(RuntimeError, match="injected"):
@@ -355,9 +355,9 @@ class TestPredictServer:
         pred = CompiledPredictor(dense["mapper"], buckets=(1,))
         orig = CompiledPredictor.predict_table
 
-        def slow(self, data):
+        def slow(self, data, **kw):
             time.sleep(0.03)
-            return orig(self, data)
+            return orig(self, data, **kw)
         monkeypatch.setattr(CompiledPredictor, "predict_table", slow)
         srv = PredictServer(pred, max_batch=1, queue_depth=2, name="bp")
         try:

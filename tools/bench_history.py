@@ -210,6 +210,10 @@ def _display_name(name: str) -> str:
     gate row is a reciprocal latency, called out explicitly."""
     if name.endswith("_p99inv"):
         return f"{name} (1/p99 s)"
+    if name.startswith("serve_") and name.endswith("_sharded"):
+        # multi-chip serving rows report per-chip throughput at the
+        # widest measured mesh (ISSUE 11)
+        return f"{name} (qps/chip)"
     if name.startswith("serve_"):
         return f"{name} (qps)"
     return name

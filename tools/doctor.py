@@ -298,6 +298,39 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
                          f"per-row host work dominates — move encode "
                          f"cost out of the request path or grow the "
                          f"model so the device amortization matters")
+        # multi-chip serving (ISSUE 11): per-chip QPS across mesh sizes
+        # — the fleet-scale verdict is that QPS/chip HOLDS as chips are
+        # added (a sharded/replicated tier that decays per chip is just
+        # burning silicon)
+        per_chip = {}
+        for k, val in row.items():
+            if str(k).startswith("qps_per_chip_") and str(k).endswith("dev"):
+                try:
+                    per_chip[int(str(k)[len("qps_per_chip_"):-3])] = val
+                except (TypeError, ValueError):
+                    pass
+        ns = sorted(per_chip)
+        scaling = None
+        if len(per_chip) >= 2:
+            lo, hi = per_chip[ns[0]], per_chip[ns[-1]]
+            scaling = round(hi / lo, 3) if lo else 0.0
+            if scaling < 0.7:
+                note = (" (expected on this rig: " + str(
+                            row.get("mesh_note")) + "; recapture on a "
+                        "physical slice)") if row.get("mesh_note") else ""
+                fixes.append(
+                    f"QPS/chip decays to {scaling:.0%} going "
+                    f"{ns[0]}->{ns[-1]} devices: the mesh is not "
+                    f"earning its chips — check replica fan-out "
+                    f"(ALINK_TPU_SERVE_REPLICAS) and whether the "
+                    f"sharded psum dominates the dispatch "
+                    f"(ALINK_TPU_SERVE_SHARDED off for small "
+                    f"models){note}")
+        if row.get("parity") == "MISMATCH":
+            fixes.append("CRITICAL: sharded bucket programs are NOT "
+                         "bitwise-identical across mesh sizes — the "
+                         "lane-blocked reduction contract is broken "
+                         "(serving/sharded.py)")
         p99_s = (row.get("p99_ms") or row.get("p99_ms_during") or 0) / 1e3
         swap_count = serve_met.get("swap_count") or 0
         if swap_count and row.get("model_swaps"):
@@ -317,6 +350,10 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
              "p99_ms": row.get("p99_ms") or row.get("p99_ms_during"),
              "bucket_hit_rate": hit, "batch_occupancy": occ,
              "failed_requests": failed, "fixes": fixes}
+        if scaling is not None:
+            v["qps_per_chip_by_devices"] = {str(n): per_chip[n]
+                                            for n in ns}
+            v["per_chip_scaling"] = scaling
         for k in ("speedup_vs_serial", "serial_qps_per_chip", "parity",
                   "model_swaps", "torn_responses", "p99_ms_before",
                   "p99_ms_during", "p99_ms_after"):
@@ -437,6 +474,14 @@ def render(doc: Dict[str, Any]) -> str:
                      f"{v['serial_qps_per_chip']:,.0f} qps serial-"
                      f"dispatch baseline)")
         out.append(line)
+        traj = v.get("qps_per_chip_by_devices")
+        if traj:
+            arrow = " -> ".join(f"{traj[n]:,.0f}" for n in sorted(
+                traj, key=int))
+            out.append(f"  QPS/chip at "
+                       f"{'/'.join(sorted(traj, key=int))} devices: "
+                       f"{arrow} ({v.get('per_chip_scaling')}x per-chip "
+                       f"scaling)")
         lat = []
         if v.get("p50_ms") is not None:
             lat.append(f"p50 {v['p50_ms']} ms")

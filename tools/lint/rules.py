@@ -88,6 +88,12 @@ def default_config() -> LintConfig:
                     "CompiledPredictor._program", frozenset({_PC})),
         FactoryRoot("alink_tpu/serving/predictor.py",
                     "CompiledPredictor.predict_table", frozenset({_PC})),
+        # the SHARDED serving program factory (ISSUE 11): mesh-sharded
+        # score fns — the mesh fingerprint + sharded mode ride the
+        # serving program-cache key, so every flag read reachable from
+        # here must be key-neutral or declared
+        FactoryRoot("alink_tpu/serving/sharded.py",
+                    "make_linear_device_fns", frozenset({_PC})),
     ]
     roots += [FactoryRoot(_FTRL, f, frozenset({_LRU}))
               for f in ftrl_factories]

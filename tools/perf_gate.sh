@@ -34,6 +34,13 @@ python -m tools.lint --strict
 # CPU-only rigs even though the default bench leg runs 1-device.
 python tools/scaling_evidence.py --smoke
 
+# 4-device sharded-serve smoke (ISSUE 11): fresh 1- and 4-device
+# children serve the SAME feature-sharded model through mesh-sharded
+# bucket programs — probe digests must match BITWISE across mesh sizes
+# and a hot-swap storm must complete with zero torn responses. Exits 5
+# (its own code) so a multi-chip-serving regression names itself.
+python tools/serve_shard_bench.py --smoke
+
 BASE=${PERF_GATE_BASE:-BENCH_quick_base.json}
 NEW=BENCH_quick.json
 THRESH=${PERF_GATE_THRESHOLD:-30}
@@ -71,7 +78,7 @@ import json, sys
 doc = json.load(open(sys.argv[1]))
 wl = doc.get("workloads") or {}
 bad = []
-for name in ("serve_logreg", "serve_ftrl_hot_swap"):
+for name in ("serve_logreg", "serve_ftrl_hot_swap", "serve_logreg_sharded"):
     row = wl.get(name)
     if not isinstance(row, dict) or "error" in row:
         bad.append(f"{name}: missing or errored ({(row or {}).get('error')})")
@@ -86,6 +93,9 @@ for name in ("serve_logreg", "serve_ftrl_hot_swap"):
     if name == "serve_logreg" and row.get("parity") != "bitwise":
         bad.append(f"{name}: parity={row.get('parity')!r} (compiled path "
                    f"diverged from the host mapper)")
+    if name == "serve_logreg_sharded" and row.get("parity") != "bitwise":
+        bad.append(f"{name}: parity={row.get('parity')!r} (sharded bucket "
+                   f"programs diverged across mesh sizes)")
 if bad:
     print("perf_gate: serve smoke FAILED:", file=sys.stderr)
     for b in bad:
