@@ -486,6 +486,36 @@ FLAGS.register(
                         else "double"),
     accessor="alink_tpu.serving.predictor.serve_swap_mode")
 
+# -- tuning (mesh-parallel sweeps, alink_tpu/tuning/) ------------------------
+FLAGS.register(
+    "ALINK_TPU_SWEEP", "bool", False,
+    "route GridSearchCV/GridSearchTVSplit candidate loops through the "
+    "mesh-parallel tuning sweep engine when every grid axis is "
+    "carry-resident for a supported estimator (fallbacks recorded as "
+    "alink_sweep_fallback_total)", "tuning",
+    folds_into=frozenset({PROGRAM_CACHE}),
+    accessor="alink_tpu.tuning.sweep.sweep_enabled")
+FLAGS.register(
+    "ALINK_TPU_SWEEP_ETA", "int", 3,
+    "ASHA successive-halving reduction factor: each rung keeps the top "
+    "ceil(alive/eta) points", "tuning",
+    key_neutral="drives HOST boundary pruning of the carry-resident "
+                "alive mask only; the compiled sweep program's geometry "
+                "and collective set are independent of the rung "
+                "schedule (chunk limits are traced scalars)",
+    clamp=lambda n: max(2, n),
+    accessor="alink_tpu.tuning.sweep.sweep_eta")
+FLAGS.register(
+    "ALINK_TPU_SWEEP_RUNG", "int", 0,
+    "default ASHA rung period in supersteps for sweeps that enable "
+    "pruning without an explicit AshaConfig (0 = max_iter // 4, "
+    "minimum 1)", "tuning",
+    key_neutral="selects the boundary cadence of the chunked sweep "
+                "loop; the chunk limit is a traced scalar, so cadence "
+                "never changes a compiled program",
+    clamp=lambda n: max(0, n),
+    accessor="alink_tpu.tuning.sweep.sweep_rung")
+
 # -- durability -------------------------------------------------------------
 FLAGS.register(
     "ALINK_TPU_ASYNC_SNAPSHOT", "bool", True,

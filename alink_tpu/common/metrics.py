@@ -405,3 +405,41 @@ def set_registry(reg: MetricsRegistry) -> MetricsRegistry:
     with _default_lock:
         prev, _default_registry = _default_registry, reg
     return prev
+
+
+# -- once-per-key fallback recording ------------------------------------
+# Shared by the serving tier (record_serve_fallback) and the tuning
+# sweep (record_sweep_fallback): a fallback must always count in the
+# registry but only WARN once per (scope, key) per process — per-event
+# warnings would be noise, and a second distinct key is a distinct
+# problem that must not be muted by the first.
+
+_fallback_once_lock = threading.Lock()
+_fallback_once_seen: set = set()
+
+
+def record_fallback_once(scope: str, metric: str, labels: Dict[str, str],
+                         message: str, *, stacklevel: int = 4) -> bool:
+    """Increment ``metric{labels}`` (metrics on), then emit ``message``
+    as a RuntimeWarning the FIRST time this (scope, labels-key) is seen.
+    Returns True when the warning fired. ``labels`` values must be a
+    small stable enum (they are metric labels AND the dedup key)."""
+    if metrics_enabled():
+        get_registry().inc(metric, 1, labels)
+    key = (scope,) + tuple(sorted(labels.items()))
+    with _fallback_once_lock:
+        if key in _fallback_once_seen:
+            return False
+        _fallback_once_seen.add(key)
+    warnings.warn(message, RuntimeWarning, stacklevel=stacklevel)
+    return True
+
+
+def reset_fallback_warnings(scope: Optional[str] = None) -> None:
+    """Test hook: re-arm the once-per-key warnings (one scope, or all)."""
+    with _fallback_once_lock:
+        if scope is None:
+            _fallback_once_seen.clear()
+        else:
+            for k in [k for k in _fallback_once_seen if k[0] == scope]:
+                _fallback_once_seen.discard(k)

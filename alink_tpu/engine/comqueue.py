@@ -642,6 +642,7 @@ class IterativeComQueue:
         self._close: Optional[Callable[[ComQueueResult], Any]] = None
         self._program_key: Optional[tuple] = None
         self._ckpt = None
+        self._boundary = None     # (every, hook) — set_boundary
         self._health = None       # HealthMonitor (set_health)
         self._data_token = None   # checkpoint-signature memo (see _run)
         if checkpoint_dir is not None:
@@ -711,6 +712,28 @@ class IterativeComQueue:
                                       every=int(every),
                                       keep_last=int(keep_last),
                                       resume_from=resume_from)
+        return self
+
+    def set_boundary(self, every: int, hook) -> "IterativeComQueue":
+        """Run the superstep loop CHUNKED with a host boundary hook every
+        ``every`` supersteps: ``hook(stacked_carry, step) -> carry|None``
+        may transform the carry between chunks (return ``None`` to keep
+        it). The batched-carry entry point of the tuning sweep
+        (``alink_tpu/tuning/``): ASHA rung decisions read the per-point
+        probe lanes from the boundary carry and flip the carry-resident
+        alive mask — the compiled chunk programs never change (the chunk
+        limit is a traced scalar), so pruning can never recompile.
+
+        Composes with :meth:`set_checkpoint`: when both are set the
+        boundary cadence wins (the sweep aligns its rung period with the
+        snapshot cadence) and the hook runs right after each snapshot
+        publishes — and again after a resume, so a resumed run re-derives
+        the same deterministic boundary decisions. Without a checkpoint
+        directory the same chunked programs run with persistence off."""
+        if int(every) < 1:
+            raise ValueError(f"set_boundary(every=) must be >= 1, "
+                             f"got {every}")
+        self._boundary = (int(every), hook)
         return self
 
     def set_health(self, monitor) -> "IterativeComQueue":
@@ -990,7 +1013,7 @@ class IterativeComQueue:
                     donate, fuse, tuple(sorted(parts)),
                     tuple(sorted(bcast)))
 
-        if self._ckpt is not None:
+        if self._ckpt is not None or self._boundary is not None:
             # -- durable chunked execution (engine/recovery.py) -----------
             from . import recovery
             if jax.process_count() > 1:
@@ -999,6 +1022,19 @@ class IterativeComQueue:
                     "per-boundary carry fetch would need a multihost "
                     "allgather + single-writer election")
             ck = self._ckpt
+            on_boundary = None
+            if self._boundary is not None:
+                # boundary-driven chunking (tuning sweep rungs): the hook
+                # cadence overrides the snapshot cadence — the sweep
+                # aligns both, and a hook without set_checkpoint runs the
+                # chunk programs with persistence off (directory=None)
+                b_every, on_boundary = self._boundary
+                if ck is None:
+                    ck = recovery.CheckpointConfig(directory=None,
+                                                   every=b_every)
+                elif int(ck.every) != b_every:
+                    import dataclasses
+                    ck = dataclasses.replace(ck, every=b_every)
             first = cont = None
             ckkey = ("__ckpt__", ckey) if ckey is not None else None
             if ckkey is not None:
@@ -1031,26 +1067,35 @@ class IterativeComQueue:
                               args={"result": cache_status})
             cost = _maybe_cost(ckkey, lambda: first.lower(
                 parts, bcast, jnp.asarray(max_iter, jnp.int32)))
-            part_sig = tuple(
-                (k, tuple(map(int, np.shape(parts[k]))),
-                 str(getattr(parts[k], "dtype", "?"))) for k in sorted(parts))
-            # fingerprint the ORIGINAL (pre-padding, host-side) inputs:
-            # np arrays hash by content, device-resident arrays degrade
-            # to shape/dtype tokens (no forced device->host round trip).
-            # Memoized per queue instance (invalidated by init_with_*):
-            # repeated exec() on the same queue must not re-hash the
-            # whole dataset per program-cache hit
-            data_token = self._data_token
-            if data_token is None:
-                data_token = self._data_token = _freeze_closure_value(
-                    {"parts": dict(self._partitioned),
-                     "bcast": dict(self._broadcast)}, 3)
-            signature = recovery.program_signature(
-                num_workers=nw, max_iter=max_iter, seed=seed,
-                part_sig=part_sig, bcast_names=tuple(sorted(bcast)),
-                stages_digest=stages_dig, data_token=data_token,
-                probes_on=probes_on, fuse_collectives=fuse)
-            resumed = recovery.resume_state(ck, signature)
+            if ck.directory or ck.resume_from:
+                part_sig = tuple(
+                    (k, tuple(map(int, np.shape(parts[k]))),
+                     str(getattr(parts[k], "dtype", "?")))
+                    for k in sorted(parts))
+                # fingerprint the ORIGINAL (pre-padding, host-side)
+                # inputs: np arrays hash by content, device-resident
+                # arrays degrade to shape/dtype tokens (no forced
+                # device->host round trip). Memoized per queue instance
+                # (invalidated by init_with_*): repeated exec() on the
+                # same queue must not re-hash the whole dataset per
+                # program-cache hit
+                data_token = self._data_token
+                if data_token is None:
+                    data_token = self._data_token = _freeze_closure_value(
+                        {"parts": dict(self._partitioned),
+                         "bcast": dict(self._broadcast)}, 3)
+                signature = recovery.program_signature(
+                    num_workers=nw, max_iter=max_iter, seed=seed,
+                    part_sig=part_sig, bcast_names=tuple(sorted(bcast)),
+                    stages_digest=stages_dig, data_token=data_token,
+                    probes_on=probes_on, fuse_collectives=fuse)
+                resumed = recovery.resume_state(ck, signature)
+            else:
+                # boundary-only chunking (set_boundary without a
+                # checkpoint dir): nothing persists and nothing resumes,
+                # so content-hashing the whole dataset for a signature
+                # no snapshot will ever carry is pure waste
+                signature, resumed = None, None
             on_snapshot = None
             if self._health is not None and probes_on:
                 # mid-run watchdog: evaluate on the carry the boundary
@@ -1065,7 +1110,8 @@ class IterativeComQueue:
                 stacked, ck_info = recovery.drive(
                     ck, first=first, cont=cont, parts=parts, bcast=bcast,
                     max_iter=max_iter, signature=signature, resumed=resumed,
-                    on_snapshot=on_snapshot, donate=donate)
+                    on_snapshot=on_snapshot, donate=donate,
+                    on_boundary=on_boundary)
             # chunked path: the program runs once per chunk, so only the
             # STATIC cost gauges are meaningful (no exec_t0 -> no achieved
             # rates; see _finish)

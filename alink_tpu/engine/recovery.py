@@ -69,12 +69,17 @@ class CheckpointConfig:
     ``every``      — persist the carry at every superstep boundary that is
                      a multiple of this (and at the final state);
     ``directory``  — snapshot root (one ``ckpt-<step>`` dir per snapshot);
+                     ``None`` runs the chunked loop WITHOUT persistence —
+                     the boundary-driven execution mode of
+                     ``IterativeComQueue.set_boundary`` (the tuning
+                     sweep's ASHA rungs), same compiled chunk programs,
+                     zero disk writes;
     ``keep_last``  — bounded retention, pruned after each publish;
     ``resume_from``— directory to resume from (usually == ``directory``);
                      the newest VALID snapshot wins; a signature mismatch
                      fails loudly instead of resuming the wrong program.
     """
-    directory: str
+    directory: Optional[str]
     every: int = 1
     keep_last: int = 3
     resume_from: Optional[str] = None
@@ -284,7 +289,8 @@ def drive(config: CheckpointConfig, *,
           max_iter: int, signature: Dict[str, Any],
           resumed: Optional[Dict[str, Any]] = None,
           on_snapshot: Optional[Callable] = None,
-          donate: bool = False
+          donate: bool = False,
+          on_boundary: Optional[Callable] = None
           ) -> Tuple[Any, Dict[str, Any]]:
     """Run the chunked superstep loop with host-side persistence.
 
@@ -303,6 +309,22 @@ def drive(config: CheckpointConfig, *,
     ``(stacked_carry, info)`` where ``info`` carries the superstep
     accounting the metrics tail needs (``steps_executed``, ``init_ran``,
     ``resumed_at``).
+
+    ``on_boundary(stacked, step)`` — if given — runs at every chunk
+    boundary AFTER the snapshot published (and once right after a
+    resume, BEFORE any new chunk dispatches) and may return a
+    replacement stacked carry (``None`` = keep). This is the tuning
+    sweep's ASHA pruning hook: it flips carry-resident alive lanes
+    between chunks without touching program geometry. Because it runs
+    after persistence but is re-applied on resume, a resumed run
+    re-derives the same (deterministic) boundary decision the
+    uninterrupted run made — kill-and-resume parity holds for the whole
+    population. The hook may also rewrite ``__stop`` (the whole
+    surviving population has converged); the driver re-reads it.
+
+    With ``config.directory`` None nothing is persisted: the chunked
+    loop runs purely for its boundaries (``IterativeComQueue.
+    set_boundary`` — the sweep's rung cadence without durability).
     """
     import jax.numpy as jnp
 
@@ -347,9 +369,11 @@ def drive(config: CheckpointConfig, *,
         return out, step, stop
 
     writer = _SnapshotWriter(config, signature, on_snapshot) \
-        if async_snapshot_enabled() else None
+        if (async_snapshot_enabled() and config.directory) else None
 
     def persist(stacked, step, stopped):
+        if not config.directory:
+            return          # boundary-only mode: chunking without disk
         if writer is not None:
             # hand the writer buffers the next chunk cannot invalidate:
             # a device-side copy when the donated cont will consume the
@@ -386,12 +410,30 @@ def drive(config: CheckpointConfig, *,
             if step != last_saved:
                 persist(stacked, step, stop or step >= max_iter)
                 last_saved = step
+            if on_boundary is not None and not stop and step < max_iter:
+                # boundary transform (ASHA rung pruning): runs after the
+                # snapshot published — the on-disk state is pre-decision,
+                # and a resume re-derives the decision deterministically
+                new = on_boundary(stacked, step)
+                if new is not None:
+                    stacked = new
+                    step, stop = boundary(stacked)
             if stop or step >= max_iter:
                 break
+            # an exhausted boundary hook (the ASHA rung maker once the
+            # population is down to its floor) has no further decisions:
+            # with persistence OFF the rest of the run is ONE chunk —
+            # boundaries are host syncs, pure overhead past that point.
+            # With a checkpoint directory the snapshot cadence wins.
+            if on_boundary is not None and not config.directory \
+                    and getattr(on_boundary, "exhausted", False):
+                limit = max_iter
+            else:
+                limit = _next_limit(step, every, max_iter)
             # snapshot t is now fetching/writing in the background; chunk
             # t+1 dispatches immediately — THE overlap this module buys
             stacked, step, stop = chunk(cont, (parts, bcast, stacked), step,
-                                        _next_limit(step, every, max_iter))
+                                        limit)
         if writer is not None:
             # durability barrier: drive returns only once every boundary
             # is on disk (or its failure raised) — callers observe the

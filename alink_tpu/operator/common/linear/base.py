@@ -136,8 +136,83 @@ def _sort_key(v):
     return (0, float(v)) if isinstance(v, (int, float, bool)) else (1, str(v))
 
 
-def train_linear_model(data: MTable, op, model_type: str) -> Tuple[MTable, MTable]:
-    """Full train flow; ``op`` supplies params. Returns (model_table, train_info)."""
+@dataclass
+class LinearTrainPrep:
+    """The hyperparameter-independent half of the linear train flow.
+
+    Everything up to (and excluding) ``optimize()`` — design extraction,
+    label encoding, standardization moments, field-block detection —
+    depends only on the data and the structural params, never on the
+    carry-resident tuning axes (``l1``/``l2``/``learning_rate``/
+    ``epsilon``). The mesh-parallel tuning sweep (``alink_tpu/tuning/``)
+    therefore prepares ONCE per split and sweeps N points through
+    :meth:`objective` + one batched program, finishing each point with
+    :meth:`finish` — the exact de-augment/de-standardize/model-build
+    tail the serial path runs."""
+    env: Any
+    dtype: Any
+    model_type: str
+    softmax: bool
+    regression: bool
+    labels: List[Any]
+    label_type: str
+    train: Dict[str, np.ndarray]
+    dim: int
+    feat_dim: int
+    mean: np.ndarray
+    std: np.ndarray
+    standardize: bool
+    with_intercept: bool
+    fb_meta: Any                    # augmented FieldBlockMeta, or None
+    reg_free: int
+    vector_col: Optional[str]
+    feature_cols: Optional[List[str]]
+    loss_kwargs: Dict[str, Any]
+
+    def objective(self, l1: float, l2: float):
+        """The training objective at (l1, l2) — the serial path's obj
+        construction, verbatim."""
+        if self.softmax:
+            k = len(self.labels)
+            return SoftmaxObjFunc(k, self.dim, l1=l1, l2=l2,
+                                  reg_free_cols=self.reg_free)
+        loss_cls = LinearModelType.LOSSES[self.model_type]
+        return UnaryLossObjFunc(loss_cls(**self.loss_kwargs), self.dim,
+                                l1=l1, l2=l2, reg_free_head=self.reg_free,
+                                fb_meta=self.fb_meta)
+
+    def finish(self, coef, loss_curve) -> Tuple[MTable, MTable]:
+        """Fitted coefficients -> (model_table, train_info): fb
+        intercept de-augmentation, de-standardization, model rows."""
+        coef = np.asarray(coef)
+        if self.fb_meta is not None and self.with_intercept:
+            # de-augment: [intercept slot, dead slots..., features]
+            coef = np.concatenate([coef[:1],
+                                   coef[self.fb_meta.field_size:]])
+        if self.standardize:
+            coef = _destandardize_coef(coef, self.mean, self.std,
+                                       self.with_intercept, self.softmax,
+                                       len(self.labels))
+        model = LinearModelData(
+            model_name=f"{self.model_type} model",
+            linear_model_type=self.model_type,
+            has_intercept=bool(self.with_intercept),
+            vector_col=self.vector_col,
+            feature_names=self.feature_cols if not self.vector_col else None,
+            vector_size=int(self.feat_dim),
+            coef=np.asarray(coef, np.float64), label_values=self.labels,
+            label_type=self.label_type, loss_curve=loss_curve)
+        model_table = LinearModelDataConverter(
+            self.label_type).save_model(model)
+        info = MTable({"iter": np.arange(1, len(loss_curve) + 1),
+                       "loss": np.asarray(loss_curve, np.float64)})
+        return model_table, info
+
+
+def prepare_linear_train(data: MTable, op, model_type: str
+                         ) -> LinearTrainPrep:
+    """The shared front half of :func:`train_linear_model` (see
+    :class:`LinearTrainPrep`)."""
     env = MLEnvironmentFactory.get(op.get_ml_environment_id())
     feature_cols = op.params._m.get("feature_cols")
     vector_col = op.params._m.get("vector_col")
@@ -209,34 +284,12 @@ def train_linear_model(data: MTable, op, model_type: str) -> Tuple[MTable, MTabl
             design = add_intercept(design, dtype)
         dim = design["dim"]
 
-    # -- optimize ---------------------------------------------------------
-    method = _default_method(op, l1)
-    lr = op.params._m.get("learning_rate")
-    if lr is None:
-        # line-search base for (quasi-)Newton methods; step size for SGD
-        lr = 0.1 if method.upper() == "SGD" else 1.0
-    optim = OptimParams(
-        method=method,
-        max_iter=int(op.params._m.get("max_iter", 100)),
-        epsilon=float(op.params._m.get("epsilon", 1e-6)),
-        learning_rate=float(lr),
-        mini_batch_fraction=float(op.params._m.get("mini_batch_fraction", 0.1)),
-        seed=int(op.params._m.get("seed", 0) or 0),
-    )
     # the fb intercept field owns the first field_size slots, all reg-free
     reg_free = 0 if not with_intercept else \
         (meta.field_size if fb is not None else 1)
-    if softmax:
-        k = len(labels)
-        obj = SoftmaxObjFunc(k, dim, l1=l1, l2=l2, reg_free_cols=reg_free)
-    else:
-        loss_cls = LinearModelType.LOSSES[model_type]
-        loss_kwargs = {}
-        if model_type == LinearModelType.SVR:
-            loss_kwargs["epsilon"] = float(op.params._m.get("tau", 0.1))
-        obj = UnaryLossObjFunc(loss_cls(**loss_kwargs), dim, l1=l1, l2=l2,
-                               reg_free_head=reg_free,
-                               fb_meta=meta if fb is not None else None)
+    loss_kwargs: Dict[str, Any] = {}
+    if model_type == LinearModelType.SVR:
+        loss_kwargs["epsilon"] = float(op.params._m.get("tau", 0.1))
 
     if fb is not None:
         train = {"fb_idx": fb_idx}
@@ -246,27 +299,36 @@ def train_linear_model(data: MTable, op, model_type: str) -> Tuple[MTable, MTabl
         train = {k2: v for k2, v in design.items() if k2 in ("X", "idx", "val")}
     train["y"] = y.astype(dtype)
     train["w"] = w
-    coef, loss_curve, steps = optimize(obj, train, optim, env)
-    if fb is not None and with_intercept:
-        # de-augment: [intercept slot, dead slots..., features] -> [b, features]
-        coef = np.concatenate([coef[:1], coef[meta.field_size:]])
+    return LinearTrainPrep(
+        env=env, dtype=dtype, model_type=model_type, softmax=softmax,
+        regression=regression, labels=labels, label_type=label_type,
+        train=train, dim=dim, feat_dim=int(feat_dim), mean=mean, std=std,
+        standardize=bool(standardize), with_intercept=bool(with_intercept),
+        fb_meta=meta if fb is not None else None, reg_free=reg_free,
+        vector_col=vector_col, feature_cols=feature_cols,
+        loss_kwargs=loss_kwargs)
 
-    # -- de-standardize back to the original feature scale ----------------
-    if standardize:
-        coef = _destandardize_coef(coef, mean, std, with_intercept,
-                                   softmax, len(labels))
 
-    model = LinearModelData(
-        model_name=f"{model_type} model", linear_model_type=model_type,
-        has_intercept=bool(with_intercept), vector_col=vector_col,
-        feature_names=feature_cols if not vector_col else None,
-        vector_size=int(feat_dim),
-        coef=np.asarray(coef, np.float64), label_values=labels,
-        label_type=label_type, loss_curve=loss_curve)
-    model_table = LinearModelDataConverter(label_type).save_model(model)
-    info = MTable({"iter": np.arange(1, len(loss_curve) + 1),
-                   "loss": np.asarray(loss_curve, np.float64)})
-    return model_table, info
+def train_linear_model(data: MTable, op, model_type: str) -> Tuple[MTable, MTable]:
+    """Full train flow; ``op`` supplies params. Returns (model_table, train_info)."""
+    prep = prepare_linear_train(data, op, model_type)
+    l1 = float(op.params._m.get("l1", 0.0) or 0.0)
+    l2 = float(op.params._m.get("l2", 0.0) or 0.0)
+    method = _default_method(op, l1)
+    lr = op.params._m.get("learning_rate")
+    if lr is None:
+        lr = default_learning_rate(method)
+    optim = OptimParams(
+        method=method,
+        max_iter=int(op.params._m.get("max_iter", 100)),
+        epsilon=float(op.params._m.get("epsilon", 1e-6)),
+        learning_rate=float(lr),
+        mini_batch_fraction=float(op.params._m.get("mini_batch_fraction", 0.1)),
+        seed=int(op.params._m.get("seed", 0) or 0),
+    )
+    obj = prep.objective(l1, l2)
+    coef, loss_curve, steps = optimize(obj, prep.train, optim, prep.env)
+    return prep.finish(coef, loss_curve)
 
 
 def _x64_enabled() -> bool:
@@ -275,10 +337,22 @@ def _x64_enabled() -> bool:
 
 
 def _default_method(op, l1: float) -> str:
+    """The ONE method-resolution rule (explicit ``optim_method`` wins;
+    otherwise OWLQN iff l1 > 0). ``op`` is anything carrying the linear
+    train params (a train op or a pipeline estimator) — the tuning
+    sweep's per-point resolution reuses this exact function so the
+    flag-on candidate set can never drift from the serial loop's."""
     m = op.params._m.get("optim_method")
     if m:
         return str(m)
     return "OWLQN" if l1 > 0 else "LBFGS"
+
+
+def default_learning_rate(method: str) -> float:
+    """The serial default when no ``learning_rate`` param is set:
+    line-search base for the (quasi-)Newton methods; step size for SGD.
+    Shared with the tuning sweep's per-point resolution."""
+    return 0.1 if method.upper() == "SGD" else 1.0
 
 
 def _weighted_moments(design: Dict, w: np.ndarray):

@@ -728,6 +728,23 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                  params: Optional[Params] = None, **kwargs):
         super().__init__(params, **kwargs)
         self._initial_model = initial_model
+        self._device_snapshot_hook = None
+
+    def set_device_snapshot_consumer(self, hook) -> "FtrlTrainStreamOp":
+        """Register a device-to-device snapshot consumer (ROADMAP item 1
+        leftover): at each emission boundary ``hook(w_device, info)`` is
+        handed the LIVE device weights derived from the device-resident
+        (z, n) state (``weights_fn`` — never donates, so the state
+        survives) plus layout info (``dim``, ``fb_S``,
+        ``has_intercept``, ``batch``, ``event_time``). When the hook
+        returns True the host model-table snapshot — and its
+        device->host weight fetch — is SKIPPED for that boundary:
+        nothing is yielded and the model stays on the mesh end-to-end
+        (the serving tier's ``swap_weights`` path,
+        :class:`~alink_tpu.serving.server.DeviceWeightsFeeder`). A
+        False/None return falls back to the host snapshot unchanged."""
+        self._device_snapshot_hook = hook
+        return self
 
     # ------------------------------------------------------------------
     def _load_initial(self) -> LinearModelData:
@@ -1212,6 +1229,28 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
             reg = get_registry() if mx else None
             m_lbl = {"op": "FtrlTrainStreamOp", "mode": update_mode}
 
+            def device_emit(t_ev, batch) -> bool:
+                """Device-to-device emission: hand the registered
+                consumer (set_device_snapshot_consumer) the LIVE device
+                weights — ``weights_fn`` reads (z, n) without donating —
+                with ZERO host traffic; the host model-table snapshot
+                and its device_get are skipped when the consumer takes
+                the hand-off. Reads gen's current (z, n, fb_S) at call
+                time (late-bound closure)."""
+                hook = self._device_snapshot_hook
+                if hook is None or z is None:
+                    return False
+                consumed = bool(hook(weights_fn(z, n),
+                                     {"fb_S": fb_S, "dim": dim,
+                                      "has_intercept": bool(has_icpt),
+                                      "batch": batch,
+                                      "event_time": t_ev}))
+                if consumed:
+                    hbm_snapshot("ftrl.snapshot")
+                    if mx:
+                        reg.inc("alink_ftrl_device_snapshots_total", 1)
+                return consumed
+
             def run_step(step, *args):
                 # per-micro-batch collective accounting (the programs
                 # are jit-cached; see _step_manifest). The execution is
@@ -1339,10 +1378,14 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
               if t + 1e-12 >= next_emit:
                   trace_instant("ftrl.snapshot", cat="stream",
                                 args={"event_time": t, "batch": b_done + 1})
-                  snap = snapshot(z, n, fb_S, batch=b_done + 1)
-                  if mon_on:
-                      flush_pv()     # pv + drift evaluated per emission
-                  yield (t, snap)
+                  if device_emit(t, b_done + 1):
+                      if mon_on:
+                          flush_pv()
+                  else:
+                      snap = snapshot(z, n, fb_S, batch=b_done + 1)
+                      if mon_on:
+                          flush_pv()  # pv + drift evaluated per emission
+                      yield (t, snap)
                   if mx:
                       reg.inc("alink_ftrl_snapshots_total", 1)
                   while next_emit <= t + 1e-12:
@@ -1369,11 +1412,17 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 reg.inc("alink_ftrl_snapshots_total", 1)
             trace_instant("ftrl.snapshot", cat="stream",
                           args={"batch": b_done, "final": True})
-            snap = snapshot(z, n, fb_S,
-                            batch=b_done if b_done > 0 else None)
-            if mon_on:
-                flush_pv()
-            yield (next_emit if next_emit is not None else interval, snap)
+            if device_emit(next_emit if next_emit is not None else interval,
+                           b_done if b_done > 0 else None):
+                if mon_on:
+                    flush_pv()
+            else:
+                snap = snapshot(z, n, fb_S,
+                                batch=b_done if b_done > 0 else None)
+                if mon_on:
+                    flush_pv()
+                yield (next_emit if next_emit is not None else interval,
+                       snap)
 
         def gen_profiled():
             # drain-level capture window (ALINK_TPU_PROFILE): wall of

@@ -240,11 +240,155 @@ class BaseGridSearch(Estimator):
     def _splits(self, table: MTable):  # pragma: no cover - abstract
         raise NotImplementedError
 
+    # -- mesh-parallel sweep path (ALINK_TPU_SWEEP; alink_tpu/tuning/) ----
+    # Carry-resident grid axes of the linear-family estimators: their
+    # values sweep as (points,) lanes inside ONE compiled BSP program
+    # per compile group. Any other axis is trace-shaping here and falls
+    # back (recorded) to the serial candidate loop.
+    _SWEEP_AXES = frozenset({"l1", "l2", "learning_rate", "epsilon"})
+
+    def _sweep_supported_model_type(self):
+        """The linear-family model type of the estimator, or None.
+        Softmax is excluded (its (k-1, d) objective is a different
+        program family; serve it serially until a sweep kernel lands)."""
+        train_cls = getattr(type(self.estimator), "TRAIN_OP_CLS", None)
+        mt = getattr(train_cls, "MODEL_TYPE", None)
+        from ..operator.common.linear.base import LinearModelType
+        if train_cls is None or mt not in LinearModelType.LOSSES:
+            return None
+        return mt
+
+    def _sweep_fit(self, table: MTable) -> Optional[BaseTuningModel]:
+        """Train every grid candidate as ONE vmapped/sharded BSP program
+        per compile group (alink_tpu/tuning/sweep.py) instead of N
+        serial execs. Per-point training is bitwise identical to the
+        serial fit of that point, so the Report, the winner, and the
+        refit model match the serial loop exactly. Returns None — with
+        the fallback RECORDED (alink_sweep_fallback_total) — whenever
+        the grid cannot sweep; the caller then runs the serial loop."""
+        from ..tuning.sweep import record_sweep_fallback
+        est = self.estimator
+        name = type(est).__name__
+        mt = self._sweep_supported_model_type()
+        if mt is None:
+            record_sweep_fallback(name, "unsupported-estimator")
+            return None
+        if type(self.tuning_evaluator) not in (
+                BinaryClassificationTuningEvaluator,
+                MultiClassClassificationTuningEvaluator,
+                RegressionTuningEvaluator, ClusterTuningEvaluator):
+            record_sweep_fallback(name, "unsupported-evaluator",
+                                  type(self.tuning_evaluator).__name__)
+            return None
+        items = self.param_grid.items if self.param_grid else []
+        for stage, pi, _ in items:
+            if stage is not est or pi.name not in self._SWEEP_AXES:
+                record_sweep_fallback(
+                    name, "trace-shaping-axis",
+                    f"{type(stage).__name__}.{pi.name}")
+                return None
+        from ..operator.common.linear.base import (_default_method,
+                                                   default_learning_rate,
+                                                   prepare_linear_train)
+        from ..operator.common.optim.optimizers import OptimParams
+        from ..tuning.sweep import sweep_optimize
+        cands = list(self._candidates())
+        descs = [desc for _, _, desc in cands]
+        P = len(cands)
+        m = est.params._m
+        base_l1 = float(m.get("l1", 0.0) or 0.0)
+        base_l2 = float(m.get("l2", 0.0) or 0.0)
+        base_eps = float(m.get("epsilon", 1e-6))
+        base_lr = m.get("learning_rate")
+        sweep_points = []
+        for combo, items_, _desc in cands:
+            pt = {pi.name: v for (st, pi, _), v in zip(items_, combo)}
+            l1 = float(pt.get("l1", base_l1))
+            # per-point resolution through the serial path's OWN rules
+            # (_default_method / default_learning_rate — one source of
+            # truth): an l1 axis that crosses zero splits the sweep
+            # into OWLQN/LBFGS compile groups exactly like flag-off
+            method = _default_method(est, l1).upper()
+            lr = pt.get("learning_rate", base_lr)
+            if lr is None:
+                lr = default_learning_rate(method)
+            sweep_points.append({
+                "method": method, "l1": l1,
+                "l2": float(pt.get("l2", base_l2)),
+                "learning_rate": float(lr),
+                "epsilon": float(pt.get("epsilon", base_eps))})
+        base_optim = OptimParams(
+            method="LBFGS", max_iter=int(m.get("max_iter", 100)),
+            epsilon=base_eps,
+            mini_batch_fraction=float(m.get("mini_batch_fraction", 0.1)),
+            seed=int(m.get("seed", 0) or 0))
+        ev = self.tuning_evaluator
+        larger = ev.is_larger_better()
+        split_scores: List[List[float]] = [[] for _ in range(P)]
+        errors: List[Optional[str]] = [None] * P
+        try:
+            for train_t, test_t in self._splits(table):
+                shell = type(est).TRAIN_OP_CLS(est.params.clone())
+                prep = prepare_linear_train(train_t, shell, mt)
+                res = sweep_optimize(prep.objective(base_l1, base_l2),
+                                     prep.train, base_optim, sweep_points,
+                                     env=prep.env)
+                for i in range(P):
+                    if errors[i] is not None:
+                        continue
+                    try:
+                        model_table, _info = prep.finish(
+                            res.values["coef"][i], res.loss_curves[i])
+                        saved = self._apply(cands[i][0], cands[i][1])
+                        try:
+                            model = type(est).MODEL_CLS(est.params.clone())
+                        finally:
+                            self._restore(saved)
+                        model.set_model_data(model_table)
+                        split_scores[i].append(float(ev.evaluate(
+                            model.transform(TableSourceBatchOp(test_t)))))
+                    except Exception as e:  # candidate failure is not
+                        # fatal — the Report records it (serial contract)
+                        errors[i] = f"{type(e).__name__}: {e}"
+        except Exception as e:
+            # a sweep-level failure must never lose the tuning run: fall
+            # back (recorded) to the serial loop
+            record_sweep_fallback(name, "sweep-error",
+                                  f"{type(e).__name__}: {e}")
+            return None
+        best = (None, -np.inf if larger else np.inf, None, "")
+        rows = []
+        for i in range(P):
+            if errors[i] is not None or not split_scores[i]:
+                rows.append((descs[i], float("nan"), False,
+                             errors[i] or "no score"))
+                continue
+            score = float(np.mean(split_scores[i]))
+            rows.append((descs[i], score, True, ""))
+            if (larger and score > best[1]) or (not larger and score < best[1]):
+                best = (cands[i][0], score, cands[i][1], descs[i])
+        if best[0] is None:
+            msgs = "; ".join(f"{d}: {msg}" for d, _, ok, msg in rows if not ok)
+            raise RuntimeError(f"all tuning candidates failed — {msgs}")
+        saved = self._apply(best[0], best[2])
+        try:
+            final_model = self.estimator.fit(TableSourceBatchOp(table))
+        finally:
+            self._restore(saved)
+        return BaseTuningModel(final_model, Report(rows), best[3])
+
     def fit(self, in_op) -> BaseTuningModel:
         if self.estimator is None or self.tuning_evaluator is None:
             raise ValueError("grid search needs estimator and tuning_evaluator")
         in_op = in_op if isinstance(in_op, BatchOperator) else TableSourceBatchOp(in_op)
         table = in_op.get_output_table()
+        from ..common.flags import flag_value
+        if flag_value("ALINK_TPU_SWEEP", False):
+            # flag-off never reaches the tuning package at all — the
+            # serial loop below is byte-identical pre-sweep code
+            got = self._sweep_fit(table)
+            if got is not None:
+                return got
         ev = self.tuning_evaluator
         larger = ev.is_larger_better()
         best = (None, -np.inf if larger else np.inf, None, "")

@@ -66,9 +66,9 @@ DEFAULT_BUCKETS = (1, 8, 32, 128, 512)
 # serving kernel (or a predictor that cannot satisfy a sharding
 # request) just quietly served off-device and the fleet-scale numbers
 # looked mysteriously flat. Every fallback now records a once-per-
-# (mapper, reason) RuntimeWarning plus a labelled counter.
-_fallback_lock = threading.Lock()
-_fallback_seen: set = set()
+# (mapper, reason) RuntimeWarning plus a labelled counter — the shared
+# ``common.metrics.record_fallback_once`` machinery (the tuning sweep's
+# fallback contract rides the same helper).
 
 
 def record_serve_fallback(mapper_name: str, reason: str,
@@ -82,26 +82,21 @@ def record_serve_fallback(mapper_name: str, reason: str,
     widths etc.) would mint a new time series per distinct value.
     Request-specific context goes in ``detail``, which reaches only the
     warning text."""
-    if metrics_enabled():
-        get_registry().inc("alink_serve_fallback_total", 1,
-                           {"mapper": mapper_name, "reason": reason})
-    key = (mapper_name, reason)
-    with _fallback_lock:
-        if key in _fallback_seen:
-            return
-        _fallback_seen.add(key)
-    warnings.warn(
+    from ..common.metrics import record_fallback_once
+    record_fallback_once(
+        "serve", "alink_serve_fallback_total",
+        {"mapper": mapper_name, "reason": reason},
         f"serving falls back to the host mapper path for {mapper_name}: "
         f"{reason}{' (' + detail + ')' if detail else ''} (recorded as "
         f"alink_serve_fallback_total{{mapper={mapper_name!r},"
         f"reason={reason!r}}}; this warning fires once per "
-        f"mapper+reason)", RuntimeWarning, stacklevel=3)
+        f"mapper+reason)")
 
 
 def _reset_fallback_warnings() -> None:
     """Test hook: re-arm the once-per-(mapper, reason) warnings."""
-    with _fallback_lock:
-        _fallback_seen.clear()
+    from ..common.metrics import reset_fallback_warnings
+    reset_fallback_warnings("serve")
 
 
 def serve_compiled_enabled() -> bool:

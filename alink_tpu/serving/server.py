@@ -350,3 +350,108 @@ class ModelStreamFeeder:
         if self.error is not None:
             raise self.error
         return len(self.versions)
+
+
+class DeviceWeightsFeeder:
+    """Device-to-device model swaps off the FTRL trainer's (z, n) state
+    (ROADMAP item 1 leftover, ISSUE 12 satellite).
+
+    :class:`ModelStreamFeeder` round-trips every snapshot through a host
+    model table — the trainer fetches its device weights to host, builds
+    rows, and ``swap_model`` re-places them on the mesh. This feeder
+    removes the round trip end-to-end: it registers itself as the
+    trainer's ``set_device_snapshot_consumer`` hook, receives the LIVE
+    device weight vector at each emission boundary, reshapes it to the
+    active serving kernel's geometry WITH DEVICE OPS ONLY (slice + pad —
+    no ``device_get``, no host staging array), and installs it through
+    ``CompiledPredictor.swap_weights`` (same-geometry in-place swap,
+    ``jax.device_put`` into a matched placement is device-to-device).
+    The served scores are bitwise identical to the host-table path —
+    both serve the same weight values through the same compiled bucket
+    programs (tests/test_serving.py pins zero host traffic AND score
+    parity).
+
+    The trainer must serve the SAME geometry the predictor was built
+    with (the warm-start model): a layout the feeder cannot map refuses
+    loudly via ``swap_weights``'s geometry check. Drive the drain with
+    :meth:`run` (the hook consumes every snapshot, so the stream yields
+    nothing — iterating it IS the training loop)."""
+
+    def __init__(self, server: PredictServer, ftrl_op,
+                 limit: Optional[int] = None,
+                 on_swap: Optional[Callable[[int], None]] = None):
+        self.server = server
+        self.ftrl_op = ftrl_op
+        self.limit = limit
+        self.on_swap = on_swap
+        self.versions: List[int] = []
+        self.error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._drain, daemon=True,
+                                        name="alink-serve-devfeeder")
+        ftrl_op.set_device_snapshot_consumer(self._consume)
+
+    # -- the trainer-side hook (runs on the draining thread) -------------
+    def _consume(self, w_full, info: dict) -> bool:
+        if self.limit is not None and len(self.versions) >= self.limit:
+            return False           # past the cap: host path resumes
+        import jax.numpy as jnp
+        kernel = self.server.predictor._active.kernel
+        wf8_len = int(kernel.model_arrays[0].shape[0])
+        dim, fb_S = int(info["dim"]), info.get("fb_S")
+        # the trainer's snapshot() layout logic, as device slices
+        if info.get("has_intercept"):
+            b = w_full[0]
+            feats = (w_full[1:dim] if fb_S is None
+                     else w_full[fb_S:fb_S + dim - 1])
+        else:
+            b = jnp.zeros((), w_full.dtype)
+            feats = w_full[:dim]
+        if int(feats.shape[0]) > wf8_len:
+            # the documented loud refusal: a trainer wider than the
+            # serving kernel's weight slot must not die in a jnp shape
+            # error on the drain thread
+            raise ValueError(
+                f"DeviceWeightsFeeder geometry mismatch: trainer emits "
+                f"{int(feats.shape[0])} feature weights, the active "
+                f"serving kernel holds {wf8_len} — a different geometry "
+                f"must go through swap_model (new signature, new "
+                f"programs)")
+        wf8 = jnp.zeros(wf8_len, w_full.dtype).at[:feats.shape[0]].set(feats)
+        version = self.server.predictor.swap_weights((wf8, b))
+        self.versions.append(version)
+        trace_instant("serve.model_stream", cat="serve",
+                      args={"version": version, "path": "device"})
+        if self.on_swap is not None:
+            self.on_swap(version)
+        return True
+
+    def _drain(self) -> None:
+        try:
+            # the hook consumes every emission, so this loop only DRIVES
+            # training; nothing crosses to host
+            for _ in self.ftrl_op.timed_batches():
+                pass
+        except BaseException as e:   # surfaced via join()
+            self.error = e
+
+    def start(self) -> "DeviceWeightsFeeder":
+        self._thread.start()
+        return self
+
+    def run(self) -> int:
+        """Drain synchronously on the caller's thread; returns the swap
+        count."""
+        self._drain()
+        if self.error is not None:
+            raise self.error
+        return len(self.versions)
+
+    def join(self, timeout: Optional[float] = None) -> int:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError(
+                f"DeviceWeightsFeeder still draining after {timeout}s "
+                f"({len(self.versions)} swaps so far)")
+        if self.error is not None:
+            raise self.error
+        return len(self.versions)

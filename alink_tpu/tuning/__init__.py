@@ -1,0 +1,45 @@
+"""Mesh-parallel hyperparameter tuning sweeps (ROADMAP item 3).
+
+The reference platform's tuning layer (``BaseTuning.findBestCV`` /
+``kFoldCv``, ``ParamGrid``, ``PipelineCandidatesGrid``) enumerates the
+candidate grid and trains candidates SEQUENTIALLY as separate Flink
+jobs; our ``pipeline/tuning.py`` port inherited that shape — N serial
+``exec`` calls, each paying the full dispatch floor, with the mesh idle
+along the candidate axis.
+
+This package turns the whole sweep into ONE compiled BSP program:
+
+* :mod:`.plan` — ``SweepPlan`` classifies every swept parameter as
+  *carry-resident* (step size, regularization, tolerance, k-means init
+  seed — stacked into a ``(points,)`` lane and swept inside one
+  program) or *trace-shaping* (method, history, k, dtype — distinct
+  program geometry, its own compile group), and ``AshaConfig`` holds
+  the successive-halving schedule (Li et al., MLSys 2020).
+* :mod:`.sweep` — the executor: per-point kernels that mirror the
+  serial optimizer/kmeans supersteps op-for-op under a fixed-order
+  ``lax.map`` points lane (per-point shapes equal the serial program's
+  shapes, so per-point results are BITWISE identical to serial fits —
+  the PR 10/11 strict-reduction discipline applied at the population
+  level), driven through the engine's existing chunked while-loop so
+  checkpoint/resume and async snapshots cover the whole population,
+  with ASHA pruning flipping a carry-resident alive mask at chunk
+  boundaries (geometry constant: pruning can never recompile).
+
+``ALINK_TPU_SWEEP=1`` routes ``GridSearchCV`` / ``GridSearchTVSplit``
+through this engine when every grid axis is carry-resident for a
+supported estimator; every fallback is recorded
+(``alink_sweep_fallback_total`` + one RuntimeWarning per reason) so a
+silently-serial sweep is impossible. See ``docs/tuning.md``.
+"""
+
+from .plan import (AshaConfig, CARRY_RESIDENT, TRACE_SHAPING, SweepPlan,
+                   classify_param)
+from .sweep import (SweepResult, record_sweep_fallback, sweep_enabled,
+                    sweep_eta, sweep_kmeans, sweep_optimize, sweep_rung)
+
+__all__ = [
+    "AshaConfig", "CARRY_RESIDENT", "TRACE_SHAPING", "SweepPlan",
+    "classify_param", "SweepResult", "record_sweep_fallback",
+    "sweep_enabled", "sweep_eta", "sweep_kmeans", "sweep_optimize",
+    "sweep_rung",
+]

@@ -2312,6 +2312,98 @@ def quick_serve_hot_swap(h: Harness):
     return _bench_serve_hot_swap(h, requests_per_phase=1_500)
 
 
+def _tuning_sweep_row(h: Harness, n_rows, d, iters, P, rung, eta, reps):
+    """Mesh-parallel tuning sweep (ROADMAP item 3): N hyperparameter
+    points as ONE BSP program with ASHA early stopping, measured against
+    the reference-shaped serial candidate loop (N full ``optimize()``
+    execs — each its own compiled program, prepare, dispatch and fetch).
+    The l2-ladder fixture keeps the loss ranking rung-stable, so 'equal
+    best-point quality' is CHECKED, not assumed: the ASHA winner must be
+    the serial grid's argmin AND its model bitwise-equal to that point's
+    serial fit. The serial leg times cache-hit execs only (the N
+    per-candidate compiles the sweep also eliminates stay OUTSIDE the
+    timing — the speedup is conservative). Legs interleave per rep so
+    rig load drift charges both sides."""
+    from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                         UnaryLossObjFunc)
+    from alink_tpu.operator.common.optim.optimizers import (OptimParams,
+                                                            optimize)
+    from alink_tpu.tuning import AshaConfig, sweep_optimize
+    from alink_tpu.common.profiling2 import measured_region
+    rng = np.random.RandomState(0)
+    X = rng.randn(n_rows, d)
+    y = np.sign(X @ rng.randn(d) + 0.3 * rng.randn(n_rows))
+    data = {"X": X, "y": y, "w": np.ones(n_rows)}
+    obj = UnaryLossObjFunc(LogLossFunc(), d)
+    base = OptimParams(method="LBFGS", max_iter=iters, epsilon=0.0)
+    l2s = [0.0] + [float(3e-4 * (1.45 ** i)) for i in range(P - 1)]
+    pts = [{"l2": l2} for l2 in l2s]
+    asha = AshaConfig(rung=rung, eta=eta)
+
+    def serial():
+        outs = []
+        for pt in pts:
+            o = UnaryLossObjFunc(LogLossFunc(), d, l2=pt["l2"])
+            coef, curve, _ = optimize(o, data, OptimParams(
+                method="LBFGS", max_iter=iters, epsilon=0.0), h.env)
+            outs.append((np.asarray(coef), np.asarray(curve)))
+        return outs
+
+    def sweep():
+        return sweep_optimize(obj, data, base, pts, env=h.env, asha=asha)
+
+    s_out = serial()        # warmup: compiles (one per candidate!) stay
+    res = sweep()           # outside the timed legs, both sides
+    res_full = sweep_optimize(obj, data, base, pts, env=h.env)  # no ASHA
+    ts_serial, ts_sweep = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        with measured_region():
+            serial()
+        ts_serial.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        with measured_region():
+            res = sweep()
+        ts_sweep.append(time.perf_counter() - t0)
+    t_serial = sorted(ts_serial)[len(ts_serial) // 2]
+    t_sweep = sorted(ts_sweep)[len(ts_sweep) // 2]
+    t0 = time.perf_counter()
+    res_full = sweep_optimize(obj, data, base, pts, env=h.env)
+    t_full = time.perf_counter() - t0
+    finals = [c[-1] for _, c in s_out]
+    serial_best = int(np.argmin(finals))
+    parity_all = all(
+        np.array_equal(s_out[i][0], res_full.values["coef"][i])
+        for i in range(P))
+    parity_winner = np.array_equal(s_out[res.best][0],
+                                   res.values["coef"][res.best])
+    return {
+        # the shared rate column: candidate points tuned per second
+        # through the ASHA sweep (bench_history labels it points/s)
+        "samples_per_sec_per_chip": round(P / t_sweep / h.chips, 2),
+        "points": P, "iters": iters, "dt_s": round(t_sweep, 3),
+        "serial_s": round(t_serial, 3),
+        "speedup_vs_serial": round(t_serial / t_sweep, 2),
+        "sweep_full_speedup": round(t_serial / max(t_full, 1e-9), 2),
+        "rungs": len(res.rungs), "rung_every": rung, "eta": eta,
+        "pruned_fraction": round(1.0 - float(res.alive.sum()) / P, 3),
+        "winner_match": bool(res.best == serial_best),
+        # bitwise contract: EVERY point of the full (no-ASHA) sweep
+        # equals its serial fit; the ASHA winner equals its serial fit
+        "parity": "bitwise" if (parity_all and parity_winner)
+                  else "MISMATCH",
+        "compiled_programs": int(res.programs),
+    }
+
+
+def bench_tuning_sweep(h: Harness):
+    return _tuning_sweep_row(h, 4000, 32, 100, 24, rung=5, eta=5, reps=3)
+
+
+def quick_tuning_sweep(h: Harness):
+    return _tuning_sweep_row(h, 4000, 32, 100, 24, rung=5, eta=5, reps=2)
+
+
 QUICK_WORKLOADS = (("logreg_criteo", quick_logreg),
                    ("logreg_ckpt", quick_logreg_ckpt),
                    ("kmeans_iris", quick_kmeans),
@@ -2319,6 +2411,7 @@ QUICK_WORKLOADS = (("logreg_criteo", quick_logreg),
                    ("ftrl_stream_drain", quick_ftrl_drain),
                    ("gbdt_hist_fused", quick_gbdt_hist),
                    ("logreg_from_disk", quick_from_disk),
+                   ("tuning_sweep", quick_tuning_sweep),
                    ("serve_logreg", quick_serve_logreg),
                    ("serve_ftrl_hot_swap", quick_serve_hot_swap),
                    ("serve_logreg_sharded", quick_serve_sharded))
@@ -2430,6 +2523,7 @@ def main(argv=None):
                      ("gbdt_adult_large", bench_gbdt_large),
                      ("als_movielens", bench_als),
                      ("als_movielens_large", bench_als_large),
+                     ("tuning_sweep", bench_tuning_sweep),
                      ("serve_logreg", bench_serve_logreg),
                      ("serve_ftrl_hot_swap", bench_serve_hot_swap),
                      ("serve_logreg_sharded", bench_serve_sharded))

@@ -320,3 +320,64 @@ class TestBenchHistory:
                    {"logreg_criteo": 456.0})
         assert history.main([r01, b]) == 0
         assert "logreg_criteo" in capsys.readouterr().out
+
+
+class TestDoctorSweepVerdict:
+    def _row(self, **over):
+        row = {"samples_per_sec_per_chip": 95.8, "points": 24,
+               "iters": 100, "dt_s": 0.25, "serial_s": 1.7,
+               "speedup_vs_serial": 6.6, "sweep_full_speedup": 1.4,
+               "rungs": 19, "rung_every": 5, "eta": 5,
+               "pruned_fraction": 0.958, "winner_match": True,
+               "parity": "bitwise", "compiled_programs": 1}
+        row.update(over)
+        return row
+
+    def _render(self, doctor, row):
+        doc = doctor.diagnose(
+            {"workloads": {"tuning_sweep": row},
+             "rig": {"dispatch_gap_est_s": 0.001, "peak_tflops": 1.0,
+                     "peak_hbm_gbps": 1.0}}, None, None, 1.0, 1.0)
+        return doc, doctor.render(doc)
+
+    def test_healthy_verdict(self, doctor):
+        doc, text = self._render(doctor, self._row())
+        assert doc["tuning"][0]["fixes"] == []
+        assert "tuning sweep: tuning_sweep" in text
+        assert "6.6x the serial candidate loop" in text
+        assert "96% pruned" in text
+        assert "winner MATCHES serial grid" in text
+        assert "per-point parity bitwise" in text
+        # the sweep row never enters the generic capture-window section
+        assert all(v["workload"] != "tuning_sweep"
+                   for v in doc["workloads"])
+
+    def test_fix_lines_name_the_problem(self, doctor):
+        doc, text = self._render(doctor, self._row(
+            parity="MISMATCH", winner_match=False,
+            speedup_vs_serial=1.2, compiled_programs=24))
+        fixes = "\n".join(doc["tuning"][0]["fixes"])
+        assert "CRITICAL" in fixes and "bitwise" in fixes
+        assert "ALINK_TPU_SWEEP_RUNG" in fixes
+        assert "alink_sweep_fallback_total" in fixes
+        assert "trace-shaping" in fixes
+        assert "fix 1:" in text
+
+    def test_bench_history_labels_points_per_sec(self, history):
+        assert history._display_name("tuning_sweep") == \
+            "tuning_sweep (points/s)"
+        assert history._display_name("serve_logreg") == \
+            "serve_logreg (qps)"
+
+    def test_bench_compare_labels_points_per_sec(self, history):
+        import importlib
+        bc = importlib.import_module("tools.bench_compare")
+        rows = [{"workload": "tuning_sweep", "old": 50.0, "new": 95.0,
+                 "delta_pct": 90.0}]
+        text = bc.render(rows, "a.json", "b.json")
+        assert "tuning_sweep (points/s)" in text
+        # the two gate tools must label rows identically (unit parity)
+        for name in ("tuning_sweep", "serve_logreg",
+                     "serve_logreg_sharded", "serve_logreg_p99inv",
+                     "logreg_criteo"):
+            assert bc._display_name(name) == history._display_name(name)

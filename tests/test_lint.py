@@ -555,7 +555,7 @@ class TestGeneratedDocs:
         rows = FLAGS.doc_rows()
         assert {r["section"] for r in rows} == {
             "observability", "performance", "durability", "debug", "io",
-            "bench", "serving"}
+            "bench", "serving", "tuning"}
         by_name = {r["name"]: r for r in rows}
         assert by_name["ALINK_TPU_DONATE"]["folds"] == \
             "program_cache, step_lru"

@@ -100,7 +100,19 @@ def _fmt_sps(v: Optional[float]) -> str:
     return f"{v:,.1f}" if v is not None else "-"
 
 
+def _display_name(name: str) -> str:
+    """Rows whose rate is not samples/sec get their unit called out.
+    ONE implementation serves both gate tools: this delegates to
+    tools/bench_history.py, so the compare table and the history table
+    can never label the same row differently."""
+    if ROOT not in sys.path:
+        sys.path.insert(0, ROOT)   # script invocation from elsewhere
+    from tools.bench_history import _display_name as _impl
+    return _impl(name)
+
+
 def render(rows: List[dict], old_path: str, new_path: str) -> str:
+    rows = [{**r, "workload": _display_name(r["workload"])} for r in rows]
     out = [f"bench compare: {os.path.basename(old_path)} -> "
            f"{os.path.basename(new_path)}  (samples/sec/chip)"]
     headers = ["workload", "old", "new", "delta"]

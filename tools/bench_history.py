@@ -210,6 +210,10 @@ def _display_name(name: str) -> str:
     gate row is a reciprocal latency, called out explicitly."""
     if name.endswith("_p99inv"):
         return f"{name} (1/p99 s)"
+    if name == "tuning_sweep":
+        # the sweep row's rate is candidate points tuned per second
+        # through the ASHA sweep engine (ISSUE 12)
+        return f"{name} (points/s)"
     if name.startswith("serve_") and name.endswith("_sharded"):
         # multi-chip serving rows report per-chip throughput at the
         # widest measured mesh (ISSUE 11)

@@ -140,6 +140,11 @@ def _workload_entries(bench: Optional[Dict[str, Any]],
             # attribution sees only their host side and would render a
             # misleading all-host bar
             continue
+        if str(name) == "tuning_sweep":
+            # the sweep row times two interleaved legs (serial grid +
+            # swept program) — it gets its own verdict section; a merged
+            # capture-window bar would attribute both legs as one
+            continue
         row = rows.get(name, {})
         attr = row.get("profile") or prof_wl.get(name)
         if attr:
@@ -363,6 +368,65 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
     return out
 
 
+def _sweep_verdicts(bench: Optional[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+    """The ``tuning_sweep`` row's verdict: points/s vs the serial
+    candidate loop, the rung schedule and pruned fraction, and named
+    fixes when the sweep fell back to serial economics, mispicked the
+    winner, or broke the bitwise per-point contract."""
+    rows = ((bench or {}).get("workloads") or {})
+    out: List[Dict[str, Any]] = []
+    for name, row in rows.items():
+        if str(name) != "tuning_sweep" or not isinstance(row, dict):
+            continue
+        if "error" in row:
+            out.append({"workload": name, "error": row["error"]})
+            continue
+        fixes: List[str] = []
+        if row.get("parity") == "MISMATCH":
+            fixes.append(
+                "CRITICAL: per-point sweep results are NOT bitwise-"
+                "identical to serial fits — the points-lane kernel "
+                "drifted from the serial stage code (alink_tpu/tuning/"
+                "sweep.py mirrors operator/common/optim/optimizers.py "
+                "op-for-op; re-run tests/test_sweep.py)")
+        if row.get("winner_match") is False:
+            fixes.append(
+                "ASHA picked a different winner than the full serial "
+                "grid: the rung schedule prunes on a loss ranking that "
+                "flips later — lengthen the rung period "
+                "(ALINK_TPU_SWEEP_RUNG) or soften the reduction "
+                "(ALINK_TPU_SWEEP_ETA)")
+        speed = row.get("speedup_vs_serial")
+        if speed is not None and speed < 2.0:
+            fixes.append(
+                f"the sweep barely beats the serial loop ({speed}x): "
+                f"it fell back to serial economics — check "
+                f"alink_sweep_fallback_total (every decline names its "
+                f"reason: unsupported-estimator / trace-shaping-axis / "
+                f"unsupported-evaluator), deepen the rung schedule, or "
+                f"grow the population so pruning has leverage")
+        progs = row.get("compiled_programs")
+        pts = row.get("points")
+        if progs is not None and pts and progs >= pts:
+            fixes.append(
+                f"{progs} compiled programs for {pts} points: every "
+                f"point became its own compile group — the swept axes "
+                f"are trace-shaping; move the grid onto carry-resident "
+                f"axes (learning_rate/epsilon/l1/l2/tol/seed)")
+        out.append({
+            "workload": name,
+            "points_per_sec": row.get("samples_per_sec_per_chip"),
+            "speedup_vs_serial": speed,
+            "sweep_full_speedup": row.get("sweep_full_speedup"),
+            "points": pts, "rungs": row.get("rungs"),
+            "pruned_fraction": row.get("pruned_fraction"),
+            "compiled_programs": progs,
+            "winner_match": row.get("winner_match"),
+            "parity": row.get("parity"), "fixes": fixes})
+    return out
+
+
 def diagnose(bench: Optional[Dict[str, Any]],
              profile: Optional[Dict[str, Any]],
              metrics: Optional[Dict[str, Any]],
@@ -402,6 +466,9 @@ def diagnose(bench: Optional[Dict[str, Any]],
     serving = _serve_verdicts(bench, metrics)
     if serving:
         doc["serving"] = serving
+    sweeps = _sweep_verdicts(bench)
+    if sweeps:
+        doc["tuning"] = sweeps
     if profile:
         doc["hbm"] = profile.get("hbm") or []
         if profile.get("donation"):
@@ -511,6 +578,32 @@ def render(doc: Dict[str, Any]) -> str:
         if not v.get("fixes"):
             out.append("  verdict: healthy — batches fill, programs "
                        "cache-hit, no failed/torn requests")
+    for v in doc.get("tuning", []):
+        out.append(f"\n== tuning sweep: {v['workload']} ==")
+        if v.get("error"):
+            out.append(f"  ERROR: {v['error']}")
+            continue
+        line = f"  {v.get('points_per_sec')} points/s"
+        if v.get("speedup_vs_serial") is not None:
+            line += (f" ({v['speedup_vs_serial']}x the serial candidate "
+                     f"loop with ASHA; {v.get('sweep_full_speedup')}x "
+                     f"full-depth)")
+        out.append(line)
+        bits = [f"{v.get('points')} points",
+                f"{v.get('compiled_programs')} compiled program(s)",
+                f"{v.get('rungs')} rungs"]
+        if v.get("pruned_fraction") is not None:
+            bits.append(f"{v['pruned_fraction']:.0%} pruned")
+        bits.append(f"winner {'MATCHES' if v.get('winner_match') else 'DIFFERS from'} serial grid")
+        if v.get("parity"):
+            bits.append(f"per-point parity {v['parity']}")
+        out.append("  " + ", ".join(bits))
+        for i, fx in enumerate(v.get("fixes") or [], 1):
+            out.append(f"  fix {i}: {fx}")
+        if not v.get("fixes"):
+            out.append("  verdict: healthy — one program per compile "
+                       "group, deterministic pruning, serial-bitwise "
+                       "per-point results")
     hbm = doc.get("hbm")
     if hbm is not None:
         out.append("\n== HBM (live device buffers) ==")

@@ -100,6 +100,8 @@ FLAG_TABLE_TARGETS = {
         ("observability",),
     os.path.join("docs", "serving.md"):
         ("serving",),
+    os.path.join("docs", "tuning.md"):
+        ("tuning",),
 }
 
 

@@ -94,6 +94,12 @@ def default_config() -> LintConfig:
         # here must be key-neutral or declared
         FactoryRoot("alink_tpu/serving/sharded.py",
                     "make_linear_device_fns", frozenset({_PC})),
+        # the tuning sweep's program factory (ISSUE 12): one compiled
+        # BSP program per compile group, keyed through the engine cache
+        # — ALINK_TPU_SWEEP folds into the sweep program key, the ASHA
+        # knobs are key-neutral host boundary pruning
+        FactoryRoot("alink_tpu/tuning/sweep.py",
+                    "_run_sweep_queue", frozenset({_PC})),
     ]
     roots += [FactoryRoot(_FTRL, f, frozenset({_LRU}))
               for f in ftrl_factories]
@@ -113,6 +119,7 @@ def default_config() -> LintConfig:
             "alink_tpu/operator/common/*",
             "alink_tpu/operator/stream/onlinelearning/*",
             "alink_tpu/serving/*",
+            "alink_tpu/tuning/*",
             "alink_tpu/common/profiling.py",
             "alink_tpu/common/health.py",
         ),

@@ -41,6 +41,14 @@ python tools/scaling_evidence.py --smoke
 # (its own code) so a multi-chip-serving regression names itself.
 python tools/serve_shard_bench.py --smoke
 
+# tuning-sweep smoke (ISSUE 12): a small grid through BOTH paths —
+# every sweep point bitwise vs its serial fit, full+ASHA winner
+# identical to the serial grid's, deterministic rungs, ONE compiled
+# program per carry-resident group, and the ASHA sweep not slower than
+# the serial loop. Exits 6 (its own code) so a sweep regression names
+# itself.
+python tools/sweep_smoke.py
+
 BASE=${PERF_GATE_BASE:-BENCH_quick_base.json}
 NEW=BENCH_quick.json
 THRESH=${PERF_GATE_THRESHOLD:-30}

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Tuning-sweep smoke (perf_gate leg, ISSUE 12) — exit 6 on failure.
+
+A small grid runs through BOTH paths:
+
+  * serial — N full ``optimize()`` execs (the reference-shaped
+    candidate loop);
+  * sweep  — ONE compiled BSP program over the ``(points,)`` lane,
+    full-depth for the parity checks, plus an ASHA run for the
+    early-stopping checks.
+
+Asserted (the load-bearing sweep contracts, cheap enough for every
+gate run):
+  1. per-point BITWISE parity: every full-sweep point equals its serial
+     fit (coef + executed step count);
+  2. best-point identity: the full sweep's argmin-loss winner is the
+     serial grid's winner, and the ASHA run keeps that same winner with
+     a bitwise-equal model;
+  3. determinism: two ASHA runs produce identical survivors and rungs;
+  4. compile-group invariant: ONE compiled program serves the whole
+     carry-resident grid (engine cache misses == 1 for the first run,
+     0 for the repeat);
+  5. speedup sanity: the ASHA sweep is not slower than the serial loop
+     (the real >=5x claim is the bench row's; the gate only catches a
+     sweep that fell back to serial economics).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+EXIT = 6
+_MARK = "ALINK_SWEEP_SMOKE_CHILD"
+
+
+def main() -> int:
+    if os.environ.get(_MARK) != "1":
+        # re-exec in a fresh interpreter on a 4-virtual-device f64 mesh
+        # (bootenv.cpu_mesh_env — XLA device-count flags latch at
+        # backend init, so the parent process cannot widen its own mesh)
+        import bootenv
+        env = bootenv.cpu_mesh_env(4)
+        env[_MARK] = "1"
+        env["JAX_ENABLE_X64"] = "1"
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             cwd=ROOT, env=env, timeout=900)
+        return out.returncode
+    from alink_tpu.common.mlenv import MLEnvironmentFactory
+    from alink_tpu.engine.comqueue import program_cache_stats
+    from alink_tpu.operator.common.optim.objfunc import (LogLossFunc,
+                                                         UnaryLossObjFunc)
+    from alink_tpu.operator.common.optim.optimizers import (OptimParams,
+                                                            optimize)
+    from alink_tpu.tuning import AshaConfig, sweep_optimize
+
+    env = MLEnvironmentFactory.get_default()
+    rng = np.random.RandomState(0)
+    n, d, iters = 2000, 16, 24
+    X = rng.randn(n, d)
+    y = np.sign(X @ rng.randn(d) + 0.3 * rng.randn(n))
+    data = {"X": X, "y": y, "w": np.ones(n)}
+    obj = UnaryLossObjFunc(LogLossFunc(), d)
+    base = OptimParams(method="LBFGS", max_iter=iters, epsilon=0.0)
+    l2s = [0.0] + [float(1e-3 * (2.2 ** i)) for i in range(8)]
+    pts = [{"l2": l2} for l2 in l2s]
+    asha = AshaConfig(rung=3, eta=3)
+    bad = []
+
+    serial = []
+    t0 = time.perf_counter()
+    for pt in pts:
+        o = UnaryLossObjFunc(LogLossFunc(), d, l2=pt["l2"])
+        coef, curve, steps = optimize(o, data, OptimParams(
+            method="LBFGS", max_iter=iters, epsilon=0.0), env)
+        serial.append((np.asarray(coef), np.asarray(curve), int(steps)))
+    t_serial_cold = time.perf_counter() - t0
+
+    miss0 = program_cache_stats()["misses"]
+    full = sweep_optimize(obj, data, base, pts, env=env)
+    miss1 = program_cache_stats()["misses"]
+    sweep_optimize(obj, data, base, pts, env=env)
+    miss2 = program_cache_stats()["misses"]
+
+    # 1. per-point bitwise parity
+    for i in range(len(pts)):
+        if not np.array_equal(serial[i][0], full.values["coef"][i]):
+            bad.append(f"point {i} (l2={pts[i]['l2']}): sweep coef != "
+                       f"serial fit (bitwise)")
+        if serial[i][2] != int(full.steps[i]):
+            bad.append(f"point {i}: step count {int(full.steps[i])} != "
+                       f"serial {serial[i][2]}")
+    # 2. best-point identity (full + ASHA)
+    serial_best = int(np.argmin([c[-1] for _, c, _ in serial]))
+    if full.best != serial_best:
+        bad.append(f"full-sweep winner {full.best} != serial winner "
+                   f"{serial_best}")
+    r1 = sweep_optimize(obj, data, base, pts, env=env, asha=asha)
+    if r1.best != serial_best:
+        bad.append(f"ASHA winner {r1.best} != serial winner {serial_best}")
+    elif not np.array_equal(serial[r1.best][0],
+                            r1.values["coef"][r1.best]):
+        bad.append("ASHA winning model is not bitwise-equal to its "
+                   "serial fit")
+    # 3. determinism
+    r2 = sweep_optimize(obj, data, base, pts, env=env, asha=asha)
+    if r1.survivors() != r2.survivors() or r1.rungs != r2.rungs:
+        bad.append(f"ASHA not deterministic: survivors "
+                   f"{r1.survivors()} vs {r2.survivors()}")
+    # 4. one compiled program per compile group
+    if miss1 - miss0 != 1:
+        bad.append(f"full sweep compiled {miss1 - miss0} programs for "
+                   f"one carry-resident group (want 1)")
+    if miss2 - miss1 != 0:
+        bad.append(f"repeat sweep missed the program cache "
+                   f"({miss2 - miss1} new compiles)")
+    # 5. speedup sanity (warm serial vs warm ASHA sweep)
+    t0 = time.perf_counter()
+    for pt in pts:
+        o = UnaryLossObjFunc(LogLossFunc(), d, l2=pt["l2"])
+        coef, _c, _s = optimize(o, data, OptimParams(
+            method="LBFGS", max_iter=iters, epsilon=0.0), env)
+        np.asarray(coef)
+    t_serial = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    sweep_optimize(obj, data, base, pts, env=env, asha=asha)
+    t_sweep = time.perf_counter() - t0
+    speedup = t_serial / max(t_sweep, 1e-9)
+    if speedup < 1.0:
+        bad.append(f"ASHA sweep SLOWER than the serial loop "
+                   f"({speedup:.2f}x) — serial economics")
+
+    if bad:
+        print("sweep_smoke: FAILED:", file=sys.stderr)
+        for b in bad:
+            print(f"  {b}", file=sys.stderr)
+        return EXIT
+    print(f"sweep_smoke: ok — {len(pts)} points bitwise vs serial, "
+          f"winner {serial_best} identical (full + ASHA), deterministic "
+          f"rungs {[(r['step'], r['alive_after']) for r in r1.rungs]}, "
+          f"1 compiled program, ASHA {speedup:.2f}x the serial loop "
+          f"(cold serial leg paid {t_serial_cold:.1f}s for "
+          f"{len(pts)} per-candidate compiles the sweep never pays)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
