@@ -279,6 +279,32 @@ def _fused_hist_parse(raw: str) -> str:
     return "xla"
 
 
+def _ftrl_kernel_parse(raw: str) -> str:
+    """Normalize ``ALINK_TPU_FTRL_KERNEL``: falsy OR "xla" -> "off"
+    (the XLA gather/scatter IS the flag-off path, and the sibling
+    ``ALINK_TPU_FUSED_HIST`` taught users that "xla" names it); any
+    other truthy value -> "pallas". Backend gating stays with
+    ``kernels/ftrl.ftrl_kernel_mode``."""
+    v = raw.strip().lower()
+    return "off" if v in _FALSY or v == "xla" else "pallas"
+
+
+def _serve_dtype_parse(raw: str) -> str:
+    """Normalize ``ALINK_TPU_SERVE_DTYPE``: falsy -> "f32" (the full
+    ship precision); bf16/bfloat16 -> "bf16"; int8/i8 -> "int8";
+    f32/fp32/float32 -> "f32". Anything else refuses loudly — a typo'd
+    precision must not silently serve full-precision scores."""
+    v = raw.strip().lower()
+    if v in _FALSY or v in ("f32", "fp32", "float32"):
+        return "f32"
+    if v in ("bf16", "bfloat16"):
+        return "bf16"
+    if v in ("int8", "i8"):
+        return "int8"
+    raise ValueError(
+        f"ALINK_TPU_SERVE_DTYPE={raw!r}: want f32 | bf16 | int8")
+
+
 FLAGS = FlagRegistry()
 
 # -- observability ----------------------------------------------------------
@@ -394,10 +420,22 @@ FLAGS.register(
     accessor="alink_tpu.operator.common.tree.hist.fused_hist_mode")
 FLAGS.register(
     "ALINK_TPU_PALLAS_INTERPRET", "bool", False,
-    "run Pallas kernels in interpret mode off-TPU (tests/CI)",
+    "run Pallas kernels in interpret mode off-TPU (tests/CI) — the "
+    "availability gate of the whole kernel tier (kernels/runtime.py)",
     "performance",
-    key_neutral="only shifts the RESOLVED fused-hist mode, and the "
-                "resolved mode is what folds into the program-cache key")
+    key_neutral="only shifts the RESOLVED kernel modes (fused-hist, "
+                "FTRL kernel, fused serve), and the resolved modes are "
+                "what fold into the program/step/serving cache keys",
+    accessor="alink_tpu.kernels.runtime.pallas_interpret")
+FLAGS.register(
+    "ALINK_TPU_FTRL_KERNEL", "mode", "off",
+    "Pallas FTRL kernel tier: off | pallas — VMEM-resident (z, n) "
+    "state gather / duplicate-safe scatter-add in the per-sample and "
+    "staleness step programs, triangular chained-correction matvec in "
+    "the chained step program", "performance",
+    folds_into=frozenset({STEP_LRU, CHECKPOINT_SIGNATURE}),
+    parser=_ftrl_kernel_parse,
+    accessor="alink_tpu.kernels.ftrl.ftrl_kernel_mode")
 
 # -- serving ----------------------------------------------------------------
 # The compiled serving tier's program cache keys on (model signature,
@@ -474,6 +512,30 @@ FLAGS.register(
                 "is device-independent host routing",
     clamp=lambda n: max(0, n),
     accessor="alink_tpu.serving.sharded.serve_replicas")
+FLAGS.register(
+    "ALINK_TPU_SERVE_FUSED", "bool", False,
+    "fused Pallas serving score kernel for linear bucket programs: "
+    "encode-gather -> dot -> link in one kernel, no intermediate HBM "
+    "round-trip (TPU or ALINK_TPU_PALLAS_INTERPRET=1; demotions "
+    "recorded via alink_serve_fallback_total)", "serving",
+    key_neutral="the RESOLVED fused mode rides the ServingKernel "
+                "signature, which leads every serving program-cache "
+                "key — a toggle compiles new programs, never reuses a "
+                "stale one (tests/test_kernels.py pins the miss)",
+    accessor="alink_tpu.kernels.serve.serve_fused_requested")
+FLAGS.register(
+    "ALINK_TPU_SERVE_DTYPE", "mode", "f32",
+    "serving score precision: f32 (full ship precision) | bf16 "
+    "(bf16 terms, f32 accumulation) | int8 (symmetric per-model "
+    "weight quantization with a stored scale, f32 accumulation); "
+    "parity gate is bitwise for f32, label-exact + pinned-tolerance "
+    "for bf16/int8", "serving",
+    key_neutral="the resolved dtype rides the ServingKernel signature, "
+                "which leads every serving program-cache key — a "
+                "toggle compiles new programs, never reuses a stale "
+                "one (tests/test_kernels.py pins the miss)",
+    parser=_serve_dtype_parse,
+    accessor="alink_tpu.kernels.serve.serve_dtype")
 FLAGS.register(
     "ALINK_TPU_SERVE_SWAP", "mode", "double",
     "hot model-swap mode: double (standby slot prepared off the serving "

@@ -1,0 +1,27 @@
+"""alink_tpu.kernels — the hand-written Pallas kernel tier (ISSUE 13).
+
+The SURVEY's stated target stack is "JAX/XLA/pjit/pallas"; this package
+hosts the hand-written kernels for the dispatch-floor holdouts plus the
+ONE availability/demotion contract they all ride (``runtime``):
+
+* ``runtime``  — availability (TPU or ``ALINK_TPU_PALLAS_INTERPRET=1``),
+  one-time-warn demotion, eager shape-class probing;
+* ``ftrl``     — the sparse FTRL state gather / duplicate-safe
+  scatter-add kernels (VMEM-resident (z, n) slot tiles) and the
+  chained-correction triangular matvec (``ALINK_TPU_FTRL_KERNEL``);
+* ``serve``    — the fused encode-gather -> dot -> link serving score
+  kernel (``ALINK_TPU_SERVE_FUSED``) and the opt-in bf16/int8
+  low-precision score path (``ALINK_TPU_SERVE_DTYPE``).
+
+Every kernel is parity-pinned against its XLA path (bitwise where the
+contract demands it, pinned tolerance where association differs) and
+every flag-off path lowers byte-identically to pre-kernel-tier
+programs — see tests/test_kernels.py and docs/performance.md
+"Pallas kernel tier".
+"""
+
+from .runtime import (demote_once, eager_probe, interpret_mode,
+                      pallas_available, pallas_interpret, reset_demotions)
+
+__all__ = ["demote_once", "eager_probe", "interpret_mode",
+           "pallas_available", "pallas_interpret", "reset_demotions"]

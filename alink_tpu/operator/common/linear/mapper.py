@@ -142,6 +142,21 @@ class LinearModelMapper(ModelMapper):
             from ....serving.sharded import LANE_PAD
             dim8 = -(-dim // LANE_PAD) * LANE_PAD
 
+        # Pallas kernel tier (ISSUE 13): resolve the fused-score and
+        # low-precision requests ONCE per kernel build. The resolved
+        # (dtype, fused) pair rides the SIGNATURE — the serving
+        # program-cache key leads with it, so a flag toggle compiles
+        # new programs and can never reuse a stale one; every demotion
+        # (softmax, backend, probe) is recorded via
+        # record_serve_fallback before this returns (False, "f32")
+        from ....kernels.serve import (lowp_model_arrays,
+                                       make_linear_score_fns,
+                                       resolve_serve_kernel)
+        fused, sdtype = resolve_serve_kernel(type(self).__name__, dim8,
+                                             ship_dt,
+                                             supported=not softmax)
+        signature = signature + (sdtype, bool(fused))
+
         def encode(data: MTable, bucket: int):
             design = extract_design(data, m.feature_names, m.vector_col,
                                     ship_dt, vector_size=m.vector_size)
@@ -199,6 +214,15 @@ class LinearModelMapper(ModelMapper):
                 w, b = mdl
                 return _seq_chunk_sum(val * w[idx], axis=1) + b
         device_fns = {"dense": _dense, "sparse": _sparse}
+        if fused or sdtype != "f32":
+            # the kernel-tier score fns replace the inline ones ONLY
+            # when a flag is on: the (off, f32) default executes the
+            # statements above verbatim, keeping the flag-off lowered
+            # HLO byte-identical to pre-kernel-tier programs
+            if sdtype != "f32":
+                model_arrays = lowp_model_arrays(model_arrays[0],
+                                                 model_arrays[1], sdtype)
+            device_fns = make_linear_score_fns(fused, sdtype, ship_dt)
 
         def decode(outputs, data: MTable) -> MTable:
             scores = np.asarray(outputs[0])
@@ -208,10 +232,12 @@ class LinearModelMapper(ModelMapper):
                     axis=1)
             return self._finish(scores, data)
 
-        if softmax:
-            # the softmax kernel serves single-device (or replicated)
-            # only; a sharding request records a fallback and runs the
-            # unsharded programs
+        if softmax or fused or sdtype != "f32":
+            # single-device-only kernels: softmax has no sharded twin,
+            # and the fused/low-precision tier is single-device too —
+            # a sharding request on any of them records the standard
+            # no-sharded-kernel fallback (CompiledPredictor) and
+            # serves these programs unsharded
             return ServingKernel(signature=signature,
                                  model_arrays=model_arrays,
                                  encode=encode, device_fns=device_fns,

@@ -215,8 +215,6 @@ def level_hist(binned, stats, node_id, n_nodes: int, n_bins: int,
 # collective set (one psum per level, after the histogram) is identical
 # in every mode.
 
-import warnings as _warnings
-
 FUSED_HIST_ENV = "ALINK_TPU_FUSED_HIST"
 _PALLAS_WARNED = [False]
 
@@ -231,11 +229,13 @@ def fused_hist_mode() -> str:
     (common/flags.py — which also declares the program-cache-key fold);
     only the backend gating lives here. The RESOLVED mode is what the
     tree trainers fold into their program keys, so the interpret flag
-    needs no fold of its own."""
-    from ....common.flags import env_flag, flag_value
+    needs no fold of its own. The availability check is the kernel
+    tier's shared one (``kernels/runtime.pallas_available`` — the
+    ISSUE 13 dedupe of the contract this kernel pioneered)."""
+    from ....common.flags import flag_value
+    from ....kernels.runtime import pallas_available
     v = flag_value(FUSED_HIST_ENV)
-    if v == "pallas" and not (jax.default_backend() == "tpu"
-                              or env_flag("ALINK_TPU_PALLAS_INTERPRET")):
+    if v == "pallas" and not pallas_available():
         return "xla"
     return v
 
@@ -299,6 +299,7 @@ def _pallas_level_hist(binned, stats, node_id, n_nodes: int, n_bins: int):
         acc = jnp.dot(oh.T, s, preferred_element_type=jnp.float32)
         out_ref[...] += acc.reshape(1, n_nodes, n_bins, m)
 
+    from ....kernels.runtime import interpret_mode
     out = pl.pallas_call(
         kernel,
         grid=(F, npad // blk),
@@ -308,7 +309,7 @@ def _pallas_level_hist(binned, stats, node_id, n_nodes: int, n_bins: int):
         out_specs=pl.BlockSpec((1, n_nodes, n_bins, m),
                                lambda f, r: (f, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((F, n_nodes, n_bins, m), jnp.float32),
-        interpret=jax.default_backend() != "tpu",
+        interpret=interpret_mode(),
     )(binned, nid2, s32)
     return out.transpose(1, 0, 2, 3).astype(stats.dtype)
 
@@ -334,25 +335,24 @@ def _pallas_probe(n_nodes: int, n_bins: int, m: int) -> bool:
                 np.zeros((8, 1), np.int32), np.zeros((8, m), np.float32),
                 np.zeros((8,), np.int32), n_nodes, n_bins)
             np.asarray(out)              # force the eager compile+run
+        from ....kernels.runtime import demote_once, run_eagerly
         try:
-            # jax trace contexts are THREAD-LOCAL: the dispatch call site
+            # run_eagerly (kernels/runtime.py): the dispatch call site
             # sits inside the engine's shard_map/jit trace, where even
-            # concrete-input pallas_calls bind into the trace as tracers.
-            # A fresh thread is a genuinely eager context, so the probe
-            # really compiles+runs the kernel here and now.
-            import concurrent.futures
-            with concurrent.futures.ThreadPoolExecutor(1) as ex:
-                ex.submit(probe).result()
+            # concrete-input pallas_calls bind into the trace as
+            # tracers; a fresh thread is a genuinely eager context, so
+            # the probe really compiles+runs the kernel here and now.
+            run_eagerly(probe)
             ok = True
         except Exception as e:  # pragma: no cover - backend-specific
             ok = False
-            if not _PALLAS_WARNED[0]:
-                _PALLAS_WARNED[0] = True
-                _warnings.warn(
-                    f"ALINK_TPU_FUSED_HIST=pallas failed to compile at "
-                    f"level shape (n_nodes={n_nodes}, n_bins={n_bins}, "
-                    f"m={m}) ({type(e).__name__}: {e}); demoting to the "
-                    f"fused XLA formulation", RuntimeWarning)
+            demote_once(
+                "fused_hist", "probe-failed", gate=_PALLAS_WARNED,
+                message=f"ALINK_TPU_FUSED_HIST=pallas failed to compile "
+                        f"at level shape (n_nodes={n_nodes}, "
+                        f"n_bins={n_bins}, m={m}) "
+                        f"({type(e).__name__}: {e}); demoting to the "
+                        f"fused XLA formulation")
         _PALLAS_PROBED[key] = ok
     return ok
 
@@ -367,12 +367,12 @@ def _hist_dispatch(hist_mode, pre, binned, stats, node_id, n_nodes, n_bins):
             return _pallas_level_hist(binned, stats, node_id, n_nodes,
                                       n_bins)
         except Exception as e:  # pragma: no cover - backend-specific
-            if not _PALLAS_WARNED[0]:
-                _PALLAS_WARNED[0] = True
-                _warnings.warn(
-                    f"ALINK_TPU_FUSED_HIST=pallas failed to trace "
-                    f"({type(e).__name__}: {e}); demoting to the fused "
-                    f"XLA formulation", RuntimeWarning)
+            from ....kernels.runtime import demote_once
+            demote_once(
+                "fused_hist", "trace-failed", gate=_PALLAS_WARNED,
+                message=f"ALINK_TPU_FUSED_HIST=pallas failed to trace "
+                        f"({type(e).__name__}: {e}); demoting to the "
+                        f"fused XLA formulation")
     return level_hist(binned, stats, node_id, n_nodes, n_bins,
                       use_onehot=True, pre=pre)
 

@@ -136,8 +136,42 @@ def _ftrl_step_factory(mesh, alpha, beta, l1, l2, donate=False):
             jax.jit(weights_fn))
 
 
+def _state_kernels(kernel: str):
+    """The state gather / duplicate-safe scatter-add pair under the
+    RESOLVED FTRL kernel mode (``kernels/ftrl.py``, ISSUE 13).
+
+    ``"off"`` returns the verbatim XLA ops — routing through these
+    thunks stages the exact pre-kernel-tier primitive sequence, so the
+    flag-off lowered HLO stays byte-identical (tests/test_kernels.py).
+    ``"pallas"`` returns the VMEM-resident Pallas kernels, with an
+    eager shape-class probe at trace time: a probe failure demotes THIS
+    shape class to the XLA ops (one-time warning via
+    ``kernels/runtime.demote_once``) — bitwise-identical output either
+    way, so a demoted program can never poison the lru cache."""
+    if kernel == "pallas":
+        from ....kernels.ftrl import (gather_rows, probe_scatter,
+                                      scatter_add_rows)
+
+        def _gather(st, flat):
+            C = st.shape[1] if st.ndim > 1 else 1
+            if probe_scatter(st.shape[0], C, st.dtype):
+                return gather_rows(st, flat)
+            return st[flat]
+
+        def _scatter(st, flat, upd):
+            C = st.shape[1] if st.ndim > 1 else 1
+            if probe_scatter(st.shape[0], C, st.dtype):
+                return scatter_add_rows(st, flat, upd)
+            return st.at[flat].add(upd)
+
+        return _gather, _scatter
+    return (lambda st, flat: st[flat],
+            lambda st, flat, upd: st.at[flat].add(upd))
+
+
 @functools.lru_cache(maxsize=64)
-def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2, donate=False):
+def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2, donate=False,
+                              kernel="off"):
     """Sparse twin of :func:`_ftrl_step_factory` — O(nnz) per sample.
 
     The micro-batch arrives as padded COO ``idx/val`` of shape
@@ -162,6 +196,7 @@ def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2, donate=False):
         return _ftrl_weights(z, n, alpha, beta, l1, l2)
 
     K = 4   # samples per scan step (see chunking note below)
+    _sgather, _sscatter = _state_kernels(kernel)
 
     def shard_fn(idx, val, y, z, n):
         shard = z.shape[0]                    # block-local feature range
@@ -190,8 +225,10 @@ def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2, donate=False):
             xi, xv, yy = xvy                  # (K, w), (K, w), (K,)
             local = (xi >= lo) & (xi < lo + shard)
             li = jnp.clip(xi - lo, 0, shard - 1)
-            zs = jnp.where(local, z[li.reshape(-1)].reshape(K, w), 0.0)
-            ns = jnp.where(local, n[li.reshape(-1)].reshape(K, w), 0.0)
+            zs = jnp.where(local, _sgather(z, li.reshape(-1)).reshape(K, w),
+                           0.0)
+            ns = jnp.where(local, _sgather(n, li.reshape(-1)).reshape(K, w),
+                           0.0)
             dzs, dns, margins = [], [], []
             for k in range(K):
                 zk, nk = zs[k], ns[k]
@@ -217,8 +254,8 @@ def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2, donate=False):
                 dzs.append(jnp.where(local[k], g - sigma * wj, 0.0))
                 dns.append(jnp.where(local[k], g * g, 0.0))
                 margins.append(margin)
-            z = z.at[li.reshape(-1)].add(jnp.stack(dzs).reshape(-1))
-            n = n.at[li.reshape(-1)].add(jnp.stack(dns).reshape(-1))
+            z = _sscatter(z, li.reshape(-1), jnp.stack(dzs).reshape(-1))
+            n = _sscatter(n, li.reshape(-1), jnp.stack(dns).reshape(-1))
             return (z, n), jnp.stack(margins)
 
         (z, n), margins = jax.lax.scan(
@@ -235,7 +272,7 @@ def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2, donate=False):
 
 @functools.lru_cache(maxsize=64)
 def _ftrl_sparse_chained_step_factory(mesh, alpha, beta, l1, l2, K=16,
-                                      donate=False):
+                                      donate=False, kernel="off"):
     """Chained-correction strict FTRL — EXACT strict semantics at chunked
     throughput (``update_mode="chained"``).
 
@@ -280,6 +317,10 @@ def _ftrl_sparse_chained_step_factory(mesh, alpha, beta, l1, l2, K=16,
     def weights(z, n):
         return _ftrl_weights(z, n, alpha, beta, l1, l2)
 
+    _sgather, _sscatter = _state_kernels(kernel)
+    if kernel == "pallas":
+        from ....kernels.ftrl import chained_corr, chained_kernel_available
+
     def shard_fn(idx, val, y, z, n):
         shard = z.shape[0]
         lo = jax.lax.axis_index("d") * shard
@@ -289,6 +330,12 @@ def _ftrl_sparse_chained_step_factory(mesh, alpha, beta, l1, l2, K=16,
             idx = jnp.concatenate([idx, jnp.zeros((Bp - B, w), idx.dtype)])
             val = jnp.concatenate([val, jnp.zeros((Bp - B, w), val.dtype)])
             y = jnp.concatenate([y, jnp.zeros((Bp - B,), y.dtype)])
+        # resolved at the CANONICAL probe width, never per batch width:
+        # the chained checkpoint signature folds on exactly this
+        # predicate, and a width-dependent demotion would change the
+        # accumulation association mid-stream under one signature
+        use_tri = kernel == "pallas" and chained_kernel_available(
+            K, val.dtype)
 
         def body(carry, xvy):
             z, n = carry
@@ -296,8 +343,8 @@ def _ftrl_sparse_chained_step_factory(mesh, alpha, beta, l1, l2, K=16,
             local = (xi >= lo) & (xi < lo + shard)
             li = jnp.clip(xi - lo, 0, shard - 1)
             flat = li.reshape(-1)
-            zs = jnp.where(local, z[flat].reshape(K, w), 0.0)
-            ns = jnp.where(local, n[flat].reshape(K, w), 0.0)
+            zs = jnp.where(local, _sgather(z, flat).reshape(K, w), 0.0)
+            ns = jnp.where(local, _sgather(n, flat).reshape(K, w), 0.0)
             # collision tensor, built once per chunk in parallel (not on
             # the dependent chain)
             M = ((xi[:, None, :, None] == xi[None, :, None, :])
@@ -307,9 +354,17 @@ def _ftrl_sparse_chained_step_factory(mesh, alpha, beta, l1, l2, K=16,
             margins = []
             for k in range(K):
                 # HIGHEST: bf16 MXU rounding of the f32 deltas would
-                # break the exact-strict-semantics claim under collisions
-                corr = jnp.einsum("jab,jbc->ac", M[k], D,
-                                  precision=jax.lax.Precision.HIGHEST)
+                # break the exact-strict-semantics claim under collisions.
+                # The triangular Pallas kernel contracts over exactly the
+                # k live delta rows (rows j >= k are structurally zero —
+                # dead flops the dense einsum pays every sample) in full
+                # input precision; association-only difference, inside
+                # the pinned chained tolerance
+                if use_tri:
+                    corr = chained_corr(M[k], D, k)
+                else:
+                    corr = jnp.einsum("jab,jbc->ac", M[k], D,
+                                      precision=jax.lax.Precision.HIGHEST)
                 zk = zs[k] + corr[:, 0]
                 nk = ns[k] + corr[:, 1]
                 wk = jnp.where(local[k], weights(zk, nk), 0.0)
@@ -323,8 +378,8 @@ def _ftrl_sparse_chained_step_factory(mesh, alpha, beta, l1, l2, K=16,
                     [jnp.where(local[k], g - sigma * wk, 0.0),
                      jnp.where(local[k], g * g, 0.0)], axis=-1))
                 margins.append(margin)
-            z = z.at[flat].add(D[..., 0].reshape(-1))
-            n = n.at[flat].add(D[..., 1].reshape(-1))
+            z = _sscatter(z, flat, D[..., 0].reshape(-1))
+            n = _sscatter(n, flat, D[..., 1].reshape(-1))
             return (z, n), jnp.stack(margins)
 
         (z, n), margins = jax.lax.scan(
@@ -341,7 +396,7 @@ def _ftrl_sparse_chained_step_factory(mesh, alpha, beta, l1, l2, K=16,
 
 @functools.lru_cache(maxsize=64)
 def _ftrl_sparse_staleness_step_factory(mesh, alpha, beta, l1, l2, K,
-                                        donate=False):
+                                        donate=False, kernel="off"):
     """Bounded-staleness sparse FTRL — the reference's ACTUAL feedback-edge
     semantics, made explicit and measured.
 
@@ -373,6 +428,8 @@ def _ftrl_sparse_staleness_step_factory(mesh, alpha, beta, l1, l2, K,
     def weights(z, n):
         return _ftrl_weights(z, n, alpha, beta, l1, l2)
 
+    _sgather, _sscatter = _state_kernels(kernel)
+
     def shard_fn(idx, val, y, z, n):
         shard = z.shape[0]
         lo = jax.lax.axis_index("d") * shard
@@ -389,7 +446,7 @@ def _ftrl_sparse_staleness_step_factory(mesh, alpha, beta, l1, l2, K,
             local = (xi >= lo) & (xi < lo + shard)
             li = jnp.clip(xi - lo, 0, shard - 1)
             flat = li.reshape(-1)
-            s = zn[flat].reshape(K, w, 2)
+            s = _sgather(zn, flat).reshape(K, w, 2)
             zj = jnp.where(local, s[..., 0], 0.0)
             nj = jnp.where(local, s[..., 1], 0.0)
             wj = jnp.where(local, weights(zj, nj), 0.0)
@@ -401,8 +458,9 @@ def _ftrl_sparse_staleness_step_factory(mesh, alpha, beta, l1, l2, K,
             sigma = (jnp.sqrt(nj + g * g) - jnp.sqrt(nj)) / alpha
             dz = jnp.where(local, g - sigma * wj, 0.0)
             dn = jnp.where(local, g * g, 0.0)
-            zn = zn.at[flat].add(
-                jnp.stack([dz.reshape(-1), dn.reshape(-1)], axis=-1))
+            zn = _sscatter(zn, flat,
+                           jnp.stack([dz.reshape(-1), dn.reshape(-1)],
+                                     axis=-1))
             return zn, margins
 
         zn, margins = jax.lax.scan(
@@ -812,6 +870,31 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
             # CONDITIONALLY so pre-existing snapshots of the other modes
             # keep their exact signature and stay resumable
             ck_signature["chunk_size"] = chunk_size
+        # resolved Pallas kernel tier mode (ALINK_TPU_FTRL_KERNEL,
+        # kernels/ftrl.py): latched once per drain and passed into the
+        # sparse/staleness/chained factory lookups — it rides the lru
+        # key, so toggling never serves a stale step program
+        from ....kernels.ftrl import (chained_kernel_available,
+                                      ftrl_kernel_mode)
+        import jax as _jx
+        kern = ftrl_kernel_mode()
+        if update_mode == "chained" and kern == "pallas" \
+                and chained_kernel_available(
+                    chunk_size,
+                    np.float64 if _jx.config.jax_enable_x64
+                    else np.float32):
+            # the triangular correction kernel accumulates the SAME
+            # deltas in a different association than the dense einsum
+            # (last-ulp difference under collisions), so a chained
+            # resume refuses across the toggle. The fold resolves
+            # through the SAME memoized availability probe the step
+            # factory uses (canonical width, link-time ship dtype), so
+            # the signature always describes the arithmetic actually
+            # traced — a probe-demoted drain keeps the flag-off
+            # signature and its snapshots stay interchangeable with
+            # flag-off ones (they are the same numbers). Conditional,
+            # so every pre-existing snapshot keeps its exact signature
+            ck_signature["ftrl_kernel"] = "pallas"
         from ....engine.communication import fusion_enabled
         if update_mode == "chained" and fusion_enabled():
             # ALINK_TPU_FUSE_COLLECTIVES folds into the chained-mode
@@ -1336,7 +1419,7 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                       elif update_mode == "staleness":
                           sparse_step[0] = _ftrl_sparse_staleness_step_factory(
                               mesh, alpha, beta, l1, l2, staleness,
-                              donate=don)
+                              donate=don, kernel=kern)
                       elif update_mode == "chained":
                           # strict semantics through the chained-
                           # correction chunk kernel; dense rows keep the
@@ -1344,10 +1427,11 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                           # gather-bound — chunking buys nothing there)
                           sparse_step[0] = _ftrl_sparse_chained_step_factory(
                               mesh, alpha, beta, l1, l2, chunk_size,
-                              donate=don)
+                              donate=don, kernel=kern)
                       else:
                           sparse_step[0] = _ftrl_sparse_step_factory(
-                              mesh, alpha, beta, l1, l2, donate=don)
+                              mesh, alpha, beta, l1, l2, donate=don,
+                              kernel=kern)
                   z, n, mg = run_step(sparse_step[0], idx, val, y, z, n)
               if mon_on:
                   # progressive validation on the device scalars; real

@@ -34,12 +34,13 @@ silently-serial sweep is impossible. See ``docs/tuning.md``.
 
 from .plan import (AshaConfig, CARRY_RESIDENT, TRACE_SHAPING, SweepPlan,
                    classify_param)
-from .sweep import (SweepResult, record_sweep_fallback, sweep_enabled,
-                    sweep_eta, sweep_kmeans, sweep_optimize, sweep_rung)
+from .sweep import (FtrlSweepResult, SweepResult, record_sweep_fallback,
+                    sweep_enabled, sweep_eta, sweep_ftrl, sweep_kmeans,
+                    sweep_optimize, sweep_rung)
 
 __all__ = [
     "AshaConfig", "CARRY_RESIDENT", "TRACE_SHAPING", "SweepPlan",
     "classify_param", "SweepResult", "record_sweep_fallback",
-    "sweep_enabled", "sweep_eta", "sweep_kmeans", "sweep_optimize",
-    "sweep_rung",
+    "sweep_enabled", "sweep_eta", "sweep_ftrl", "sweep_kmeans",
+    "sweep_optimize", "sweep_rung", "FtrlSweepResult",
 ]

@@ -30,18 +30,25 @@ __all__ = ["CARRY_RESIDENT", "TRACE_SHAPING", "AshaConfig", "SweepPlan",
 
 # Per-trainer parameter classification. "optimizer" covers the five
 # iterative trainers behind OptimParams (LBFGS/OWLQN/GD/SGD/Newton);
-# "kmeans" covers kmeans_train. Names are the OptimParams / kmeans_train
-# keyword names (l1/l2 ride the objective in the serial path but sweep
-# as per-point lanes through the parameterized kernels).
+# "kmeans" covers kmeans_train; "ftrl" covers the online FTRL staleness
+# kernel (sweep_ftrl — ISSUE 13 satellite, the ROADMAP item 3
+# leftover). Names are the OptimParams / kmeans_train /
+# FtrlTrainStreamOp keyword names (l1/l2 ride the objective in the
+# serial path but sweep as per-point lanes through the parameterized
+# kernels; FTRL's alpha/beta/l1/l2 enter the weights closed form as
+# pure data).
 CARRY_RESIDENT: Dict[str, frozenset] = {
     "optimizer": frozenset({"learning_rate", "epsilon", "l1", "l2",
                             "mini_batch_fraction"}),
     "kmeans": frozenset({"tol", "seed"}),
+    "ftrl": frozenset({"alpha", "beta", "l1", "l2"}),
 }
 
 TRACE_SHAPING: Dict[str, frozenset] = {
     "optimizer": frozenset({"method", "max_iter", "seed"}),
     "kmeans": frozenset({"k", "distance_type", "init", "max_iter"}),
+    # the staleness bound is the scan chunk length — program geometry
+    "ftrl": frozenset({"staleness", "update_mode"}),
 }
 
 

@@ -41,6 +41,7 @@ rung hook re-derives its (deterministic) decisions after a resume.
 
 from __future__ import annotations
 
+import functools as _functools
 import threading
 import warnings
 from dataclasses import dataclass, field
@@ -50,8 +51,9 @@ import numpy as np
 
 from .plan import AshaConfig, SweepPlan
 
-__all__ = ["SweepResult", "record_sweep_fallback", "sweep_enabled",
-           "sweep_eta", "sweep_rung", "sweep_optimize", "sweep_kmeans"]
+__all__ = ["SweepResult", "FtrlSweepResult", "record_sweep_fallback",
+           "sweep_enabled", "sweep_eta", "sweep_rung", "sweep_optimize",
+           "sweep_kmeans", "sweep_ftrl"]
 
 
 # -- flags ------------------------------------------------------------------
@@ -903,3 +905,281 @@ def sweep_kmeans(X: np.ndarray, k: int, points: Sequence[Dict[str, Any]],
                        alive=alive_all, converged=conv_all,
                        loss_curves=curves, rungs=rung_log_all,
                        programs=len(groups))
+
+
+# -- FTRL hyperparameter sweeps (ISSUE 13 satellite; ROADMAP item 3
+# leftover) -----------------------------------------------------------------
+
+@dataclass
+class FtrlSweepResult:
+    """Per-point outcomes of one FTRL staleness-kernel sweep.
+
+    ``z``/``n``: (P, dim_pad) final FTRL state per point — each lane
+    round-equal to a serial staleness-kernel drain with that point's
+    hyperparameters at the pinned 1e-12 tolerance, and BITWISE
+    independent of the population (a lane's result never changes when
+    other points join or leave the sweep — tests/test_sweep.py);
+    ``margins``:
+    (P, total_rows) pre-update margins in arrival order;
+    ``pv_logloss``: per-point progressive-validation logloss over the
+    whole drain (margins are computed at pre-update weights in the
+    staleness kernel, so this is the honest online loss — the
+    winner-selection lane); ``programs``: compiled program count (1
+    for a carry-resident grid); ``fallback``: True when a
+    trace-shaping axis forced the recorded serial path."""
+    points: List[Dict[str, Any]]
+    z: np.ndarray
+    n: np.ndarray
+    margins: np.ndarray
+    pv_logloss: np.ndarray
+    programs: int
+    fallback: bool = False
+
+    @property
+    def num_points(self) -> int:
+        return len(self.points)
+
+    @property
+    def best(self) -> int:
+        """Lowest progressive-validation logloss, ties broken by lowest
+        point index — deterministic and seed-free."""
+        key = np.where(np.isfinite(self.pv_logloss), self.pv_logloss,
+                       np.inf)
+        return int(np.lexsort((np.arange(len(key)), key))[0])
+
+
+@_functools.lru_cache(maxsize=16)
+def _ftrl_sweep_staleness_factory(mesh, K, P_pts, kernel="off"):
+    """The bounded-staleness FTRL step with a ``(points,)`` lane: the
+    per-point body mirrors ``_ftrl_sparse_staleness_step_factory``'s
+    shard_fn OP-FOR-OP with the hyperparameters as traced per-point
+    scalars (the serial program bakes python floats into the same
+    arithmetic), run under a fixed-order ``jax.lax.map`` at exactly
+    the serial program's shapes. Lane ``p`` matches the serial kernel
+    with point ``p``'s hyperparameters to the pinned 1e-12 tolerance —
+    XLA's mul->add FMA contraction is CONTEXT-dependent, so the mapped
+    body rounds a last ulp differently from the standalone serial
+    program on some ops (measured ~1e-17 on the f64 rig); what IS
+    bitwise is population independence: a lane's result never depends
+    on which other points share the sweep (same program, same lane
+    shapes). One psum per chunk per point (the
+    serial program's collective set, times P). ``kernel`` is the
+    RESOLVED Pallas kernel-tier mode riding the lru key (the
+    gather/scatter kernels are bitwise, so parity holds either way)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..common.compat import shard_map
+    from ..engine.communication import manifest_psum
+    from ..operator.stream.onlinelearning.ftrl import (_ftrl_weights,
+                                                       _state_kernels)
+
+    _sgather, _sscatter = _state_kernels(kernel)
+
+    def shard_fn(idx, val, y, hyp, Z, N):
+        # hyp: (P_pts, 4) = [alpha, beta, l1, l2] lanes; Z/N:
+        # (P_pts, shard) feature-sharded per point
+        shard = Z.shape[1]
+        lo = jax.lax.axis_index("d") * shard
+        B, w = idx.shape
+        Bp = -(-B // K) * K
+        if Bp != B:               # zero rows are algebraic no-ops
+            idx = jnp.concatenate([idx, jnp.zeros((Bp - B, w), idx.dtype)])
+            val = jnp.concatenate([val, jnp.zeros((Bp - B, w), val.dtype)])
+            y = jnp.concatenate([y, jnp.zeros((Bp - B,), y.dtype)])
+        xi3 = idx.reshape(Bp // K, K, w)
+        xv3 = val.reshape(Bp // K, K, w)
+        yy2 = y.reshape(Bp // K, K)
+
+        def point(args):
+            hp, z, n = args
+            alpha, beta, l1, l2 = hp[0], hp[1], hp[2], hp[3]
+            zn = jnp.stack([z, n], axis=-1)               # (shard, 2)
+
+            def body(zn, xvy):
+                xi, xv, yy = xvy
+                local = (xi >= lo) & (xi < lo + shard)
+                li = jnp.clip(xi - lo, 0, shard - 1)
+                flat = li.reshape(-1)
+                s = _sgather(zn, flat).reshape(K, w, 2)
+                zj = jnp.where(local, s[..., 0], 0.0)
+                nj = jnp.where(local, s[..., 1], 0.0)
+                wj = jnp.where(local,
+                               _ftrl_weights(zj, nj, alpha, beta, l1, l2),
+                               0.0)
+                margins = manifest_psum((xv * wj).sum(-1), "d",
+                                        name="ftrl_margins",
+                                        num_workers=mesh.size)
+                p = 1.0 / (1.0 + jnp.exp(-jnp.clip(margins, -35.0, 35.0)))
+                g = (p - yy)[:, None] * xv
+                sigma = (jnp.sqrt(nj + g * g) - jnp.sqrt(nj)) / alpha
+                dz = jnp.where(local, g - sigma * wj, 0.0)
+                dn = jnp.where(local, g * g, 0.0)
+                zn = _sscatter(zn, flat,
+                               jnp.stack([dz.reshape(-1), dn.reshape(-1)],
+                                         axis=-1))
+                return zn, margins
+
+            zn, margins = jax.lax.scan(body, zn, (xi3, xv3, yy2))
+            return zn[..., 0], zn[..., 1], margins.reshape(Bp)[:B]
+
+        Z, N, M = jax.lax.map(point, (hyp, Z, N))
+        return Z, N, M
+
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(P(), P(), P(), P(), P(None, "d"),
+                             P(None, "d")),
+                   out_specs=(P(None, "d"), P(None, "d"), P()))
+    return jax.jit(fn)
+
+
+def sweep_ftrl(batches, dim: int, points, base=None, env=None,
+               coef0=None) -> FtrlSweepResult:
+    """Sweep N FTRL hyperparameter points (alpha/beta/l1/l2 lanes)
+    through the bounded-staleness kernel as ONE program.
+
+    ``batches``: padded-COO micro-batches ``[(idx, val, y), ...]``
+    (the FTRL encode convention: (B, width) int32/float + (B,) labels,
+    padding entries val == 0); ``dim``: model dimension (padded to the
+    mesh); ``points``: per-point overrides over ``base`` —
+    carry-resident axes alpha/beta/l1/l2 sweep inside one compiled
+    program (a ``staleness`` axis whose values all RESOLVE equal keeps
+    the one-program path — the compile-group base-fill semantics);
+    heterogeneous ``staleness`` values record
+    ``alink_sweep_fallback_total{estimator="ftrl"}`` and run the
+    serial per-point STALENESS kernels instead (identical numbers,
+    serial economics); an ``update_mode`` other than "staleness" is
+    REFUSED loudly — this executor implements the bounded-staleness
+    kernel only. ``coef0``: warm-start weights — each point's z lane
+    initializes to ``-coef0 * (beta/alpha + l2)`` exactly like the
+    serial drain's warm start, which is hyperparameter-DEPENDENT, so
+    it must be built per point.
+
+    Per-point results match serial
+    ``_ftrl_sparse_staleness_step_factory`` drains at the pinned 1e-12
+    tolerance and are BITWISE population-independent
+    (tests/test_sweep.py); the winner is the lowest
+    progressive-validation logloss."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from ..common.mlenv import MLEnvironmentFactory
+    from ..kernels.ftrl import ftrl_kernel_mode
+    from ..operator.stream.onlinelearning.ftrl import (
+        _ftrl_sparse_staleness_step_factory)
+
+    base = dict(base or {})
+    base.setdefault("alpha", 0.1)
+    base.setdefault("beta", 1.0)
+    base.setdefault("l1", 0.0)
+    base.setdefault("l2", 0.0)
+    base.setdefault("staleness", 32)
+    base.setdefault("update_mode", "staleness")
+    plan = SweepPlan("ftrl", [dict(p) for p in points], base=base)
+    modes = {str(p.get("update_mode", base["update_mode"]))
+             for p in plan.points}
+    if modes != {"staleness"}:
+        # update_mode classifies as a trace axis so SweepPlan accepts
+        # it, but this executor only implements the bounded-staleness
+        # kernel — running a chained/per-sample point through it would
+        # return silently wrong semantics. Refuse loudly instead.
+        raise ValueError(
+            f"sweep_ftrl sweeps the bounded-staleness kernel only; "
+            f"update_mode values {sorted(modes - {'staleness'})} must "
+            f"train through the serial drain (FtrlTrainStreamOp)")
+    env = env or MLEnvironmentFactory.get_default()
+    mesh = env.mesh
+    n_dev = int(mesh.devices.size)
+    dim_pad = -(-dim // n_dev) * n_dev
+    K = int(base["staleness"])
+    P_pts = plan.num_points
+    coef0 = np.zeros(dim) if coef0 is None else np.asarray(coef0)
+
+    def resolved(i, name):
+        return float(plan.points[i].get(name, base[name]))
+
+    hyp = np.stack([[resolved(i, "alpha"), resolved(i, "beta"),
+                     resolved(i, "l1"), resolved(i, "l2")]
+                    for i in range(P_pts)])
+
+    def z0_for(i):
+        # the warm start encodes the initial weights into z at n = 0 —
+        # scale = beta/alpha + l2 depends on the POINT's hypers
+        scale = resolved(i, "beta") / resolved(i, "alpha") \
+            + resolved(i, "l2")
+        z = np.zeros(dim_pad)
+        z[:dim] = -coef0 * scale
+        return z
+
+    # a staleness axis only forces the serial path when its values
+    # actually DIFFER: a point that names staleness explicitly but
+    # equals every other point's resolved value still has ONE trace
+    # group (the plan.groups() base-fill semantics) and sweeps as one
+    # program — the sibling sweepers' compile-group discipline
+    staleness_vals = {int(p.get("staleness", base["staleness"]))
+                      for p in plan.points}
+    if len(staleness_vals) == 1:
+        K = staleness_vals.pop()
+    else:
+        record_sweep_fallback(
+            "ftrl", "trace-shaping-axis",
+            f"staleness values {sorted(staleness_vals)} split the scan "
+            f"geometry into {len(plan.groups())} compile groups — "
+            f"serial per-point kernels (identical numbers)")
+        sh = NamedSharding(mesh, P("d"))
+        zs, ns, ms = [], [], []
+        progs = set()
+        for i in range(P_pts):
+            Ki = int(plan.points[i].get("staleness", base["staleness"]))
+            step = _ftrl_sparse_staleness_step_factory(
+                mesh, resolved(i, "alpha"), resolved(i, "beta"),
+                resolved(i, "l1"), resolved(i, "l2"), Ki,
+                kernel=ftrl_kernel_mode())
+            progs.add((resolved(i, "alpha"), resolved(i, "beta"),
+                       resolved(i, "l1"), resolved(i, "l2"), Ki))
+            z = jax.device_put(z0_for(i), sh)
+            n = jax.device_put(np.zeros(dim_pad), sh)
+            mm = []
+            for idx, val, y in batches:
+                z, n, m = step(idx, val, y, z, n)
+                mm.append(m)
+            zs.append(np.asarray(z))
+            ns.append(np.asarray(n))
+            ms.append(np.concatenate([np.asarray(m) for m in mm]))
+        Zh, Nh = np.stack(zs), np.stack(ns)
+        Mh = np.stack(ms)
+        return _finish_ftrl(plan, batches, Zh, Nh, Mh, len(progs), True)
+
+    step = _ftrl_sweep_staleness_factory(mesh, K, P_pts,
+                                         kernel=ftrl_kernel_mode())
+    state_sh = NamedSharding(mesh, P(None, "d"))
+    Z = jax.device_put(np.stack([z0_for(i) for i in range(P_pts)]),
+                       state_sh)
+    N = jax.device_put(np.zeros((P_pts, dim_pad)), state_sh)
+    margins = []
+    for idx, val, y in batches:
+        Z, N, M = step(idx, val, y, hyp, Z, N)
+        margins.append(M)
+    Mh = np.concatenate([np.asarray(m) for m in margins], axis=1) \
+        if margins else np.zeros((P_pts, 0))
+    return _finish_ftrl(plan, batches, np.asarray(Z), np.asarray(N), Mh,
+                        1, False)
+
+
+def _finish_ftrl(plan, batches, Z, N, M, programs: int,
+                 fallback: bool) -> FtrlSweepResult:
+    y_all = (np.concatenate([y for _, _, y in batches])
+             if batches else np.zeros(0))
+    if M.shape[1]:
+        m = np.clip(M, -35.0, 35.0)
+        ll = (np.logaddexp(0.0, -m) * y_all[None, :]
+              + np.logaddexp(0.0, m) * (1.0 - y_all[None, :]))
+        # a non-finite margin must surface in the lane's loss, not be
+        # laundered by the clip (the drain's pv_stats contract): a
+        # diverged point's pv is NaN and ranks LAST in `best`
+        pv = np.where(np.isfinite(M).all(axis=1), ll.mean(axis=1),
+                      np.nan)
+    else:
+        pv = np.full(M.shape[0], np.nan)
+    return FtrlSweepResult(points=plan.points, z=Z, n=N, margins=M,
+                           pv_logloss=pv, programs=programs,
+                           fallback=fallback)

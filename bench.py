@@ -2112,6 +2112,103 @@ def quick_gbdt_hist(h: Harness):
 
 
 # ---------------------------------------------------------------------------
+# Pallas kernel tier (alink_tpu/kernels, ISSUE 13): ftrl_pallas row
+# ---------------------------------------------------------------------------
+
+def _bench_ftrl_pallas(h: Harness, dim, B, n_pool, spans, reps):
+    """The sparse FTRL scatter-update kernel (ALINK_TPU_FTRL_KERNEL)
+    vs the XLA gather/scatter step, staleness mode, with a bitwise
+    parity field. HONEST RIG NOTE: off-TPU the kernel executes in
+    Pallas interpret mode — a simulated grid of XLA ops, which
+    measures correctness economics, not the VMEM-resident win; the
+    row's winner field records which kernel is faster on THIS rig
+    (XLA wins interpret-mode CPU; the pallas win is the physical-TPU
+    recapture, where XLA's serialized gather/scatter ~5M elem/s wall
+    is the bound — docs/performance.md "Pallas kernel tier")."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from alink_tpu.operator.stream.onlinelearning.ftrl import (
+        _ftrl_sparse_staleness_step_factory)
+    nnz = 16
+    n_dev = h.chips
+    dim_pad = -(-dim // n_dev) * n_dev
+    width = -(-(nnz + 1) // 8) * 8
+
+    def make_batch(seed):
+        r = np.random.RandomState(seed)
+        idx = np.zeros((B, width), np.int32)
+        val = np.zeros((B, width), np.float64)
+        idx[:, 0], val[:, 0] = 0, 1.0
+        idx[:, 1:nnz + 1] = r.randint(1, dim, size=(B, nnz))
+        val[:, 1:nnz + 1] = 1.0
+        y = (r.rand(B) < 0.5).astype(np.float64)
+        return idx, val, y
+
+    pool = [make_batch(s) for s in range(n_pool)]
+    mesh = h.env.mesh
+    shard = NamedSharding(mesh, P("d"))
+    sp_idx = h.put(np.stack([p[0] for p in pool]))
+    sp_val = h.put(np.stack([p[1] for p in pool]))
+    sp_y = h.put(np.stack([p[2] for p in pool]))
+    zrng = np.random.RandomState(3)
+    z0 = zrng.randn(dim_pad) * 1e-8
+    rates = {}
+    finals = {}
+    for kern in ("off", "pallas"):
+        step = _ftrl_sparse_staleness_step_factory(
+            mesh, 0.05, 1.0, 1e-5, 1e-5, 32, kernel=kern)
+
+        @jax.jit
+        def pool_fn(sp_idx, sp_val, sp_y, z, nacc, step=step):
+            def body(carry, xs):
+                z, nacc = carry
+                z, nacc, m = step(xs[0], xs[1], xs[2], z, nacc)
+                return (z, nacc), m[0]
+            (z, nacc), _ = jax.lax.scan(body, (z, nacc),
+                                        (sp_idx, sp_val, sp_y))
+            return z, nacc
+
+        def run(n_pools, pool_fn=pool_fn):
+            st = [jax.device_put(z0, shard),
+                  jax.device_put(np.zeros(dim_pad), shard)]
+
+            def step_once():
+                st[0], st[1] = pool_fn(sp_idx, sp_val, sp_y, st[0], st[1])
+            _kernel_loop("ftrl.pallas", n_pools, step_once,
+                         lambda: np.asarray(st[0]))
+            finals[kern] = np.asarray(st[0])
+
+        dt = h.delta(run, spans, reps=reps)
+        rates[kern] = B * n_pool * spans / dt / h.chips
+    parity = "bitwise" if np.array_equal(
+        finals["off"].view(np.int64), finals["pallas"].view(np.int64)) \
+        else "MISMATCH"
+    winner = "pallas" if rates["pallas"] >= rates["off"] else "xla"
+    return {"samples_per_sec_per_chip": round(rates["pallas"], 1),
+            "xla_samples_per_sec_per_chip": round(rates["off"], 1),
+            "pallas_vs_xla": round(rates["pallas"]
+                                   / max(rates["off"], 1e-9), 3),
+            "scatter_kernel": winner,
+            "parity": parity,
+            "bound": "latency",
+            "rig_note": ("interpret-mode Pallas (no TPU): measures "
+                         "correctness economics only; recapture on a "
+                         "physical slice for the VMEM-resident win"
+                         if jax.default_backend() != "tpu"
+                         else "native Mosaic kernels")}
+
+
+def bench_ftrl_pallas(h: Harness):
+    return _bench_ftrl_pallas(h, dim=16_384, B=512, n_pool=4, spans=3,
+                              reps=2)
+
+
+def quick_ftrl_pallas(h: Harness):
+    return _bench_ftrl_pallas(h, dim=4_096, B=128, n_pool=2, spans=2,
+                              reps=2)
+
+
+# ---------------------------------------------------------------------------
 # Serving tier (alink_tpu/serving): micro-batched compiled predict rows
 # ---------------------------------------------------------------------------
 
@@ -2295,6 +2392,112 @@ def quick_serve_sharded(h: Harness):
     return _bench_serve_sharded(h, requests=1_000, swaps=8)
 
 
+def _bench_serve_fused(h: Harness, n_rows, dim, passes, reps):
+    """The fused serving score kernel (ALINK_TPU_SERVE_FUSED) + the
+    opt-in low-precision path (ALINK_TPU_SERVE_DTYPE): whole-table
+    scoring rate through CompiledPredictor per (fused, dtype) setting,
+    with the parity fields the gate checks — fused f32 BITWISE vs the
+    XLA path, bf16/int8 label agreement vs the f32 labels. HONEST RIG
+    NOTE: off-TPU the kernel runs in interpret mode (a simulated grid
+    — the HBM-round-trip elimination only shows on a physical slice),
+    so ``dtype_winner``/``fused_vs_xla`` on this rig measure the
+    arithmetic cost, not the memory win."""
+    import jax
+    from alink_tpu.common.flags import flag_raw
+    from alink_tpu.serving import CompiledPredictor
+    from alink_tpu.common.profiling2 import measured_region
+    tbl, _warm, mapper, _schema = _serve_fixture(n_rows, dim)
+    req = tbl.select(["vec"])
+
+    saved = {k: flag_raw(k) for k in
+             ("ALINK_TPU_SERVE_FUSED", "ALINK_TPU_SERVE_DTYPE",
+              "ALINK_TPU_PALLAS_INTERPRET")}
+
+    def setenv(fused, dtype):
+        for k in saved:
+            os.environ.pop(k, None)
+        if jax.default_backend() != "tpu":
+            os.environ["ALINK_TPU_PALLAS_INTERPRET"] = "1"
+        if fused:
+            os.environ["ALINK_TPU_SERVE_FUSED"] = "1"
+        if dtype != "f32":
+            os.environ["ALINK_TPU_SERVE_DTYPE"] = dtype
+
+    def restore():
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    def measure(pred):
+        for b in pred.buckets:
+            pred.predict_table(req.first_n(min(b, n_rows)))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            with measured_region():
+                for _ in range(passes):
+                    pred.predict_table(req)
+            ts.append(time.perf_counter() - t0)
+        return n_rows * passes / sorted(ts)[len(ts) // 2]
+
+    try:
+        preds, rates = {}, {}
+        setenv(False, "f32")
+        preds["base"] = CompiledPredictor(mapper)
+        rates["base"] = measure(preds["base"])
+        for name, (fused, dtype) in (("fused", (True, "f32")),
+                                     ("bf16", (True, "bf16")),
+                                     ("int8", (True, "int8"))):
+            setenv(fused, dtype)
+            preds[name] = CompiledPredictor(mapper)
+            rates[name] = measure(preds[name])
+    finally:
+        restore()
+    sample = req.first_n(min(300, n_rows))
+    base_out = preds["base"].predict_table(sample)
+    fused_out = preds["fused"].predict_table(sample)
+    parity = "bitwise" if all(
+        all(str(a) == str(b) for a, b in
+            zip(fused_out.col(c), base_out.col(c)))
+        for c in base_out.col_names) else "MISMATCH"
+    base_labels = [str(v) for v in base_out.col(base_out.col_names[-1])]
+    agree = {}
+    for name in ("bf16", "int8"):
+        out = preds[name].predict_table(sample)
+        got = [str(v) for v in out.col(out.col_names[-1])]
+        agree[name] = sum(a == b for a, b in zip(got, base_labels)) \
+            / max(len(base_labels), 1)
+    dtype_winner = max(("fused", "bf16", "int8"), key=lambda k: rates[k])
+    return {
+        "samples_per_sec_per_chip": round(rates["fused"] / h.chips, 1),
+        "xla_rows_per_sec_per_chip": round(rates["base"] / h.chips, 1),
+        "fused_vs_xla": round(rates["fused"] / max(rates["base"], 1e-9),
+                              3),
+        "bf16_rows_per_sec_per_chip": round(rates["bf16"] / h.chips, 1),
+        "int8_rows_per_sec_per_chip": round(rates["int8"] / h.chips, 1),
+        "dtype_winner": {"fused": "f32"}.get(dtype_winner, dtype_winner),
+        "label_agreement_bf16": round(agree["bf16"], 4),
+        "label_agreement_int8": round(agree["int8"], 4),
+        "parity": parity,
+        "bound": "serving-host",
+        "rig_note": ("interpret-mode Pallas (no TPU): arithmetic cost "
+                     "only — the HBM-round-trip elimination needs a "
+                     "physical slice"
+                     if jax.default_backend() != "tpu"
+                     else "native Mosaic kernels"),
+    }
+
+
+def bench_serve_fused(h: Harness):
+    return _bench_serve_fused(h, n_rows=2000, dim=64, passes=4, reps=3)
+
+
+def quick_serve_fused(h: Harness):
+    return _bench_serve_fused(h, n_rows=512, dim=64, passes=2, reps=2)
+
+
 def bench_serve_logreg(h: Harness):
     return _bench_serve_logreg(h, requests=20_000, serial_requests=2_000)
 
@@ -2410,9 +2613,11 @@ QUICK_WORKLOADS = (("logreg_criteo", quick_logreg),
                    ("ftrl_criteo", quick_ftrl),
                    ("ftrl_stream_drain", quick_ftrl_drain),
                    ("gbdt_hist_fused", quick_gbdt_hist),
+                   ("ftrl_pallas", quick_ftrl_pallas),
                    ("logreg_from_disk", quick_from_disk),
                    ("tuning_sweep", quick_tuning_sweep),
                    ("serve_logreg", quick_serve_logreg),
+                   ("serve_fused", quick_serve_fused),
                    ("serve_ftrl_hot_swap", quick_serve_hot_swap),
                    ("serve_logreg_sharded", quick_serve_sharded))
 
@@ -2518,6 +2723,7 @@ def main(argv=None):
                      ("kmeans_iris", bench_kmeans),
                      ("softmax_mnist", bench_softmax),
                      ("ftrl_criteo", bench_ftrl),
+                     ("ftrl_pallas", bench_ftrl_pallas),
                      ("logreg_from_disk", bench_logreg_from_disk),
                      ("gbdt_adult", bench_gbdt),
                      ("gbdt_adult_large", bench_gbdt_large),
@@ -2525,6 +2731,7 @@ def main(argv=None):
                      ("als_movielens_large", bench_als_large),
                      ("tuning_sweep", bench_tuning_sweep),
                      ("serve_logreg", bench_serve_logreg),
+                     ("serve_fused", bench_serve_fused),
                      ("serve_ftrl_hot_swap", bench_serve_hot_swap),
                      ("serve_logreg_sharded", bench_serve_sharded))
     for name, fn in suite:

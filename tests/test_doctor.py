@@ -381,3 +381,86 @@ class TestDoctorSweepVerdict:
                      "serve_logreg_sharded", "serve_logreg_p99inv",
                      "logreg_criteo"):
             assert bc._display_name(name) == history._display_name(name)
+
+
+class TestKernelTierVerdicts:
+    """ISSUE 13: doctor fix lines name the Pallas kernel tier when
+    scatter-bound FTRL or HBM-round-trip serving shows."""
+
+    def test_ftrl_device_low_roof_names_ftrl_kernel(self, doctor,
+                                                    tmp_path, capsys):
+        d = _canned_run_dir(str(tmp_path / "run"))
+        bench = json.load(open(os.path.join(d, "bench.json")))
+        row = bench["workloads"]["ftrl_criteo"]
+        # single-leg device-dominated with a cost model whose achieved
+        # rate sits far under the roof — the scatter-bound signature
+        row["profile"].update(
+            dispatch_s=0.5, device_s=9.0,
+            fractions={"dispatch": 0.05, "transfer": 0.04,
+                       "device": 0.86, "collective": 0.0, "host": 0.05},
+            bound_measured="device",
+            device_scopes=["ftrl.kernel"])
+        with open(os.path.join(d, "bench.json"), "w") as f:
+            json.dump(bench, f)
+        assert doctor.main(["--run-dir", d, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        wl = {v["workload"]: v for v in doc["workloads"]}
+        tier = [f for f in wl["ftrl_criteo"]["fixes"]
+                if "ALINK_TPU_FTRL_KERNEL=pallas" in f]
+        assert tier and "scatter-bound" in tier[0]
+        # the non-FTRL device-bound workload does NOT get the FTRL line
+        assert not any("ALINK_TPU_FTRL_KERNEL" in f
+                       for f in wl["kmeans_iris"]["fixes"])
+
+    def _serve_fused_doc(self, doctor, tmp_path, capsys, **row):
+        d = _canned_run_dir(str(tmp_path / "run"))
+        bench = json.load(open(os.path.join(d, "bench.json")))
+        bench["workloads"]["serve_fused"] = {
+            "samples_per_sec_per_chip": 1000.0,
+            "xla_rows_per_sec_per_chip": 2000.0,
+            "fused_vs_xla": 0.5, "dtype_winner": "f32",
+            "label_agreement_bf16": 1.0, "label_agreement_int8": 1.0,
+            "parity": "bitwise", "bound": "serving-host",
+            "rig_note": "interpret-mode Pallas (no TPU)", **row}
+        with open(os.path.join(d, "bench.json"), "w") as f:
+            json.dump(bench, f)
+        assert doctor.main(["--run-dir", d, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        return {v["workload"]: v for v in doc.get("serving", [])}
+
+    def test_serve_fused_losing_names_recapture(self, doctor, tmp_path,
+                                                capsys):
+        sv = self._serve_fused_doc(doctor, tmp_path, capsys)
+        v = sv["serve_fused"]
+        assert v["fused_vs_xla"] == 0.5
+        fix = [f for f in v["fixes"] if "ALINK_TPU_SERVE_FUSED" in f]
+        assert fix and "physical TPU slice" in fix[0]
+
+    def test_serve_fused_losing_on_native_rig_flags_regression(
+            self, doctor, tmp_path, capsys):
+        """A native-Mosaic rig losing fused-vs-xla is a real kernel
+        regression, not an interpret artifact — the fix line must say
+        so instead of telling the operator to recapture the
+        measurement they already have."""
+        sv = self._serve_fused_doc(doctor, tmp_path, capsys,
+                                   rig_note="native Mosaic kernels")
+        fix = [f for f in sv["serve_fused"]["fixes"]
+               if "kernel-tier regression" in f]
+        assert fix and "native rig" in fix[0]
+        assert not any("recapture there" in f
+                       for f in sv["serve_fused"]["fixes"])
+
+    def test_serve_fused_parity_mismatch_is_critical(self, doctor,
+                                                     tmp_path, capsys):
+        sv = self._serve_fused_doc(doctor, tmp_path, capsys,
+                                   parity="MISMATCH")
+        fix = [f for f in sv["serve_fused"]["fixes"]
+               if f.startswith("CRITICAL")]
+        assert fix and "kernels/serve.py" in fix[0]
+        # not the sharded-mesh message — this is the fused kernel's
+        assert "serving/sharded.py" not in fix[0]
+
+    def test_bench_history_labels_kernel_rows(self, history):
+        assert history._display_name("serve_fused") \
+            == "serve_fused (rows/s)"
+        assert "kernel tier" in history._display_name("ftrl_pallas")

@@ -551,3 +551,158 @@ class TestGridSearchIntegration:
             record_sweep_fallback("Est", "trace-shaping-axis", "y")
         with pytest.warns(RuntimeWarning):
             record_sweep_fallback("Est", "unsupported-evaluator")
+
+
+class TestFtrlSweep:
+    """FTRL hyperparameter lanes through the staleness kernel
+    (ISSUE 13 satellite — the ROADMAP item 3 leftover)."""
+
+    DIM, NNZ, B, W, NB = 256, 10, 48, 16, 2
+
+    def _batches(self):
+        out = []
+        for s in range(self.NB):
+            r = np.random.RandomState(s)
+            idx = np.zeros((self.B, self.W), np.int32)
+            val = np.zeros((self.B, self.W))
+            for i in range(self.B):
+                idx[i, :self.NNZ] = r.choice(self.DIM, self.NNZ,
+                                             replace=False)
+            val[:, :self.NNZ] = r.randn(self.B, self.NNZ)
+            y = (r.rand(self.B) < 0.5).astype(np.float64)
+            out.append((idx, val, y))
+        return out
+
+    PTS = [{"alpha": 0.05, "l1": 1e-5}, {"alpha": 0.1, "l2": 1e-4},
+           {"beta": 2.0}, {"alpha": 0.02, "beta": 0.5, "l1": 1e-4}]
+
+    def test_serial_parity_and_one_program(self):
+        """Each lane matches a serial staleness-kernel drain with that
+        point's hyperparameters at the pinned 1e-12 tolerance
+        (hyper-dependent warm start included), from ONE compiled
+        program for the whole carry-resident grid."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from alink_tpu.common.mlenv import MLEnvironmentFactory
+        from alink_tpu.operator.stream.onlinelearning.ftrl import (
+            _ftrl_sparse_staleness_step_factory)
+        from alink_tpu.tuning import sweep_ftrl
+        batches = self._batches()
+        coef0 = np.random.RandomState(9).randn(self.DIM) * 0.01
+        res = sweep_ftrl(batches, self.DIM, self.PTS,
+                         base={"staleness": 16}, coef0=coef0)
+        assert res.programs == 1 and not res.fallback
+        mesh = MLEnvironmentFactory.get_default().mesh
+        sh = NamedSharding(mesh, P("d"))
+        for i, pt in enumerate(self.PTS):
+            a, b = pt.get("alpha", 0.1), pt.get("beta", 1.0)
+            l1, l2 = pt.get("l1", 0.0), pt.get("l2", 0.0)
+            step = _ftrl_sparse_staleness_step_factory(
+                mesh, a, b, l1, l2, 16)
+            z0 = np.zeros(self.DIM)
+            z0[:] = -coef0 * (b / a + l2)     # the warm start is
+            z = jax.device_put(z0, sh)        # hyper-dependent
+            n = jax.device_put(np.zeros(self.DIM), sh)
+            ms = []
+            for idx, val, y in batches:
+                z, n, m = step(idx, val, y, z, n)
+                ms.append(np.asarray(m))
+            np.testing.assert_allclose(np.asarray(z), res.z[i],
+                                       rtol=1e-12, atol=1e-14)
+            np.testing.assert_allclose(np.concatenate(ms),
+                                       res.margins[i],
+                                       rtol=1e-12, atol=1e-14)
+
+    def test_population_independence_bitwise(self):
+        """A lane's result is BITWISE independent of which other points
+        share the sweep (same program shapes per point)."""
+        from alink_tpu.tuning import sweep_ftrl
+        batches = self._batches()
+        coef0 = np.random.RandomState(9).randn(self.DIM) * 0.01
+        full = sweep_ftrl(batches, self.DIM, self.PTS,
+                          base={"staleness": 16}, coef0=coef0)
+        solo = sweep_ftrl(batches, self.DIM, [self.PTS[2]],
+                          base={"staleness": 16}, coef0=coef0)
+        assert np.array_equal(solo.z[0].view(np.int64),
+                              full.z[2].view(np.int64))
+        assert np.array_equal(solo.margins[0].view(np.int64),
+                              full.margins[2].view(np.int64))
+
+    def test_classification(self):
+        assert classify_param("ftrl", "alpha") == "carry"
+        assert classify_param("ftrl", "l2") == "carry"
+        assert classify_param("ftrl", "staleness") == "trace"
+        with pytest.raises(KeyError):
+            classify_param("ftrl", "time_interval")
+
+    def test_trace_axis_falls_back_recorded_and_identical(
+            self, fresh_registry):
+        """A staleness axis records the fallback (metric + one warning)
+        and still returns per-point results identical to the serial
+        kernels."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from alink_tpu.common.mlenv import MLEnvironmentFactory
+        from alink_tpu.operator.stream.onlinelearning.ftrl import (
+            _ftrl_sparse_staleness_step_factory)
+        from alink_tpu.tuning import sweep_ftrl
+        _reset_fallback_warnings()
+        batches = self._batches()
+        pts = [{"alpha": 0.05, "staleness": 8},
+               {"alpha": 0.1, "staleness": 16}]
+        with pytest.warns(RuntimeWarning, match="trace-shaping-axis"):
+            res = sweep_ftrl(batches, self.DIM, pts)
+        assert res.fallback
+        assert fresh_registry.value(
+            "alink_sweep_fallback_total",
+            {"estimator": "ftrl", "reason": "trace-shaping-axis"}) == 1
+        mesh = MLEnvironmentFactory.get_default().mesh
+        sh = NamedSharding(mesh, P("d"))
+        for i, pt in enumerate(pts):
+            a = pt.get("alpha", 0.1)
+            step = _ftrl_sparse_staleness_step_factory(
+                mesh, a, 1.0, 0.0, 0.0, pt["staleness"])
+            # the warm start writes -coef*scale — for a zero coef that
+            # is -0.0, exactly like the drain's alloc (bitwise matters)
+            z0 = np.zeros(self.DIM)
+            z0[:] = -np.zeros(self.DIM) * (1.0 / a)
+            z = jax.device_put(z0, sh)
+            n = jax.device_put(np.zeros(self.DIM), sh)
+            for idx, val, y in batches:
+                z, n, _ = step(idx, val, y, z, n)
+            assert np.array_equal(np.asarray(z).view(np.int64),
+                                  res.z[i].view(np.int64))
+        _reset_fallback_warnings()
+
+    def test_uniform_explicit_staleness_keeps_one_program(self):
+        """A point naming staleness EXPLICITLY but equal to every other
+        point's resolved value has one compile group: the sweep stays
+        one program, records NO fallback (the compile-group base-fill
+        semantics of the sibling sweepers)."""
+        import warnings as w
+        from alink_tpu.tuning import sweep_ftrl
+        _reset_fallback_warnings()
+        with w.catch_warnings():
+            w.simplefilter("error")          # any fallback warning fails
+            res = sweep_ftrl(self._batches(), self.DIM,
+                             [{"alpha": 0.05, "staleness": 16},
+                              {"alpha": 0.1}],
+                             base={"staleness": 16})
+        assert res.programs == 1 and not res.fallback
+
+    def test_update_mode_axis_refused_loudly(self):
+        """sweep_ftrl implements the staleness kernel only: a point
+        asking for chained/per-sample semantics must refuse, never
+        silently serve staleness numbers as that point's result."""
+        from alink_tpu.tuning import sweep_ftrl
+        with pytest.raises(ValueError, match="bounded-staleness"):
+            sweep_ftrl(self._batches(), self.DIM,
+                       [{"alpha": 0.05, "update_mode": "chained"}])
+
+    def test_winner_is_lowest_pv_logloss(self):
+        from alink_tpu.tuning import sweep_ftrl
+        res = sweep_ftrl(self._batches(), self.DIM, self.PTS,
+                         base={"staleness": 16})
+        key = np.where(np.isfinite(res.pv_logloss), res.pv_logloss,
+                       np.inf)
+        assert res.best == int(np.argmin(key))

@@ -214,6 +214,14 @@ def _display_name(name: str) -> str:
         # the sweep row's rate is candidate points tuned per second
         # through the ASHA sweep engine (ISSUE 12)
         return f"{name} (points/s)"
+    if name == "serve_fused":
+        # whole-table scoring through the fused Pallas kernel, not the
+        # micro-batcher: rows scored per second (ISSUE 13)
+        return f"{name} (rows/s)"
+    if name == "ftrl_pallas":
+        # the Pallas-path staleness kernel rate — interpret-mode on CPU
+        # rigs (the row's rig_note), native Mosaic on TPU (ISSUE 13)
+        return f"{name} (samples/s, kernel tier)"
     if name.startswith("serve_") and name.endswith("_sharded"):
         # multi-chip serving rows report per-chip throughput at the
         # widest measured mesh (ISSUE 11)

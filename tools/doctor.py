@@ -228,10 +228,15 @@ def _fixes(name: str, attr: Dict[str, Any], fr: Dict[str, float],
             roof = max(ach.get("pct_peak_flops", 0.0),
                        ach.get("pct_peak_hbm", 0.0))
             if roof < 15.0:
+                tier = (" — scatter-bound FTRL belongs on the Pallas "
+                        "kernel tier (ALINK_TPU_FTRL_KERNEL=pallas: "
+                        "VMEM-resident (z, n) tiles instead of XLA's "
+                        "serialized gather/scatter)"
+                        if name.startswith("ftrl") else "")
                 cands.append((dev, f"device-busy {dev:.0%} but only "
                                    f"{roof:.1f}% of the chip roof: fuse "
                                    f"kernels (ALINK_TPU_FUSED_HIST, "
-                                   f"Pallas) or grow the shapes"))
+                                   f"Pallas) or grow the shapes{tier}"))
             else:
                 cands.append((dev * 0.5,
                               f"device compute at {roof:.0f}% of the "
@@ -301,8 +306,38 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
         if speed is not None and speed < 2.0:
             fixes.append(f"micro-batching barely wins ({speed}x serial): "
                          f"per-row host work dominates — move encode "
-                         f"cost out of the request path or grow the "
-                         f"model so the device amortization matters")
+                         f"cost out of the request path, grow the "
+                         f"model so the device amortization matters, "
+                         f"or cut the score path's HBM round-trips with "
+                         f"the fused kernel tier (ALINK_TPU_SERVE_FUSED"
+                         f"=1: encode-gather->dot->link in one Pallas "
+                         f"kernel)")
+        # the Pallas kernel tier's serving row (ISSUE 13)
+        fv = row.get("fused_vs_xla")
+        if fv is not None:
+            if row.get("parity") == "MISMATCH":
+                fixes.append("CRITICAL: the fused serving score kernel "
+                             "is NOT bitwise-identical to the "
+                             "seq_chunk_sum XLA path — the kernel "
+                             "tier's reduction-order contract is "
+                             "broken (kernels/serve.py)")
+            elif fv < 1.0:
+                note = str(row.get("rig_note") or "")
+                if "interpret" in note:
+                    fixes.append(f"the fused score kernel loses to the "
+                                 f"XLA path on this rig ({fv}x; "
+                                 f"{note}): the HBM-round-trip "
+                                 f"elimination (ALINK_TPU_SERVE_FUSED) "
+                                 f"shows on a physical TPU slice, not "
+                                 f"in interpret mode — recapture there")
+                else:
+                    fixes.append(f"the fused score kernel LOSES to the "
+                                 f"XLA path on a native rig ({fv}x) — "
+                                 f"a genuine kernel-tier regression, "
+                                 f"not an interpret-mode artifact: "
+                                 f"profile the kernel's grid/BlockSpec "
+                                 f"(kernels/serve.py) before trusting "
+                                 f"serve_fused gains")
         # multi-chip serving (ISSUE 11): per-chip QPS across mesh sizes
         # — the fleet-scale verdict is that QPS/chip HOLDS as chips are
         # added (a sharded/replicated tier that decays per chip is just
@@ -331,7 +366,7 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
                     f"sharded psum dominates the dispatch "
                     f"(ALINK_TPU_SERVE_SHARDED off for small "
                     f"models){note}")
-        if row.get("parity") == "MISMATCH":
+        if row.get("parity") == "MISMATCH" and fv is None:
             fixes.append("CRITICAL: sharded bucket programs are NOT "
                          "bitwise-identical across mesh sizes — the "
                          "lane-blocked reduction contract is broken "
@@ -361,7 +396,9 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
             v["per_chip_scaling"] = scaling
         for k in ("speedup_vs_serial", "serial_qps_per_chip", "parity",
                   "model_swaps", "torn_responses", "p99_ms_before",
-                  "p99_ms_during", "p99_ms_after"):
+                  "p99_ms_during", "p99_ms_after", "fused_vs_xla",
+                  "dtype_winner", "label_agreement_bf16",
+                  "label_agreement_int8"):
             if row.get(k) is not None:
                 v[k] = row[k]
         out.append(v)

@@ -100,6 +100,16 @@ def default_config() -> LintConfig:
         # knobs are key-neutral host boundary pruning
         FactoryRoot("alink_tpu/tuning/sweep.py",
                     "_run_sweep_queue", frozenset({_PC})),
+        # the Pallas kernel tier (ISSUE 13): the serving-kernel build
+        # resolves ALINK_TPU_SERVE_FUSED/_DTYPE into the ServingKernel
+        # signature (the serving program-cache key), and the FTRL
+        # kernel-mode resolution rides the step factories' lru keys
+        FactoryRoot("alink_tpu/operator/common/linear/mapper.py",
+                    "LinearModelMapper.serving_kernel", frozenset({_PC})),
+        FactoryRoot("alink_tpu/kernels/serve.py",
+                    "resolve_serve_kernel", frozenset({_PC})),
+        FactoryRoot("alink_tpu/kernels/ftrl.py",
+                    "ftrl_kernel_mode", frozenset({_LRU, _CKS})),
     ]
     roots += [FactoryRoot(_FTRL, f, frozenset({_LRU}))
               for f in ftrl_factories]
@@ -115,6 +125,7 @@ def default_config() -> LintConfig:
         ),
         compiled_path_globs=(
             "alink_tpu/engine/*",
+            "alink_tpu/kernels/*",
             "alink_tpu/ops/*",
             "alink_tpu/operator/common/*",
             "alink_tpu/operator/stream/onlinelearning/*",

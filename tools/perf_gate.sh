@@ -49,6 +49,15 @@ python tools/serve_shard_bench.py --smoke
 # itself.
 python tools/sweep_smoke.py
 
+# Pallas kernel-tier smoke (ISSUE 13): interpret-mode parity of all
+# three hand-written kernels in a fresh 4-device f64 child — FTRL
+# scatter bitwise vs the XLA step, chained matvec <= the pinned 1e-12,
+# fused serve score bitwise vs seq_chunk_sum per bucket + bf16/int8
+# label-exact — and the demotion warning fires EXACTLY once when the
+# backend is unavailable. Exits 7 (its own code) so a kernel-tier
+# regression names itself.
+python tools/kernel_smoke.py
+
 BASE=${PERF_GATE_BASE:-BENCH_quick_base.json}
 NEW=BENCH_quick.json
 THRESH=${PERF_GATE_THRESHOLD:-30}
