@@ -536,6 +536,72 @@ FLAGS.register(
                 "one (tests/test_kernels.py pins the miss)",
     parser=_serve_dtype_parse,
     accessor="alink_tpu.kernels.serve.serve_dtype")
+# -- serving resilience (ISSUE 14): every knob below is host-side
+# runtime POLICY — when to shed, when to degrade, how fast to re-probe
+# — and never trace-shaping: no compiled serving program, cache key or
+# checkpoint signature reads any of them.
+FLAGS.register(
+    "ALINK_TPU_SERVE_BREAKER", "bool", True,
+    "circuit-broken degradation of the compiled serving dispatch: "
+    "consecutive failures open a per-model-version breaker that routes "
+    "traffic to the host-mapper fallback and re-probes the compiled "
+    "path on a deterministic backoff schedule; 0 = pre-resilience "
+    "behavior (a failed batch fails its requests, no fallback routing)",
+    "serving",
+    key_neutral="breaker state is runtime dispatch ROUTING between two "
+                "already-compiled paths (the bucket programs and the "
+                "host mapper), never trace-shaping: no program is "
+                "compiled, keyed or invalidated by it",
+    accessor="alink_tpu.serving.resilience.serve_breaker_enabled")
+FLAGS.register(
+    "ALINK_TPU_SERVE_BREAKER_THRESHOLD", "int", 3,
+    "consecutive compiled-dispatch failures (closed state) that trip "
+    "the serving circuit breaker open", "serving",
+    key_neutral="host-side failure counting for dispatch routing only; "
+                "never read at trace time",
+    clamp=lambda n: max(1, n),
+    accessor="alink_tpu.serving.resilience.breaker_threshold")
+FLAGS.register(
+    "ALINK_TPU_SERVE_BREAKER_BACKOFF_MS", "float", 50.0,
+    "first open->half-open probe delay of the serving breaker "
+    "(deterministic exponential schedule, no jitter)", "serving",
+    key_neutral="host-side recovery scheduling only; never read at "
+                "trace time",
+    clamp=lambda v: max(0.0, v),
+    accessor="alink_tpu.serving.resilience.breaker_backoff_s")
+FLAGS.register(
+    "ALINK_TPU_SERVE_BREAKER_FACTOR", "float", 2.0,
+    "serving-breaker backoff multiplier applied per re-open (a failed "
+    "half-open probe re-opens with the NEXT step — the no-flap rule)",
+    "serving",
+    key_neutral="host-side recovery scheduling only; never read at "
+                "trace time",
+    clamp=lambda v: max(1.0, v),
+    accessor="alink_tpu.serving.resilience.breaker_factor")
+FLAGS.register(
+    "ALINK_TPU_SERVE_BREAKER_MAX_MS", "float", 5000.0,
+    "serving-breaker backoff ceiling", "serving",
+    key_neutral="host-side recovery scheduling only; never read at "
+                "trace time",
+    clamp=lambda v: max(0.0, v),
+    accessor="alink_tpu.serving.resilience.breaker_max_s")
+FLAGS.register(
+    "ALINK_TPU_SERVE_FEEDER_RETRIES", "int", 3,
+    "bounded retry budget of the supervised model-stream feeders for a "
+    "TRANSIENT swap failure (poisoned snapshots skip-and-record "
+    "instead; the server keeps serving the last good model either way)",
+    "serving",
+    key_neutral="host-side feeder retry policy; a retried swap_model "
+                "re-runs the same keyed build — never trace-shaping",
+    clamp=lambda n: max(0, n),
+    accessor="alink_tpu.serving.resilience.feeder_retries")
+FLAGS.register(
+    "ALINK_TPU_SERVE_FEEDER_BACKOFF_MS", "float", 20.0,
+    "first feeder retry delay, doubling per attempt", "serving",
+    key_neutral="host-side feeder retry pacing only; never read at "
+                "trace time",
+    clamp=lambda v: max(0.0, v),
+    accessor="alink_tpu.serving.resilience.feeder_backoff_s")
 FLAGS.register(
     "ALINK_TPU_SERVE_SWAP", "mode", "double",
     "hot model-swap mode: double (standby slot prepared off the serving "
@@ -588,10 +654,12 @@ FLAGS.register(
     accessor="alink_tpu.engine.recovery.async_snapshot_enabled")
 FLAGS.register(
     "ALINK_TPU_FAULT_INJECT", "str", "",
-    "deterministic kill injection at durability sites "
-    "(site:index[;site:index...] spec)", "durability",
-    key_neutral="host-side raise at superstep/batch/save boundaries; "
-                "never enters a traced program",
+    "deterministic fault injection at durability/serving sites: "
+    "site:index[-end][:mode[:param]] entries (;-separated) with modes "
+    "kill (default) | error (catchable transient) | delay:MS (latency) "
+    "| corrupt (snapshot bit-flip at the producer)", "durability",
+    key_neutral="host-side raise/sleep/corrupt at superstep/batch/save/"
+                "dispatch boundaries; never enters a traced program",
     accessor="alink_tpu.common.faults.fault_spec")
 
 # -- debug ------------------------------------------------------------------

@@ -63,6 +63,23 @@ def ftrl_state_rules():
     return ((r"^(z|n)$", P("d")),)
 
 
+def _corrupt_snapshot_table(snap: MTable) -> MTable:
+    """The ``feeder.snapshot`` fault site's ``corrupt`` mode
+    (common/faults.py, ISSUE 14): return a copy of the emitted model
+    table with the first coefficient payload row mangled into invalid
+    JSON, so the consumer's ``load_model`` fails LOUDLY (the serving
+    feeder's poisoned-snapshot path) instead of silently serving
+    flipped bits. The original table is never touched — the trainer's
+    own state is not corrupted, only the emitted snapshot."""
+    rows = [list(snap.row(i)) for i in range(snap.num_rows)]
+    for r in rows:
+        # payload rows carry model_id >= 1 and a JSON string
+        if r[0] and isinstance(r[1], str) and r[1]:
+            r[1] = "\x00CORRUPT" + r[1][1:]
+            break
+    return MTable([tuple(r) for r in rows], snap.schema)
+
+
 def _ftrl_weights(z, n, alpha, beta, l1, l2):
     """w from the accumulated (z, n) state — the FTRL-proximal closed form
     (one copy shared by the dense program, the sparse program, and the
@@ -1466,7 +1483,14 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                       if mon_on:
                           flush_pv()
                   else:
+                      # fault site (ISSUE 14): kill/error fail the
+                      # emission BEFORE the snapshot fetch; corrupt
+                      # mangles the EMITTED table (the serving feeder's
+                      # poisoned-snapshot path) without touching state
+                      _poison = maybe_crash("feeder.snapshot")
                       snap = snapshot(z, n, fb_S, batch=b_done + 1)
+                      if _poison:
+                          snap = _corrupt_snapshot_table(snap)
                       if mon_on:
                           flush_pv()  # pv + drift evaluated per emission
                       yield (t, snap)
@@ -1501,8 +1525,11 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 if mon_on:
                     flush_pv()
             else:
+                _poison = maybe_crash("feeder.snapshot")
                 snap = snapshot(z, n, fb_S,
                                 batch=b_done if b_done > 0 else None)
+                if _poison:
+                    snap = _corrupt_snapshot_table(snap)
                 if mon_on:
                     flush_pv()
                 yield (next_emit if next_emit is not None else interval,

@@ -49,6 +49,8 @@ import time
 from collections import deque
 from typing import Callable, Iterable, Iterator, Optional, TypeVar
 
+from ...common.faults import maybe_crash
+
 T = TypeVar("T")
 U = TypeVar("U")
 
@@ -125,6 +127,12 @@ class _Channel:
         (``_EMPTY``). ``timeout=None`` is the historical behavior;
         ``timeout=0`` polls without blocking (the micro-batcher's
         "queue already holds a full batch" fast path)."""
+        # deterministic fault site (common/faults.py): every consumer —
+        # stream drains AND the serving micro-batcher — pulls through
+        # here, so an error-mode fault is a consumer-loop crash (the
+        # serving supervisor's respawn path) and delay:MS injects
+        # upstream latency. Unarmed cost: one os.environ probe
+        maybe_crash("prefetch.get")
         deadline = None if timeout is None \
             else time.monotonic() + max(0.0, timeout)
         with self._not_empty:

@@ -23,6 +23,15 @@ that layer rebuilt TPU-first:
 * :class:`LoadGenerator` — the closed-loop load generator behind the
   ``bench.py serve_*`` rows (QPS/chip, p50/p99, bucket-hit rate,
   batch occupancy).
+* resilience (``resilience.py``) — end-to-end request deadlines with
+  typed load shedding (``submit(row, deadline_s=)`` →
+  :class:`DeadlineExceeded` BEFORE the dispatch is paid), a
+  per-model-version :class:`CircuitBreaker` that degrades compiled-path
+  failures to the host-mapper fallback and re-probes on a
+  deterministic backoff schedule, supervised feeders (bounded retry /
+  poisoned-snapshot skip / last-good-model guarantee) and supervised
+  serving loops (crash → typed quarantine + respawn); chaos-tested by
+  ``tools/chaos_smoke.py`` + the ``serve_chaos`` bench row.
 * multi-chip serving (``sharded.py``) — ``ALINK_TPU_SERVE_SHARDED``
   compiles the bucket programs under the session mesh's partition
   rules (feature-sharded model state placed by ``io/sharding.py``,
@@ -36,13 +45,18 @@ admission control, and load-generator usage.
 
 from .predictor import (CompiledPredictor, ServingKernel,
                         serve_buckets, serve_compiled_enabled)
-from .server import ModelStreamFeeder, PredictServer, RequestFuture
+from .server import (DeviceWeightsFeeder, ModelStreamFeeder, PredictServer,
+                     RequestFuture)
 from .loadgen import LoadGenerator, LoadReport, percentile, serial_qps
+from .resilience import (CircuitBreaker, DeadlineExceeded, ReplicaCrashed,
+                         RequestCancelled, serve_breaker_enabled)
 from .sharded import serve_replicas, serve_sharded_enabled, serving_mesh
 
 __all__ = [
     "CompiledPredictor", "ServingKernel", "PredictServer", "RequestFuture",
-    "ModelStreamFeeder", "LoadGenerator", "LoadReport", "percentile",
-    "serial_qps", "serve_buckets", "serve_compiled_enabled",
-    "serve_replicas", "serve_sharded_enabled", "serving_mesh",
+    "ModelStreamFeeder", "DeviceWeightsFeeder", "LoadGenerator",
+    "LoadReport", "percentile", "serial_qps", "serve_buckets",
+    "serve_compiled_enabled", "serve_replicas", "serve_sharded_enabled",
+    "serving_mesh", "CircuitBreaker", "DeadlineExceeded", "ReplicaCrashed",
+    "RequestCancelled", "serve_breaker_enabled",
 ]

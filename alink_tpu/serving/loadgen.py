@@ -33,12 +33,16 @@ def percentile(values: Sequence[float], pct: float) -> float:
 
 @dataclass
 class LoadReport:
-    """One load phase: counts, wall, throughput and latency quantiles."""
+    """One load phase: counts, wall, throughput and latency quantiles.
+    ``timeouts`` is the subset of ``failures`` where the future never
+    resolved within the reap timeout — the serving tier's SILENT-drop
+    signal (a typed rejection resolves and is a non-timeout failure)."""
     requests: int
     failures: int
     wall_s: float
     latencies_s: List[float] = field(repr=False, default_factory=list)
     responses: List[Tuple] = field(repr=False, default_factory=list)
+    timeouts: int = 0
 
     @property
     def qps(self) -> float:
@@ -88,6 +92,7 @@ class LoadGenerator:
         latencies: List[float] = []
         responses: List[Tuple] = []
         failures = [0]
+        timeouts = [0]
 
         def client(ci: int) -> None:
             from collections import deque
@@ -96,15 +101,22 @@ class LoadGenerator:
             lat_local: List[float] = []
             resp_local: List[Tuple] = []
             fail_local = 0
+            tmo_local = 0
 
             def reap(entry):
-                nonlocal fail_local
+                nonlocal fail_local, tmo_local
                 t0, fut = entry
                 try:
                     out = fut.result(self.timeout_s)
                     lat_local.append(time.perf_counter() - t0)
                     if self.collect_responses:
                         resp_local.append(out)
+                except TimeoutError:
+                    # the future never resolved: a SILENT drop, kept
+                    # distinct from typed rejections (resilience-tier
+                    # SLO accounting — chaos_smoke / serve_chaos)
+                    fail_local += 1
+                    tmo_local += 1
                 except BaseException:
                     fail_local += 1
 
@@ -124,6 +136,7 @@ class LoadGenerator:
                 latencies.extend(lat_local)
                 responses.extend(resp_local)
                 failures[0] += fail_local
+                timeouts[0] += tmo_local
 
         threads = [threading.Thread(target=client, args=(i,), daemon=True,
                                     name=f"alink-loadgen-{i}")
@@ -136,7 +149,8 @@ class LoadGenerator:
         wall = time.perf_counter() - t0
         return LoadReport(requests=per_client * self.clients,
                           failures=failures[0], wall_s=wall,
-                          latencies_s=latencies, responses=responses)
+                          latencies_s=latencies, responses=responses,
+                          timeouts=timeouts[0])
 
 
 def serial_qps(predictor, rows: Sequence[Tuple],

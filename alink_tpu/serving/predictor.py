@@ -53,6 +53,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common.faults import maybe_crash
 from ..common.metrics import get_registry, metrics_enabled
 from ..common.mtable import MTable
 from ..common.tracing import trace_complete, trace_span
@@ -430,6 +431,11 @@ class CompiledPredictor:
         Serialized across swappers; never blocks the serving loop."""
         with self._swap_lock:
             t0 = time.perf_counter()
+            # fault site: an error-mode fault fails the swap BEFORE the
+            # standby build — the active version never flips, so the
+            # last good model keeps serving (the feeder-supervision
+            # contract this site exists to test)
+            maybe_crash("serve.swap")
             with trace_span("serve.swap", cat="serve"):
                 base = self._active.mapper
                 mapper = type(base)(model_table.schema, base.data_schema,
@@ -464,6 +470,8 @@ class CompiledPredictor:
         :meth:`swap_model`."""
         with self._swap_lock:
             t0 = time.perf_counter()
+            maybe_crash("serve.swap")   # same site as swap_model: both
+                                        # are the feeder's swap boundary
             with trace_span("serve.swap", cat="serve",
                             args={"mode": "weights"}):
                 base = self._active
@@ -655,6 +663,12 @@ class CompiledPredictor:
     def _predict_chunk(self, data: MTable, replica: int = 0) -> MTable:
         import jax
         t0 = time.perf_counter()
+        # deterministic fault site (common/faults.py): error = a
+        # catchable transient dispatch failure (what trips the serving
+        # circuit breaker), delay:MS = latency injection, kill = the
+        # loop-supervisor/respawn path. BEFORE encode: a shed/failed
+        # dispatch must not have paid any device work
+        maybe_crash("serve.dispatch")
         ver = self._active           # one consistent model per dispatch
         n = data.num_rows
         bucket = self.bucket_for(n)

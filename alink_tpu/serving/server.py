@@ -37,6 +37,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Tuple
 
+from ..common.faults import FaultInjected
 from ..common.metrics import get_registry, metrics_enabled
 from ..common.mtable import MTable
 from ..common.tracing import trace_complete, trace_instant
@@ -44,6 +45,11 @@ from ..operator.stream.prefetch import _Channel, _EMPTY, _SENTINEL
 from .loadgen import percentile as _percentile
 from .predictor import (CompiledPredictor, serve_min_fill,
                         serve_queue_depth, serve_window_s)
+from .resilience import (CircuitBreaker, DeadlineExceeded, ReplicaCrashed,
+                         RequestCancelled, classify_feeder_error,
+                         feeder_backoff_s, feeder_retries,
+                         record_feeder_error, record_shed,
+                         serve_breaker_enabled)
 
 _P99_RING = 4096        # rolling latency window behind the p99 gauge
 _P99_EVERY = 128        # gauge refresh cadence (requests)
@@ -53,16 +59,31 @@ class RequestFuture:
     """One in-flight request: the submitter blocks on :meth:`result`;
     the serving loop delivers via :meth:`set_result`/``set_exception``.
     Latency (submit -> delivery) is recorded as the ``serve.request``
-    span when the result lands."""
+    span when the result lands.
 
-    __slots__ = ("row", "_event", "_value", "_error", "submitted_at")
+    **Cancellation / deadline semantics (ISSUE 14).** A ``result(
+    timeout=)`` that raises ``TimeoutError`` does NOT remove the request
+    — it stays live in the queue, is still dispatched, and its answer
+    lands in this future (the submitter just stopped waiting). To bound
+    the *server's* work, not merely the caller's patience, either pass
+    ``deadline_s=`` to ``submit()`` (the serving loop sheds the request
+    with a typed :class:`~alink_tpu.serving.resilience.DeadlineExceeded`
+    BEFORE paying the dispatch once its queue wait exceeds the budget)
+    or call :meth:`cancel` (best-effort: the loop sheds a cancelled
+    request it has not dispatched yet with :class:`~alink_tpu.serving.
+    resilience.RequestCancelled`)."""
 
-    def __init__(self, row: Tuple):
+    __slots__ = ("row", "_event", "_value", "_error", "submitted_at",
+                 "deadline_s", "_cancelled")
+
+    def __init__(self, row: Tuple, deadline_s: Optional[float] = None):
         self.row = row
         self._event = threading.Event()
         self._value = None
         self._error: Optional[BaseException] = None
         self.submitted_at = time.perf_counter()
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self._cancelled = False
 
     def set_result(self, value) -> None:
         self._value = value
@@ -75,9 +96,25 @@ class RequestFuture:
     def done(self) -> bool:
         return self._event.is_set()
 
+    def cancel(self) -> bool:
+        """Best-effort cancel: mark the request so the serving loop
+        sheds it before dispatch. Returns ``False`` when the result (or
+        a typed rejection) already landed; ``True`` marks it — but a
+        dispatch already in flight may still deliver a result."""
+        if self._event.is_set():
+            return False
+        self._cancelled = True
+        return True
+
+    def cancelled(self) -> bool:
+        return self._cancelled
+
     def result(self, timeout: Optional[float] = None):
         if not self._event.wait(timeout):
-            raise TimeoutError("serving request timed out")
+            raise TimeoutError(
+                "serving request timed out (the request is STILL live — "
+                "pass deadline_s= to submit() or call cancel() to bound "
+                "the server's work, not just the wait)")
         if self._error is not None:
             raise self._error
         return self._value
@@ -122,6 +159,16 @@ class PredictServer:
         self._batches = 0
         self._occupancy_sum = 0.0
         self._latencies: deque = deque(maxlen=_P99_RING)
+        # -- resilience (ISSUE 14) ------------------------------------
+        self._shed = 0                 # deadline/cancel rejections
+        self._fallback_batches = 0     # breaker-routed host-mapper serves
+        self._respawns = 0             # supervised loop restarts
+        self._quarantined = 0          # requests typed-failed by a crash
+        self._breaker_lock = threading.Lock()
+        self._breakers: dict = {}      # model version -> CircuitBreaker
+        # cumulative opens/reopens/probes across ALL model versions (a
+        # hot-swap storm retires breakers; the run's totals must not)
+        self._breaker_totals = {"opens": 0, "reopens": 0, "probes": 0}
         # -- replica dispatch (ISSUE 11): R serving loops drain the ONE
         # admission channel and fan bucket batches out across the
         # session mesh's chips (one single-device model placement per
@@ -132,7 +179,7 @@ class PredictServer:
         self._threads = []
         for i in range(self.replicas):
             th = threading.Thread(
-                target=self._loop, args=(i,), daemon=True,
+                target=self._run_replica, args=(i,), daemon=True,
                 name=(f"alink-serve-{name}" if self.replicas == 1
                       else f"alink-serve-{name}-r{i}"))
             self._threads.append(th)
@@ -158,41 +205,90 @@ class PredictServer:
         return max(1, r)
 
     # -- submission (any thread) ----------------------------------------
-    def submit(self, row: Tuple) -> RequestFuture:
+    def submit(self, row: Tuple,
+               deadline_s: Optional[float] = None) -> RequestFuture:
         """Enqueue one request row; blocks when the admission queue is
-        full (backpressure). Raises after :meth:`close`."""
+        full (backpressure). Raises after :meth:`close`.
+
+        ``deadline_s`` is an END-TO-END budget stamped at admission: a
+        request whose queue wait already exceeds it is SHED by the
+        serving loop before the dispatch is paid — the future resolves
+        to a typed :class:`~alink_tpu.serving.resilience.
+        DeadlineExceeded`, and the compiled program never sees the row
+        (counted in ``alink_serve_shed_total{reason="deadline"}``)."""
         if self._closed.is_set():
             raise RuntimeError(f"PredictServer {self.name!r} is closed")
-        fut = RequestFuture(tuple(row))
+        fut = RequestFuture(tuple(row), deadline_s=deadline_s)
         if not self._ch.put(fut):
             raise RuntimeError(f"PredictServer {self.name!r} is closed")
         return fut
 
-    def predict(self, row: Tuple, timeout: Optional[float] = None) -> Tuple:
+    def predict(self, row: Tuple, timeout: Optional[float] = None,
+                deadline_s: Optional[float] = None) -> Tuple:
         """Synchronous single-request round trip."""
-        return self.submit(row).result(timeout)
+        return self.submit(row, deadline_s=deadline_s).result(timeout)
 
     def swap_model(self, model_table: MTable) -> int:
         """Hot-swap the served model (double-buffered; see predictor)."""
         return self.predictor.swap_model(model_table)
 
-    # -- the serving loop (one per replica) -------------------------------
-    def _loop(self, replica: int = 0) -> None:
+    # -- the serving loop (one per replica, supervised) -------------------
+    def _run_replica(self, replica: int) -> None:
+        """Supervisor: run the serving loop; when it CRASHES (an escape
+        past :meth:`_serve`'s handling — e.g. an injected ``kill`` at
+        ``serve.dispatch`` or a ``prefetch.get`` fault), quarantine the
+        in-flight batch (every unresolved request fails with a typed
+        :class:`~alink_tpu.serving.resilience.ReplicaCrashed` — never
+        silence) and RESPAWN the loop. A respawned loop after
+        :meth:`close` sees the channel sentinel and exits cleanly."""
+        backoff = 0.01
         while True:
+            inflight: List[RequestFuture] = []
+            try:
+                self._loop(replica, inflight)
+                return
+            except BaseException as e:
+                # BaseException for the QUARANTINE (an interrupt must
+                # not strand in-flight futures in silence) — but only
+                # Exception respawns; KeyboardInterrupt / SystemExit
+                # propagate after the quarantine (the feeder-
+                # supervision rule)
+                quarantined = [f for f in inflight if not f.done()]
+                for f in quarantined:
+                    f.set_exception(ReplicaCrashed(replica, e))
+                with self._stats_lock:
+                    self._failed += len(quarantined)
+                    self._quarantined += len(quarantined)
+                    self._respawns += 1
+                trace_instant("serve.respawn", cat="serve",
+                              args={"server": self.name, "replica": replica,
+                                    "quarantined": len(quarantined),
+                                    "error": type(e).__name__})
+                if metrics_enabled():
+                    get_registry().inc("alink_serve_loop_respawns_total", 1,
+                                       {"server": self.name})
+                if not isinstance(e, Exception):
+                    raise
+                time.sleep(backoff)
+                backoff = min(0.5, backoff * 2)
+
+    def _loop(self, replica: int, inflight: List[RequestFuture]) -> None:
+        while True:
+            del inflight[:]
             first = self._ch.get()
             if first is _SENTINEL:
                 return
-            batch: List[RequestFuture] = [first]
+            inflight.append(first)
             deadline = None
             closing = False
-            while len(batch) < self.max_batch:
-                got = self._ch.drain(self.max_batch - len(batch))
+            while len(inflight) < self.max_batch:
+                got = self._ch.drain(self.max_batch - len(inflight))
                 if got:
-                    batch.extend(got)
+                    inflight.extend(got)
                     continue
                 # queue drained: dispatch NOW unless the batch is under
                 # min_fill and latency budget remains
-                if len(batch) >= self.min_fill:
+                if len(inflight) >= self.min_fill:
                     break
                 if deadline is None:
                     deadline = time.monotonic() + self.window_s
@@ -205,17 +301,123 @@ class PredictServer:
                 if nxt is _SENTINEL:
                     closing = True
                     break
-                batch.append(nxt)
-            self._serve(batch, replica)
+                inflight.append(nxt)
+            self._serve(inflight, replica)
             if closing:
                 return
 
+    # -- deadline / cancellation shedding ---------------------------------
+    def _admit(self, batch: List[RequestFuture],
+               now: float) -> List[RequestFuture]:
+        """Shed requests whose queue wait already exceeds their deadline
+        (or that the submitter cancelled) BEFORE the dispatch is paid:
+        the typed rejection lands through the future, the compiled
+        program never sees the row."""
+        kept: List[RequestFuture] = []
+        for fut in batch:
+            if fut.cancelled():
+                fut.set_exception(RequestCancelled(
+                    "request cancelled before dispatch"))
+                self._record_shed("cancelled")
+                continue
+            dl = fut.deadline_s
+            if dl is not None:
+                waited = now - fut.submitted_at
+                if waited > dl:
+                    fut.set_exception(DeadlineExceeded(waited, dl))
+                    self._record_shed("deadline")
+                    continue
+            kept.append(fut)
+        return kept
+
+    def _record_shed(self, reason: str) -> None:
+        with self._stats_lock:
+            self._shed += 1
+        record_shed(self.name, reason)
+
+    # -- circuit-broken dispatch ------------------------------------------
+    def _breaker_for(self, version: int) -> CircuitBreaker:
+        """The ACTIVE model version's breaker (a hot swap starts the new
+        version closed — per-model-version state, the PR 11 fallback
+        upgraded to a recovering policy). Old versions' breakers are
+        dropped; a replica mid-dispatch on one keeps its own reference."""
+        with self._breaker_lock:
+            br = self._breakers.get(version)
+            if br is None:
+                for old in self._breakers.values():   # retire, keep totals
+                    old.retire()    # a stale in-flight verdict must not
+                                    # move the gauge or post-snapshot
+                                    # counters (frozen from here on)
+                    s = old.snapshot()
+                    for k in self._breaker_totals:
+                        self._breaker_totals[k] += s[k]
+                br = CircuitBreaker(self.name, version)
+                self._breakers = {version: br}
+            return br
+
+    def breaker_stats(self) -> dict:
+        """state/step of the ACTIVE version's breaker plus cumulative
+        opens/reopens/probes across every version this server served
+        (zeros when the breaker never engaged)."""
+        with self._breaker_lock:
+            brs = list(self._breakers.values())
+            totals = dict(self._breaker_totals)
+        if not brs:
+            return {"state": "closed", "step": 0, "version": None,
+                    **totals}
+        snap = brs[-1].snapshot()
+        for k in totals:
+            snap[k] = snap[k] + totals[k]
+        return snap
+
     def _serve(self, batch: List[RequestFuture], replica: int = 0) -> None:
+        batch = self._admit(batch, time.perf_counter())
+        if not batch:
+            return
         done_t = None
+        route, br, settled = "compiled", None, False
+        if serve_breaker_enabled():
+            br = self._breaker_for(self.predictor.model_version)
+            route = br.acquire()
+
+        def _settle_failure() -> None:
+            # an escape (injected kill, encode error, fan-out error)
+            # past the paired on_success/on_failure MUST still release
+            # the breaker slot: a leaked half-open probe would wedge
+            # the breaker in fallback forever (no caller left to close
+            # or re-open it)
+            nonlocal settled
+            if br is not None and route != "fallback" and not settled:
+                settled = True
+                br.on_failure(probe=(route == "probe"))
         try:
             data = MTable([f.row for f in batch],
                           self.predictor.data_schema)
-            out = self.predictor.predict_table(data, replica=replica)
+            if route == "fallback":
+                out = self._fallback(data)
+            else:
+                try:
+                    out = self.predictor.predict_table(data, replica=replica)
+                    if br is not None:
+                        settled = True
+                        br.on_success(probe=(route == "probe"))
+                except FaultInjected:
+                    raise       # the injected process kill: the loop
+                                # supervisor quarantines + respawns
+                except Exception as e:
+                    if br is None:
+                        raise
+                    settled = True
+                    br.on_failure(probe=(route == "probe"))
+                    if route == "probe":
+                        # degraded traffic stays degraded on a failed
+                        # probe — the batch serves through the host
+                        # mapper instead of paying for the re-test
+                        out = self._fallback(data)
+                    else:
+                        raise   # closed-state failure: the batch fails
+                                # its own requests (pre-resilience
+                                # contract) while the breaker counts
             # vectorized fan-out: pull the output columns once, hand
             # each future its row tuple (out.row(i) would re-resolve
             # every column per request)
@@ -223,7 +425,11 @@ class PredictServer:
             done_t = time.perf_counter()
             for i, fut in enumerate(batch):
                 fut.set_result(tuple(c[i] for c in cols))
+        except FaultInjected:
+            _settle_failure()
+            raise
         except BaseException as e:
+            _settle_failure()
             done_t = done_t or time.perf_counter()
             for fut in batch:
                 if not fut.done():
@@ -231,6 +437,18 @@ class PredictServer:
             with self._stats_lock:
                 self._failed += len(batch)
         self._account(batch, done_t)
+
+    def _fallback(self, data: MTable) -> MTable:
+        """Breaker-open degradation: the batch serves through the HOST
+        mapper path (the active model applied off-device) — degraded
+        throughput, correct answers, zero dropped requests."""
+        out = self.predictor.host_reference(data)
+        with self._stats_lock:
+            self._fallback_batches += 1
+        if metrics_enabled():
+            get_registry().inc("alink_serve_breaker_fallback_total", 1,
+                               {"server": self.name})
+        return out
 
     def _account(self, batch: List[RequestFuture], done_t: float) -> None:
         n = len(batch)
@@ -258,11 +476,14 @@ class PredictServer:
     # -- stats / shutdown -------------------------------------------------
     def stats(self) -> dict:
         """A point-in-time snapshot: request/batch counts, mean batch
-        occupancy, rolling p50/p99, program-cache hit rate."""
+        occupancy, rolling p50/p99, program-cache hit rate, plus the
+        resilience counters (shed, breaker fallbacks, loop respawns)."""
         with self._stats_lock:
             lats = list(self._latencies)
             requests, failed = self._requests, self._failed
             batches, occ = self._batches, self._occupancy_sum
+            shed, fb = self._shed, self._fallback_batches
+            respawns, quarantined = self._respawns, self._quarantined
         cache = self.predictor.cache_stats()
         looked = cache["hits"] + cache["misses"]
         return {
@@ -275,6 +496,9 @@ class PredictServer:
             "programs": cache["programs"],
             "model_version": self.predictor.model_version,
             "queue_depth": self._ch.depth(),
+            "shed": shed, "fallback_batches": fb,
+            "loop_respawns": respawns, "quarantined": quarantined,
+            "breaker": self.breaker_stats(),
         }
 
     def close(self, timeout: float = 10.0) -> None:
@@ -294,7 +518,58 @@ class PredictServer:
         self.close()
 
 
-class ModelStreamFeeder:
+class _FeederSupervision:
+    """The shared feeder supervision policy (ISSUE 14): bounded
+    retry + doubling backoff for TRANSIENT swap failures, skip-and-
+    record for POISONED snapshots (corrupt payload, geometry refusal —
+    deterministic, retrying cannot help), and the last-good-model
+    guarantee — a swap that never succeeds never flips the active
+    version, so the server keeps serving the previous model, never a
+    torn or absent one. Every error is visible AT THE FAILURE
+    (``alink_serve_feeder_errors_total`` + one RuntimeWarning per
+    feeder+kind), not only at the deferred ``join()``."""
+
+    #: set by subclasses for metric labels / warnings
+    feeder_kind = "feeder"
+
+    retried = 0          # transient retries spent
+    skipped = 0          # poisoned snapshots skipped
+
+    def _supervised_swap(self, swap: Callable[[], int]) -> Optional[int]:
+        """Run one swap attempt under supervision; returns the new
+        version, or ``None`` when the snapshot was skipped (poisoned /
+        budget exhausted) — the caller moves on to the next snapshot
+        with the last good model still serving."""
+        budget = feeder_retries()
+        backoff = feeder_backoff_s()
+        attempt = 0
+        while True:
+            try:
+                return swap()
+            except FaultInjected:
+                raise            # the injected process kill passes through
+            except Exception as e:
+                # Exception, NOT BaseException: a KeyboardInterrupt /
+                # SystemExit must propagate immediately, not sleep
+                # through retry cycles misrecorded as a backend blip
+                kind = classify_feeder_error(e)
+                record_feeder_error(self.feeder_kind, kind, e)
+                if kind == "poisoned":
+                    self.skipped += 1
+                    return None
+                attempt += 1
+                if attempt > budget:
+                    raise        # the run loop records this as "fatal"
+                self.retried += 1
+                if metrics_enabled():
+                    get_registry().inc(
+                        "alink_serve_feeder_retries_total", 1,
+                        {"feeder": self.feeder_kind})
+                time.sleep(backoff)
+                backoff *= 2
+
+
+class ModelStreamFeeder(_FeederSupervision):
     """Tap a model-snapshot stream into a server's hot-swap path.
 
     Drains ``stream_op.timed_batches()`` on a background thread and
@@ -302,7 +577,15 @@ class ModelStreamFeeder:
     the FTRL trainer's model stream (reference: ``FtrlPredictStreamOp``'s
     CollectModel swap). Keeps every swapped model table (``versions``)
     so a bench/test can re-validate responses against the exact model
-    set that was ever active."""
+    set that was ever active.
+
+    Swaps run SUPERVISED (:class:`_FeederSupervision`): transient
+    failures retry with bounded backoff, poisoned snapshots skip with
+    the error recorded, and in both cases the server keeps serving the
+    last good model. A stream-side error still ends the feeder — but it
+    is recorded at the failure, not only at ``join()``."""
+
+    feeder_kind = "ModelStreamFeeder"
 
     def __init__(self, server: PredictServer, stream_op,
                  limit: Optional[int] = None,
@@ -313,6 +596,8 @@ class ModelStreamFeeder:
         self.on_swap = on_swap
         self.versions: List[Tuple[int, MTable]] = []
         self.error: Optional[BaseException] = None
+        self.retried = 0
+        self.skipped = 0
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="alink-serve-feeder")
 
@@ -323,7 +608,11 @@ class ModelStreamFeeder:
     def _run(self) -> None:
         try:
             for _t, model_table in self.stream_op.timed_batches():
-                version = self.server.swap_model(model_table)
+                version = self._supervised_swap(
+                    lambda: self.server.swap_model(model_table))
+                if version is None:
+                    continue     # poisoned snapshot skipped; last good
+                                 # model keeps serving
                 self.versions.append((version, model_table))
                 trace_instant("serve.model_stream", cat="serve",
                               args={"version": version})
@@ -332,8 +621,10 @@ class ModelStreamFeeder:
                 if self.limit is not None \
                         and len(self.versions) >= self.limit:
                     return
-        except BaseException as e:   # surfaced via join()
+        except BaseException as e:   # surfaced via join() AND recorded now
             self.error = e
+            if not getattr(e, "_alink_feeder_recorded", False):
+                record_feeder_error(self.feeder_kind, "fatal", e)
 
     def join(self, timeout: Optional[float] = None) -> int:
         """Wait for the stream to drain; returns the swap count. Raises
@@ -352,7 +643,7 @@ class ModelStreamFeeder:
         return len(self.versions)
 
 
-class DeviceWeightsFeeder:
+class DeviceWeightsFeeder(_FeederSupervision):
     """Device-to-device model swaps off the FTRL trainer's (z, n) state
     (ROADMAP item 1 leftover, ISSUE 12 satellite).
 
@@ -377,6 +668,8 @@ class DeviceWeightsFeeder:
     :meth:`run` (the hook consumes every snapshot, so the stream yields
     nothing — iterating it IS the training loop)."""
 
+    feeder_kind = "DeviceWeightsFeeder"
+
     def __init__(self, server: PredictServer, ftrl_op,
                  limit: Optional[int] = None,
                  on_swap: Optional[Callable[[int], None]] = None):
@@ -386,6 +679,8 @@ class DeviceWeightsFeeder:
         self.on_swap = on_swap
         self.versions: List[int] = []
         self.error: Optional[BaseException] = None
+        self.retried = 0
+        self.skipped = 0
         self._thread = threading.Thread(target=self._drain, daemon=True,
                                         name="alink-serve-devfeeder")
         ftrl_op.set_device_snapshot_consumer(self._consume)
@@ -409,15 +704,26 @@ class DeviceWeightsFeeder:
         if int(feats.shape[0]) > wf8_len:
             # the documented loud refusal: a trainer wider than the
             # serving kernel's weight slot must not die in a jnp shape
-            # error on the drain thread
-            raise ValueError(
+            # error on the drain thread — recorded at the failure
+            # (metric + one-time warning), then raised
+            err = ValueError(
                 f"DeviceWeightsFeeder geometry mismatch: trainer emits "
                 f"{int(feats.shape[0])} feature weights, the active "
                 f"serving kernel holds {wf8_len} — a different geometry "
                 f"must go through swap_model (new signature, new "
                 f"programs)")
+            # kind="fatal", not "poisoned": the documented loud refusal
+            # KILLS the drain (a wiring bug, not a per-snapshot poison
+            # the supervision could skip past) — the metric must say so
+            record_feeder_error(self.feeder_kind, "fatal", err)
+            err._alink_feeder_recorded = True   # _drain must not record
+            raise err                           # the SAME event twice
         wf8 = jnp.zeros(wf8_len, w_full.dtype).at[:feats.shape[0]].set(feats)
-        version = self.server.predictor.swap_weights((wf8, b))
+        version = self._supervised_swap(
+            lambda: self.server.predictor.swap_weights((wf8, b)))
+        if version is None:
+            return True    # poisoned swap skipped (recorded); the last
+                           # good model keeps serving
         self.versions.append(version)
         trace_instant("serve.model_stream", cat="serve",
                       args={"version": version, "path": "device"})
@@ -431,8 +737,10 @@ class DeviceWeightsFeeder:
             # training; nothing crosses to host
             for _ in self.ftrl_op.timed_batches():
                 pass
-        except BaseException as e:   # surfaced via join()
+        except BaseException as e:   # surfaced via join() AND recorded now
             self.error = e
+            if not getattr(e, "_alink_feeder_recorded", False):
+                record_feeder_error(self.feeder_kind, "fatal", e)
 
     def start(self) -> "DeviceWeightsFeeder":
         self._thread.start()

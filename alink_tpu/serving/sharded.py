@@ -77,7 +77,15 @@ def serve_replicas() -> int:
     """``ALINK_TPU_SERVE_REPLICAS``: serving-loop replica count of
     :class:`~alink_tpu.serving.server.PredictServer` (data-parallel
     dispatch fan-out across the session mesh's chips). 0 = one replica
-    per mesh device; default 1 = the historical single loop."""
+    per mesh device; default 1 = the historical single loop.
+
+    Every replica loop runs SUPERVISED (ISSUE 14): a crash — anything
+    escaping the per-batch failure handling, e.g. an injected
+    ``serve.dispatch`` kill or an admission-channel fault — quarantines
+    the replica's in-flight batch (typed ``ReplicaCrashed`` through
+    each unresolved future, never silence) and respawns the loop, so
+    one bad replica degrades capacity instead of stranding requests
+    (``alink_serve_loop_respawns_total``)."""
     from ..common.flags import flag_value
     return int(flag_value("ALINK_TPU_SERVE_REPLICAS", 1))
 

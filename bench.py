@@ -2515,6 +2515,175 @@ def quick_serve_hot_swap(h: Harness):
     return _bench_serve_hot_swap(h, requests_per_phase=1_500)
 
 
+def _bench_serve_chaos(h: Harness, requests_per_phase: int,
+                       n_rows: int = 2048, dim: int = 48,
+                       batch_rows: int = 128):
+    """Serving under a scripted fault storm (ISSUE 14): transient
+    ``serve.dispatch`` errors + injected latency + one corrupt FTRL
+    snapshot + a concurrent swap storm, driven by the deterministic
+    ``ALINK_TPU_FAULT_INJECT`` windows. The row records the SLO
+    contract — zero torn responses, zero silent drops (results + typed
+    rejections == submissions), measurable breaker recovery to the
+    compiled path — plus shed/breaker/retry counts and p99 before/
+    during/after. Typed rejections during the storm are BY DESIGN
+    (that is what load shedding and closed-state failure accounting
+    are); torn or silent is what fails the gate."""
+    import time as _time
+
+    from alink_tpu.common.faults import reset_faults
+    from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+    from alink_tpu.operator.stream.onlinelearning.ftrl import (
+        FtrlTrainStreamOp)
+    from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+    from alink_tpu.serving import (CompiledPredictor, LoadGenerator,
+                                   ModelStreamFeeder, PredictServer)
+    tbl, warm, mapper, data_schema = _serve_fixture(n_rows, dim, seed=13)
+    req = tbl.select(["vec"])
+    pred = CompiledPredictor(mapper, name="serve_chaos")
+    for b in pred.buckets:
+        pred.predict_table(req.first_n(min(b, n_rows)))
+    srv = PredictServer(pred, name="serve_chaos")
+    probe = req.row(0)
+    saved_fault = os.environ.pop("ALINK_TPU_FAULT_INJECT", None)
+    saved_maxms = os.environ.get("ALINK_TPU_SERVE_BREAKER_MAX_MS")
+    os.environ["ALINK_TPU_SERVE_BREAKER_MAX_MS"] = "200"
+    tally = {"submitted": 0, "results": 0, "typed": 0, "silent": 0}
+    responses = []
+
+    def lg(requests):
+        gen = LoadGenerator(srv.submit, [probe], clients=4, pipeline=8,
+                            collect_responses=True)
+        rep = gen.run(requests)
+        tally["submitted"] += rep.requests
+        tally["results"] += rep.requests - rep.failures
+        # timeouts = futures that never resolved: SILENT drops, even
+        # inside the load-generator phases (the gated invariant)
+        tally["typed"] += rep.failures - rep.timeouts
+        tally["silent"] += rep.timeouts
+        responses.extend(rep.responses)
+        return rep
+
+    def one(deadline_s=None):
+        tally["submitted"] += 1
+        try:
+            responses.append(tuple(
+                srv.submit(probe, deadline_s=deadline_s).result(60)))
+            tally["results"] += 1
+        except TimeoutError:
+            tally["silent"] += 1
+        except BaseException:
+            tally["typed"] += 1
+
+    t0 = time.perf_counter()
+    try:
+        lg(max(100, requests_per_phase // 4))             # warm the loop
+        from alink_tpu.common.profiling2 import measured_region
+        with measured_region():
+            rep_before = lg(requests_per_phase)
+            # -- the storm: error window + one corrupt snapshot + swaps
+            reset_faults()
+            os.environ["ALINK_TPU_FAULT_INJECT"] = \
+                "serve.dispatch:1-14:error;feeder.snapshot:1-1:corrupt"
+            src = MemSourceStreamOp(tbl, batch_size=batch_rows)
+            ftrl = FtrlTrainStreamOp(warm, vector_col="vec",
+                                     label_col="label", alpha=0.1,
+                                     update_mode="batch",
+                                     time_interval=1.0).link_from(src)
+            feeder = ModelStreamFeeder(srv, ftrl).start()
+            rep_storm = lg(requests_per_phase)
+            # latency + deadline leg (same counter timeline — the
+            # corrupt window stays exactly-once)
+            wait_until = _time.monotonic() + 20
+            while srv.breaker_stats()["state"] != "closed" \
+                    and _time.monotonic() < wait_until:
+                one()
+                _time.sleep(0.05)
+            os.environ["ALINK_TPU_FAULT_INJECT"] = \
+                "serve.dispatch:1:delay:30;feeder.snapshot:1-1:corrupt"
+            f_first = srv.submit(probe)
+            tally["submitted"] += 1
+            _time.sleep(0.01)
+            shed_futs = [srv.submit(probe, deadline_s=0.004)
+                         for _ in range(6)]
+            tally["submitted"] += 6
+            for f in [f_first] + shed_futs:
+                try:
+                    responses.append(tuple(f.result(60)))
+                    tally["results"] += 1
+                except TimeoutError:
+                    tally["silent"] += 1
+                except BaseException:
+                    tally["typed"] += 1
+            swaps = feeder.join(timeout=180)
+            # -- the storm clears: recovery phase
+            del os.environ["ALINK_TPU_FAULT_INJECT"]
+            reset_faults()
+            _time.sleep(0.25)
+            batches_pre = srv.stats()["batches"]
+            fallback_pre = srv.stats()["fallback_batches"]
+            rep_after = lg(requests_per_phase)
+        stats = srv.stats()
+        compiled_after = (stats["batches"] - batches_pre) \
+            - (stats["fallback_batches"] - fallback_pre)
+    finally:
+        srv.close()
+        os.environ.pop("ALINK_TPU_FAULT_INJECT", None)
+        if saved_fault is not None:
+            os.environ["ALINK_TPU_FAULT_INJECT"] = saved_fault
+        if saved_maxms is None:
+            os.environ.pop("ALINK_TPU_SERVE_BREAKER_MAX_MS", None)
+        else:
+            os.environ["ALINK_TPU_SERVE_BREAKER_MAX_MS"] = saved_maxms
+        reset_faults()
+    dt = time.perf_counter() - t0
+    # torn check: every response must match a model version that was
+    # actually active (warm start or a completed swap)
+    expected = set()
+    for _v, mt in [(0, warm.get_output_table())] + feeder.versions:
+        m2 = LinearModelMapper(mt.schema, data_schema, mapper.params)
+        m2.load_model(mt)
+        expected.add(repr(tuple(m2.map_row(probe))))
+    torn = len({repr(tuple(r)) for r in responses} - expected)
+    brk = stats["breaker"]
+    recovered = (brk["state"] == "closed" and compiled_after > 0
+                 and stats["breaker"]["opens"] >= 1)
+    return {
+        "samples_per_sec_per_chip": round(rep_storm.qps, 1),
+        "qps_per_chip": round(rep_storm.qps, 1),
+        "qps_before": round(rep_before.qps, 1),
+        "qps_after": round(rep_after.qps, 1),
+        "p99_ms_before": round(rep_before.p99_s * 1e3, 3),
+        "p99_ms_during": round(rep_storm.p99_s * 1e3, 3),
+        "p99_ms_after": round(rep_after.p99_s * 1e3, 3),
+        "p50_ms_during": round(rep_storm.p50_s * 1e3, 3),
+        "requests_total": tally["submitted"],
+        "typed_rejections": tally["typed"],
+        "silent_drops": tally["silent"],
+        "torn_responses": torn,
+        "shed_requests": int(stats["shed"]),
+        "breaker_opens": int(brk["opens"]),
+        "breaker_reopens": int(brk["reopens"]),
+        "breaker_probes": int(brk["probes"]),
+        "fallback_batches": int(stats["fallback_batches"]),
+        "loop_respawns": int(stats["loop_respawns"]),
+        "feeder_retries": int(feeder.retried),
+        "feeder_skipped": int(feeder.skipped),
+        "model_swaps": int(swaps),
+        "post_storm_compiled_batches": int(compiled_after),
+        "recovered_compiled": bool(recovered),
+        "bound": "serving-host",
+        "dt_s": round(dt, 3),
+    }
+
+
+def bench_serve_chaos(h: Harness):
+    return _bench_serve_chaos(h, requests_per_phase=3_000, n_rows=4096)
+
+
+def quick_serve_chaos(h: Harness):
+    return _bench_serve_chaos(h, requests_per_phase=800)
+
+
 def _tuning_sweep_row(h: Harness, n_rows, d, iters, P, rung, eta, reps):
     """Mesh-parallel tuning sweep (ROADMAP item 3): N hyperparameter
     points as ONE BSP program with ASHA early stopping, measured against
@@ -2619,7 +2788,8 @@ QUICK_WORKLOADS = (("logreg_criteo", quick_logreg),
                    ("serve_logreg", quick_serve_logreg),
                    ("serve_fused", quick_serve_fused),
                    ("serve_ftrl_hot_swap", quick_serve_hot_swap),
-                   ("serve_logreg_sharded", quick_serve_sharded))
+                   ("serve_logreg_sharded", quick_serve_sharded),
+                   ("serve_chaos", quick_serve_chaos))
 
 
 # ---------------------------------------------------------------------------
@@ -2733,7 +2903,8 @@ def main(argv=None):
                      ("serve_logreg", bench_serve_logreg),
                      ("serve_fused", bench_serve_fused),
                      ("serve_ftrl_hot_swap", bench_serve_hot_swap),
-                     ("serve_logreg_sharded", bench_serve_sharded))
+                     ("serve_logreg_sharded", bench_serve_sharded),
+                     ("serve_chaos", bench_serve_chaos))
     for name, fn in suite:
         r = None
         for attempt in (1, 2):

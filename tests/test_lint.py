@@ -491,9 +491,12 @@ class TestMigrationByteIdentity:
         assert stream_workers() == 1            # legacy max(1, n) clamp
 
     def test_fault_spec_reads_through_registry(self, monkeypatch):
-        from alink_tpu.common.faults import fault_spec
+        from alink_tpu.common.faults import FaultRule, fault_spec
         monkeypatch.setenv("ALINK_TPU_FAULT_INJECT", "ftrl.batch:3")
-        assert fault_spec() == {"ftrl.batch": 3}
+        # the r14 grammar parses the legacy site:index form to an
+        # open-ended kill rule — same semantics, richer spec type
+        assert fault_spec() == {"ftrl.batch": FaultRule(3, None, "kill",
+                                                        0.0)}
         monkeypatch.delenv("ALINK_TPU_FAULT_INJECT", raising=False)
         assert fault_spec() == {}
 

@@ -264,11 +264,12 @@ class TestAsyncSnapshot:
         coef_full, _, steps_full = _lbfgs(
             data, checkpoint_dir=d_full, checkpoint_every=4)
         d_kill = str(tmp_path / "kill")
-        # ckpt.save uses a per-process auto counter (faults._AUTO_INDEX);
-        # zero it so the threshold means "the 2nd save of THIS run"
-        # regardless of which tests armed the site earlier
-        from alink_tpu.common import faults
-        monkeypatch.setitem(faults._AUTO_INDEX, "ckpt.save", 0)
+        # ckpt.save uses a per-process auto counter; reset_faults() zeros
+        # it so the threshold means "the 2nd save of THIS run" regardless
+        # of which tests armed the site earlier (ISSUE 14 satellite: the
+        # exported fixture hook, replacing ad-hoc _AUTO_INDEX pokes)
+        from alink_tpu.common.faults import reset_faults
+        reset_faults()
         monkeypatch.setenv(FAULT_ENV, "ckpt.save:2")
         with pytest.raises(FaultInjected):
             _lbfgs(data, checkpoint_dir=d_kill, checkpoint_every=4)

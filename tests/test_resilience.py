@@ -1,0 +1,845 @@
+"""Serving & stream resilience tier (ISSUE 14): deadlines, load
+shedding, circuit-broken degradation, supervised feeders, generalized
+fault modes.
+
+The load-bearing invariants:
+  * a deadline-shed request NEVER reaches the compiled program — the
+    typed DeadlineExceeded lands through the future before the dispatch
+    is paid (counted via the serving metrics);
+  * the circuit breaker's closed -> open -> half-open -> closed sequence
+    is DETERMINISTIC under a scripted fault schedule, including the
+    no-flap rule (a failed half-open probe re-opens with the NEXT
+    backoff step, not the first);
+  * supervised feeders retry transient swap failures, skip-and-record
+    poisoned snapshots, and the server keeps serving the LAST GOOD
+    model either way;
+  * a crashed serving loop quarantines its in-flight requests (typed
+    rejection, never silence) and respawns;
+  * default flags + no armed faults = the exact pre-resilience serving
+    behavior (responses bitwise vs the host mapper, zero resilience
+    counters moving).
+"""
+
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.faults import (FAULT_ENV, FaultInjected, FaultRule,
+                                     TransientFault, fault_spec,
+                                     maybe_crash, reset_faults)
+from alink_tpu.common.mtable import MTable
+from alink_tpu.common.params import Params
+from alink_tpu.common.vector import DenseVector
+from alink_tpu.operator.batch.classification.linear import (
+    LogisticRegressionTrainBatchOp)
+from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+from alink_tpu.serving import (CompiledPredictor, DeadlineExceeded,
+                               ModelStreamFeeder, PredictServer,
+                               ReplicaCrashed, RequestCancelled)
+from alink_tpu.serving.resilience import (CLOSED, HALF_OPEN, OPEN,
+                                          CircuitBreaker,
+                                          _reset_feeder_warnings)
+
+
+@pytest.fixture
+def fresh_registry():
+    from alink_tpu.common.metrics import MetricsRegistry, set_registry
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        yield reg
+    finally:
+        set_registry(prev)
+
+
+@pytest.fixture
+def clean_faults(monkeypatch):
+    """Arm-from-zero fault state: counters reset before AND after, env
+    cleared after (the reset_faults satellite contract)."""
+    reset_faults()
+    yield monkeypatch
+    monkeypatch.delenv(FAULT_ENV, raising=False)
+    reset_faults()
+
+
+def _metric(reg, name, **labels):
+    total = 0.0
+    found = False
+    for rec in reg.snapshot():
+        if rec["name"] != name:
+            continue
+        lb = rec.get("labels") or {}
+        if all(lb.get(k) == v for k, v in labels.items()):
+            total += rec.get("value") or 0.0
+            found = True
+    return total if found else 0.0
+
+
+@pytest.fixture(scope="module")
+def base():
+    """One shared trained model for the default-geometry tests (the
+    mapper is immutable post-load; every test builds its OWN predictor
+    and server). Variant-seed tests call :func:`_fixture` directly."""
+    return _fixture()
+
+
+def _fixture(seed=0, n=192, d=12):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d)
+    y = (X @ rng.randn(d) > 0).astype(np.int64)
+    vecs = np.empty(n, object)
+    vecs[:] = [DenseVector(X[i]) for i in range(n)]
+    tbl = MTable({"vec": vecs, "label": y}, "vec VECTOR, label LONG")
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label",
+        max_iter=3).link_from(MemSourceBatchOp(tbl))
+    data_schema = tbl.select(["vec"]).schema
+    mapper = LinearModelMapper(warm.get_output_table().schema, data_schema,
+                               Params({"prediction_col": "pred",
+                                       "vector_col": "vec"}))
+    mapper.load_model(warm.get_output_table())
+    return tbl, warm, mapper, data_schema
+
+
+# ---------------------------------------------------------------------------
+# fault-mode grammar (common/faults.py)
+# ---------------------------------------------------------------------------
+
+class TestFaultGrammar:
+    def test_kill_backward_compat(self, clean_faults):
+        clean_faults.setenv(FAULT_ENV, "a.b:3; c.d:1")
+        maybe_crash("a.b", 2)
+        maybe_crash("other", 99)
+        with pytest.raises(FaultInjected) as ei:
+            maybe_crash("a.b", 5)      # open-ended window: >= 3 fires
+        assert ei.value.site == "a.b" and ei.value.threshold == 3
+
+    def test_range_window_clears(self, clean_faults):
+        clean_faults.setenv(FAULT_ENV, "s.x:2-3:error")
+        maybe_crash("s.x", 1)                       # below
+        with pytest.raises(TransientFault):
+            maybe_crash("s.x", 2)
+        with pytest.raises(TransientFault):
+            maybe_crash("s.x", 3)
+        maybe_crash("s.x", 4)                       # the storm CLEARED
+
+    def test_error_is_catchable_kill_is_distinct(self, clean_faults):
+        clean_faults.setenv(FAULT_ENV, "s.y:1:error")
+        with pytest.raises(TransientFault) as ei:
+            maybe_crash("s.y", 1)
+        assert not isinstance(ei.value, FaultInjected)
+        assert isinstance(ei.value, RuntimeError)
+
+    def test_delay_sleeps_and_returns_false(self, clean_faults):
+        clean_faults.setenv(FAULT_ENV, "s.d:1:delay:60")
+        t0 = time.perf_counter()
+        assert maybe_crash("s.d", 1) is False
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_corrupt_signals_caller(self, clean_faults):
+        clean_faults.setenv(FAULT_ENV, "s.c:2-2:corrupt")
+        assert maybe_crash("s.c", 1) is False
+        assert maybe_crash("s.c", 2) is True
+        assert maybe_crash("s.c", 3) is False
+
+    def test_auto_index_and_reset(self, clean_faults):
+        clean_faults.setenv(FAULT_ENV, "s.auto:2-2:corrupt")
+        assert maybe_crash("s.auto") is False       # visit 1
+        assert maybe_crash("s.auto") is True        # visit 2
+        reset_faults()                              # counters cleared
+        assert maybe_crash("s.auto") is False       # visit 1 again
+        assert maybe_crash("s.auto") is True        # visit 2 again
+
+    def test_non_integer_index_names_site_and_env(self, clean_faults):
+        clean_faults.setenv(FAULT_ENV, "serve.dispatch:oops")
+        with pytest.raises(ValueError) as ei:
+            fault_spec()
+        msg = str(ei.value)
+        assert FAULT_ENV in msg and "serve.dispatch" in msg \
+            and "oops" in msg and "malformed" in msg
+
+    def test_malformed_variants_refused(self, clean_faults):
+        for bad in ("justasite", "s.x:1:frobnicate", "s.x:1:delay",
+                    "s.x:1:delay:NaNms", "s.x:5-2:error",
+                    "s.x:1:error:9"):
+            clean_faults.setenv(FAULT_ENV, bad)
+            with pytest.raises(ValueError, match="malformed"):
+                fault_spec()
+
+    def test_duplicate_site_refused(self, clean_faults):
+        """Last-wins would silently drop the earlier rule — a storm
+        spec that tests nothing; duplicates refuse loudly like every
+        other malformed spec."""
+        clean_faults.setenv(
+            FAULT_ENV, "serve.dispatch:1-14:error;serve.dispatch:20:delay:30")
+        with pytest.raises(ValueError, match="already has a rule"):
+            fault_spec()
+
+    def test_rule_window_semantics(self):
+        r = FaultRule(3, None, "kill", 0.0)
+        assert not r.active(2) and r.active(3) and r.active(10**9)
+        r = FaultRule(3, 5, "error", 0.0)
+        assert [r.active(i) for i in (2, 3, 5, 6)] == \
+            [False, True, True, False]
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine (deterministic, scripted clock)
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _breaker(clock, threshold=3, backoff=0.1, factor=2.0, max_s=1.0):
+    return CircuitBreaker("t", 1, threshold=threshold, backoff_s=backoff,
+                          factor=factor, max_s=max_s, clock=clock)
+
+
+class TestCircuitBreaker:
+    def test_full_cycle_pinned(self):
+        """closed -> open -> half-open -> closed under a scripted fault
+        schedule, transitions pinned exactly."""
+        clk = _Clock()
+        br = _breaker(clk)
+        # closed: failures below threshold keep the compiled route
+        for _ in range(2):
+            assert br.acquire() == "compiled"
+            br.on_failure()
+        assert br.state == CLOSED
+        # third consecutive failure trips it
+        assert br.acquire() == "compiled"
+        br.on_failure()
+        assert br.state == OPEN and br.opens == 1
+        # open: everything falls back until the backoff elapses
+        assert br.acquire() == "fallback"
+        clk.t = 0.11
+        route = br.acquire()
+        assert route == "probe" and br.state == HALF_OPEN
+        # concurrent dispatch during the probe stays on the fallback
+        assert br.acquire() == "fallback"
+        br.on_success(probe=True)
+        assert br.state == CLOSED
+        assert [(f, t) for f, t, _ in br.transitions] == \
+            [(CLOSED, OPEN), (OPEN, HALF_OPEN), (HALF_OPEN, CLOSED)]
+
+    def test_no_flap_probe_failure_next_backoff_step(self):
+        """A failed half-open probe re-opens with the NEXT backoff step:
+        0.1 -> 0.2 -> 0.4, capped at max_s."""
+        clk = _Clock()
+        br = _breaker(clk, threshold=1)
+        br.acquire()
+        br.on_failure()                      # open, step 0 (backoff 0.1)
+        assert br.backoff_for(0) == pytest.approx(0.1)
+        clk.t = 0.11
+        assert br.acquire() == "probe"
+        br.on_failure(probe=True)            # re-open, step 1
+        assert br.state == OPEN and br.reopens == 1
+        clk.t += 0.11                        # 0.1 elapsed < 0.2: still open
+        assert br.acquire() == "fallback"
+        clk.t += 0.11                        # now past the 0.2 step
+        assert br.acquire() == "probe"
+        br.on_failure(probe=True)            # re-open, step 2 (0.4)
+        assert br.reopens == 2
+        clk.t += 0.41
+        assert br.acquire() == "probe"
+        br.on_success(probe=True)            # recovery resets the step
+        assert br.state == CLOSED
+        br.on_failure()                      # threshold=1: opens again
+        assert br.snapshot()["step"] == 0    # fresh spell, first backoff
+
+    def test_success_resets_consecutive_count(self):
+        clk = _Clock()
+        br = _breaker(clk, threshold=2)
+        br.on_failure()
+        br.on_success()
+        br.on_failure()                      # 1 consecutive, not 2
+        assert br.state == CLOSED
+
+    def test_stale_signals_cannot_steal_the_probe_verdict(self):
+        """Replica-fleet race (review hardening): a dispatch that
+        STARTED before the trip lands its verdict after another
+        replica's probe is in flight — neither a stale success (must
+        not close / release the probe slot) nor a stale failure (must
+        not re-open / bump the backoff step) moves the breaker; only
+        the probe's own verdict does."""
+        clk = _Clock()
+        br = _breaker(clk, threshold=1)
+        br.acquire()
+        br.on_failure()                        # trip open
+        clk.t = 0.11
+        assert br.acquire() == "probe"         # replica C holds the slot
+        br.on_success(probe=False)             # stale pre-trip success
+        assert br.state == HALF_OPEN           # probe slot NOT released
+        assert br.acquire() == "fallback"      # still exactly one probe
+        br.on_failure(probe=False)             # stale pre-trip failure
+        assert br.state == HALF_OPEN and br.reopens == 0
+        br.on_success(probe=True)              # the probe's OWN verdict
+        assert br.state == CLOSED
+
+    def test_probe_slot_released_on_dispatch_escape(self, base,
+                                                    clean_faults):
+        """Review hardening: a probe-routed dispatch that dies OUTSIDE
+        the paired handler (an injected kill) must still release the
+        breaker slot — a leaked half-open probe would wedge the server
+        in fallback forever."""
+        clean_faults.setenv("ALINK_TPU_SERVE_BREAKER_THRESHOLD", "1")
+        clean_faults.setenv("ALINK_TPU_SERVE_BREAKER_BACKOFF_MS", "30")
+        tbl, _w, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(1,), name="probeleak")
+        pred.predict_table(tbl.select(["vec"]).first_n(1))
+        srv = PredictServer(pred, max_batch=1, name="probeleak")
+        row = tbl.select(["vec"]).row(0)
+        try:
+            reset_faults()
+            # dispatch 1 fails (opens, threshold 1); dispatch 2 is the
+            # half-open probe and DIES with a kill — the slot must be
+            # released with the next backoff step, not leaked
+            clean_faults.setenv(FAULT_ENV, "serve.dispatch:1-2:kill")
+            with pytest.raises(ReplicaCrashed):
+                srv.submit(row).result(30)
+            assert srv.breaker_stats()["state"] == OPEN
+            time.sleep(0.05)
+            with pytest.raises(ReplicaCrashed):
+                srv.submit(row).result(30)     # the probe, killed
+            bs = srv.breaker_stats()
+            assert bs["state"] == OPEN and bs["reopens"] == 1
+            # past the NEXT backoff step the breaker probes again and
+            # (the fault window over) recovers — not wedged
+            time.sleep(0.12)
+            assert srv.submit(row).result(30) is not None
+            assert srv.breaker_stats()["state"] == CLOSED
+        finally:
+            srv.close()
+
+    def test_backoff_schedule_deterministic_and_capped(self):
+        br = _breaker(_Clock(), backoff=0.05, factor=3.0, max_s=0.2)
+        assert [br.backoff_for(k) for k in range(4)] == \
+            [pytest.approx(v) for v in (0.05, 0.15, 0.2, 0.2)]
+
+
+# ---------------------------------------------------------------------------
+# deadlines, shedding, cancellation (server integration)
+# ---------------------------------------------------------------------------
+
+class TestDeadlineShedding:
+    def test_shed_request_never_reaches_compiled_program(
+            self, base, clean_faults, fresh_registry):
+        """THE regression (ISSUE 14 satellite): a deadline-shed request
+        resolves to a typed DeadlineExceeded and the compiled program
+        never sees it — dispatches counted via the serving metrics."""
+        tbl, _w, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(1, 4), name="shed")
+        row = tbl.select(["vec"]).row(0)
+        pred.predict_table(tbl.select(["vec"]).first_n(1))   # warm compile
+        srv = PredictServer(pred, max_batch=1, name="shed")
+        try:
+            # stall the serving loop: the FIRST dispatch sleeps 300 ms
+            # (injected latency), so the second request's queue wait
+            # blows its 1 ms deadline deterministically
+            reset_faults()
+            clean_faults.setenv(FAULT_ENV, "serve.dispatch:1-1:delay:300")
+            before = _metric(fresh_registry, "alink_serve_batches_total")
+            f1 = srv.submit(row)
+            time.sleep(0.05)                 # f1 is in its delayed dispatch
+            f2 = srv.submit(row, deadline_s=0.001)
+            assert f1.result(30) is not None
+            with pytest.raises(DeadlineExceeded) as ei:
+                f2.result(30)
+            assert ei.value.deadline_s == pytest.approx(0.001)
+            assert ei.value.waited_s > 0.001
+            # exactly ONE batch was dispatched (f1's); the shed request
+            # paid no compiled execution
+            after = _metric(fresh_registry, "alink_serve_batches_total")
+            assert after - before == 1
+            assert _metric(fresh_registry, "alink_serve_shed_total",
+                           reason="deadline") == 1
+            st = srv.stats()
+            assert st["shed"] == 1 and st["failed"] == 0
+        finally:
+            srv.close()
+
+    def test_timeout_leaves_request_live(self, base, clean_faults):
+        """result(timeout) raising TimeoutError does NOT cancel — the
+        request still dispatches and the answer lands (the documented
+        pre-deadline semantics, now stated in the error message)."""
+        tbl, _w, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(1,), name="late")
+        pred.predict_table(tbl.select(["vec"]).first_n(1))
+        srv = PredictServer(pred, max_batch=1, name="late")
+        try:
+            reset_faults()
+            clean_faults.setenv(FAULT_ENV, "serve.dispatch:1-1:delay:150")
+            fut = srv.submit(tbl.select(["vec"]).row(0))
+            with pytest.raises(TimeoutError, match="deadline_s"):
+                fut.result(0.005)
+            assert fut.result(30) is not None      # still delivered
+        finally:
+            srv.close()
+
+    def test_cancel_sheds_before_dispatch(self, base, clean_faults,
+                                          fresh_registry):
+        tbl, _w, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(1,), name="cxl")
+        pred.predict_table(tbl.select(["vec"]).first_n(1))
+        srv = PredictServer(pred, max_batch=1, name="cxl")
+        row = tbl.select(["vec"]).row(0)
+        try:
+            reset_faults()
+            clean_faults.setenv(FAULT_ENV, "serve.dispatch:1-1:delay:200")
+            f1 = srv.submit(row)
+            time.sleep(0.05)
+            f2 = srv.submit(row)
+            assert f2.cancel() is True
+            assert f1.result(30) is not None
+            with pytest.raises(RequestCancelled):
+                f2.result(30)
+            assert f2.cancel() is False            # already resolved
+            assert _metric(fresh_registry, "alink_serve_shed_total",
+                           reason="cancelled") == 1
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# circuit-broken degradation (server integration, scripted fault storm)
+# ---------------------------------------------------------------------------
+
+class TestBreakerIntegration:
+    def _server(self, base, monkeypatch, name):
+        monkeypatch.setenv("ALINK_TPU_SERVE_BREAKER_THRESHOLD", "2")
+        monkeypatch.setenv("ALINK_TPU_SERVE_BREAKER_BACKOFF_MS", "40")
+        tbl, _w, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(1, 4), name=name)
+        req = tbl.select(["vec"])
+        pred.predict_table(req.first_n(1))
+        pred.predict_table(req.first_n(4))
+        return tbl, mapper, PredictServer(pred, max_batch=1, name=name)
+
+    def test_storm_opens_degrades_and_recovers(self, base, clean_faults,
+                                               fresh_registry):
+        """The tentpole integration: transient dispatch errors trip the
+        breaker, open traffic serves CORRECT answers through the host
+        mapper, and once the storm clears a half-open probe recovers
+        the compiled path."""
+        tbl, mapper, srv = self._server(base, clean_faults, "storm")
+        row = tbl.select(["vec"]).row(0)
+        expected = mapper.map_row(row)
+        try:
+            reset_faults()
+            clean_faults.setenv(FAULT_ENV, "serve.dispatch:1-2:error")
+            # dispatches 1-2 fail (closed-state contract: the batch
+            # fails its own requests) and trip the threshold-2 breaker
+            for _ in range(2):
+                with pytest.raises(TransientFault):
+                    srv.submit(row).result(30)
+            assert srv.breaker_stats()["state"] == OPEN
+            assert srv.breaker_stats()["opens"] == 1
+            # open: requests SUCCEED through the host-mapper fallback —
+            # degraded, not dropped — with correct answers
+            out = srv.submit(row).result(30)
+            assert out == tuple(expected)
+            assert srv.stats()["fallback_batches"] >= 1
+            # past the backoff the probe re-tests the compiled path;
+            # the fault window (1-2) has cleared, so it succeeds
+            time.sleep(0.06)
+            compiled_before = _metric(fresh_registry,
+                                      "alink_serve_batches_total")
+            out = srv.submit(row).result(30)
+            assert out == tuple(expected)
+            assert srv.breaker_stats()["state"] == CLOSED
+            # the recovery is measurable: the probe ran COMPILED
+            assert _metric(fresh_registry,
+                           "alink_serve_batches_total") \
+                == compiled_before + 1
+            st = srv.stats()
+            assert st["failed"] == 2 and st["shed"] == 0
+            assert _metric(fresh_registry,
+                           "alink_serve_breaker_fallback_total") >= 1
+        finally:
+            srv.close()
+
+    def test_failed_probe_reopens(self, base, clean_faults):
+        """No-flap at the integration level: a storm outliving the first
+        probe re-opens the breaker instead of flapping closed."""
+        tbl, mapper, srv = self._server(base, clean_faults, "flap")
+        row = tbl.select(["vec"]).row(0)
+        expected = mapper.map_row(row)
+        try:
+            reset_faults()
+            clean_faults.setenv(FAULT_ENV, "serve.dispatch:1-3:error")
+            for _ in range(2):
+                with pytest.raises(TransientFault):
+                    srv.submit(row).result(30)
+            assert srv.breaker_stats()["state"] == OPEN
+            time.sleep(0.06)
+            # the probe (dispatch 3) fails INSIDE the window: the batch
+            # still serves through the fallback (degraded traffic stays
+            # degraded) and the breaker re-opens at the next step
+            out = srv.submit(row).result(30)
+            assert out == tuple(expected)
+            bs = srv.breaker_stats()
+            assert bs["state"] == OPEN and bs["reopens"] == 1 \
+                and bs["step"] == 1
+        finally:
+            srv.close()
+
+    def test_breaker_disabled_restores_pre_resilience(self, base, clean_faults):
+        clean_faults.setenv("ALINK_TPU_SERVE_BREAKER", "0")
+        tbl, _mapper, srv = self._server(base, clean_faults, "nobrk")
+        row = tbl.select(["vec"]).row(0)
+        try:
+            reset_faults()
+            clean_faults.setenv(FAULT_ENV, "serve.dispatch:1-4:error")
+            for _ in range(4):
+                with pytest.raises(TransientFault):
+                    srv.submit(row).result(30)
+            st = srv.stats()
+            assert st["fallback_batches"] == 0
+            assert st["breaker"]["opens"] == 0
+        finally:
+            srv.close()
+
+    def test_swap_resets_breaker_per_model_version(self, base, clean_faults):
+        """A hot swap starts the NEW version's breaker closed — breaker
+        state is per model version."""
+        tbl, _mapper, srv = self._server(base, clean_faults, "perver")
+        row = tbl.select(["vec"]).row(0)
+        try:
+            reset_faults()
+            clean_faults.setenv(FAULT_ENV, "serve.dispatch:1-2:error")
+            for _ in range(2):
+                with pytest.raises(TransientFault):
+                    srv.submit(row).result(30)
+            assert srv.breaker_stats()["state"] == OPEN
+            clean_faults.delenv(FAULT_ENV)
+            _tbl2, warm2, _m2, _s2 = _fixture(seed=9)
+            srv.swap_model(warm2.get_output_table())
+            assert srv.submit(row).result(30) is not None
+            bs = srv.breaker_stats()
+            # the NEW version starts closed at step 0 (per-model-version
+            # state); the retired version's trip stays in the run totals
+            assert bs["state"] == CLOSED and bs["step"] == 0
+            assert bs["opens"] == 1
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# supervised serving loops (crash -> quarantine + respawn)
+# ---------------------------------------------------------------------------
+
+class TestLoopRespawn:
+    def test_kill_fault_quarantines_and_respawns(self, base, clean_faults,
+                                                 fresh_registry):
+        tbl, _w, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(1,), name="crash")
+        pred.predict_table(tbl.select(["vec"]).first_n(1))
+        srv = PredictServer(pred, max_batch=1, name="crash")
+        row = tbl.select(["vec"]).row(0)
+        try:
+            reset_faults()
+            clean_faults.setenv(FAULT_ENV, "serve.dispatch:1-1:kill")
+            fut = srv.submit(row)
+            with pytest.raises(ReplicaCrashed) as ei:
+                fut.result(30)
+            assert isinstance(ei.value.cause, FaultInjected)
+            # the respawned loop serves the next request normally
+            assert srv.submit(row).result(30) is not None
+            st = srv.stats()
+            assert st["loop_respawns"] == 1 and st["quarantined"] == 1
+            assert _metric(fresh_registry,
+                           "alink_serve_loop_respawns_total",
+                           server="crash") == 1
+        finally:
+            srv.close()
+
+    def test_channel_fault_respawns_loop(self, base, clean_faults):
+        """A prefetch.get fault (the admission channel itself) is a
+        loop crash too — supervised the same way."""
+        tbl, _w, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(1,), name="chfault")
+        pred.predict_table(tbl.select(["vec"]).first_n(1))
+        reset_faults()
+        # the serving loop's FIRST get crashes; later gets are clean
+        clean_faults.setenv(FAULT_ENV, "prefetch.get:1-1:error")
+        srv = PredictServer(pred, max_batch=1, name="chfault")
+        row = tbl.select(["vec"]).row(0)
+        try:
+            assert srv.submit(row).result(30) is not None
+            assert srv.stats()["loop_respawns"] >= 1
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# supervised feeders
+# ---------------------------------------------------------------------------
+
+class _ListStream:
+    """A minimal stream op: timed_batches() yields the given tables."""
+
+    def __init__(self, tables):
+        self._tables = list(tables)
+
+    def timed_batches(self):
+        for i, t in enumerate(self._tables):
+            yield (float(i), t)
+
+
+def _corrupt_copy(model_table):
+    from alink_tpu.operator.stream.onlinelearning.ftrl import (
+        _corrupt_snapshot_table)
+    return _corrupt_snapshot_table(model_table)
+
+
+class TestFeederSupervision:
+    def test_poisoned_snapshot_skips_and_keeps_last_good(
+            self, base, clean_faults, fresh_registry):
+        tbl, warm, mapper, _s = base
+        _t2, warm2, _m2, _s2 = _fixture(seed=5)
+        pred = CompiledPredictor(mapper, buckets=(1, 4), name="poison")
+        srv = PredictServer(pred, name="poison")
+        good1 = warm.get_output_table()
+        good2 = warm2.get_output_table()
+        bad = _corrupt_copy(good1)
+        _reset_feeder_warnings()
+        try:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                feeder = ModelStreamFeeder(
+                    srv, _ListStream([good1, bad, good2])).start()
+                swaps = feeder.join(timeout=60)
+            assert swaps == 2                       # the bad one skipped
+            assert feeder.skipped == 1
+            # last-good guarantee: the active version is good2's swap
+            assert srv.stats()["model_version"] == \
+                feeder.versions[-1][0]
+            assert _metric(fresh_registry,
+                           "alink_serve_feeder_errors_total",
+                           feeder="ModelStreamFeeder",
+                           kind="poisoned") == 1
+            warns = [w for w in caught
+                     if "poisoned" in str(w.message)]
+            assert len(warns) == 1                  # once per feeder+kind
+        finally:
+            srv.close()
+
+    def test_transient_swap_failures_retry_then_succeed(
+            self, base, clean_faults, fresh_registry):
+        tbl, warm, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(1,), name="retry")
+        srv = PredictServer(pred, name="retry")
+        clean_faults.setenv("ALINK_TPU_SERVE_FEEDER_BACKOFF_MS", "5")
+        _reset_feeder_warnings()
+        try:
+            reset_faults()
+            # swap visits 1-2 fail transiently; visit 3 (the 2nd retry)
+            # succeeds — inside the default retry budget of 3
+            clean_faults.setenv(FAULT_ENV, "serve.swap:1-2:error")
+            feeder = ModelStreamFeeder(
+                srv, _ListStream([warm.get_output_table()])).start()
+            swaps = feeder.join(timeout=60)
+            assert swaps == 1 and feeder.retried == 2
+            assert _metric(fresh_registry,
+                           "alink_serve_feeder_retries_total",
+                           feeder="ModelStreamFeeder") == 2
+            assert _metric(fresh_registry,
+                           "alink_serve_feeder_errors_total",
+                           feeder="ModelStreamFeeder",
+                           kind="transient") == 2
+        finally:
+            srv.close()
+
+    def test_retry_budget_exhausted_is_fatal_and_recorded(
+            self, base, clean_faults, fresh_registry):
+        tbl, warm, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(1,), name="fatal")
+        srv = PredictServer(pred, name="fatal")
+        clean_faults.setenv("ALINK_TPU_SERVE_FEEDER_BACKOFF_MS", "2")
+        clean_faults.setenv("ALINK_TPU_SERVE_FEEDER_RETRIES", "1")
+        _reset_feeder_warnings()
+        try:
+            reset_faults()
+            clean_faults.setenv(FAULT_ENV, "serve.swap:1-50:error")
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                feeder = ModelStreamFeeder(
+                    srv, _ListStream([warm.get_output_table()])).start()
+                with pytest.raises(TransientFault):
+                    feeder.join(timeout=60)
+            # visible AT the failure, not only via the join re-raise
+            assert _metric(fresh_registry,
+                           "alink_serve_feeder_errors_total",
+                           feeder="ModelStreamFeeder", kind="fatal") == 1
+            assert any("fatal" in str(w.message) for w in caught)
+            # the server still serves the warm-start model (version 1)
+            assert srv.stats()["model_version"] == 1
+        finally:
+            srv.close()
+
+    def test_ftrl_corrupt_snapshot_end_to_end(self, clean_faults,
+                                              fresh_registry):
+        """feeder.snapshot:1-1:corrupt poisons exactly the FIRST emitted
+        FTRL snapshot; the supervised feeder skips it, swaps the later
+        good ones, zero torn serving."""
+        from alink_tpu.operator.stream.onlinelearning.ftrl import (
+            FtrlTrainStreamOp)
+        from alink_tpu.operator.stream.source.sources import (
+            MemSourceStreamOp)
+        tbl, warm, mapper, _s = _fixture(n=256)
+        pred = CompiledPredictor(mapper, buckets=(1, 4), name="ftrlpois")
+        srv = PredictServer(pred, name="ftrlpois")
+        _reset_feeder_warnings()
+        try:
+            reset_faults()
+            clean_faults.setenv(FAULT_ENV, "feeder.snapshot:1-1:corrupt")
+            src = MemSourceStreamOp(tbl, batch_size=64)
+            ftrl = FtrlTrainStreamOp(warm, vector_col="vec",
+                                     label_col="label", alpha=0.1,
+                                     update_mode="batch",
+                                     time_interval=1.0).link_from(src)
+            feeder = ModelStreamFeeder(srv, ftrl).start()
+            swaps = feeder.join(timeout=120)
+            assert feeder.skipped == 1 and swaps >= 1
+            assert _metric(fresh_registry,
+                           "alink_serve_feeder_errors_total",
+                           feeder="ModelStreamFeeder",
+                           kind="poisoned") == 1
+            # the served model is a real (uncorrupted) swap
+            row = tbl.select(["vec"]).row(0)
+            assert srv.submit(row).result(30) is not None
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# default flags + no faults = pre-resilience behavior
+# ---------------------------------------------------------------------------
+
+class TestDefaultPathUnchanged:
+    def test_fault_free_serving_identical_and_counters_quiet(
+            self, base, clean_faults, fresh_registry):
+        """Fault env unset, default flags: responses are bitwise the
+        host mapper's (the pre-PR parity contract) and ZERO resilience
+        machinery engages — no sheds, no fallbacks, no respawns, the
+        breaker never leaves closed."""
+        tbl, _w, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(1, 4, 16),
+                                 name="default")
+        srv = PredictServer(pred, name="default")
+        req = tbl.select(["vec"])
+        try:
+            ref = mapper.map_table(req.first_n(16))
+            outs = [srv.submit(req.row(i)).result(30) for i in range(16)]
+            for i, out in enumerate(outs):
+                assert out == tuple(ref.row(i))
+            st = srv.stats()
+            assert st["shed"] == 0 and st["fallback_batches"] == 0
+            assert st["loop_respawns"] == 0 and st["failed"] == 0
+            assert st["breaker"]["state"] == CLOSED \
+                and st["breaker"]["opens"] == 0
+            assert _metric(fresh_registry, "alink_serve_shed_total") == 0
+            assert _metric(fresh_registry,
+                           "alink_serve_breaker_fallback_total") == 0
+        finally:
+            srv.close()
+
+    def test_serving_lowered_hlo_invariant_to_resilience_flags(
+            self, base, clean_faults):
+        """The whole resilience tier is host-side policy: the lowered
+        HLO of a serving bucket program is BYTE-identical with the
+        fault env unset, armed-out-of-window, and the breaker toggled
+        — the acceptance criterion's no-new-compiled-ops contract."""
+        import jax
+        tbl, _w, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(4,), name="hlo")
+        ver = pred._active
+        kind, arrays = ver.kernel.encode(tbl.select(["vec"]).first_n(3), 4)
+
+        def lowered():
+            return jax.jit(ver.kernel.device_fns[kind]).lower(
+                ver.device_arrays, *arrays).as_text()
+
+        ref_hlo = lowered()
+        clean_faults.setenv(FAULT_ENV, "serve.dispatch:999999:error")
+        assert lowered() == ref_hlo
+        clean_faults.delenv(FAULT_ENV)
+        for flag in ("0", "1"):
+            clean_faults.setenv("ALINK_TPU_SERVE_BREAKER", flag)
+            assert lowered() == ref_hlo
+
+    def test_doctor_chaos_and_shed_verdicts(self):
+        """tools/doctor.py renders the serve_chaos SLO verdict (CRITICAL
+        on torn/silent/non-recovery) and the shed fix line for ordinary
+        serving rows with a nonzero shed rate."""
+        import tools.doctor as doctor
+        chaos_row = {
+            "qps_per_chip": 2000.0, "p99_ms_before": 8.0,
+            "p99_ms_during": 40.0, "p99_ms_after": 9.0,
+            "typed_rejections": 47, "silent_drops": 0,
+            "torn_responses": 0, "shed_requests": 6,
+            "breaker_opens": 1, "breaker_reopens": 4,
+            "recovered_compiled": True, "model_swaps": 15,
+            "feeder_skipped": 1, "loop_respawns": 0,
+        }
+        bench = {"workloads": {"serve_chaos": dict(chaos_row)}}
+        doc = doctor.diagnose(bench, None, None, 100.0, 800.0)
+        v = [x for x in doc["serving"]
+             if x["workload"] == "serve_chaos"][0]
+        assert v["recovered_compiled"] is True and not v["fixes"]
+        text = doctor.render(doc)
+        assert "6 shed" in text and "breaker opened 1x" in text
+        assert "47 typed rejections / 0 silent" in text
+        assert "recovered to compiled" in text
+        # SLO breaks turn CRITICAL
+        broken = dict(chaos_row)
+        broken.update(silent_drops=3, recovered_compiled=False)
+        doc2 = doctor.diagnose({"workloads": {"serve_chaos": broken}},
+                               None, None, 100.0, 800.0)
+        fixes = "\n".join(
+            [x for x in doc2["serving"]
+             if x["workload"] == "serve_chaos"][0]["fixes"])
+        assert "SILENT" in fixes and "never recovered" in fixes \
+            and "CRITICAL" in fixes
+        # an ordinary serving row shedding requests gets the fix line;
+        # and a shed metric without a chaos row gets the summary verdict
+        plain = {"workloads": {"serve_logreg": {
+            "qps_per_chip": 5000.0, "shed_requests": 12,
+            "batch_occupancy": 0.9, "bucket_hit_rate": 1.0}}}
+        doc3 = doctor.diagnose(plain, None,
+                               {"serve": {"shed": 12,
+                                          "feeder_errors": 2}},
+                               100.0, 800.0)
+        names = {x["workload"]: x for x in doc3["serving"]}
+        assert any("load shedding is ACTIVE" in f
+                   for f in names["serve_logreg"]["fixes"])
+        assert any("feeders hit 2 errors" in f
+                   for f in names["serving (metrics)"]["fixes"])
+
+    def test_breaker_toggle_is_response_invariant(self, base, clean_faults):
+        """ALINK_TPU_SERVE_BREAKER on/off serves byte-identical
+        responses when nothing fails (the routing only diverges on
+        failure)."""
+        tbl, _w, mapper, _s = base
+        req = tbl.select(["vec"])
+        outs = {}
+        for flag in ("1", "0"):
+            clean_faults.setenv("ALINK_TPU_SERVE_BREAKER", flag)
+            pred = CompiledPredictor(mapper, buckets=(1, 4),
+                                     name=f"tog{flag}")
+            srv = PredictServer(pred, name=f"tog{flag}")
+            try:
+                outs[flag] = [srv.submit(req.row(i)).result(30)
+                              for i in range(8)]
+            finally:
+                srv.close()
+        assert outs["1"] == outs["0"]

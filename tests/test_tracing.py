@@ -595,5 +595,8 @@ class TestLbfgsTraceAcceptance:
         evs = [e for e in fresh_tracer.events()
                if e["name"] == "fault.injected"]
         assert len(evs) == 1
+        # r14: the instant additionally names the fault MODE (kill /
+        # error / delay / corrupt) so a flight recorder distinguishes
+        # an injected kill from an injected transient
         assert evs[0]["args"] == {"site": "test.site", "index": 5,
-                                  "threshold": 2}
+                                  "threshold": 2, "mode": "kill"}

@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""Chaos-storm smoke (perf_gate leg, ISSUE 14) — exit 8 on failure.
+
+Drives the closed-loop load generator against a live ``PredictServer``
+through three phases — clean, STORM, recovered — where the storm is a
+scripted ``ALINK_TPU_FAULT_INJECT`` schedule (common/faults.py):
+
+  * transient ``serve.dispatch`` errors (trips the circuit breaker,
+    traffic degrades to the host-mapper fallback),
+  * injected ``serve.dispatch`` latency (``delay:MS``),
+  * ONE corrupt FTRL snapshot (``feeder.snapshot:…:corrupt`` — the
+    supervised feeder must skip it and keep the last good model),
+  * a concurrent hot-swap storm off a live FTRL trainer.
+
+The SLO contract it gates:
+
+  1. ZERO torn responses — every response matches a model version that
+     was actually active (warm start or a completed swap);
+  2. ZERO silent drops — results + typed rejections == submissions
+     (no future ever times out unresolved);
+  3. the breaker RECOVERS: post-storm requests are served through the
+     COMPILED path again (measured via alink_serve_batches_total, not
+     asserted from state alone) and the breaker ends closed;
+  4. p99 stays bounded during the storm (the generous smoke bound —
+     the publishable numbers live in the ``serve_chaos`` bench row).
+
+Runs in a fresh child interpreter (bootenv CPU mesh) so the fault env
+and auto-index counters start from zero.
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+EXIT = 8
+_MARK = "ALINK_CHAOS_SMOKE_CHILD"
+
+# the scripted storm, two legs over ONE uninterrupted visit-counter
+# timeline (no reset between legs — the feeder.snapshot:1-1 window
+# stays exactly-once across both):
+#   leg A: dispatch visits 1-14 after arming fail transiently (trips
+#          the breaker, traffic degrades to the host fallback) and the
+#          FIRST FTRL snapshot is emitted corrupt;
+#   leg B: every dispatch runs 30 ms slow (open-ended window — the
+#          arming interval bounds it) so tight-deadline requests shed.
+STORM_SPEC = ("serve.dispatch:1-14:error;"
+              "feeder.snapshot:1-1:corrupt")
+DELAY_SPEC = ("serve.dispatch:1:delay:30;"
+              "feeder.snapshot:1-1:corrupt")
+P99_STORM_BOUND_S = 5.0
+
+
+def main() -> int:
+    if os.environ.get(_MARK) != "1":
+        import bootenv
+        env = bootenv.cpu_mesh_env(4)
+        env[_MARK] = "1"
+        env["JAX_ENABLE_X64"] = "1"
+        env.pop("ALINK_TPU_FAULT_INJECT", None)
+        # cap the breaker backoff so the smoke's recovery phases finish
+        # in CI time (the schedule itself is exercised by
+        # tests/test_resilience.py with a scripted clock)
+        env["ALINK_TPU_SERVE_BREAKER_MAX_MS"] = "200"
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             cwd=ROOT, env=env, timeout=900)
+        return out.returncode
+
+    import numpy as np
+
+    from alink_tpu.common.faults import reset_faults
+    from alink_tpu.common.metrics import MetricsRegistry, set_registry
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.common.params import Params
+    from alink_tpu.common.vector import DenseVector
+    from alink_tpu.operator.batch.classification.linear import (
+        LogisticRegressionTrainBatchOp)
+    from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+    from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+    from alink_tpu.operator.stream.onlinelearning.ftrl import (
+        FtrlTrainStreamOp)
+    from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+    from alink_tpu.serving import (CompiledPredictor, LoadGenerator,
+                                   ModelStreamFeeder, PredictServer)
+    from alink_tpu.serving.loadgen import percentile
+
+    reg = MetricsRegistry()
+    set_registry(reg)
+
+    def metric(name, **labels):
+        total = 0.0
+        for rec in reg.snapshot():
+            if rec["name"] != name:
+                continue
+            lb = rec.get("labels") or {}
+            if all(lb.get(k) == v for k, v in labels.items()):
+                total += rec.get("value") or 0.0
+        return total
+
+    bad = []
+
+    # -- fixture: a trained dense-LR model + request rows -----------------
+    n_rows, dim = 1024, 32
+    rng = np.random.RandomState(11)
+    X = rng.randn(n_rows, dim)
+    y = (X @ rng.randn(dim) > 0).astype(np.int64)
+    vecs = np.empty(n_rows, object)
+    vecs[:] = [DenseVector(X[i]) for i in range(n_rows)]
+    tbl = MTable({"vec": vecs, "label": y}, "vec VECTOR, label LONG")
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=3).link_from(
+        MemSourceBatchOp(tbl.first_n(256)))
+    data_schema = tbl.select(["vec"]).schema
+    mapper = LinearModelMapper(warm.get_output_table().schema, data_schema,
+                               Params({"prediction_col": "pred",
+                                       "vector_col": "vec"}))
+    mapper.load_model(warm.get_output_table())
+
+    pred = CompiledPredictor(mapper, name="chaos")
+    req = tbl.select(["vec"])
+    for b in pred.buckets:
+        pred.predict_table(req.first_n(min(b, n_rows)))
+    srv = PredictServer(pred, name="chaos")
+    probe = req.row(0)     # one fixed probe row -> exact torn detection
+
+    # -- no-silent-drops accounting: every submission resolves ------------
+    tally = {"submitted": 0, "results": 0, "typed": 0, "silent": 0}
+
+    def lg(requests, phase):
+        gen = LoadGenerator(srv.submit, [probe], clients=4, pipeline=8,
+                            collect_responses=True)
+        rep = gen.run(requests)
+        tally["submitted"] += rep.requests
+        tally["results"] += rep.requests - rep.failures
+        # LoadReport.timeouts is the futures that never resolved within
+        # the reap timeout — the silent-drop signal INSIDE the load-
+        # generator phases (plus the explicit future-by-future rounds)
+        tally["typed"] += rep.failures - rep.timeouts
+        tally["silent"] += rep.timeouts
+        print(f"chaos_smoke: {phase}: {rep.summary()}")
+        return rep
+
+    def explicit_round(requests, deadline_s=None):
+        """Submission-by-submission accounting: a future that neither
+        returns nor raises within the generous timeout is a SILENT
+        drop — the invariant the typed-rejection contract forbids."""
+        futs = [srv.submit(probe, deadline_s=deadline_s)
+                for _ in range(requests)]
+        tally["submitted"] += len(futs)
+        resps = []
+        for f in futs:
+            try:
+                resps.append(f.result(60))
+                tally["results"] += 1
+            except TimeoutError:
+                tally["silent"] += 1
+            except BaseException:
+                tally["typed"] += 1
+        return resps
+
+    responses = []
+
+    # -- phase 1: clean ----------------------------------------------------
+    lg(200, "warmup")
+    rep_before = lg(400, "before")
+    responses += rep_before.responses
+
+    # -- phase 2: the storm ------------------------------------------------
+    # concurrent swap storm off a live FTRL trainer, with snapshot 1
+    # corrupt (the supervised feeder must skip it, keep the last good
+    # model, and apply the later swaps)
+    reset_faults()
+    os.environ["ALINK_TPU_FAULT_INJECT"] = STORM_SPEC
+    src = MemSourceStreamOp(tbl, batch_size=128)
+    ftrl = FtrlTrainStreamOp(warm, vector_col="vec", label_col="label",
+                             alpha=0.1, update_mode="batch",
+                             time_interval=1.0).link_from(src)
+    feeder = ModelStreamFeeder(srv, ftrl).start()
+    rep_storm = lg(600, "storm(errors+corrupt+swaps)")
+    responses += rep_storm.responses
+    responses += explicit_round(100)
+    # latency-injection leg: slow dispatches + tight deadlines = typed
+    # deadline sheds, never silence. NO reset_faults between the legs:
+    # the visit counters keep running, so the snapshot-corruption window
+    # stays exactly-once across the whole storm
+    import time as _time
+
+    def one(deadline_s=None):
+        tally["submitted"] += 1
+        try:
+            responses.append(tuple(
+                srv.submit(probe, deadline_s=deadline_s).result(60)))
+            tally["results"] += 1
+            return True
+        except TimeoutError:
+            tally["silent"] += 1
+        except BaseException:
+            tally["typed"] += 1
+        return False
+
+    # the error leg may leave the breaker open; drive probes until it
+    # recovers so the delay leg measures the COMPILED path's latency
+    # (an open breaker serves host-side and never meets the fault site)
+    wait_until = _time.monotonic() + 20
+    while srv.breaker_stats()["state"] != "closed" \
+            and _time.monotonic() < wait_until:
+        one()
+        _time.sleep(0.05)
+    if srv.breaker_stats()["state"] != "closed":
+        bad.append("breaker did not re-close between the storm legs")
+    os.environ["ALINK_TPU_FAULT_INJECT"] = DELAY_SPEC
+    f_first = srv.submit(probe)      # occupies the loop in a 30 ms dispatch
+    tally["submitted"] += 1
+    _time.sleep(0.01)
+    shed_futs = [srv.submit(probe, deadline_s=0.004) for _ in range(6)]
+    tally["submitted"] += 6
+    try:
+        responses.append(tuple(f_first.result(60)))
+        tally["results"] += 1
+    except TimeoutError:
+        tally["silent"] += 1
+    except BaseException:
+        tally["typed"] += 1
+    for f in shed_futs:
+        try:
+            responses.append(tuple(f.result(60)))
+            tally["results"] += 1
+        except TimeoutError:
+            tally["silent"] += 1
+        except BaseException:
+            tally["typed"] += 1
+    try:
+        swaps = feeder.join(timeout=180)
+    except BaseException as e:
+        bad.append(f"feeder died during the storm: {type(e).__name__}: {e}")
+        swaps = len(feeder.versions)
+
+    # -- phase 3: the storm clears — recovery ------------------------------
+    del os.environ["ALINK_TPU_FAULT_INJECT"]
+    reset_faults()
+    import time as _time
+    _time.sleep(0.2)      # past any remaining breaker backoff
+    compiled_before = metric("alink_serve_batches_total")
+    rep_after = lg(400, "after")
+    responses += rep_after.responses
+    responses += explicit_round(50)
+    compiled_after = metric("alink_serve_batches_total")
+    stats = srv.stats()
+    srv.close()
+
+    # -- the SLO contract ---------------------------------------------------
+    # 1. zero torn responses: every response matches a model version
+    # that was actually active (warm start or a completed swap)
+    expected = set()
+    for _v, mt in [(0, warm.get_output_table())] + feeder.versions:
+        m2 = LinearModelMapper(mt.schema, data_schema, mapper.params)
+        m2.load_model(mt)
+        expected.add(repr(tuple(m2.map_row(probe))))
+    torn = {r for r in (repr(tuple(r)) for r in responses)
+            if r not in expected}
+    if torn:
+        bad.append(f"{len(torn)} TORN response value(s) matched no "
+                   f"active model version")
+    # 2. zero silent drops
+    if tally["silent"]:
+        bad.append(f"{tally['silent']} SILENT drops (futures resolved "
+                   f"neither to a result nor a typed rejection)")
+    if tally["results"] + tally["typed"] != tally["submitted"]:
+        bad.append(f"accounting broke: {tally}")
+    # the storm must actually have engaged the machinery it gates
+    if feeder.skipped != 1:
+        bad.append(f"corrupt snapshot not skipped exactly once "
+                   f"(skipped={feeder.skipped})")
+    if stats["breaker"]["opens"] < 1:
+        bad.append("the dispatch-error storm never opened the breaker")
+    if stats["fallback_batches"] < 1:
+        bad.append("no batch was served through the breaker fallback")
+    if metric("alink_serve_shed_total", reason="deadline") < 1:
+        bad.append("the latency+deadline leg shed nothing")
+    if swaps < 2:
+        bad.append(f"swap storm too small ({swaps} swaps; want >= 2)")
+    # 3. measurable recovery: post-storm traffic ran COMPILED and the
+    # breaker ended closed
+    if stats["breaker"]["state"] != "closed":
+        bad.append(f"breaker did not recover "
+                   f"(state={stats['breaker']['state']})")
+    if compiled_after - compiled_before < 5:
+        bad.append(f"post-storm traffic not served compiled "
+                   f"({compiled_after - compiled_before} compiled "
+                   f"batches for 450 post-storm requests)")
+    # 4. p99 bounded during the storm
+    if rep_storm.p99_s > P99_STORM_BOUND_S:
+        bad.append(f"storm p99 {rep_storm.p99_s:.3f}s exceeds the "
+                   f"{P99_STORM_BOUND_S}s bound")
+
+    if bad:
+        print("chaos_smoke: FAILED:", file=sys.stderr)
+        for m in bad:
+            print(f"  {m}", file=sys.stderr)
+        return EXIT
+    p99 = percentile(rep_storm.latencies_s, 99.0) * 1e3
+    print(f"chaos_smoke: clean — zero torn / zero silent drops over "
+          f"{tally['submitted']} requests, breaker "
+          f"opened {stats['breaker']['opens']}x and recovered to the "
+          f"compiled path, {swaps} swaps (+1 corrupt snapshot skipped), "
+          f"{int(stats['shed'])} shed, storm p99 {p99:.1f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -113,6 +113,21 @@ def _metrics_summary(path: str) -> Dict[str, Any]:
             elif name == "alink_serve_p99_seconds":
                 out["serve"]["p99_s"] = max(out["serve"].get("p99_s", 0.0),
                                             rec.get("value", 0.0))
+            elif name == "alink_serve_shed_total":
+                out["serve"]["shed"] = out["serve"].get("shed", 0) \
+                    + rec.get("value", 0)
+            elif name == "alink_serve_breaker_fallback_total":
+                out["serve"]["breaker_fallbacks"] = \
+                    out["serve"].get("breaker_fallbacks", 0) \
+                    + rec.get("value", 0)
+            elif name == "alink_serve_feeder_errors_total":
+                out["serve"]["feeder_errors"] = \
+                    out["serve"].get("feeder_errors", 0) \
+                    + rec.get("value", 0)
+            elif name == "alink_serve_loop_respawns_total":
+                out["serve"]["loop_respawns"] = \
+                    out["serve"].get("loop_respawns", 0) \
+                    + rec.get("value", 0)
     if not out["serve"]:
         del out["serve"]
     return out
@@ -280,13 +295,42 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
         fixes: List[str] = []
         failed = int(row.get("failed_requests") or 0)
         torn = int(row.get("torn_responses") or 0)
-        if failed or torn:
+        chaos = str(name) == "serve_chaos"
+        if failed or (torn and not chaos):
             fixes.append(f"CRITICAL: {failed} failed / {torn} torn "
                          f"responses — the tier dropped or corrupted "
                          f"requests; check swap geometry (model "
                          f"signature changes recompile mid-swap) and "
                          f"server exceptions before trusting any other "
                          f"number")
+        if chaos:
+            # the chaos row's SLO contract (ISSUE 14): typed rejections
+            # during the storm are by design; torn/silent/no-recovery
+            # is what breaks the tier
+            silent = int(row.get("silent_drops") or 0)
+            if torn or silent:
+                fixes.append(f"CRITICAL: chaos storm broke the SLO "
+                             f"contract — {torn} torn / {silent} SILENT "
+                             f"drops (every submitted request must "
+                             f"resolve to a result or a typed "
+                             f"rejection; serving/resilience.py)")
+            if row.get("recovered_compiled") is False:
+                fixes.append("CRITICAL: the circuit breaker never "
+                             "recovered to the compiled path after the "
+                             "storm cleared — the half-open probe "
+                             "schedule is broken (serving/resilience.py "
+                             "CircuitBreaker / ALINK_TPU_SERVE_BREAKER_*"
+                             ") or the compiled path stayed genuinely "
+                             "down")
+        shed = row.get("shed_requests")
+        if shed and not chaos:
+            fixes.append(f"load shedding is ACTIVE ({int(shed)} requests "
+                         f"shed on deadline/cancel): queue wait exceeds "
+                         f"request budgets — add replicas "
+                         f"(ALINK_TPU_SERVE_REPLICAS), widen the "
+                         f"admission bound (ALINK_TPU_SERVE_QUEUE) only "
+                         f"if deadlines allow the extra wait, or relax "
+                         f"the submitted deadline_s")
         occ = row.get("batch_occupancy")
         if occ is not None and occ < 0.5:
             fixes.append(f"batches run under-occupied ({occ:.0%} of "
@@ -398,10 +442,37 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
                   "model_swaps", "torn_responses", "p99_ms_before",
                   "p99_ms_during", "p99_ms_after", "fused_vs_xla",
                   "dtype_winner", "label_agreement_bf16",
-                  "label_agreement_int8"):
+                  "label_agreement_int8", "shed_requests",
+                  "breaker_opens", "breaker_reopens", "typed_rejections",
+                  "silent_drops", "recovered_compiled",
+                  "feeder_retries", "feeder_skipped", "loop_respawns"):
             if row.get(k) is not None:
                 v[k] = row[k]
         out.append(v)
+    # run-level resilience signals from the metrics dump (ISSUE 14):
+    # one summary verdict, not one line per bench row — metrics are
+    # process-global. Skipped when a serve_chaos row already explains
+    # the storm it deliberately ran.
+    has_chaos = any(str(n) == "serve_chaos" for n in rows)
+    met_fixes: List[str] = []
+    if not has_chaos and serve_met.get("shed"):
+        met_fixes.append(
+            f"load shedding is ACTIVE ({int(serve_met['shed'])} requests "
+            f"shed on deadline/cancel — alink_serve_shed_total): queue "
+            f"wait exceeds request budgets; add replicas "
+            f"(ALINK_TPU_SERVE_REPLICAS) or relax the submitted "
+            f"deadline_s")
+    if not has_chaos and serve_met.get("feeder_errors"):
+        met_fixes.append(
+            f"model-stream feeders hit "
+            f"{int(serve_met['feeder_errors'])} errors "
+            f"(alink_serve_feeder_errors_total): the server keeps "
+            f"serving the last good model, but it STOPPED UPDATING on "
+            f"those boundaries — check the feeder warnings for "
+            f"poisoned vs transient kinds")
+    if met_fixes:
+        out.append({"workload": "serving (metrics)",
+                    "fixes": met_fixes})
     return out
 
 
@@ -609,6 +680,20 @@ def render(doc: Dict[str, Any]) -> str:
         bits.append(f"{v.get('failed_requests', 0)} failed")
         if v.get("parity"):
             bits.append(f"parity {v['parity']}")
+        # resilience counters (ISSUE 14; the serve_chaos row and any
+        # shedding/degrading server)
+        if v.get("shed_requests") is not None:
+            bits.append(f"{v['shed_requests']} shed")
+        if v.get("breaker_opens") is not None:
+            bits.append(f"breaker opened {v['breaker_opens']}x "
+                        f"(re-opened {v.get('breaker_reopens', 0)}x)")
+        if v.get("typed_rejections") is not None:
+            bits.append(f"{v['typed_rejections']} typed rejections / "
+                        f"{v.get('silent_drops', 0)} silent")
+        if v.get("recovered_compiled") is not None:
+            bits.append("recovered to compiled"
+                        if v["recovered_compiled"]
+                        else "NOT recovered to compiled")
         out.append("  " + ", ".join(bits))
         for i, fx in enumerate(v.get("fixes") or [], 1):
             out.append(f"  fix {i}: {fx}")

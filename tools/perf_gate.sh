@@ -58,6 +58,16 @@ python tools/sweep_smoke.py
 # regression names itself.
 python tools/kernel_smoke.py
 
+# chaos-storm smoke (ISSUE 14): a live PredictServer under a scripted
+# ALINK_TPU_FAULT_INJECT storm (transient dispatch errors + injected
+# latency + one corrupt FTRL snapshot + a concurrent swap storm) must
+# hold the SLO contract — zero torn responses, zero silent drops
+# (results + typed rejections == submissions), deadline sheds are
+# typed, and the circuit breaker measurably recovers to the COMPILED
+# path once the storm clears. Exits 8 (its own code) so a resilience
+# regression names itself.
+python tools/chaos_smoke.py
+
 BASE=${PERF_GATE_BASE:-BENCH_quick_base.json}
 NEW=BENCH_quick.json
 THRESH=${PERF_GATE_THRESHOLD:-30}
@@ -113,6 +123,25 @@ for name in ("serve_logreg", "serve_ftrl_hot_swap", "serve_logreg_sharded"):
     if name == "serve_logreg_sharded" and row.get("parity") != "bitwise":
         bad.append(f"{name}: parity={row.get('parity')!r} (sharded bucket "
                    f"programs diverged across mesh sizes)")
+# the chaos row's SLO contract (ISSUE 14): typed rejections during the
+# storm are BY DESIGN; torn, silent, or a breaker that never recovered
+# to the compiled path is what fails the gate
+row = wl.get("serve_chaos")
+if not isinstance(row, dict) or "error" in row:
+    bad.append(f"serve_chaos: missing or errored "
+               f"({(row or {}).get('error')})")
+else:
+    if row.get("torn_responses"):
+        bad.append(f"serve_chaos: {row['torn_responses']} TORN responses")
+    if row.get("silent_drops"):
+        bad.append(f"serve_chaos: {row['silent_drops']} SILENT drops "
+                   f"(a future resolved to neither a result nor a typed "
+                   f"rejection)")
+    if not row.get("recovered_compiled"):
+        bad.append("serve_chaos: the breaker did not recover to the "
+                   "compiled path after the storm")
+    if not row.get("shed_requests"):
+        bad.append("serve_chaos: the latency+deadline leg shed nothing")
 if bad:
     print("perf_gate: serve smoke FAILED:", file=sys.stderr)
     for b in bad:
