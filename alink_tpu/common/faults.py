@@ -57,7 +57,12 @@ Sites are plain dotted strings; current producers:
     table so the consumer's load fails loudly), auto-indexed;
   * ``prefetch.get``        — inside the bounded channel's ``get``
     (operator/stream/prefetch.py — the serving loop and every stream
-    drain pull through it), auto-indexed.
+    drain pull through it), auto-indexed;
+  * ``ingest.batch``        — before the online DAG's resumable ingest
+    delivers a micro-batch to the scoring/eval leg (online/dag.py —
+    the resume-at-offset restart policy's test point), auto-indexed:
+    a redelivery after a crashed delivery advances the visit counter,
+    so bounded kill windows clear across ingest restarts.
 
 The env var is re-read on every call (monkeypatch-friendly); parsing is
 cached per raw string so the hot-path cost is one dict lookup. Tests
@@ -68,14 +73,15 @@ tests that arm the same site twice.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
-from typing import Dict, NamedTuple, Optional
+from typing import Dict, Iterator, NamedTuple, Optional
 
 __all__ = ["FAULT_ENV", "FAULT_MODES", "FaultInjected", "FaultRule",
            "TransientFault", "fault_spec", "faults_armed", "maybe_crash",
-           "reset_faults"]
+           "reset_faults", "scoped_fault_env"]
 
 FAULT_ENV = "ALINK_TPU_FAULT_INJECT"
 
@@ -234,6 +240,40 @@ def reset_faults() -> None:
     every later threshold."""
     _AUTO_INDEX.clear()
     _PARSED.clear()
+
+
+@contextlib.contextmanager
+def scoped_fault_env(spec: Optional[str]) -> Iterator[None]:
+    """Arm ``spec`` in :data:`FAULT_ENV` for the duration of a scenario,
+    with the counter hygiene the chaos harnesses need (ISSUE 15
+    satellite): the per-process auto-index visit counters are reset on
+    ENTRY (so the scenario's windows count from zero regardless of what
+    ran before) and the previous env value is restored — and the
+    counters reset again — on EXIT, **including failure paths** (the
+    body raising must not bleed armed faults or shifted visit counters
+    into the next scenario). ``spec=None`` runs the body with the fault
+    env guaranteed UNSET (a clean scenario between storms).
+
+    One storm leg per ``with`` block; legs that must share one
+    uninterrupted visit-counter timeline (the chaos smoke's
+    exactly-once corrupt window across an error leg and a delay leg)
+    belong inside a SINGLE scope, flipping ``os.environ[FAULT_ENV]``
+    directly between them.
+    """
+    saved = os.environ.get(FAULT_ENV)
+    reset_faults()
+    if spec:
+        os.environ[FAULT_ENV] = spec
+    else:
+        os.environ.pop(FAULT_ENV, None)
+    try:
+        yield
+    finally:
+        if saved is None:
+            os.environ.pop(FAULT_ENV, None)
+        else:
+            os.environ[FAULT_ENV] = saved
+        reset_faults()
 
 
 def maybe_crash(site: str, index: Optional[int] = None) -> bool:

@@ -614,6 +614,84 @@ FLAGS.register(
                         else "double"),
     accessor="alink_tpu.serving.predictor.serve_swap_mode")
 
+# -- online-learning DAG (alink_tpu/online/, ISSUE 15) -----------------------
+# Every ALINK_TPU_E2E_* flag is host-side DAG runtime policy — stage
+# supervision, SLO bounds, request pacing. None reaches a traced
+# program: the DAG composes the EXISTING trainer/serving/feeder program
+# factories unchanged, and with the flag family at defaults (and no
+# OnlineDag constructed) the serving and trainer lowered HLO and
+# response bytes are byte-identical to pre-DAG builds
+# (tests/test_online.py pins it).
+FLAGS.register(
+    "ALINK_TPU_E2E_DAG", "bool", False,
+    "arm the online DAG's flag-derived defaults: an OnlineDag built "
+    "without an explicit SloContract/deadline picks them up from the "
+    "ALINK_TPU_E2E_SLO_*/_DEADLINE_MS flags (off = explicit arguments "
+    "only; constructing the DAG itself is always explicit API)", "e2e",
+    key_neutral="host-side default selection for the DAG runtime; the "
+                "DAG only composes existing keyed program factories "
+                "and the flag is never read at trace time",
+    accessor="alink_tpu.online.slo.e2e_dag_enabled")
+FLAGS.register(
+    "ALINK_TPU_E2E_SLO_P99_MS", "float", 0.0,
+    "end-to-end SLO: serving p99 bound in ms evaluated live per eval "
+    "window by the online DAG's SloContract (0 = clause off)", "e2e",
+    key_neutral="host-side SLO verdict evaluation over already-"
+                "measured latencies; never trace-shaping",
+    clamp=lambda v: max(0.0, v),
+    accessor="alink_tpu.online.slo.slo_p99_s")
+FLAGS.register(
+    "ALINK_TPU_E2E_SLO_STALENESS_MS", "float", 0.0,
+    "end-to-end SLO: model swap staleness bound in ms (snapshot "
+    "emission -> swap installed) for the online DAG (0 = clause off)",
+    "e2e",
+    key_neutral="host-side SLO verdict evaluation over swap wall "
+                "times; never trace-shaping",
+    clamp=lambda v: max(0.0, v),
+    accessor="alink_tpu.online.slo.slo_staleness_s")
+FLAGS.register(
+    "ALINK_TPU_E2E_SLO_AUC", "float", 0.0,
+    "end-to-end SLO: final-window AUC floor for the online DAG's "
+    "windowed stream eval (0 = clause off)", "e2e",
+    key_neutral="host-side SLO verdict over eval-window metrics "
+                "computed from served responses; never trace-shaping",
+    clamp=lambda v: max(0.0, min(1.0, v)),
+    accessor="alink_tpu.online.slo.slo_auc_floor")
+FLAGS.register(
+    "ALINK_TPU_E2E_DEADLINE_MS", "float", 0.0,
+    "default request deadline the online DAG stamps on its side "
+    "traffic when ALINK_TPU_E2E_DAG=1 and no explicit deadline_s was "
+    "passed (0 = no deadline); eval ground-truth traffic retries typed "
+    "rejections instead of dropping windows", "e2e",
+    key_neutral="request deadline routing (shed-before-dispatch) "
+                "between already-compiled paths; the PR 14 deadline "
+                "machinery it feeds is itself key-neutral",
+    clamp=lambda v: max(0.0, v),
+    accessor="alink_tpu.online.slo.e2e_deadline_s")
+FLAGS.register(
+    "ALINK_TPU_E2E_MAX_RESTARTS", "int", 3,
+    "per-stage restart budget of the online DAG's supervisors "
+    "(trainer restart-from-checkpoint, feeder respawn-with-last-good-"
+    "model, ingest resume-at-offset)", "e2e",
+    key_neutral="host-side supervision budget; a restarted stage "
+                "rebuilds through the same keyed factories (the FTRL "
+                "checkpoint signature refuses any mismatch)",
+    clamp=lambda n: max(0, n),
+    accessor="alink_tpu.online.dag.e2e_max_restarts")
+FLAGS.register(
+    "ALINK_TPU_E2E_PACING", "mode", "deterministic",
+    "online DAG pacing: deterministic (score batch k+1 only after "
+    "train-commit k — bitwise-resumable eval windows) | throughput "
+    "(free-running scoring; the bench's steady-state mode)", "e2e",
+    key_neutral="host-side scheduling of how scoring interleaves with "
+                "training; both modes dispatch the same compiled "
+                "programs, and the trainer pace hook is host-only",
+    parser=lambda raw: ("throughput"
+                        if raw.strip().lower() in ("throughput", "free",
+                                                   "async")
+                        else "deterministic"),
+    accessor="alink_tpu.online.dag.e2e_pacing")
+
 # -- tuning (mesh-parallel sweeps, alink_tpu/tuning/) ------------------------
 FLAGS.register(
     "ALINK_TPU_SWEEP", "bool", False,

@@ -1,0 +1,231 @@
+"""End-to-end SLO contract for the online-learning DAG (ISSUE 15).
+
+"The Tail at Scale" discipline applied to the WHOLE loop instead of per
+stage: one :class:`SloContract` declares the service-level bounds the
+ingest -> train -> hot-swap -> serve -> eval program must hold —
+
+* ``serve_p99_s``        — serving p99 latency bound, evaluated live at
+  every eval-window close over the server's rolling latency window;
+* ``swap_staleness_s``   — model-swap staleness bound: wall time from a
+  model snapshot leaving the trainer to the swap being installed in the
+  serving tier (the "how stale can the served model be" clause);
+* ``final_window_auc``   — quality floor on the LAST closed eval
+  window's AUC (the convergence anchor; VERDICT #7 wants this number
+  discriminating, not chance-shaped).
+
+Breaches are TYPED (:class:`SloVerdict`), recorded live (metric
+``alink_e2e_slo_breaches_total{slo=}`` + an ``e2e.slo_breach`` trace
+instant) and collected on the :class:`~alink_tpu.online.dag.DagReport`;
+:meth:`SloContract.final` renders the end-of-run verdict list. A bound
+of ``None``/0 disarms its clause — the contract never invents bounds
+the operator did not set (``ALINK_TPU_E2E_DAG=1`` opts into the
+flag-derived defaults).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, NamedTuple, Optional
+
+from ..common.flags import flag_value
+from ..common.metrics import get_registry, metrics_enabled
+from ..common.tracing import trace_instant
+
+__all__ = ["SloContract", "SloVerdict", "e2e_dag_enabled", "slo_p99_s",
+           "slo_staleness_s", "slo_auc_floor", "e2e_deadline_s"]
+
+
+def e2e_dag_enabled() -> bool:
+    """``ALINK_TPU_E2E_DAG``: arm flag-derived DAG defaults."""
+    return bool(flag_value("ALINK_TPU_E2E_DAG"))
+
+
+def slo_p99_s() -> Optional[float]:
+    """``ALINK_TPU_E2E_SLO_P99_MS`` in seconds (None = clause off)."""
+    ms = float(flag_value("ALINK_TPU_E2E_SLO_P99_MS"))
+    return ms / 1e3 if ms > 0 else None
+
+
+def slo_staleness_s() -> Optional[float]:
+    """``ALINK_TPU_E2E_SLO_STALENESS_MS`` in seconds (None = off)."""
+    ms = float(flag_value("ALINK_TPU_E2E_SLO_STALENESS_MS"))
+    return ms / 1e3 if ms > 0 else None
+
+
+def slo_auc_floor() -> Optional[float]:
+    """``ALINK_TPU_E2E_SLO_AUC`` (None = clause off)."""
+    v = float(flag_value("ALINK_TPU_E2E_SLO_AUC"))
+    return v if v > 0 else None
+
+
+def e2e_deadline_s() -> Optional[float]:
+    """``ALINK_TPU_E2E_DEADLINE_MS`` in seconds (None = no deadline)."""
+    ms = float(flag_value("ALINK_TPU_E2E_DEADLINE_MS"))
+    return ms / 1e3 if ms > 0 else None
+
+
+class SloVerdict(NamedTuple):
+    """One typed SLO clause verdict: ``slo`` names the clause
+    (``serve_p99`` | ``swap_staleness`` | ``final_window_auc``),
+    ``ok`` whether the observation honored the bound, ``observed``/
+    ``bound`` the numbers (seconds for the latency clauses), and
+    ``detail`` a human sentence naming the phase/window."""
+    slo: str
+    ok: bool
+    observed: Optional[float]
+    bound: float
+    detail: str
+
+    def to_dict(self) -> dict:
+        return {"slo": self.slo, "ok": bool(self.ok),
+                "observed": self.observed, "bound": self.bound,
+                "detail": self.detail}
+
+
+class SloContract:
+    """Declarative end-to-end SLO bounds + live breach recording.
+
+    Construct explicitly, or :meth:`from_flags` under
+    ``ALINK_TPU_E2E_DAG=1``. ``observe_*`` methods are called by the
+    DAG at window closes / swaps; every breach lands in
+    :attr:`breaches` exactly once per (clause, context) so a sustained
+    storm reads as one typed event per window, not a counter melt."""
+
+    def __init__(self, serve_p99_s: Optional[float] = None,
+                 swap_staleness_s: Optional[float] = None,
+                 final_window_auc: Optional[float] = None,
+                 name: str = "online"):
+        self.serve_p99_s = serve_p99_s
+        self.swap_staleness_s = swap_staleness_s
+        self.final_window_auc = final_window_auc
+        self.name = name
+        self.breaches: List[SloVerdict] = []
+
+    @classmethod
+    def from_flags(cls, name: str = "online") -> "SloContract":
+        """The ``ALINK_TPU_E2E_SLO_*`` flag-derived contract."""
+        return cls(serve_p99_s=slo_p99_s(),
+                   swap_staleness_s=slo_staleness_s(),
+                   final_window_auc=slo_auc_floor(), name=name)
+
+    def armed(self) -> bool:
+        return any(b is not None for b in (self.serve_p99_s,
+                                           self.swap_staleness_s,
+                                           self.final_window_auc))
+
+    # -- live observation (the DAG calls these) ---------------------------
+    def _breach(self, verdict: SloVerdict) -> None:
+        self.breaches.append(verdict)
+        trace_instant("e2e.slo_breach", cat="e2e",
+                      args={"slo": verdict.slo,
+                            "observed": verdict.observed,
+                            "bound": verdict.bound,
+                            "detail": verdict.detail})
+        if metrics_enabled():
+            get_registry().inc("alink_e2e_slo_breaches_total", 1,
+                               {"dag": self.name, "slo": verdict.slo})
+
+    def observe_p99(self, p99_s: Optional[float],
+                    window: int) -> Optional[SloVerdict]:
+        """Live p99 check at an eval-window close; returns the typed
+        breach (already recorded) or ``None``."""
+        if self.serve_p99_s is None or p99_s is None:
+            return None
+        if p99_s <= self.serve_p99_s:
+            return None
+        v = SloVerdict("serve_p99", False, float(p99_s),
+                       float(self.serve_p99_s),
+                       f"window {window}: serving p99 "
+                       f"{p99_s * 1e3:.1f} ms > bound "
+                       f"{self.serve_p99_s * 1e3:.1f} ms")
+        self._breach(v)
+        return v
+
+    def observe_swap(self, staleness_s: float,
+                     version: int) -> Optional[SloVerdict]:
+        """Per-swap staleness check (emission -> installed)."""
+        if self.swap_staleness_s is None \
+                or staleness_s <= self.swap_staleness_s:
+            return None
+        v = SloVerdict("swap_staleness", False, float(staleness_s),
+                       float(self.swap_staleness_s),
+                       f"swap to version {version} took "
+                       f"{staleness_s * 1e3:.1f} ms > bound "
+                       f"{self.swap_staleness_s * 1e3:.1f} ms")
+        self._breach(v)
+        return v
+
+    # -- the end-of-run verdict -------------------------------------------
+    def final(self, p99_s: Optional[float],
+              max_staleness_s: Optional[float],
+              final_auc: Optional[float]) -> List[SloVerdict]:
+        """The whole-run verdict list — one typed entry per ARMED
+        clause, ``ok`` reflecting the run's worst observation (live
+        breaches already recorded separately in :attr:`breaches`)."""
+        out: List[SloVerdict] = []
+        if self.serve_p99_s is not None:
+            ok = p99_s is not None and p99_s <= self.serve_p99_s
+            out.append(SloVerdict(
+                "serve_p99", ok, p99_s, float(self.serve_p99_s),
+                f"run p99 {p99_s * 1e3:.1f} ms vs bound "
+                f"{self.serve_p99_s * 1e3:.1f} ms"
+                if p99_s is not None else "no latency samples"))
+        if self.swap_staleness_s is not None:
+            ok = (max_staleness_s is None
+                  or max_staleness_s <= self.swap_staleness_s)
+            out.append(SloVerdict(
+                "swap_staleness", ok, max_staleness_s,
+                float(self.swap_staleness_s),
+                f"max swap staleness "
+                f"{(max_staleness_s or 0.0) * 1e3:.1f} ms vs bound "
+                f"{self.swap_staleness_s * 1e3:.1f} ms"))
+        if self.final_window_auc is not None:
+            ok = final_auc is not None \
+                and final_auc >= self.final_window_auc
+            out.append(SloVerdict(
+                "final_window_auc", ok, final_auc,
+                float(self.final_window_auc),
+                f"final-window AUC "
+                f"{final_auc if final_auc is not None else 'n/a'} vs "
+                f"floor {self.final_window_auc}"))
+        return out
+
+
+class SwapStalenessTracker:
+    """Measures the emission->installed wall time of every model swap.
+
+    The DAG's feeder callback opens a sample when a snapshot leaves the
+    trainer (``mark_emitted``) and closes it when the swap lands
+    (``mark_installed``); the max/mean ride the report and the
+    ``alink_e2e_swap_staleness_seconds`` gauge."""
+
+    def __init__(self, contract: Optional[SloContract] = None,
+                 name: str = "online"):
+        self.contract = contract
+        self.name = name
+        self.samples: List[float] = []
+        self._open: Optional[float] = None
+
+    def mark_emitted(self) -> None:
+        self._open = time.perf_counter()
+
+    def mark_installed(self, version: int) -> float:
+        t0 = self._open if self._open is not None else time.perf_counter()
+        dt = time.perf_counter() - t0
+        self._open = None
+        self.samples.append(dt)
+        if metrics_enabled():
+            get_registry().set_gauge("alink_e2e_swap_staleness_seconds",
+                                     dt, {"dag": self.name})
+        if self.contract is not None:
+            self.contract.observe_swap(dt, version)
+        return dt
+
+    @property
+    def max_s(self) -> Optional[float]:
+        return max(self.samples) if self.samples else None
+
+    @property
+    def mean_s(self) -> Optional[float]:
+        return (sum(self.samples) / len(self.samples)
+                if self.samples else None)

@@ -74,6 +74,19 @@ class LinearModelDataConverter(LabeledModelDataConverter):
     def __init__(self, label_type: str = AlinkTypes.STRING):
         super().__init__(label_type)
 
+    @classmethod
+    def load_table(cls, table) -> "LinearModelData":
+        """Load a serialized linear model table, sniffing the label
+        type from its third column (the labeled layout's label slot;
+        STRING for the label-less two-column shape). The ONE
+        label-type/positive-label convention every consumer of a
+        linear model table must share — the FTRL warm start, the
+        predict mapper, and the online DAG's eval leg all load
+        through here (``label_values[0]`` is the positive label)."""
+        label_type = table.schema.types[2] if len(table.schema) > 2 \
+            else AlinkTypes.STRING
+        return cls(label_type).load_model(table)
+
     def serialize_model(self, m: LinearModelData):
         meta = Params({
             "model_name": m.model_name, "linear_model_type": m.linear_model_type,

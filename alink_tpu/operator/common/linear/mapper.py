@@ -51,9 +51,7 @@ class LinearModelMapper(ModelMapper):
         self.model: Optional[LinearModelData] = None
 
     def load_model(self, model_table: MTable):
-        label_type = model_table.schema.types[2] if len(model_table.schema) > 2 \
-            else AlinkTypes.STRING
-        self.model = LinearModelDataConverter(label_type).load_model(model_table)
+        self.model = LinearModelDataConverter.load_table(model_table)
 
     # ------------------------------------------------------------------
     def _scores(self, data: MTable) -> np.ndarray:

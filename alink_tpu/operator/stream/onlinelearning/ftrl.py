@@ -40,7 +40,7 @@ from ....common.params import InValidator, ParamInfo, Params, RangeValidator
 from ....common.profiling2 import (hbm_snapshot, mark as profile_mark,
                                    open_window)
 from ....common.tracing import trace_complete, trace_instant
-from ....common.types import AlinkTypes, TableSchema
+from ....common.types import TableSchema
 from ....params.shared import (HasFeatureCols, HasLabelCol, HasPredictionCol,
                                HasPredictionDetailCol, HasReservedCols,
                                HasVectorCol)
@@ -804,6 +804,22 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
         super().__init__(params, **kwargs)
         self._initial_model = initial_model
         self._device_snapshot_hook = None
+        self._batch_hook = None
+
+    def set_batch_hook(self, hook) -> "FtrlTrainStreamOp":
+        """Register a host-side micro-batch lifecycle hook (ISSUE 15,
+        the online DAG's pacing point): ``hook("pre", b, t)`` fires
+        before batch ``b``'s state update runs (1-based, ``t`` = event
+        time) and ``hook("post", b, t)`` after the update — and any
+        snapshot emission the batch triggered — has committed. The hook
+        runs on the drain thread and MAY BLOCK (that is the point: the
+        DAG's deterministic pacing holds the trainer at ``pre`` until
+        the scoring leg has consumed the model state the batch is about
+        to advance). Unset (the default) the drain is byte-for-byte the
+        hook-less path; the hook is never read at trace time and shapes
+        no compiled program."""
+        self._batch_hook = hook
+        return self
 
     def set_device_snapshot_consumer(self, hook) -> "FtrlTrainStreamOp":
         """Register a device-to-device snapshot consumer (ROADMAP item 1
@@ -828,9 +844,7 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                 "FTRL requires an initial batch model (reference "
                 "FtrlTrainStreamOp.java:56-60 warm start)")
         table = self._initial_model.get_output_table()
-        label_type = table.schema.types[2] if len(table.schema) > 2 \
-            else AlinkTypes.STRING
-        return LinearModelDataConverter(label_type).load_model(table)
+        return LinearModelDataConverter.load_table(table)
 
     def link_from(self, data_op: StreamOperator) -> "FtrlTrainStreamOp":
         env = self.get_ml_env()
@@ -1375,10 +1389,15 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
             # ordered pool: workers=1 (default) is byte-for-byte the old
             # single-prefetch-thread drain; ALINK_TPU_STREAM_WORKERS=N
             # parallelizes the host encode N-wide with order preserved
+            pace = self._batch_hook
             for t, mt, enc, batch_size in prefetch_map(raw_batches(),
                                                        encode_task,
                                                        name="ftrl.encode"):
               t0 = time.perf_counter()
+              if pace is not None:
+                  # pacing gate (online DAG): may block until the
+                  # scoring leg has consumed the pre-batch model state
+                  pace("pre", b_done + 1, t)
               if next_emit is None:
                   next_emit = (np.floor(t / interval) + 1) * interval
               if (layout == "fb" and (
@@ -1499,6 +1518,10 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                   while next_emit <= t + 1e-12:
                       next_emit += interval
               b_done += 1
+              if pace is not None:
+                  # committed: the state update AND any snapshot
+                  # emission (swap) this batch triggered are done
+                  pace("post", b_done, t)
               # the injected-preemption point sits BEFORE the periodic
               # save: a crash at batch k genuinely loses the work since
               # the last snapshot, which is what the kill-and-resume

@@ -626,6 +626,16 @@ class ModelStreamFeeder(_FeederSupervision):
             if not getattr(e, "_alink_feeder_recorded", False):
                 record_feeder_error(self.feeder_kind, "fatal", e)
 
+    def run(self) -> int:
+        """Drain the model stream synchronously on the caller's thread
+        (the online DAG's train-stage supervisor owns the drain and
+        needs the crash to surface HERE, not on a daemon thread);
+        returns the swap count."""
+        self._run()
+        if self.error is not None:
+            raise self.error
+        return len(self.versions)
+
     def join(self, timeout: Optional[float] = None) -> int:
         """Wait for the stream to drain; returns the swap count. Raises
         the feeder thread's error, if any — and refuses to return a

@@ -2684,6 +2684,175 @@ def quick_serve_chaos(h: Harness):
     return _bench_serve_chaos(h, requests_per_phase=800)
 
 
+def _bench_serve_online_e2e(h: Harness, n_rows: int, dim: int,
+                            storm_rows: int, batch_rows: int = 128):
+    """The whole online-learning loop as ONE supervised program
+    (ISSUE 15; ROADMAP item 5): stream ingest -> FTRL training with
+    checkpoints -> model-snapshot stream -> hot-swap serving (breaker +
+    deadlines armed) -> windowed stream eval, run by
+    ``alink_tpu.online.OnlineDag`` with per-stage restart policies and
+    an end-to-end SloContract. Four phases:
+
+    1. steady state (``pacing="throughput"``): scoring QPS, p99, swap
+       staleness, per-window + final-window AUC, SLO verdicts — the
+       armed contract (generous latency bounds + the 0.75 AUC anchor)
+       must hold on a clean run;
+    2. a deterministic-pacing golden run on a shorter stream — the
+       bitwise reference for the storms;
+    3. trainer-side storm (ftrl.batch kill + ckpt.save fault +
+       ingest.batch kill + prefetch.get delay): every restart is typed
+       with a MEASURED recovery time and the run's eval journals are
+       bitwise the golden run's (no drop, no double-apply);
+    4. serve-side storm (serve.dispatch error window + one corrupt
+       model snapshot): the breaker opens, degrades to the host
+       fallback, and measurably recovers to the compiled path — the
+       final scored batch is bitwise the golden run's — while the
+       poisoned snapshot is skipped with the last good model serving.
+
+    Zero silent drops is gated across ALL phases (every scoring future
+    resolves to a result or a typed rejection)."""
+    import tempfile
+
+    from alink_tpu.common.faults import FAULT_ENV, scoped_fault_env
+    from alink_tpu.online import OnlineDag, SloContract
+    from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+    tbl, warm, _mapper, _schema = _serve_fixture(n_rows, dim, seed=17)
+    storm_tbl = tbl.first_n(storm_rows)
+
+    def mkdag(source_tbl, art, interval, **kw):
+        return OnlineDag(
+            source_fn=lambda: MemSourceStreamOp(source_tbl,
+                                                batch_size=batch_rows),
+            warm_model=warm, artifacts_dir=art, label_col="label",
+            vector_col="vec", time_interval=interval,
+            checkpoint_every=2, name="serve_online_e2e", **kw)
+
+    def eval_files(art):
+        return (open(os.path.join(art, "eval", "windows.jsonl")).read(),
+                open(os.path.join(art, "eval", "scores.jsonl")).read())
+
+    saved_maxms = os.environ.get("ALINK_TPU_SERVE_BREAKER_MAX_MS")
+    os.environ["ALINK_TPU_SERVE_BREAKER_MAX_MS"] = "200"
+    t0 = time.perf_counter()
+    try:
+        # -- phase 1: steady state under the armed SLO contract ----------
+        slo = SloContract(serve_p99_s=2.0, swap_staleness_s=30.0,
+                          final_window_auc=0.75, name="serve_online_e2e")
+        with scoped_fault_env(None):
+            steady = mkdag(tbl, tempfile.mkdtemp(prefix="e2e_steady_"),
+                           interval=3.0, pacing="throughput",
+                           slo=slo).run()
+        if steady.failed is not None:
+            return {"error": f"steady-state phase failed: {steady.failed}"}
+
+        # -- phase 2: the deterministic golden reference -----------------
+        with scoped_fault_env(None):
+            g_art = tempfile.mkdtemp(prefix="e2e_gold_")
+            golden = mkdag(storm_tbl, g_art, interval=2.0).run()
+        if golden.failed is not None:
+            return {"error": f"golden phase failed: {golden.failed}"}
+        gold_files = eval_files(g_art)
+
+        # -- phase 3: trainer-side storm, bitwise + measured recovery ----
+        def clear_trainer_kill(stage, exc):
+            # the kill is keyed on the batch NUMBER, which the
+            # checkpoint replay revisits — the supervisor's crash
+            # callback clears that one entry so the restart survives
+            if getattr(exc, "site", None) == "ftrl.batch":
+                os.environ[FAULT_ENV] = ";".join(
+                    e for e in os.environ.get(FAULT_ENV, "").split(";")
+                    if e and not e.startswith("ftrl.batch"))
+
+        with scoped_fault_env("ftrl.batch:4-4;ckpt.save:2-2:error;"
+                              "ingest.batch:3-3;prefetch.get:1-60:delay:1"):
+            s3_art = tempfile.mkdtemp(prefix="e2e_storm_train_")
+            r3 = mkdag(storm_tbl, s3_art, interval=2.0,
+                       on_stage_event=clear_trainer_kill).run()
+        if r3.failed is not None:
+            return {"error": f"trainer-storm phase failed: {r3.failed}"}
+        storm_bitwise = eval_files(s3_art) == gold_files
+        recovery = {}
+        for rec in r3.restarts:
+            site = rec.get("site") or rec.get("error")
+            if rec.get("recovery_s") is not None:
+                recovery[site] = rec["recovery_s"]
+        train_recs = [r for r in r3.restarts if r["stage"] == "train"]
+
+        # -- phase 4: serve-side storm, breaker recovery + last-good -----
+        with scoped_fault_env("serve.dispatch:1-8:error;"
+                              "feeder.snapshot:1-1:corrupt"):
+            s4_art = tempfile.mkdtemp(prefix="e2e_storm_serve_")
+            r4 = mkdag(storm_tbl, s4_art, interval=2.0).run()
+        if r4.failed is not None:
+            return {"error": f"serve-storm phase failed: {r4.failed}"}
+        brk = (r4.server_stats.get("breaker") or {})
+        tail_bitwise = (eval_files(s4_art)[1].splitlines()[-1]
+                        == gold_files[1].splitlines()[-1])
+        recovered = bool(brk.get("opens") and brk.get("state") == "closed"
+                         and tail_bitwise)
+    finally:
+        if saved_maxms is None:
+            os.environ.pop("ALINK_TPU_SERVE_BREAKER_MAX_MS", None)
+        else:
+            os.environ["ALINK_TPU_SERVE_BREAKER_MAX_MS"] = saved_maxms
+    dt = time.perf_counter() - t0
+    silent = (steady.silent_drops + golden.silent_drops
+              + r3.silent_drops + r4.silent_drops)
+    return {
+        "samples_per_sec_per_chip": round(steady.qps, 1),
+        "qps": round(steady.qps, 1),
+        "p99_ms": (round(steady.p99_s * 1e3, 3)
+                   if steady.p99_s is not None else None),
+        "swap_staleness_max_ms": (
+            round(steady.swap_staleness_max_s * 1e3, 3)
+            if steady.swap_staleness_max_s is not None else None),
+        "swap_staleness_mean_ms": (
+            round(steady.swap_staleness_mean_s * 1e3, 3)
+            if steady.swap_staleness_mean_s is not None else None),
+        "model_swaps": int(steady.swaps),
+        "windows": len(steady.windows),
+        "window_auc": [round(w["auc"], 4) for w in steady.windows
+                       if w["auc"] is not None],
+        "final_window_auc": (round(steady.final_window_auc, 4)
+                             if steady.final_window_auc is not None
+                             else None),
+        "auc_note": steady.auc_note,
+        "slo_ok": steady.slo_ok(),
+        "slo": [v.to_dict() for v in steady.slo],
+        "slo_breaches": len(steady.breaches),
+        "scored_rows": int(steady.scored_rows),
+        "shed_requests": int(steady.shed_requests),
+        "silent_drops": int(silent),
+        "typed_rejections": int(r4.typed_rejections),
+        "storm_restarts": len(r3.restarts),
+        "storm_bitwise_journals": bool(storm_bitwise),
+        "recovery_s_by_fault": recovery,
+        "recovery_train_restart_s": (train_recs[0].get("recovery_s")
+                                     if train_recs else None),
+        "recovery_ingest_s": recovery.get("ingest.batch"),
+        "breaker_opens": int(brk.get("opens") or 0),
+        "fallback_batches": int(
+            r4.server_stats.get("fallback_batches") or 0),
+        "feeder_skipped": int(r4.feeder_skipped),
+        "recovered_compiled": bool(recovered),
+        "bound": "serving-host",
+        "dt_s": round(dt, 3),
+    }
+
+
+def bench_serve_online_e2e(h: Harness):
+    return _bench_serve_online_e2e(h, n_rows=4096, dim=32,
+                                   storm_rows=2048)
+
+
+def quick_serve_online_e2e(h: Harness):
+    # the storm stream needs a post-storm tail long enough for the
+    # breaker's half-open probe to re-close and re-serve compiled
+    # (12 batches; measured — a 6-batch stream ends still degraded)
+    return _bench_serve_online_e2e(h, n_rows=1536, dim=24,
+                                   storm_rows=1536)
+
+
 def _tuning_sweep_row(h: Harness, n_rows, d, iters, P, rung, eta, reps):
     """Mesh-parallel tuning sweep (ROADMAP item 3): N hyperparameter
     points as ONE BSP program with ASHA early stopping, measured against
@@ -2789,7 +2958,8 @@ QUICK_WORKLOADS = (("logreg_criteo", quick_logreg),
                    ("serve_fused", quick_serve_fused),
                    ("serve_ftrl_hot_swap", quick_serve_hot_swap),
                    ("serve_logreg_sharded", quick_serve_sharded),
-                   ("serve_chaos", quick_serve_chaos))
+                   ("serve_chaos", quick_serve_chaos),
+                   ("serve_online_e2e", quick_serve_online_e2e))
 
 
 # ---------------------------------------------------------------------------
@@ -2904,7 +3074,8 @@ def main(argv=None):
                      ("serve_fused", bench_serve_fused),
                      ("serve_ftrl_hot_swap", bench_serve_hot_swap),
                      ("serve_logreg_sharded", bench_serve_sharded),
-                     ("serve_chaos", bench_serve_chaos))
+                     ("serve_chaos", bench_serve_chaos),
+                     ("serve_online_e2e", bench_serve_online_e2e))
     for name, fn in suite:
         r = None
         for attempt in (1, 2):

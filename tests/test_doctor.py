@@ -464,3 +464,97 @@ class TestKernelTierVerdicts:
         assert history._display_name("serve_fused") \
             == "serve_fused (rows/s)"
         assert "kernel tier" in history._display_name("ftrl_pallas")
+
+
+class TestDoctorE2eVerdict:
+    """ISSUE 15: the serve_online_e2e row gets its own whole-loop
+    verdict section naming the weakest stage."""
+
+    def _row(self, **over):
+        row = {"samples_per_sec_per_chip": 3193.8, "qps": 3193.8,
+               "p99_ms": 330.5, "windows": 4,
+               "final_window_auc": 0.9963, "auc_note": None,
+               "model_swaps": 4, "swap_staleness_max_ms": 1.267,
+               "slo_ok": True, "slo_breaches": 0,
+               "slo": [
+                   {"slo": "serve_p99", "ok": True, "observed": 0.33,
+                    "bound": 2.0, "detail": "x"},
+                   {"slo": "swap_staleness", "ok": True,
+                    "observed": 0.0013, "bound": 30.0, "detail": "x"},
+                   {"slo": "final_window_auc", "ok": True,
+                    "observed": 0.9963, "bound": 0.75, "detail": "x"}],
+               "silent_drops": 0, "typed_rejections": 768,
+               "storm_restarts": 3, "storm_bitwise_journals": True,
+               "recovery_s_by_fault": {"ftrl.batch": 0.084,
+                                       "ckpt.save": 0.043,
+                                       "ingest.batch": 0.0005},
+               "recovery_train_restart_s": 0.084,
+               "recovered_compiled": True, "feeder_skipped": 1,
+               "shed_requests": 0, "dt_s": 4.0}
+        row.update(over)
+        return row
+
+    def _render(self, doctor, row):
+        doc = doctor.diagnose(
+            {"workloads": {"serve_online_e2e": row},
+             "rig": {"dispatch_gap_est_s": 0.001, "peak_tflops": 1.0,
+                     "peak_hbm_gbps": 1.0}}, None, None, 1.0, 1.0)
+        return doc, doctor.render(doc)
+
+    def test_healthy_verdict_names_weakest_stage(self, doctor):
+        doc, text = self._render(doctor, self._row())
+        v = doc["e2e"][0]
+        assert v["fixes"] == []
+        assert "online DAG e2e: serve_online_e2e" in text
+        assert "3,194 qps steady-state" in text
+        assert "4 eval windows" in text and "final AUC 0.9963" in text
+        assert "journals bitwise" in text
+        assert "breaker recovered to compiled" in text
+        # the AUC clause runs at 75% of budget — the tightest margin —
+        # so the weakest stage names train/eval quality
+        assert v["weakest_stage"] == "train"
+        assert "weakest stage: train" in text
+        assert "verdict: healthy" in text
+        # the e2e row enters NEITHER the generic capture-window section
+        # NOR the per-serve-row section (it has its own)
+        assert all(w["workload"] != "serve_online_e2e"
+                   for w in doc["workloads"])
+        assert all(w["workload"] != "serve_online_e2e"
+                   for w in doc.get("serving", []))
+
+    def test_tight_p99_margin_moves_weakest_to_serve(self, doctor):
+        row = self._row()
+        row["slo"][0] = {"slo": "serve_p99", "ok": True,
+                         "observed": 1.9, "bound": 2.0, "detail": "x"}
+        doc, _ = self._render(doctor, row)
+        assert doc["e2e"][0]["weakest_stage"] == "serve"
+
+    def test_breached_clause_and_broken_storm_are_critical(self, doctor):
+        doc, text = self._render(doctor, self._row(
+            slo_ok=False,
+            slo=[{"slo": "final_window_auc", "ok": False,
+                  "observed": 0.52, "bound": 0.75,
+                  "detail": "final-window AUC 0.52 vs floor 0.75"}],
+            auc_note="final-window AUC 0.52 is below the 0.75 anchor",
+            storm_bitwise_journals=False, recovered_compiled=False,
+            silent_drops=2))
+        fixes = "\n".join(doc["e2e"][0]["fixes"])
+        assert "SILENT drops" in fixes
+        assert "did NOT resume bitwise" in fixes
+        assert "never recovered to the compiled path" in fixes
+        assert "SLO clause final_window_auc failed" in fixes
+        assert "quality anchor did not clear" in fixes
+        assert "CRITICAL" in text and "SLO BREACHED" in text
+
+    def test_errored_row_renders_error(self, doctor):
+        doc, text = self._render(doctor, {"error": "boom"})
+        assert doc["e2e"][0]["error"] == "boom"
+        assert "ERROR: boom" in text
+
+    def test_bench_history_labels_e2e_row(self, history):
+        assert history._display_name("serve_online_e2e") == \
+            "serve_online_e2e (qps, whole-loop DAG)"
+        import importlib
+        bc = importlib.import_module("tools.bench_compare")
+        assert bc._display_name("serve_online_e2e") == \
+            history._display_name("serve_online_e2e")

@@ -558,9 +558,43 @@ class TestGeneratedDocs:
         rows = FLAGS.doc_rows()
         assert {r["section"] for r in rows} == {
             "observability", "performance", "durability", "debug", "io",
-            "bench", "serving", "tuning"}
+            "bench", "serving", "tuning", "e2e"}
         by_name = {r["name"]: r for r in rows}
         assert by_name["ALINK_TPU_DONATE"]["folds"] == \
             "program_cache, step_lru"
         assert "key-neutral" not in by_name["ALINK_TPU_DONATE"]["key_note"]
         assert by_name["ALINK_TPU_METRICS"]["folds"] == "—"
+
+    def test_readme_bench_table_current(self):
+        """The docs freshness gate (ISSUE 15 satellite, VERDICT #2):
+        README's measured-performance table matches a regeneration from
+        the newest BENCH_r*.json capture (gen_docs --check gates it in
+        perf_gate.sh; regenerate with tools/gen_readme_table.py)."""
+        from tools.gen_docs import check_readme_bench
+        assert check_readme_bench()
+
+    def test_readme_bench_check_catches_staleness(self, monkeypatch,
+                                                  tmp_path, capsys):
+        """A doctored README (numbers drifted from the capture) fails
+        the check and the message names the regeneration command."""
+        import tools.gen_docs as gd
+        from tools import gen_readme_table as grt
+        with open(os.path.join(gd._ROOT, "README.md")) as f:
+            readme = f.read()
+        start = readme.index(grt.START)
+        stale = readme[:start] + readme[start:].replace(
+            "|", "|", 1).replace("M |", "G |", 1)
+        assert stale != readme, "fixture needs a number to doctor"
+        (tmp_path / "README.md").write_text(stale)
+        monkeypatch.setattr(gd, "_ROOT", str(tmp_path))
+        # the captures stay the real ones (grt.ROOT untouched)
+        assert not gd.check_readme_bench()
+        assert "STALE" in capsys.readouterr().out
+
+    def test_readme_bench_check_skips_without_capture(self, monkeypatch,
+                                                      capsys):
+        import tools.gen_docs as gd
+        from tools import gen_readme_table as grt
+        monkeypatch.setattr(grt, "newest_capture", lambda: None)
+        assert gd.check_readme_bench()
+        assert "skipped" in capsys.readouterr().out

@@ -386,3 +386,28 @@ def test_dict_general_vocabulary_scale():
     # of non-name entries, and names must not be the only mass
     non_name = sum(v for k, v in stats.items() if k != "name")
     assert non_name >= 13_000, stats
+    # ISSUE 15 satellite (VERDICT #4): the open-class GENERAL inventory
+    # (everything outside the compositional closed classes) clears 50k
+    closed = {"name", "number", "date", "measure", "place", "redup"}
+    general = sum(v for k, v in stats.items() if k not in closed)
+    assert general >= 50_000, (general, stats)
+
+
+def test_gold_set_scale_and_certified_f1():
+    """ISSUE 15 satellite (VERDICT #4): the gold segmentation set holds
+    >= 300 sentences so segment_eval certifies the published F1 to two
+    digits, and the measured F1 stays at the published 0.84+ level
+    (deterministic: dictionary + gold are both committed artifacts)."""
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    from tools.segment_eval import evaluate, load_gold
+    gold = load_gold()
+    assert len(gold) >= 300, len(gold)
+    r = evaluate()
+    assert r["sentences"] == len(gold)
+    assert r["f1"] >= 0.84, r
+    assert r["general_words"] >= 50_000, r
+    # every gold line re-joins to its sentence (authoring integrity)
+    for toks in gold:
+        assert all(t for t in toks)

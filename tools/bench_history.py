@@ -230,6 +230,10 @@ def _display_name(name: str) -> str:
         # throughput DURING the scripted fault storm — degraded by
         # design; the SLO contract rides the row's own fields (ISSUE 14)
         return f"{name} (qps under storm)"
+    if name == "serve_online_e2e":
+        # the whole online-learning DAG's steady-state scoring rate;
+        # the SLO verdicts / recovery evidence ride the row (ISSUE 15)
+        return f"{name} (qps, whole-loop DAG)"
     if name.startswith("serve_"):
         return f"{name} (qps)"
     return name

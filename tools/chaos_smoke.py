@@ -71,7 +71,7 @@ def main() -> int:
 
     import numpy as np
 
-    from alink_tpu.common.faults import reset_faults
+    from alink_tpu.common.faults import FAULT_ENV, scoped_fault_env
     from alink_tpu.common.metrics import MetricsRegistry, set_registry
     from alink_tpu.common.mtable import MTable
     from alink_tpu.common.params import Params
@@ -164,28 +164,23 @@ def main() -> int:
     responses = []
 
     # -- phase 1: clean ----------------------------------------------------
-    lg(200, "warmup")
-    rep_before = lg(400, "before")
-    responses += rep_before.responses
+    # scoped_fault_env(None) guarantees the clean phases run UNARMED
+    # with fresh visit counters, whatever the parent process had set
+    with scoped_fault_env(None):
+        lg(200, "warmup")
+        rep_before = lg(400, "before")
+        responses += rep_before.responses
 
     # -- phase 2: the storm ------------------------------------------------
     # concurrent swap storm off a live FTRL trainer, with snapshot 1
     # corrupt (the supervised feeder must skip it, keep the last good
-    # model, and apply the later swaps)
-    reset_faults()
-    os.environ["ALINK_TPU_FAULT_INJECT"] = STORM_SPEC
-    src = MemSourceStreamOp(tbl, batch_size=128)
-    ftrl = FtrlTrainStreamOp(warm, vector_col="vec", label_col="label",
-                             alpha=0.1, update_mode="batch",
-                             time_interval=1.0).link_from(src)
-    feeder = ModelStreamFeeder(srv, ftrl).start()
-    rep_storm = lg(600, "storm(errors+corrupt+swaps)")
-    responses += rep_storm.responses
-    responses += explicit_round(100)
-    # latency-injection leg: slow dispatches + tight deadlines = typed
-    # deadline sheds, never silence. NO reset_faults between the legs:
-    # the visit counters keep running, so the snapshot-corruption window
-    # stays exactly-once across the whole storm
+    # model, and apply the later swaps). BOTH storm legs live inside
+    # ONE scoped_fault_env (counters reset on entry, env restored +
+    # counters reset on exit EVEN WHEN A LEG FAILS — a failed scenario
+    # must not bleed armed faults or shifted visit counters into the
+    # recovery phase); the leg flip rewrites the env var inside the
+    # scope so the feeder.snapshot:1-1 corrupt window stays
+    # exactly-once across one uninterrupted visit timeline.
     import time as _time
 
     def one(deadline_s=None):
@@ -201,47 +196,62 @@ def main() -> int:
             tally["typed"] += 1
         return False
 
-    # the error leg may leave the breaker open; drive probes until it
-    # recovers so the delay leg measures the COMPILED path's latency
-    # (an open breaker serves host-side and never meets the fault site)
-    wait_until = _time.monotonic() + 20
-    while srv.breaker_stats()["state"] != "closed" \
-            and _time.monotonic() < wait_until:
-        one()
-        _time.sleep(0.05)
-    if srv.breaker_stats()["state"] != "closed":
-        bad.append("breaker did not re-close between the storm legs")
-    os.environ["ALINK_TPU_FAULT_INJECT"] = DELAY_SPEC
-    f_first = srv.submit(probe)      # occupies the loop in a 30 ms dispatch
-    tally["submitted"] += 1
-    _time.sleep(0.01)
-    shed_futs = [srv.submit(probe, deadline_s=0.004) for _ in range(6)]
-    tally["submitted"] += 6
-    try:
-        responses.append(tuple(f_first.result(60)))
-        tally["results"] += 1
-    except TimeoutError:
-        tally["silent"] += 1
-    except BaseException:
-        tally["typed"] += 1
-    for f in shed_futs:
+    with scoped_fault_env(STORM_SPEC):
+        src = MemSourceStreamOp(tbl, batch_size=128)
+        ftrl = FtrlTrainStreamOp(warm, vector_col="vec",
+                                 label_col="label",
+                                 alpha=0.1, update_mode="batch",
+                                 time_interval=1.0).link_from(src)
+        feeder = ModelStreamFeeder(srv, ftrl).start()
+        rep_storm = lg(600, "storm(errors+corrupt+swaps)")
+        responses += rep_storm.responses
+        responses += explicit_round(100)
+        # latency-injection leg: slow dispatches + tight deadlines =
+        # typed deadline sheds, never silence. Same scope, so the
+        # visit counters keep running.
+        # The error leg may leave the breaker open; drive probes until
+        # it recovers so the delay leg measures the COMPILED path's
+        # latency (an open breaker serves host-side and never meets
+        # the fault site)
+        wait_until = _time.monotonic() + 20
+        while srv.breaker_stats()["state"] != "closed" \
+                and _time.monotonic() < wait_until:
+            one()
+            _time.sleep(0.05)
+        if srv.breaker_stats()["state"] != "closed":
+            bad.append("breaker did not re-close between the storm legs")
+        os.environ[FAULT_ENV] = DELAY_SPEC
+        f_first = srv.submit(probe)   # occupies the loop in a 30 ms
+        tally["submitted"] += 1       # dispatch
+        _time.sleep(0.01)
+        shed_futs = [srv.submit(probe, deadline_s=0.004)
+                     for _ in range(6)]
+        tally["submitted"] += 6
         try:
-            responses.append(tuple(f.result(60)))
+            responses.append(tuple(f_first.result(60)))
             tally["results"] += 1
         except TimeoutError:
             tally["silent"] += 1
         except BaseException:
             tally["typed"] += 1
-    try:
-        swaps = feeder.join(timeout=180)
-    except BaseException as e:
-        bad.append(f"feeder died during the storm: {type(e).__name__}: {e}")
-        swaps = len(feeder.versions)
+        for f in shed_futs:
+            try:
+                responses.append(tuple(f.result(60)))
+                tally["results"] += 1
+            except TimeoutError:
+                tally["silent"] += 1
+            except BaseException:
+                tally["typed"] += 1
+        try:
+            swaps = feeder.join(timeout=180)
+        except BaseException as e:
+            bad.append(f"feeder died during the storm: "
+                       f"{type(e).__name__}: {e}")
+            swaps = len(feeder.versions)
 
     # -- phase 3: the storm clears — recovery ------------------------------
-    del os.environ["ALINK_TPU_FAULT_INJECT"]
-    reset_faults()
-    import time as _time
+    # (the scope exit above already restored the env and reset the
+    # visit counters, failure paths included)
     _time.sleep(0.2)      # past any remaining breaker backoff
     compiled_before = metric("alink_serve_batches_total")
     rep_after = lg(400, "after")

@@ -289,6 +289,9 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
     for name, row in rows.items():
         if not str(name).startswith("serve_") or not isinstance(row, dict):
             continue
+        if str(name) == "serve_online_e2e":
+            continue    # the whole-loop DAG row gets its own e2e
+                        # verdict section (_e2e_verdicts)
         if "error" in row:
             out.append({"workload": name, "error": row["error"]})
             continue
@@ -476,6 +479,109 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
     return out
 
 
+#: SLO clause -> the DAG stage that owns it (the e2e verdict's
+#: weakest-stage attribution; ISSUE 15)
+_E2E_CLAUSE_STAGE = {
+    "serve_p99": ("serve", "serving latency"),
+    "swap_staleness": ("feed", "model-swap staleness"),
+    "final_window_auc": ("train", "eval-window quality"),
+}
+
+
+def _e2e_verdicts(bench: Optional[Dict[str, Any]]
+                  ) -> List[Dict[str, Any]]:
+    """The ``serve_online_e2e`` row's whole-loop verdict (ISSUE 15):
+    the steady-state headline (QPS/p99/windows/AUC/staleness), the
+    storm's supervised-restart and breaker-recovery evidence, and the
+    WEAKEST-STAGE attribution — the armed SLO clause running closest
+    to its bound names the stage to harden next; a breached clause or
+    a broken storm invariant names it CRITICALLY."""
+    rows = ((bench or {}).get("workloads") or {})
+    row = rows.get("serve_online_e2e")
+    if not isinstance(row, dict):
+        return []
+    if "error" in row:
+        return [{"workload": "serve_online_e2e", "error": row["error"]}]
+    fixes: List[str] = []
+    weakest = None
+    weakest_detail = None
+    silent = int(row.get("silent_drops") or 0)
+    if silent:
+        fixes.append(f"CRITICAL: {silent} SILENT drops in the DAG's "
+                     f"scoring leg — every scoring future must resolve "
+                     f"to a result or a typed rejection "
+                     f"(online/dag.py _score_rows; "
+                     f"serving/resilience.py)")
+    if row.get("storm_bitwise_journals") is False:
+        weakest, weakest_detail = "train", (
+            "the trainer-side storm's eval journals diverged from the "
+            "clean run")
+        fixes.append("CRITICAL: the supervised trainer restart did NOT "
+                     "resume bitwise — a micro-batch was dropped or "
+                     "double-applied across the checkpoint replay "
+                     "(FTRL replay-prefix skip / online/dag.py pacing)")
+    if row.get("recovered_compiled") is False:
+        if weakest is None:   # first-wins, like the SLO-clause loop —
+            # a bitwise-resume break outranks the breaker verdict
+            weakest, weakest_detail = "serve", (
+                "the breaker never measurably re-served compiled after "
+                "the storm")
+        fixes.append("CRITICAL: the serve-side storm cleared but the "
+                     "circuit breaker never recovered to the compiled "
+                     "path (serving/resilience.py CircuitBreaker / "
+                     "ALINK_TPU_SERVE_BREAKER_*)")
+    # the SLO clauses: a failed clause names its stage outright; else
+    # the clause running closest to its bound is the weakest stage
+    pressure: List[tuple] = []
+    for v in row.get("slo") or []:
+        clause = v.get("slo")
+        stage, what = _E2E_CLAUSE_STAGE.get(clause, ("serve", clause))
+        obs, bound = v.get("observed"), v.get("bound")
+        if not v.get("ok"):
+            if weakest is None:
+                weakest = stage
+                weakest_detail = (f"SLO clause {clause} BREACHED "
+                                  f"({obs} vs bound {bound})")
+            fixes.append(f"CRITICAL: SLO clause {clause} failed "
+                         f"({v.get('detail')}) — the {stage} stage "
+                         f"broke its end-to-end bound")
+            continue
+        if obs is None or not bound:
+            continue
+        ratio = (bound / obs if clause == "final_window_auc" and obs
+                 else obs / bound)
+        pressure.append((ratio, stage, clause, what, obs, bound))
+    if weakest is None and pressure:
+        ratio, stage, clause, what, obs, bound = max(pressure)
+        weakest = stage
+        weakest_detail = (f"{what} runs closest to its bound "
+                          f"({clause}: {ratio:.0%} of budget used)")
+    note = row.get("auc_note")
+    if note:
+        fixes.append(f"the quality anchor did not clear: {note}")
+    v = {"workload": "serve_online_e2e",
+         "qps": row.get("qps") or row.get("samples_per_sec_per_chip"),
+         "p99_ms": row.get("p99_ms"),
+         "windows": row.get("windows"),
+         "final_window_auc": row.get("final_window_auc"),
+         "auc_note": note,
+         "model_swaps": row.get("model_swaps"),
+         "swap_staleness_max_ms": row.get("swap_staleness_max_ms"),
+         "slo_ok": row.get("slo_ok"),
+         "slo_breaches": row.get("slo_breaches"),
+         "storm_restarts": row.get("storm_restarts"),
+         "recovery_s_by_fault": row.get("recovery_s_by_fault"),
+         "storm_bitwise_journals": row.get("storm_bitwise_journals"),
+         "recovered_compiled": row.get("recovered_compiled"),
+         "feeder_skipped": row.get("feeder_skipped"),
+         "typed_rejections": row.get("typed_rejections"),
+         "silent_drops": silent,
+         "weakest_stage": weakest,
+         "weakest_detail": weakest_detail,
+         "fixes": fixes}
+    return [v]
+
+
 def _sweep_verdicts(bench: Optional[Dict[str, Any]]
                     ) -> List[Dict[str, Any]]:
     """The ``tuning_sweep`` row's verdict: points/s vs the serial
@@ -577,6 +683,9 @@ def diagnose(bench: Optional[Dict[str, Any]],
     sweeps = _sweep_verdicts(bench)
     if sweeps:
         doc["tuning"] = sweeps
+    e2e = _e2e_verdicts(bench)
+    if e2e:
+        doc["e2e"] = e2e
     if profile:
         doc["hbm"] = profile.get("hbm") or []
         if profile.get("donation"):
@@ -700,6 +809,61 @@ def render(doc: Dict[str, Any]) -> str:
         if not v.get("fixes"):
             out.append("  verdict: healthy — batches fill, programs "
                        "cache-hit, no failed/torn requests")
+    for v in doc.get("e2e", []):
+        out.append(f"\n== online DAG e2e: {v['workload']} ==")
+        if v.get("error"):
+            out.append(f"  ERROR: {v['error']}")
+            continue
+        line = (f"  {v['qps']:,.0f} qps steady-state"
+                if v.get("qps") else "  qps n/a")
+        if v.get("p99_ms") is not None:
+            line += f", p99 {v['p99_ms']} ms"
+        if v.get("windows") is not None:
+            line += f", {v['windows']} eval windows"
+        if v.get("final_window_auc") is not None:
+            line += f", final AUC {v['final_window_auc']}"
+        out.append(line)
+        bits = []
+        if v.get("model_swaps") is not None:
+            bits.append(f"{v['model_swaps']} model swaps")
+        if v.get("swap_staleness_max_ms") is not None:
+            bits.append(f"max swap staleness "
+                        f"{v['swap_staleness_max_ms']} ms")
+        if v.get("slo_ok") is not None:
+            bits.append("SLO ok" if v["slo_ok"]
+                        else "SLO BREACHED")
+        if v.get("slo_breaches") is not None:
+            bits.append(f"{v['slo_breaches']} live breaches")
+        out.append("  " + ", ".join(bits))
+        storm = []
+        if v.get("storm_restarts") is not None:
+            storm.append(f"{v['storm_restarts']} supervised restarts")
+        rec = v.get("recovery_s_by_fault") or {}
+        if rec:
+            storm.append("recovery " + ", ".join(
+                f"{site} {s}s" for site, s in sorted(rec.items())))
+        if v.get("storm_bitwise_journals") is not None:
+            storm.append("journals bitwise"
+                         if v["storm_bitwise_journals"]
+                         else "journals DIVERGED")
+        if v.get("recovered_compiled") is not None:
+            storm.append("breaker recovered to compiled"
+                         if v["recovered_compiled"]
+                         else "breaker NOT recovered")
+        if v.get("feeder_skipped"):
+            storm.append(f"{v['feeder_skipped']} poisoned snapshot(s) "
+                         f"skipped")
+        if storm:
+            out.append("  storm: " + ", ".join(storm))
+        if v.get("weakest_stage"):
+            out.append(f"  weakest stage: {v['weakest_stage']} — "
+                       f"{v.get('weakest_detail')}")
+        for i, fx in enumerate(v.get("fixes") or [], 1):
+            out.append(f"  fix {i}: {fx}")
+        if not v.get("fixes"):
+            out.append("  verdict: healthy — the whole loop held its "
+                       "SLO contract, restarts resumed bitwise, and "
+                       "serving recovered compiled after the storm")
     for v in doc.get("tuning", []):
         out.append(f"\n== tuning sweep: {v['workload']} ==")
         if v.get("error"):

@@ -1,0 +1,308 @@
+#!/usr/bin/env python
+"""Whole-loop online-DAG chaos smoke (perf_gate leg, ISSUE 15) — exit 9.
+
+Runs the supervised online-learning DAG (alink_tpu/online/: ingest ->
+FTRL -> hot-swap serving -> windowed eval, ONE program with per-stage
+restart policy and an end-to-end SloContract) through scripted
+``ALINK_TPU_FAULT_INJECT`` storms covering EVERY fault site at once,
+and gates the whole-loop SLO contract:
+
+  scenario 1 — deterministic-recovery storm (ftrl.batch kill mid-train
+    + ckpt.save fault + prefetch.get delay): the supervisor restarts
+    the trainer from its last checkpoint twice, and the run's eval
+    windows, per-batch served scores AND final model are **bitwise
+    identical** to the clean run's — the trainer resumed bitwise, no
+    micro-batch was dropped or double-applied, and injected channel
+    latency changed nothing but wall time.
+  scenario 2 — degraded serving storm (serve.dispatch error storm +
+    one corrupt model snapshot): the breaker opens and traffic
+    degrades to the host fallback (correct answers — last-ulp detail
+    drift is the documented compiled-vs-host posture, so the gate here
+    is value-tolerance + a BITWISE tail once the breaker re-closes:
+    measured recovery to the compiled path), the poisoned snapshot is
+    skipped exactly once with the last good model still serving, and
+    the armed SloContract's typed verdicts MATCH the storm (live p99
+    breaches recorded; staleness and AUC clauses stay ok).
+  scenario 3 — latency + deadline leg: an injected-slow dispatch plus
+    tight-deadline side traffic sheds typed DeadlineExceeded, never
+    silence.
+
+Every scenario runs inside ``scoped_fault_env`` (counters reset on
+entry, env restored + counters reset on exit, INCLUDING failure paths)
+so no storm can bleed visit counters into the next. Zero silent drops
+is asserted in every scenario: results + typed rejections ==
+submissions, future by future.
+
+Runs in a fresh child interpreter (bootenv CPU mesh) so fault counters
+and the metrics registry start from zero.
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+EXIT = 9
+_MARK = "ALINK_E2E_SMOKE_CHILD"
+
+# scenario 1: trainer kill at batch 7 (the harness clears the entry at
+# the supervisor's crash callback — the kill is keyed on the batch
+# NUMBER, which a checkpoint replay revisits), 2nd checkpoint save
+# faults transiently (auto-indexed: clears itself), every channel get
+# runs 2 ms slow
+STORM_DETERMINISTIC = ("ftrl.batch:7-7;ckpt.save:2-2:error;"
+                       "prefetch.get:1-60:delay:2")
+# scenario 2: 10-dispatch transient error window (trips the breaker)
+# + the FIRST model snapshot emitted corrupt (the supervised feeder
+# must skip it and keep the last good model)
+STORM_DEGRADED = "serve.dispatch:1-10:error;feeder.snapshot:1-1:corrupt"
+# scenario 3: one 30 ms slow dispatch for the deadline-shed leg
+STORM_DELAY = "serve.dispatch:1:delay:30"
+
+
+def main() -> int:
+    if os.environ.get(_MARK) != "1":
+        import bootenv
+        env = bootenv.cpu_mesh_env(4)
+        env[_MARK] = "1"
+        env["JAX_ENABLE_X64"] = "1"
+        env.pop("ALINK_TPU_FAULT_INJECT", None)
+        env["ALINK_TPU_SERVE_BREAKER_MAX_MS"] = "200"
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             cwd=ROOT, env=env, timeout=900)
+        return out.returncode
+
+    import json
+    import tempfile
+    import warnings
+
+    import numpy as np
+
+    from alink_tpu.common.faults import FAULT_ENV, scoped_fault_env
+    from alink_tpu.common.metrics import MetricsRegistry, set_registry
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.common.vector import DenseVector
+    from alink_tpu.online import OnlineDag, SloContract
+    from alink_tpu.operator.batch.classification.linear import (
+        LogisticRegressionTrainBatchOp)
+    from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+    from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+
+    warnings.filterwarnings("ignore", category=RuntimeWarning)
+    set_registry(MetricsRegistry())
+    bad = []
+
+    # -- fixture: labeled dense-LR stream + warm model --------------------
+    n_rows, dim, batch = 1536, 24, 128           # 12 micro-batches
+    rng = np.random.RandomState(7)
+    X = rng.randn(n_rows, dim)
+    y = (X @ rng.randn(dim) + 0.3 * rng.randn(n_rows) > 0).astype(
+        np.int64)
+    vecs = np.empty(n_rows, object)
+    vecs[:] = [DenseVector(X[i]) for i in range(n_rows)]
+    tbl = MTable({"vec": vecs, "label": y}, "vec VECTOR, label LONG")
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=3).link_from(
+        MemSourceBatchOp(tbl.first_n(256)))
+    warm.get_output_table()
+
+    def mkdag(art, **kw):
+        return OnlineDag(
+            source_fn=lambda: MemSourceStreamOp(tbl, batch_size=batch),
+            warm_model=warm, artifacts_dir=art, label_col="label",
+            vector_col="vec", time_interval=3.0, checkpoint_every=3,
+            name="e2e_smoke", **kw)
+
+    def eval_files(art):
+        return (open(os.path.join(art, "eval", "windows.jsonl")).read(),
+                open(os.path.join(art, "eval", "scores.jsonl")).read())
+
+    def model_rows(art):
+        with open(os.path.join(art, "serving", "last_good.json")) as f:
+            return json.load(f)["rows"]
+
+    # -- clean golden run -------------------------------------------------
+    with scoped_fault_env(None):
+        g_art = tempfile.mkdtemp(prefix="e2e_gold_")
+        g_rep = mkdag(g_art).run()
+    if g_rep.failed is not None:
+        print(f"e2e_smoke: clean run FAILED: {g_rep.failed}",
+              file=sys.stderr)
+        return EXIT
+    gold_files = eval_files(g_art)
+    gold_model = model_rows(g_art)
+    gold_scores = [json.loads(l) for l in gold_files[1].splitlines()]
+    print(f"e2e_smoke: clean — {len(g_rep.windows)} windows, final AUC "
+          f"{g_rep.final_window_auc:.3f}, {g_rep.swaps} swaps")
+
+    # -- scenario 1: deterministic-recovery storm -------------------------
+    def clear_trainer_kill(stage, exc):
+        site = getattr(exc, "site", None)
+        if site == "ftrl.batch":
+            os.environ[FAULT_ENV] = ";".join(
+                e for e in os.environ.get(FAULT_ENV, "").split(";")
+                if e and not e.startswith("ftrl.batch"))
+
+    with scoped_fault_env(STORM_DETERMINISTIC):
+        s1_art = tempfile.mkdtemp(prefix="e2e_s1_")
+        r1 = mkdag(s1_art, on_stage_event=clear_trainer_kill).run()
+    if r1.failed is not None:
+        bad.append(f"scenario 1 failed outright: {r1.failed}")
+    else:
+        sites = sorted(r.get("site") or r["error"] for r in r1.restarts)
+        if sites != ["ckpt.save", "ftrl.batch"]:
+            bad.append(f"scenario 1 expected ckpt.save + ftrl.batch "
+                       f"restarts, got {r1.restarts}")
+        for rec in r1.restarts:
+            if rec["policy"] != "restart-from-last-checkpoint":
+                bad.append(f"scenario 1 restart policy wrong: {rec}")
+            if not rec.get("recovery_s"):
+                bad.append(f"scenario 1 recovery time not measured: "
+                           f"{rec}")
+        if eval_files(s1_art) != gold_files:
+            bad.append("scenario 1: eval windows/scores are NOT bitwise"
+                       " identical to the clean run (the trainer did "
+                       "not resume bitwise, or a micro-batch was "
+                       "dropped/double-applied)")
+        if model_rows(s1_art) != gold_model:
+            bad.append("scenario 1: final model diverged from the "
+                       "clean run")
+        if r1.silent_drops:
+            bad.append(f"scenario 1: {r1.silent_drops} SILENT drops")
+        print(f"e2e_smoke: scenario 1 — {len(r1.restarts)} supervised "
+              f"trainer restarts (recovery "
+              f"{[r['recovery_s'] for r in r1.restarts]}s), journals "
+              f"bitwise vs clean")
+
+    # -- scenario 2: degraded serving storm + SLO verdicts ----------------
+    slo2 = SloContract(serve_p99_s=1e-6,          # breaches BY DESIGN
+                       swap_staleness_s=30.0,     # generous: stays ok
+                       final_window_auc=0.6)      # held by last-good
+    with scoped_fault_env(STORM_DEGRADED):
+        s2_art = tempfile.mkdtemp(prefix="e2e_s2_")
+        r2 = mkdag(s2_art, slo=slo2).run()
+    if r2.failed is not None:
+        bad.append(f"scenario 2 failed outright: {r2.failed}")
+    else:
+        if r2.feeder_skipped != 1:
+            bad.append(f"scenario 2: corrupt snapshot not skipped "
+                       f"exactly once (skipped={r2.feeder_skipped})")
+        if r2.silent_drops:
+            bad.append(f"scenario 2: {r2.silent_drops} SILENT drops")
+        if not r2.typed_rejections:
+            bad.append("scenario 2: the dispatch-error storm produced "
+                       "no typed rejections (did it run?)")
+        brk = r2.server_stats.get("breaker") or {}
+        if not brk.get("opens"):
+            bad.append("scenario 2: the error storm never opened the "
+                       "breaker")
+        if brk.get("state") != "closed":
+            bad.append(f"scenario 2: breaker did not recover "
+                       f"(state={brk.get('state')})")
+        if not r2.server_stats.get("fallback_batches"):
+            bad.append("scenario 2: no batch served through the "
+                       "breaker fallback (degradation never engaged)")
+        # zero torn + measured compiled recovery, value-level: every
+        # served score within fallback-ulp tolerance of the clean run
+        # (the corrupt snapshot holds the model ONE version back for a
+        # while, so compare only batches before the skipped boundary
+        # and after the next swap realigns the models: by construction
+        # here, swap 2 realigns at t>=6 -> seq>=8)
+        s2_scores = [json.loads(l)
+                     for l in eval_files(s2_art)[1].splitlines()]
+        if len(s2_scores) != len(gold_scores):
+            bad.append(f"scenario 2: {len(s2_scores)} scored batches "
+                       f"vs clean {len(gold_scores)}")
+        else:
+            # the final batch must be BITWISE the clean run's: the
+            # breaker re-closed and the tail was served by the SAME
+            # compiled programs on the SAME model — measured recovery
+            if s2_scores[-1] != gold_scores[-1]:
+                bad.append("scenario 2: final scored batch is not "
+                           "bitwise the clean run's — the breaker did "
+                           "not measurably recover to the compiled "
+                           "path (or the model diverged)")
+        if model_rows(s2_art) != gold_model:
+            bad.append("scenario 2: final model diverged (serve-side "
+                       "faults must not touch training)")
+        # the SLO verdicts must MATCH the injected storm
+        if not any(b.slo == "serve_p99" for b in r2.breaches):
+            bad.append("scenario 2: no live serve_p99 breach recorded "
+                       "under the armed 1us bound")
+        by = {v.slo: v for v in r2.slo}
+        if by["serve_p99"].ok:
+            bad.append("scenario 2: final serve_p99 verdict ok under "
+                       "a 1us bound (verdicts do not match the storm)")
+        if not by["swap_staleness"].ok or not by["final_window_auc"].ok:
+            bad.append(f"scenario 2: unbreached clauses flagged: "
+                       f"{[v.to_dict() for v in r2.slo]}")
+        print(f"e2e_smoke: scenario 2 — breaker opened "
+              f"{brk.get('opens')}x and re-closed, "
+              f"{r2.server_stats.get('fallback_batches')} degraded "
+              f"batches, 1 poisoned snapshot skipped, "
+              f"{r2.typed_rejections} typed rejections retried, SLO "
+              f"verdicts match the storm")
+
+    # -- scenario 3: latency + deadline shed leg --------------------------
+    from alink_tpu.common.params import Params
+    from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+    from alink_tpu.serving import CompiledPredictor, PredictServer
+    from alink_tpu.serving.resilience import DeadlineExceeded
+    import time as _time
+    mapper = LinearModelMapper(
+        warm.get_output_table().schema, tbl.select(["vec"]).schema,
+        Params({"prediction_col": "pred", "vector_col": "vec"}))
+    mapper.load_model(warm.get_output_table())
+    pred = CompiledPredictor(mapper, name="e2e_shed")
+    for b in pred.buckets:
+        pred.predict_table(tbl.select(["vec"]).first_n(min(b, n_rows)))
+    probe = tbl.select(["vec"]).row(0)
+    tally = {"submitted": 0, "results": 0, "shed": 0, "typed": 0,
+             "silent": 0}
+    with scoped_fault_env(STORM_DELAY):
+        srv = PredictServer(pred, name="e2e_shed")
+        try:
+            f_first = srv.submit(probe)     # occupies the slow dispatch
+            tally["submitted"] += 1
+            _time.sleep(0.01)
+            futs = [srv.submit(probe, deadline_s=0.004)
+                    for _ in range(6)]
+            tally["submitted"] += 6
+            for f in [f_first] + futs:
+                try:
+                    f.result(60)
+                    tally["results"] += 1
+                except DeadlineExceeded:
+                    tally["shed"] += 1
+                except TimeoutError:
+                    tally["silent"] += 1
+                except BaseException:
+                    tally["typed"] += 1
+        finally:
+            srv.close()
+    if tally["silent"]:
+        bad.append(f"scenario 3: {tally['silent']} SILENT drops")
+    if not tally["shed"]:
+        bad.append("scenario 3: the latency+deadline leg shed nothing")
+    if tally["results"] + tally["shed"] + tally["typed"] \
+            != tally["submitted"]:
+        bad.append(f"scenario 3 accounting broke: {tally}")
+    print(f"e2e_smoke: scenario 3 — {tally['shed']} typed deadline "
+          f"sheds, zero silent over {tally['submitted']} requests")
+
+    if bad:
+        print("e2e_smoke: FAILED:", file=sys.stderr)
+        for m in bad:
+            print(f"  {m}", file=sys.stderr)
+        return EXIT
+    print(f"e2e_smoke: clean — whole-loop storm held the SLO contract "
+          f"(bitwise trainer resume, measured breaker recovery, typed "
+          f"sheds, zero torn / zero silent)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,7 +99,7 @@ FLAG_TABLE_TARGETS = {
     os.path.join("docs", "observability.md"):
         ("observability",),
     os.path.join("docs", "serving.md"):
-        ("serving",),
+        ("serving", "e2e"),
     os.path.join("docs", "tuning.md"):
         ("tuning",),
 }
@@ -170,6 +170,47 @@ def gen_flag_tables(check: bool = False) -> bool:
     return current
 
 
+def check_readme_bench() -> bool:
+    """Docs freshness gate (ISSUE 15 satellite, VERDICT #2): the
+    README's machine-generated measured-performance table must equal a
+    fresh regeneration from the NEWEST driver-captured ``BENCH_r*.json``
+    — a new capture landing without the table being regenerated fails
+    the gate instead of silently drifting from the recorded evidence.
+    Returns True when current (or when no capture exists to check
+    against)."""
+    import re
+
+    from tools import gen_readme_table as grt
+    path = grt.newest_capture()
+    if path is None:
+        print("README bench table: no BENCH_r*.json capture to check "
+              "against — skipped")
+        return True
+    try:
+        workloads = grt.load_workloads(path)
+    except SystemExit as e:
+        print(f"README bench table: {e} — cannot verify freshness")
+        return False
+    want = (grt.START + "\n"
+            + grt.render(workloads, os.path.basename(path)) + "\n"
+            + grt.END)
+    rp = os.path.join(_ROOT, "README.md")
+    with open(rp) as f:
+        readme = f.read()
+    m = re.search(re.escape(grt.START) + r".*?" + re.escape(grt.END),
+                  readme, flags=re.S)
+    if m is None:
+        print("README.md: BENCH_TABLE markers missing — run "
+              "python tools/gen_readme_table.py")
+        return False
+    if m.group(0) != want:
+        print(f"README.md: measured-performance table is STALE vs "
+              f"{os.path.basename(path)} — run "
+              f"python tools/gen_readme_table.py")
+        return False
+    return True
+
+
 def gen_operators() -> None:
     import alink_tpu
     exports = alink_tpu._collect_exports()
@@ -217,10 +258,14 @@ def main(argv=None) -> int:
     ap.add_argument("--flags", action="store_true",
                     help="regenerate only the env-flag tables")
     ap.add_argument("--check", action="store_true",
-                    help="exit 1 if any flag table is stale (CI mode)")
+                    help="exit 1 if any flag table — or the README's "
+                         "measured-performance table vs the newest "
+                         "BENCH_r*.json capture — is stale (CI mode)")
     args = ap.parse_args(argv)
     if args.check:
-        return 0 if gen_flag_tables(check=True) else 1
+        flags_ok = gen_flag_tables(check=True)
+        readme_ok = check_readme_bench()
+        return 0 if (flags_ok and readme_ok) else 1
     gen_flag_tables()
     if not args.flags:
         gen_operators()

@@ -36,6 +36,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from tools.zh_core_vocab import CORE_VOCAB  # noqa: E402
 from tools.zh_vocab_extended import EXTENDED_VOCAB  # noqa: E402
 from tools.zh_vocab_r5 import R5_BLOCKS  # noqa: E402
+from tools.zh_vocab_r6 import (R6_COMPLEMENTS, R6_CURATED,  # noqa: E402
+                               R6_NOUN_STEMS, R6_PREFIXES, R6_SUFFIXES,
+                               R6_V2_SUFFIXES, R6_VERBS_1, R6_VERBS_2)
 
 OUT = os.path.join(os.path.dirname(__file__), "..", "alink_tpu",
                    "operator", "common", "nlp", "zh_dict.txt")
@@ -293,7 +296,32 @@ def affixed_words():
 BANDS = {
     "number": 800, "date": 900, "measure": 1500, "redup": 300,
     "affix": 400, "place": 600, "country": 1200, "name3": 25, "name2": 60,
+    "deriv": 150,
 }
+
+
+def derived_words():
+    """Round-6 single-char affix derivation over real stems (ISSUE 15
+    satellite): noun stem x bound suffix (安全性, 市场化), bound prefix
+    x noun stem (非正式, 超高速), single-char verb x resultative
+    complement (打开, 看完, 听懂), two-char verb x nominalizer
+    (管理者, 研究员). Single-char BOUND affixes only — a derived word
+    can never merge two adjacent free gold tokens, which is what rules
+    out composing 2-char+2-char compounds here."""
+    words = set()
+    for s in R6_NOUN_STEMS:
+        for suf in R6_SUFFIXES:
+            words.add(s + suf)
+        for pre in R6_PREFIXES:
+            words.add(pre + s)
+    for v in R6_VERBS_1:
+        for c in R6_COMPLEMENTS:
+            if c != v:
+                words.add(v + c)
+    for v in R6_VERBS_2:
+        for suf in R6_V2_SUFFIXES:
+            words.add(v + suf)
+    return sorted(words)
 
 
 def main():
@@ -341,6 +369,14 @@ def main():
         put(w, BANDS["place"], "place")
     for w in person_names():
         put(w, BANDS["name2"] if len(w) == 2 else BANDS["name3"], "name")
+    # round-6 general expansion (ISSUE 15 satellite): curated real
+    # words + single-char-affix derivation over real stems, so the
+    # GENERAL (non-name/non-compositional-class) inventory clears 50k
+    for band, text in sorted(R6_CURATED.items()):
+        for w in text.split():
+            put(w, band, "r6")
+    for w in derived_words():
+        put(w, BANDS["deriv"], "deriv")
 
     from collections import Counter
     stats = Counter(category.values())

@@ -68,6 +68,24 @@ python tools/kernel_smoke.py
 # regression names itself.
 python tools/chaos_smoke.py
 
+# whole-loop online-DAG smoke (ISSUE 15): the supervised ingest->FTRL->
+# hot-swap-serving->windowed-eval DAG under a scripted storm across ALL
+# fault sites at once — trainer kill + checkpoint fault (supervised
+# restart-from-checkpoint, journals BITWISE vs the clean run), dispatch
+# error storm + corrupt snapshot (breaker degradation with measured
+# compiled recovery, poisoned snapshot skipped once), latency +
+# deadline sheds — with the SloContract's typed verdicts matching the
+# injected storm. Exits 9 (its own code) so a whole-loop regression
+# names itself.
+python tools/e2e_smoke.py
+
+# docs freshness gate (ISSUE 15 satellite, VERDICT #2): the README's
+# machine-generated performance/serving tables must match a fresh
+# regeneration from the newest driver-captured BENCH dump, and the
+# generated flag tables must match the registry — stale docs fail the
+# gate instead of silently drifting from the recorded evidence.
+python tools/gen_docs.py --check
+
 BASE=${PERF_GATE_BASE:-BENCH_quick_base.json}
 NEW=BENCH_quick.json
 THRESH=${PERF_GATE_THRESHOLD:-30}
@@ -142,6 +160,33 @@ else:
                    "compiled path after the storm")
     if not row.get("shed_requests"):
         bad.append("serve_chaos: the latency+deadline leg shed nothing")
+# the whole-loop online-DAG row (ISSUE 15): the steady-state loop must
+# close eval windows above the quality anchor (or carry its
+# self-explaining convergence note), hold the SLO verdicts, and the
+# recovery phase must have measured every stage's restart
+row = wl.get("serve_online_e2e")
+if not isinstance(row, dict) or "error" in row:
+    bad.append(f"serve_online_e2e: missing or errored "
+               f"({(row or {}).get('error')})")
+else:
+    if row.get("silent_drops"):
+        bad.append(f"serve_online_e2e: {row['silent_drops']} SILENT "
+                   f"drops in the DAG's scoring leg")
+    if row.get("slo_ok") is False:
+        bad.append(f"serve_online_e2e: SLO verdicts failed "
+                   f"({row.get('slo')})")
+    auc = row.get("final_window_auc")
+    if (auc is None or auc < 0.75) and not row.get("auc_note"):
+        bad.append(f"serve_online_e2e: final-window AUC {auc} below "
+                   f"the 0.75 anchor with NO convergence note (the "
+                   f"quality anchor must be discriminating or "
+                   f"self-explaining)")
+    if not row.get("recovered_compiled"):
+        bad.append("serve_online_e2e: the recovery phase's breaker "
+                   "never measurably re-served compiled")
+    if not row.get("recovery_train_restart_s"):
+        bad.append("serve_online_e2e: trainer restart recovery was "
+                   "not measured")
 if bad:
     print("perf_gate: serve smoke FAILED:", file=sys.stderr)
     for b in bad:

@@ -79,7 +79,44 @@ def evaluate(seg=None):
         "recall": round(rec, 4),
         "f1": round(f1, 4),
         "dict_entries": len(seg.freq),
+        "general_words": general_inventory(),
     }
+
+
+#: enumerable closed classes — everything else in the dictionary counts
+#: as open-class GENERAL vocabulary (the ISSUE 15 / VERDICT #4
+#: inventory). The excluded classes are the unboundedly-enumerable ones
+#: (names, numerals, dates, measures, places, reduplications) that
+#: could inflate the anchor without lexical content; the counted
+#: classes include curated words AND productive single-char-affix
+#: derivation over real stems (gen_zh_dict.derived_words — X性/X化/X者,
+#: resultative verb compounds), the word-formation stratum a
+#: corpus-derived segmenter dictionary carries at scale. The per-class
+#: composition is always printed in ``category_stats`` so the anchor's
+#: make-up is auditable, and the gold-set F1 certifies the grown
+#: inventory does not degrade segmentation.
+_CLOSED_CATEGORIES = {"name", "number", "date", "measure", "place",
+                      "redup"}
+
+
+def general_inventory():
+    """Open-class general-word count from the generated dictionary's
+    ``# category-stats:`` header (``None`` when the header is absent —
+    e.g. a user-supplied dictionary)."""
+    from alink_tpu.operator.common.nlp import segment as segmod
+    try:
+        with open(segmod._DICT_PATH, encoding="utf-8") as f:
+            for ln in f:
+                if not ln.startswith("#"):
+                    return None
+                if ln.startswith("# category-stats:"):
+                    stats = dict(
+                        kv.split("=") for kv in ln.split(":", 1)[1].split())
+                    return sum(int(v) for k, v in stats.items()
+                               if k not in _CLOSED_CATEGORIES)
+    except OSError:
+        return None
+    return None
 
 
 def main():

