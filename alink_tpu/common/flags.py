@@ -335,6 +335,35 @@ FLAGS.register(
     clamp=lambda n: max(1, n), tolerant=True,
     accessor="alink_tpu.common.tracing._buffer_capacity")
 FLAGS.register(
+    "ALINK_TPU_ADMIN_PORT", "int", 0,
+    "live operations plane (common/adminz.py): serve /metrics /healthz "
+    "/readyz /statusz /tracez /varz from an in-process HTTP endpoint on "
+    "this port (0 = off, -1 = ephemeral OS-assigned port — tests and "
+    "smokes discover it via adminz.get_admin().port)", "observability",
+    key_neutral="binds a host-side stdlib HTTP server that only READS "
+                "the live registry/tracer/flag state; never consulted "
+                "at trace time — lowered HLO and program-cache keys "
+                "byte-identical on/off (tests/test_adminz.py)",
+    clamp=lambda n: max(-1, n), tolerant=True,
+    accessor="alink_tpu.common.adminz.admin_port")
+FLAGS.register(
+    "ALINK_TPU_ADMIN_HOST", "str", "127.0.0.1",
+    "bind address of the admin endpoint (loopback by default; set "
+    "0.0.0.0 only on trusted networks — the plane has no auth)",
+    "observability",
+    key_neutral="host-side socket bind address for the admin server; "
+                "never read inside a traced program",
+    accessor="alink_tpu.common.adminz.admin_host")
+FLAGS.register(
+    "ALINK_TPU_ADMIN_TRACEZ", "int", 512,
+    "max flight-recorder events one /tracez response returns (the "
+    "ring itself is sized by ALINK_TPU_TRACE_BUFFER; ?n= lowers "
+    "per-request)", "observability",
+    key_neutral="bounds a host-side HTTP response body; the tracer "
+                "ring and traced programs never see it",
+    clamp=lambda n: max(1, n), tolerant=True,
+    accessor="alink_tpu.common.adminz.admin_tracez_events")
+FLAGS.register(
     "ALINK_TPU_PROFILE", "bool", False,
     "measured device profiling: capture windows, timing-harness "
     "attribution, live-HBM accounting (common/profiling2.py)",
@@ -668,6 +697,26 @@ FLAGS.register(
                 "machinery it feeds is itself key-neutral",
     clamp=lambda v: max(0.0, v),
     accessor="alink_tpu.online.slo.e2e_deadline_s")
+FLAGS.register(
+    "ALINK_TPU_E2E_BURN_FAST_S", "float", 300.0,
+    "SLO burn-rate monitor: FAST window length in seconds (the paging "
+    "window — mean clause burn over it >= 1.0 marks a CRITICAL burn "
+    "and flips /readyz to 503 while active)", "e2e",
+    key_neutral="host-side window length for burn-rate evaluation "
+                "over already-measured SLO observations; never "
+                "trace-shaping",
+    clamp=lambda v: max(1.0, v), tolerant=True,
+    accessor="alink_tpu.online.slo.burn_fast_s")
+FLAGS.register(
+    "ALINK_TPU_E2E_BURN_SLOW_S", "float", 3600.0,
+    "SLO burn-rate monitor: SLOW window length in seconds (the "
+    "sustained-burn window — budget-fraction burn over it >= 1.0 "
+    "means the whole window's error budget is spent)", "e2e",
+    key_neutral="host-side window length for burn-rate evaluation "
+                "over already-measured SLO observations; never "
+                "trace-shaping",
+    clamp=lambda v: max(1.0, v), tolerant=True,
+    accessor="alink_tpu.online.slo.burn_slow_s")
 FLAGS.register(
     "ALINK_TPU_E2E_MAX_RESTARTS", "int", 3,
     "per-stage restart budget of the online DAG's supervisors "

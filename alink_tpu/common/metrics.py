@@ -311,37 +311,59 @@ class MetricsRegistry:
 
     @classmethod
     def load(cls, path: str) -> "MetricsRegistry":
-        """Rebuild a registry from a ``dump()`` JSONL file (round-trip)."""
+        """Rebuild a registry from a ``dump()`` JSONL file (round-trip).
+
+        Tolerates a TORN FINAL line (a process killed mid-``dump`` —
+        same crash class the PR-15 eval journals repair): the complete
+        prefix loads and a ``RuntimeWarning`` names the truncation.
+        An unparsable line anywhere BEFORE the end is real corruption
+        and still raises — silent mid-file skips would fabricate
+        report numbers."""
         reg = cls()
         with open(path) as f:
-            for ln in f:
-                ln = ln.strip()
-                if not ln:
-                    continue
+            lines = f.readlines()
+        while lines and not lines[-1].strip():
+            lines.pop()
+        for i, ln in enumerate(lines):
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
                 rec = json.loads(ln)
-                kind = rec.get("kind")
-                if kind == "meta":
-                    reg._created_unix = rec.get("created_unix",
-                                                reg._created_unix)
-                    reg._dropped_series = rec.get("dropped_series", 0)
-                    continue
-                if kind not in _KINDS:
-                    raise ValueError(f"{path}: unknown record kind {kind!r}")
-                labels = rec.get("labels") or None
-                if kind == "histogram":
-                    fam = reg.histogram(rec["name"], rec.get("help", ""),
-                                        buckets=rec["buckets"])
-                    with reg._lock:
-                        s = fam._get_series(labels)
-                        s.counts = list(rec["counts"])
-                        s.sum = float(rec["sum"])
-                        s.count = int(rec["count"])
-                elif kind == "counter":
-                    reg.counter(rec["name"], rec.get("help", "")) \
-                       .inc(float(rec["value"]), labels)
-                else:
-                    reg.gauge(rec["name"], rec.get("help", "")) \
-                       .set(float(rec["value"]), labels)
+            except ValueError:
+                if i == len(lines) - 1:
+                    warnings.warn(
+                        f"{path}: final metrics record is torn "
+                        f"(truncated dump, {len(ln)} bytes) — loaded "
+                        f"the {i} complete record(s) before it",
+                        RuntimeWarning, stacklevel=2)
+                    break
+                raise ValueError(
+                    f"{path}: unparsable metrics record at line "
+                    f"{i + 1} (mid-file corruption, not a torn tail)")
+            kind = rec.get("kind")
+            if kind == "meta":
+                reg._created_unix = rec.get("created_unix",
+                                            reg._created_unix)
+                reg._dropped_series = rec.get("dropped_series", 0)
+                continue
+            if kind not in _KINDS:
+                raise ValueError(f"{path}: unknown record kind {kind!r}")
+            labels = rec.get("labels") or None
+            if kind == "histogram":
+                fam = reg.histogram(rec["name"], rec.get("help", ""),
+                                    buckets=rec["buckets"])
+                with reg._lock:
+                    s = fam._get_series(labels)
+                    s.counts = list(rec["counts"])
+                    s.sum = float(rec["sum"])
+                    s.count = int(rec["count"])
+            elif kind == "counter":
+                reg.counter(rec["name"], rec.get("help", "")) \
+                   .inc(float(rec["value"]), labels)
+            else:
+                reg.gauge(rec["name"], rec.get("help", "")) \
+                   .set(float(rec["value"]), labels)
         return reg
 
     @staticmethod

@@ -12,8 +12,10 @@ guide.
 
 from .dag import (DagFailed, DagReport, OnlineDag, RESTART_POLICIES,
                   load_model_table, save_model_table)
-from .slo import SloContract, SloVerdict, SwapStalenessTracker
+from .slo import (SloBurnRate, SloContract, SloVerdict,
+                  SwapStalenessTracker)
 
 __all__ = ["DagFailed", "DagReport", "OnlineDag", "RESTART_POLICIES",
-           "SloContract", "SloVerdict", "SwapStalenessTracker",
-           "load_model_table", "save_model_table"]
+           "SloBurnRate", "SloContract", "SloVerdict",
+           "SwapStalenessTracker", "load_model_table",
+           "save_model_table"]

@@ -63,14 +63,15 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common.adminz import acquire_admin, release_admin
 from ..common.faults import FaultInjected, maybe_crash
 from ..common.flags import flag_value
 from ..common.metrics import get_registry, metrics_enabled
 from ..common.mtable import MTable
 from ..common.tracing import trace_instant
 from ..common.types import TableSchema
-from .slo import (SloContract, SloVerdict, SwapStalenessTracker,
-                  e2e_dag_enabled, e2e_deadline_s)
+from .slo import (SloBurnRate, SloContract, SloVerdict,
+                  SwapStalenessTracker, e2e_dag_enabled, e2e_deadline_s)
 
 __all__ = ["OnlineDag", "DagReport", "DagFailed", "RESTART_POLICIES",
            "e2e_max_restarts", "e2e_pacing"]
@@ -685,6 +686,12 @@ class OnlineDag:
         self._live_feeder = None
         self._warm_table = None
         self._pos_label: Optional[str] = None
+        # live operations plane (ISSUE 16): the DAG registers its
+        # readiness + status on the shared admin endpoint for run()'s
+        # duration; _swap_log is the /statusz "last N model swaps" ring
+        self._admin = None
+        self._burn: Optional[SloBurnRate] = None
+        self._swap_log: List[dict] = []
 
     # -- stage builders ----------------------------------------------------
     def _build_serving(self):
@@ -743,9 +750,13 @@ class OnlineDag:
         return op
 
     def _on_swap(self, version: int, model_table: MTable) -> None:
-        self._tracker.mark_installed(version)
+        staleness_s = self._tracker.mark_installed(version)
         self._versions.append((version, model_table))
         self.report.swaps += 1
+        self._swap_log.append({"version": int(version),
+                               "unix": time.time(),
+                               "staleness_s": staleness_s})
+        del self._swap_log[:-32]
         save_model_table(self.last_good_path, version, model_table)
 
     def _build_feeder(self, op):
@@ -854,9 +865,21 @@ class OnlineDag:
         t_run0 = time.perf_counter()
         self.report = DagReport()
         self._versions = []
+        self._swap_log = []
         self._pacer = _Pacer(self.pacing == "deterministic")
         self._tracker = SwapStalenessTracker(self.slo, self.name)
+        self._burn = SloBurnRate(self.slo, name=self.name)
         self._build_serving()
+        # live operations plane (ISSUE 16): while armed, this run is
+        # inspectable — /healthz|/readyz fold in the DAG's supervisor
+        # state and the burn monitor (a critical fast-window burn reads
+        # unready), /statusz shows swaps/clauses/restarts live
+        self._admin = acquire_admin(self.name)
+        if self._admin is not None:
+            self._admin.add_source(f"dag:{self.name}", self._readiness)
+            self._admin.add_source(f"slo:{self.name}",
+                                   self._burn.readiness)
+            self._admin.add_status(f"dag:{self.name}", self._statusz_doc)
         # positive label: the trainer's convention (label_values[0])
         self._pos_label = self._positive_label()
         eval_log = _EvalWindowLog(self.scores_path, self.eval_path,
@@ -926,6 +949,12 @@ class OnlineDag:
             stats = self.server.stats() if self.server else {}
             self.server.close()
             eval_log.close()
+            if self._admin is not None:
+                self._admin.remove_source(f"dag:{self.name}")
+                self._admin.remove_source(f"slo:{self.name}")
+                self._admin.remove_status(f"dag:{self.name}")
+                self._admin = None
+                release_admin()
         if self._pacer.aborted is not None and self.report.failed is None:
             self.report.failed = str(self._pacer.aborted)
         # -- the report --------------------------------------------------
@@ -952,9 +981,55 @@ class OnlineDag:
         data = LinearModelDataConverter.load_table(self._warm_table)
         return str(data.label_values[0])
 
+    # -- admin-plane sources (ISSUE 16) ------------------------------------
+    def _readiness(self) -> dict:
+        """ReadinessSource: the DAG is ready while no stage aborted;
+        stage restart counts and feeder liveness ride as detail."""
+        pacer = self._pacer
+        aborted = pacer.aborted if pacer is not None else None
+        restarts: Dict[str, int] = {}
+        for rec in self.report.restarts:
+            stage = rec.get("stage", "?")
+            restarts[stage] = restarts.get(stage, 0) + 1
+        doc = {"ready": aborted is None, "healthy": aborted is None,
+               "stage_restarts": restarts,
+               "committed_batches": (pacer.committed
+                                     if pacer is not None else 0),
+               "swaps": self.report.swaps}
+        feeder = self._live_feeder
+        if feeder is not None:
+            doc["feeder"] = {
+                "versions": getattr(feeder, "versions", None),
+                "skipped": getattr(feeder, "skipped", 0),
+                "retried": getattr(feeder, "retried", 0),
+            }
+        if aborted is not None:
+            doc["aborted"] = str(aborted)
+        return doc
+
+    def _statusz_doc(self) -> dict:
+        """/statusz section: swap history, staleness, live SLO clause +
+        burn states, program-cache sizes, restart log."""
+        doc: Dict[str, Any] = {
+            "swaps": list(self._swap_log),
+            "staleness": {
+                "max_s": self._tracker.max_s if self._tracker else None,
+                "mean_s": (self._tracker.mean_s
+                           if self._tracker else None),
+            },
+            "slo_clauses": self.slo.clause_states(),
+            "restarts": [dict(r) for r in self.report.restarts],
+        }
+        if self._burn is not None:
+            doc["burn"] = self._burn.state()
+        if self.predictor is not None:
+            doc["program_cache"] = self.predictor.cache_stats()
+        return doc
+
     def _on_window_closed(self, w: dict) -> None:
         stats = self.server.stats()
         self.slo.observe_p99(stats.get("p99_s"), w["w"])
+        self.slo.observe_auc(w["auc"], w["w"])
         if self.health is not None:
             # drift/health alerting over the eval trajectory (the
             # monitor's own rules decide; a raise_on watchdog abort

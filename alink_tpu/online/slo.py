@@ -13,26 +13,55 @@ ingest -> train -> hot-swap -> serve -> eval program must hold —
   window's AUC (the convergence anchor; VERDICT #7 wants this number
   discriminating, not chance-shaped).
 
-Breaches are TYPED (:class:`SloVerdict`), recorded live (metric
-``alink_e2e_slo_breaches_total{slo=}`` + an ``e2e.slo_breach`` trace
-instant) and collected on the :class:`~alink_tpu.online.dag.DagReport`;
-:meth:`SloContract.final` renders the end-of-run verdict list. A bound
-of ``None``/0 disarms its clause — the contract never invents bounds
-the operator did not set (``ALINK_TPU_E2E_DAG=1`` opts into the
-flag-derived defaults).
+Breaches are TYPED (:class:`SloVerdict`), recorded live (metrics
+``alink_e2e_slo_breaches_total{slo=}`` + ``alink_slo_breaches_total``
+and an ``e2e.slo_breach`` trace instant) and collected on the
+:class:`~alink_tpu.online.dag.DagReport`; :meth:`SloContract.final`
+renders the end-of-run verdict list. A bound of ``None``/0 disarms its
+clause — the contract never invents bounds the operator did not set
+(``ALINK_TPU_E2E_DAG=1`` opts into the flag-derived defaults).
+
+ISSUE 16 adds the *live* posture on top of the verdicts:
+
+* every ``observe_*`` call exports the clause state as gauges
+  (``alink_slo_observed`` / ``alink_slo_bound`` with ``{dag=,slo=}``),
+  so ``/metrics`` and ``tools/fleetz.py`` see SLO posture WITHOUT
+  parsing the verdict JSON;
+* :class:`SloBurnRate` — Google-SRE-style multi-window burn-rate
+  alerting over the same observations. Each observation contributes a
+  *burn* = observed/bound (bound/observed for the quality-floor
+  clause), i.e. the rate at which the clause's error budget is being
+  spent (1.0 = exactly at the bound). Two windows per clause:
+
+  - **fast** (``ALINK_TPU_E2E_BURN_FAST_S``, 5 min): the *paging*
+    window — the mean burn of the samples inside it. Crosses the
+    threshold within one bad window; this is what flips ``/readyz``
+    to 503 (a CRITICAL burn) and fires the alert.
+  - **slow** (``ALINK_TPU_E2E_BURN_SLOW_S``, 1 h): the *sustained*
+    window — the time-integrated budget fraction
+    ``sum(burn_i * dt_i) / slow_s`` (``dt`` capped at the fast
+    window, so sparse samples cannot claim hours of burn). A short
+    burst barely moves it; only a sustained burn crosses it.
+
+  Transitions emit ``alink_slo_alerts_total{slo=,window=}``, the live
+  ``alink_slo_burn_rate{slo=,window=}`` gauges, and typed
+  ``health.alert`` tracer instants — degradation is visible while the
+  run is still going, not in the post-mortem verdict list.
 """
 
 from __future__ import annotations
 
+import threading
 import time
-from typing import List, NamedTuple, Optional
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from ..common.flags import flag_value
 from ..common.metrics import get_registry, metrics_enabled
 from ..common.tracing import trace_instant
 
-__all__ = ["SloContract", "SloVerdict", "e2e_dag_enabled", "slo_p99_s",
-           "slo_staleness_s", "slo_auc_floor", "e2e_deadline_s"]
+__all__ = ["SloContract", "SloVerdict", "SloBurnRate", "e2e_dag_enabled",
+           "slo_p99_s", "slo_staleness_s", "slo_auc_floor",
+           "e2e_deadline_s", "burn_fast_s", "burn_slow_s"]
 
 
 def e2e_dag_enabled() -> bool:
@@ -62,6 +91,16 @@ def e2e_deadline_s() -> Optional[float]:
     """``ALINK_TPU_E2E_DEADLINE_MS`` in seconds (None = no deadline)."""
     ms = float(flag_value("ALINK_TPU_E2E_DEADLINE_MS"))
     return ms / 1e3 if ms > 0 else None
+
+
+def burn_fast_s() -> float:
+    """``ALINK_TPU_E2E_BURN_FAST_S``: fast (paging) window length."""
+    return float(flag_value("ALINK_TPU_E2E_BURN_FAST_S"))
+
+
+def burn_slow_s() -> float:
+    """``ALINK_TPU_E2E_BURN_SLOW_S``: slow (sustained) window length."""
+    return float(flag_value("ALINK_TPU_E2E_BURN_SLOW_S"))
 
 
 class SloVerdict(NamedTuple):
@@ -100,6 +139,11 @@ class SloContract:
         self.final_window_auc = final_window_auc
         self.name = name
         self.breaches: List[SloVerdict] = []
+        # ISSUE 16: the live plane — an attached SloBurnRate monitor
+        # (fed by every observation) and the last-seen state per clause
+        # for /statusz
+        self.burn: Optional["SloBurnRate"] = None
+        self._last: Dict[str, dict] = {}
 
     @classmethod
     def from_flags(cls, name: str = "online") -> "SloContract":
@@ -122,8 +166,34 @@ class SloContract:
                             "bound": verdict.bound,
                             "detail": verdict.detail})
         if metrics_enabled():
-            get_registry().inc("alink_e2e_slo_breaches_total", 1,
-                               {"dag": self.name, "slo": verdict.slo})
+            reg = get_registry()
+            labels = {"dag": self.name, "slo": verdict.slo}
+            reg.inc("alink_e2e_slo_breaches_total", 1, labels)
+            # ISSUE 16 satellite: the fleet-facing name — /metrics and
+            # fleetz consumers key on alink_slo_* for SLO posture
+            reg.inc("alink_slo_breaches_total", 1, labels)
+
+    def _clause_state(self, slo: str, observed: float, bound: float,
+                      floor: bool = False) -> None:
+        """Export one clause observation live (``alink_slo_observed`` /
+        ``alink_slo_bound`` gauges), remember it for /statusz, and feed
+        the attached burn monitor. ``floor`` marks a quality-floor
+        clause (burn = bound/observed instead of observed/bound)."""
+        self._last[slo] = {"observed": observed, "bound": bound,
+                           "ok": (observed >= bound if floor
+                                  else observed <= bound),
+                           "floor": floor, "unix": time.time()}
+        if metrics_enabled():
+            reg = get_registry()
+            labels = {"dag": self.name, "slo": slo}
+            reg.set_gauge("alink_slo_observed", observed, labels)
+            reg.set_gauge("alink_slo_bound", bound, labels)
+        if self.burn is not None:
+            self.burn.record(slo, observed, bound, floor=floor)
+
+    def clause_states(self) -> Dict[str, dict]:
+        """Last-seen live state per armed clause (for /statusz)."""
+        return {k: dict(v) for k, v in self._last.items()}
 
     def observe_p99(self, p99_s: Optional[float],
                     window: int) -> Optional[SloVerdict]:
@@ -131,6 +201,8 @@ class SloContract:
         breach (already recorded) or ``None``."""
         if self.serve_p99_s is None or p99_s is None:
             return None
+        self._clause_state("serve_p99", float(p99_s),
+                           float(self.serve_p99_s))
         if p99_s <= self.serve_p99_s:
             return None
         v = SloVerdict("serve_p99", False, float(p99_s),
@@ -144,8 +216,11 @@ class SloContract:
     def observe_swap(self, staleness_s: float,
                      version: int) -> Optional[SloVerdict]:
         """Per-swap staleness check (emission -> installed)."""
-        if self.swap_staleness_s is None \
-                or staleness_s <= self.swap_staleness_s:
+        if self.swap_staleness_s is None:
+            return None
+        self._clause_state("swap_staleness", float(staleness_s),
+                           float(self.swap_staleness_s))
+        if staleness_s <= self.swap_staleness_s:
             return None
         v = SloVerdict("swap_staleness", False, float(staleness_s),
                        float(self.swap_staleness_s),
@@ -154,6 +229,20 @@ class SloContract:
                        f"{self.swap_staleness_s * 1e3:.1f} ms")
         self._breach(v)
         return v
+
+    def observe_auc(self, auc: Optional[float], window: int) -> None:
+        """Live per-window AUC posture against the quality floor.
+
+        Unlike the latency clauses this never records a BREACH — the
+        contract's AUC clause is on the FINAL window only (early
+        windows are legitimately below the floor while the model
+        converges) — but the live gauges and the burn monitor see
+        every window, so a quality regression shows as a rising
+        ``window_auc`` burn long before the end-of-run verdict."""
+        if self.final_window_auc is None or auc is None:
+            return
+        self._clause_state("window_auc", float(auc),
+                           float(self.final_window_auc), floor=True)
 
     # -- the end-of-run verdict -------------------------------------------
     def final(self, p99_s: Optional[float],
@@ -189,6 +278,170 @@ class SloContract:
                 f"{final_auc if final_auc is not None else 'n/a'} vs "
                 f"floor {self.final_window_auc}"))
         return out
+
+
+class SloBurnRate:
+    """Multi-window SLO burn-rate alerting over live clause observations
+    (ISSUE 16; window semantics in the module docstring).
+
+    Attach to a contract (``SloBurnRate(contract)`` sets
+    ``contract.burn``) and every ``observe_*`` call feeds
+    :meth:`record`; or call :meth:`record` directly in tests with a
+    scripted ``clock`` (the same injection pattern the circuit
+    breaker's deterministic tests use). A clause's *fast*-window alert
+    being active is a CRITICAL burn: :meth:`readiness` reports
+    unready, which the admin plane surfaces as ``/readyz`` 503 while
+    the burn lasts.
+    """
+
+    WINDOWS = ("fast", "slow")
+    #: burn cap — a collapsed quality floor (observed ~ 0) or a wildly
+    #: blown latency bound must read as "very bad", not inf/NaN in a
+    #: gauge
+    MAX_BURN = 1e6
+
+    def __init__(self, contract: Optional[SloContract] = None,
+                 fast_s: Optional[float] = None,
+                 slow_s: Optional[float] = None,
+                 threshold: float = 1.0,
+                 name: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.fast_s = burn_fast_s() if fast_s is None else float(fast_s)
+        self.slow_s = burn_slow_s() if slow_s is None else float(slow_s)
+        self.fast_s = max(1e-9, self.fast_s)
+        self.slow_s = max(self.fast_s, self.slow_s)
+        self.threshold = float(threshold)
+        self.name = (name if name is not None
+                     else (contract.name if contract is not None
+                           else "online"))
+        self.clock = clock
+        self._lock = threading.Lock()
+        # per clause: [(t, burn)] pruned to the slow window
+        self._samples: Dict[str, List[Tuple[float, float]]] = {}
+        self._active: Dict[Tuple[str, str], bool] = {}
+        self.alerts: List[dict] = []
+        if contract is not None:
+            contract.burn = self
+
+    def _burn_of(self, observed: float, bound: float,
+                 floor: bool) -> float:
+        """One observation's budget-burn rate: 1.0 = exactly at the
+        bound, 2.0 = spending budget twice as fast as allowed."""
+        if floor:
+            if observed <= 0:
+                return self.MAX_BURN
+            return min(self.MAX_BURN, bound / observed)
+        if bound <= 0:
+            return 0.0
+        return min(self.MAX_BURN, observed / bound)
+
+    def record(self, slo: str, observed: float, bound: float,
+               floor: bool = False) -> Dict[str, float]:
+        """Feed one clause observation; returns the fresh per-window
+        rates (after alert-transition processing)."""
+        now = self.clock()
+        burn = self._burn_of(float(observed), float(bound), floor)
+        with self._lock:
+            buf = self._samples.setdefault(slo, [])
+            buf.append((now, burn))
+            cutoff = now - self.slow_s
+            while buf and buf[0][0] < cutoff:
+                buf.pop(0)
+        return self._evaluate(slo, now)
+
+    # -- window math ------------------------------------------------------
+    def _rates(self, slo: str, now: float) -> Dict[str, float]:
+        with self._lock:
+            buf = list(self._samples.get(slo, ()))
+        if not buf:
+            return {"fast": 0.0, "slow": 0.0}
+        # fast: mean burn of the samples inside the paging window —
+        # reacts within one bad window, decays as samples age out
+        fast_cut = now - self.fast_s
+        fast = [b for t, b in buf if t >= fast_cut]
+        fast_rate = sum(fast) / len(fast) if fast else 0.0
+        # slow: time-integrated budget fraction. Sample i holds its
+        # burn until the next sample (capped at fast_s so sparse
+        # observations cannot claim hours of burn); the newest sample
+        # integrates up to `now`. A short burst therefore stays small
+        # — only a SUSTAINED burn fills the slow window.
+        slow_cut = now - self.slow_s
+        area = 0.0
+        for i, (t, b) in enumerate(buf):
+            t_next = buf[i + 1][0] if i + 1 < len(buf) else now
+            dt = min(max(0.0, t_next - max(t, slow_cut)), self.fast_s)
+            area += b * dt
+        return {"fast": fast_rate, "slow": area / self.slow_s}
+
+    # -- alerting ---------------------------------------------------------
+    def _evaluate(self, slo: str, now: float) -> Dict[str, float]:
+        rates = self._rates(slo, now)
+        reg = get_registry() if metrics_enabled() else None
+        for window in self.WINDOWS:
+            rate = rates[window]
+            labels = {"dag": self.name, "slo": slo, "window": window}
+            if reg is not None:
+                reg.set_gauge("alink_slo_burn_rate", rate, labels)
+            key = (slo, window)
+            active = rate >= self.threshold
+            was = self._active.get(key, False)
+            if active == was:
+                continue
+            self._active[key] = active
+            state = "firing" if active else "resolved"
+            trace_instant("health.alert", cat="health",
+                          args={"slo": slo, "window": window,
+                                "state": state,
+                                "burn_rate": round(rate, 6),
+                                "threshold": self.threshold,
+                                "dag": self.name})
+            self.alerts.append({"slo": slo, "window": window,
+                                "state": state,
+                                "burn_rate": rate, "unix": time.time()})
+            del self.alerts[:-64]
+            if active and reg is not None:
+                reg.inc("alink_slo_alerts_total", 1, labels)
+        return rates
+
+    # -- live verdicts (the admin plane reads these) ----------------------
+    def critical(self) -> List[str]:
+        """Clauses whose FAST-window alert is active right now
+        (re-evaluated at call time, so a burn clears by aging out even
+        with no new observations)."""
+        now = self.clock()
+        with self._lock:
+            slos = list(self._samples)
+        return [slo for slo in slos
+                if self._evaluate(slo, now)["fast"] >= self.threshold]
+
+    def readiness(self) -> dict:
+        """ReadinessSource for the admin plane: unready (-> /readyz
+        503) while any critical burn is active; always healthy — a
+        burning SLO is a degraded service, not a dead process."""
+        crit = self.critical()
+        return {"ready": not crit, "healthy": True,
+                "monitor": "slo_burn_rate", "critical_burns": crit,
+                "threshold": self.threshold,
+                "fast_s": self.fast_s, "slow_s": self.slow_s}
+
+    def state(self) -> dict:
+        """The /statusz document: per-clause window rates + the recent
+        alert-transition log."""
+        now = self.clock()
+        with self._lock:
+            slos = {slo: len(buf) for slo, buf in self._samples.items()}
+        clauses = {}
+        for slo, n in sorted(slos.items()):
+            rates = self._rates(slo, now)
+            clauses[slo] = {
+                "fast": rates["fast"], "slow": rates["slow"],
+                "fast_active": self._active.get((slo, "fast"), False),
+                "slow_active": self._active.get((slo, "slow"), False),
+                "samples": n,
+            }
+        return {"threshold": self.threshold, "fast_s": self.fast_s,
+                "slow_s": self.slow_s, "clauses": clauses,
+                "alerts": list(self.alerts)}
 
 
 class SwapStalenessTracker:

@@ -37,6 +37,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Tuple
 
+from ..common.adminz import acquire_admin, release_admin
 from ..common.faults import FaultInjected
 from ..common.metrics import get_registry, metrics_enabled
 from ..common.mtable import MTable
@@ -45,11 +46,11 @@ from ..operator.stream.prefetch import _Channel, _EMPTY, _SENTINEL
 from .loadgen import percentile as _percentile
 from .predictor import (CompiledPredictor, serve_min_fill,
                         serve_queue_depth, serve_window_s)
-from .resilience import (CircuitBreaker, DeadlineExceeded, ReplicaCrashed,
-                         RequestCancelled, classify_feeder_error,
-                         feeder_backoff_s, feeder_retries,
-                         record_feeder_error, record_shed,
-                         serve_breaker_enabled)
+from .resilience import (OPEN, CircuitBreaker, DeadlineExceeded,
+                         ReplicaCrashed, RequestCancelled,
+                         classify_feeder_error, feeder_backoff_s,
+                         feeder_retries, record_feeder_error,
+                         record_shed, serve_breaker_enabled)
 
 _P99_RING = 4096        # rolling latency window behind the p99 gauge
 _P99_EVERY = 128        # gauge refresh cadence (requests)
@@ -184,6 +185,15 @@ class PredictServer:
                       else f"alink-serve-{name}-r{i}"))
             self._threads.append(th)
             th.start()
+        # live operations plane (ISSUE 16): while ALINK_TPU_ADMIN_PORT
+        # is armed, this server's breaker/admission state answers
+        # /healthz for its lifetime (an open breaker = unhealthy AND
+        # unready; closed at close()). Host-side only — the compiled
+        # serving path never sees the endpoint.
+        self._admin = acquire_admin(name)
+        if self._admin is not None:
+            self._admin.add_source(f"serve:{name}", self._readiness)
+            self._admin.add_status(f"serve:{name}", self.stats)
 
     def _resolve_replicas(self, replicas: Optional[int]) -> int:
         from .sharded import serve_replicas
@@ -474,6 +484,22 @@ class PredictServer:
                 self.predictor.flush_metrics()
 
     # -- stats / shutdown -------------------------------------------------
+    def _readiness(self) -> dict:
+        """ReadinessSource for the admin plane (ISSUE 16): the serving
+        tier is healthy/ready while it admits requests AND the active
+        model version's circuit breaker is not OPEN — an open breaker
+        means requests are being answered by the degraded host-mapper
+        fallback (or typed-failed), which an operator must see as 503
+        on /healthz while it lasts."""
+        admitting = not self._closed.is_set()
+        breaker = self.breaker_stats()
+        ok = admitting and breaker.get("state") != OPEN
+        return {"ready": ok, "healthy": ok,
+                "admission_open": admitting,
+                "breaker": breaker,
+                "queue_depth": self._ch.depth(),
+                "model_version": self.predictor.model_version}
+
     def stats(self) -> dict:
         """A point-in-time snapshot: request/batch counts, mean batch
         occupancy, rolling p50/p99, program-cache hit rate, plus the
@@ -506,6 +532,11 @@ class PredictServer:
         if self._closed.is_set():
             return
         self._closed.set()
+        if self._admin is not None:
+            self._admin.remove_source(f"serve:{self.name}")
+            self._admin.remove_status(f"serve:{self.name}")
+            self._admin = None
+            release_admin()
         self._ch.close()
         deadline = time.monotonic() + timeout
         for th in self._threads:

@@ -1,0 +1,567 @@
+"""Live operations plane (ISSUE 16): the in-process admin endpoint
+(/metrics /varz /healthz /readyz /statusz /tracez), the SLO burn-rate
+monitor, the torn-metrics-dump repair, and the zero-compiled-ops
+guarantee with the plane armed.
+
+The load-bearing invariants:
+  * every endpoint answers from LIVE state over a real ephemeral-port
+    HTTP server — the prom text parses, /varz matches the registry
+    snapshot, /statusz shows resolved flags;
+  * /healthz follows the REAL circuit breaker: 503 while a
+    scoped_fault_env storm holds it open, 200 after the half-open
+    probe recovers the compiled path;
+  * burn-rate window math is deterministic under a scripted clock —
+    the fast window fires within one bad burst, the slow window holds
+    through it, recovery clears the alert with a firing -> resolved
+    transition pair;
+  * a dump file torn mid-final-line loads (with a warning) while
+    mid-file corruption still raises;
+  * the plane is host-side only: lowered HLO and program-cache
+    behavior are byte-identical with the admin server on (and being
+    scraped) vs off.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.adminz import (AdminServer, acquire_admin,
+                                     admin_enabled, get_admin,
+                                     release_admin)
+from alink_tpu.common.faults import FAULT_ENV, reset_faults
+from alink_tpu.common.metrics import MetricsRegistry, set_registry
+from alink_tpu.common.mtable import MTable
+from alink_tpu.common.params import Params
+from alink_tpu.common.tracing import Tracer, set_tracer, trace_instant
+from alink_tpu.common.vector import DenseVector
+from alink_tpu.online.slo import SloBurnRate, SloContract
+from alink_tpu.operator.batch.classification.linear import (
+    LogisticRegressionTrainBatchOp)
+from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+from alink_tpu.serving import CompiledPredictor, PredictServer
+from alink_tpu.serving.resilience import OPEN
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        yield reg
+    finally:
+        set_registry(prev)
+
+
+@pytest.fixture
+def clean_faults(monkeypatch):
+    reset_faults()
+    yield monkeypatch
+    monkeypatch.delenv(FAULT_ENV, raising=False)
+    reset_faults()
+
+
+@pytest.fixture(scope="module")
+def base():
+    """One shared trained model; every test builds its own predictor
+    and server (the test_resilience fixture contract)."""
+    rng = np.random.RandomState(0)
+    n, d = 192, 12
+    X = rng.randn(n, d)
+    y = (X @ rng.randn(d) > 0).astype(np.int64)
+    vecs = np.empty(n, object)
+    vecs[:] = [DenseVector(X[i]) for i in range(n)]
+    tbl = MTable({"vec": vecs, "label": y}, "vec VECTOR, label LONG")
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label",
+        max_iter=3).link_from(MemSourceBatchOp(tbl))
+    data_schema = tbl.select(["vec"]).schema
+    mapper = LinearModelMapper(warm.get_output_table().schema, data_schema,
+                               Params({"prediction_col": "pred",
+                                       "vector_col": "vec"}))
+    mapper.load_model(warm.get_output_table())
+    return tbl, warm, mapper, data_schema
+
+
+def _get(url, path):
+    """(status, text) — a 503 verdict is a result, not an exception."""
+    try:
+        with urllib.request.urlopen(url + path, timeout=10) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def _parse_prom(text):
+    import importlib.util
+    import os
+    p = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "fleetz.py")
+    spec = importlib.util.spec_from_file_location("alink_fleetz_t", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.parse_prom_text(text)
+
+
+# ---------------------------------------------------------------------------
+# the endpoint itself (direct AdminServer, ephemeral port)
+# ---------------------------------------------------------------------------
+
+class TestAdminServer:
+    def test_metrics_and_varz_round_trip(self, fresh_registry):
+        fresh_registry.inc("alink_t_requests_total", 5, {"server": "a"})
+        fresh_registry.set_gauge("alink_t_depth", 3.0)
+        fresh_registry.observe("alink_t_lat_seconds", 0.25)
+        with AdminServer(port=-1, name="t").start() as srv:
+            assert srv.port and srv.port > 0
+            code, text = _get(srv.url, "/metrics")
+            assert code == 200
+            samples = _parse_prom(text)
+            by_name = {}
+            for name, labels, val in samples:
+                by_name.setdefault(name, []).append((labels, val))
+            assert by_name["alink_t_requests_total"] == \
+                [({"server": "a"}, 5.0)]
+            assert by_name["alink_t_depth"] == [({}, 3.0)]
+            assert ("alink_t_lat_seconds_count" in by_name
+                    or "alink_t_lat_seconds" in by_name)
+            # /varz: the dump JSONL shape — meta record first, then the
+            # registry snapshot verbatim
+            code, text = _get(srv.url, "/varz")
+            assert code == 200
+            recs = json.loads(text)
+            assert recs[0]["kind"] == "meta"
+            assert recs[0]["format"] == "alink_tpu_metrics_v1"
+            # the seeded records ride verbatim (the scrape's own
+            # alink_admin_* series land alongside them)
+            seeded = [r for r in fresh_registry.snapshot()
+                      if r["name"].startswith("alink_t_")]
+            assert [r for r in recs[1:]
+                    if r["name"].startswith("alink_t_")] == seeded
+
+    def test_bare_server_healthy_and_ready(self, fresh_registry):
+        with AdminServer(port=-1).start() as srv:
+            assert _get(srv.url, "/healthz")[0] == 200
+            assert _get(srv.url, "/readyz")[0] == 200
+            code, text = _get(srv.url, "/")
+            assert code == 200 and "/statusz" in text
+            assert _get(srv.url, "/nope")[0] == 404
+
+    def test_sources_drive_the_verdicts(self, fresh_registry):
+        with AdminServer(port=-1).start() as srv:
+            srv.add_source("ok", lambda: {"ready": True})
+            srv.add_source("deg", lambda: {"ready": False,
+                                           "healthy": True,
+                                           "why": "warming"})
+            # degraded-but-healthy: ready 503, healthz 200
+            assert _get(srv.url, "/healthz")[0] == 200
+            code, text = _get(srv.url, "/readyz")
+            assert code == 503
+            doc = json.loads(text)
+            assert doc["sources"]["deg"]["why"] == "warming"
+            srv.remove_source("deg")
+            assert _get(srv.url, "/readyz")[0] == 200
+
+    def test_crashing_source_degrades_never_500s(self, fresh_registry):
+        with AdminServer(port=-1).start() as srv:
+            def boom():
+                raise RuntimeError("probe exploded")
+            srv.add_source("bad", boom)
+            code, text = _get(srv.url, "/healthz")
+            assert code == 503
+            assert "probe exploded" in \
+                json.loads(text)["sources"]["bad"]["error"]
+
+    def test_statusz_shows_resolved_flags(self, fresh_registry,
+                                          monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_SERVE_BREAKER_THRESHOLD", "7")
+        with AdminServer(port=-1, name="statusz-t").start() as srv:
+            srv.add_status("custom", lambda: {"answer": 42})
+            code, text = _get(srv.url, "/statusz")
+            assert code == 200
+            doc = json.loads(text)
+            assert doc["name"] == "statusz-t"
+            fl = doc["flags"]["ALINK_TPU_SERVE_BREAKER_THRESHOLD"]
+            assert fl["value"] == 7 and fl["set"] is True
+            # unset flags render their declared default
+            port = doc["flags"]["ALINK_TPU_ADMIN_PORT"]
+            assert port["default"] == 0
+            assert doc["sections"]["custom"]["answer"] == 42
+
+    def test_tracez_respects_the_ring_bound(self, fresh_registry,
+                                            monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_TRACE", "1")
+        tr = Tracer(capacity=8)
+        prev = set_tracer(tr)
+        try:
+            for i in range(20):
+                trace_instant(f"t.ev{i}", cat="test")
+            with AdminServer(port=-1).start() as srv:
+                code, text = _get(srv.url, "/tracez")
+                assert code == 200
+                doc = json.loads(text)
+                assert doc["meta"]["capacity"] == 8
+                assert doc["meta"]["dropped"] >= 12
+                assert len(doc["events"]) <= 8
+                # ?n= narrows the response below the flag bound
+                _, text = _get(srv.url, "/tracez?n=3")
+                doc3 = json.loads(text)
+                assert len(doc3["events"]) == 3
+                # the LAST events, not the first
+                assert doc3["events"][-1]["name"] == \
+                    doc["events"][-1]["name"]
+        finally:
+            set_tracer(prev)
+
+    def test_scrapes_record_their_own_metrics(self, fresh_registry):
+        with AdminServer(port=-1).start() as srv:
+            _get(srv.url, "/metrics")
+            _get(srv.url, "/healthz")
+            # the handler records AFTER responding — give it a beat
+            paths = set()
+            for _ in range(100):
+                paths = {r["labels"]["path"]
+                         for r in fresh_registry.snapshot()
+                         if r["name"] == "alink_admin_requests_total"}
+                if {"/metrics", "/healthz"} <= paths:
+                    break
+                time.sleep(0.01)
+            assert "/metrics" in paths and "/healthz" in paths
+
+
+# ---------------------------------------------------------------------------
+# the refcounted shared instance
+# ---------------------------------------------------------------------------
+
+class TestSharedAdmin:
+    def test_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("ALINK_TPU_ADMIN_PORT", raising=False)
+        assert not admin_enabled()
+        assert acquire_admin() is None
+        assert get_admin() is None
+        release_admin()                       # harmless when off
+
+    def test_refcount_lifecycle(self, monkeypatch, fresh_registry):
+        monkeypatch.setenv("ALINK_TPU_ADMIN_PORT", "-1")
+        a = acquire_admin("rc-test")
+        try:
+            assert a is not None and a.port > 0
+            b = acquire_admin()
+            assert b is a                     # one endpoint per process
+            release_admin()
+            assert get_admin() is a           # still one holder
+        finally:
+            release_admin()
+        assert get_admin() is None            # last holder closed it
+        # the port answered while up, refuses now
+        with pytest.raises(Exception):
+            urllib.request.urlopen(a.url + "/healthz", timeout=2)
+
+
+# ---------------------------------------------------------------------------
+# /healthz follows the REAL circuit breaker (integration)
+# ---------------------------------------------------------------------------
+
+class TestBreakerHealthz:
+    def test_healthz_flips_with_the_breaker(self, base, fresh_registry,
+                                            clean_faults):
+        clean_faults.setenv("ALINK_TPU_ADMIN_PORT", "-1")
+        clean_faults.setenv("ALINK_TPU_SERVE_BREAKER_THRESHOLD", "2")
+        clean_faults.setenv("ALINK_TPU_SERVE_BREAKER_BACKOFF_MS", "30")
+        clean_faults.setenv(FAULT_ENV, "serve.dispatch:1-2:error")
+        tbl, _w, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(1,), name="hz")
+        srv = PredictServer(pred, max_batch=1, name="hz")
+        try:
+            adm = get_admin()
+            assert adm is not None, \
+                "PredictServer did not bring the armed admin plane up"
+            assert _get(adm.url, "/healthz")[0] == 200
+            row = tbl.select(["vec"]).row(0)
+            for _ in range(2):                # the storm trips it
+                with pytest.raises(Exception):
+                    srv.submit(row).result(30)
+            assert srv.breaker_stats()["state"] == OPEN
+            code, text = _get(adm.url, "/healthz")
+            assert code == 503
+            doc = json.loads(text)
+            src = doc["sources"]["serve:hz"]
+            assert src["breaker"]["state"] == OPEN
+            assert src["admission_open"] is True
+            assert _get(adm.url, "/readyz")[0] == 503
+            # degraded answer while open, probe past the backoff
+            srv.submit(row).result(30)
+            time.sleep(0.06)
+            srv.submit(row).result(30)
+            assert srv.breaker_stats()["state"] == "closed"
+            assert _get(adm.url, "/healthz")[0] == 200
+            assert _get(adm.url, "/readyz")[0] == 200
+        finally:
+            srv.close()
+        assert get_admin() is None, \
+            "server close must release the shared endpoint"
+
+    def test_server_statusz_section(self, base, fresh_registry,
+                                    clean_faults):
+        clean_faults.setenv("ALINK_TPU_ADMIN_PORT", "-1")
+        tbl, _w, mapper, _s = base
+        pred = CompiledPredictor(mapper, buckets=(1,), name="stz")
+        srv = PredictServer(pred, max_batch=1, name="stz")
+        try:
+            srv.predict(tbl.select(["vec"]).row(0), timeout=30)
+            doc = json.loads(_get(get_admin().url, "/statusz")[1])
+            sec = doc["sections"]["serve:stz"]
+            assert sec["requests"] == 1
+            assert sec["model_version"] == pred.model_version
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# burn-rate window math (scripted clock — deterministic)
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestBurnRate:
+    def _monitor(self, clk, **kw):
+        kw.setdefault("fast_s", 300.0)
+        kw.setdefault("slow_s", 3600.0)
+        return SloBurnRate(clock=clk, name="t", **kw)
+
+    def test_fast_fires_slow_holds(self, fresh_registry):
+        """A one-burst storm crosses the paging window without
+        spending the hour's budget (the multi-window contract)."""
+        clk = _Clock()
+        mon = self._monitor(clk)
+        for i in range(6):
+            clk.t = i * 10.0
+            rates = mon.record("serve_p99", observed=0.01, bound=0.002)
+        assert rates["fast"] == pytest.approx(5.0)
+        assert rates["slow"] < 1.0
+        assert mon.critical() == ["serve_p99"]
+        assert mon.readiness()["ready"] is False
+        assert mon.readiness()["healthy"] is True   # degraded, not dead
+        fired = [a for a in mon.alerts if a["state"] == "firing"]
+        assert [(a["slo"], a["window"]) for a in fired] == \
+            [("serve_p99", "fast")]
+
+    def test_recovery_clears_by_aging_out(self, fresh_registry):
+        """No new observations needed: the fast window empties as the
+        clock advances and the alert resolves."""
+        clk = _Clock()
+        mon = self._monitor(clk)
+        mon.record("serve_p99", observed=0.01, bound=0.002)
+        assert mon.critical() == ["serve_p99"]
+        clk.t = 301.0
+        assert mon.critical() == []
+        assert mon.readiness()["ready"] is True
+        states = [a["state"] for a in mon.alerts]
+        assert states == ["firing", "resolved"]
+
+    def test_sustained_burn_fires_the_slow_window(self, fresh_registry):
+        clk = _Clock()
+        mon = self._monitor(clk)
+        rates = {}
+        for i in range(61):                  # 2x burn every minute, 1 h
+            clk.t = i * 60.0
+            rates = mon.record("swap_staleness", observed=4.0, bound=2.0)
+        assert rates["slow"] >= 1.0
+        assert ("swap_staleness", "slow") in \
+            [(a["slo"], a["window"]) for a in mon.alerts
+             if a["state"] == "firing"]
+
+    def test_sparse_samples_cannot_claim_hours(self, fresh_registry):
+        """dt is capped at the fast window: two bad samples an hour
+        apart must not integrate as an hour of burn."""
+        clk = _Clock()
+        mon = self._monitor(clk)
+        mon.record("serve_p99", observed=0.02, bound=0.002)  # burn 10
+        clk.t = 3000.0
+        rates = mon.record("serve_p99", observed=0.02, bound=0.002)
+        # first sample contributes at most fast_s * 10 / slow_s
+        assert rates["slow"] <= 10.0 * 300.0 / 3600.0 + 1e-9
+
+    def test_floor_clause_inverts_the_ratio(self, fresh_registry):
+        clk = _Clock()
+        mon = self._monitor(clk)
+        rates = mon.record("window_auc", observed=0.5, bound=0.75,
+                           floor=True)
+        assert rates["fast"] == pytest.approx(1.5)
+        rates = mon.record("window_auc", observed=0.9, bound=0.75,
+                           floor=True)
+        assert rates["fast"] < 1.5           # healthy AUC burns < 1
+        # a collapsed floor caps, never div-by-zero
+        rates = mon.record("window_auc", observed=0.0, bound=0.75,
+                           floor=True)
+        assert rates["fast"] <= SloBurnRate.MAX_BURN
+
+    def test_gauges_and_alert_counter(self, fresh_registry):
+        clk = _Clock()
+        mon = self._monitor(clk)
+        mon.record("serve_p99", observed=0.01, bound=0.002)
+        recs = fresh_registry.snapshot()
+        burn = {(r["labels"]["slo"], r["labels"]["window"]): r["value"]
+                for r in recs if r["name"] == "alink_slo_burn_rate"}
+        assert burn[("serve_p99", "fast")] == pytest.approx(5.0)
+        alerts = [r for r in recs
+                  if r["name"] == "alink_slo_alerts_total"]
+        assert len(alerts) == 1 and alerts[0]["value"] == 1.0
+        assert alerts[0]["labels"]["window"] == "fast"
+
+    def test_contract_feeds_the_monitor_and_gauges(self, fresh_registry):
+        """SloContract.observe_* exports the live clause gauges
+        (satellite 2) and drives the attached monitor."""
+        clk = _Clock()
+        c = SloContract(serve_p99_s=0.002, swap_staleness_s=1.0,
+                        final_window_auc=0.75, name="t")
+        mon = SloBurnRate(c, fast_s=300.0, slow_s=3600.0, clock=clk)
+        assert c.burn is mon
+        v = c.observe_p99(0.01, window=1)            # breach
+        assert v is not None and not v.ok
+        c.observe_swap(0.5, version=2)               # within bound
+        c.observe_auc(0.5, window=1)                 # floor posture
+        states = c.clause_states()
+        assert set(states) == {"serve_p99", "swap_staleness",
+                               "window_auc"}
+        assert states["serve_p99"]["ok"] is False
+        assert states["swap_staleness"]["ok"] is True
+        assert states["window_auc"]["floor"] is True
+        recs = fresh_registry.snapshot()
+        obs = {r["labels"]["slo"]: r["value"] for r in recs
+               if r["name"] == "alink_slo_observed"}
+        bnd = {r["labels"]["slo"]: r["value"] for r in recs
+               if r["name"] == "alink_slo_bound"}
+        assert obs["serve_p99"] == pytest.approx(0.01)
+        assert bnd["window_auc"] == pytest.approx(0.75)
+        # the fleet-facing breach counter (alink_slo_*) moved too
+        breaches = [r["value"] for r in recs
+                    if r["name"] == "alink_slo_breaches_total"]
+        assert breaches == [1.0]
+        # an AUC posture observation is NOT a breach (final-window-only
+        # clause) — only the gauges and the burn see it
+        assert len(c.breaches) == 1
+        assert mon.state()["clauses"]["window_auc"]["samples"] == 1
+
+
+# ---------------------------------------------------------------------------
+# torn metrics dump (satellite 1)
+# ---------------------------------------------------------------------------
+
+class TestTornDump:
+    def _dump(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.inc("alink_t_total", 3, {"k": "a"})
+        reg.inc("alink_t_total", 4, {"k": "b"})
+        reg.set_gauge("alink_t_g", 7.5)
+        reg.observe("alink_t_h_seconds", 0.5)
+        p = str(tmp_path / "metrics.jsonl")
+        reg.dump(p)
+        return reg, p
+
+    def test_round_trip_unchanged(self, tmp_path):
+        reg, p = self._dump(tmp_path)
+        assert MetricsRegistry.load(p).render_text() == reg.render_text()
+
+    def test_torn_final_line_loads_with_warning(self, tmp_path):
+        reg, p = self._dump(tmp_path)
+        data = open(p, "rb").read().rstrip(b"\n")
+        open(p, "wb").write(data[:-10])      # kill the process mid-dump
+        with pytest.warns(RuntimeWarning, match="torn"):
+            loaded = MetricsRegistry.load(p)
+        # the complete prefix survived
+        full = {(r["name"], tuple(sorted((r.get("labels") or {})
+                                         .items())))
+                for r in reg.snapshot()}
+        got = {(r["name"], tuple(sorted((r.get("labels") or {})
+                                        .items())))
+               for r in loaded.snapshot()}
+        assert got == full - (full - got)    # strict subset, no extras
+        assert len(got) == len(full) - 1
+
+    def test_mid_file_corruption_still_raises(self, tmp_path):
+        _reg, p = self._dump(tmp_path)
+        lines = open(p, "rb").read().splitlines()
+        lines[1] = b'{"kind": "counter", "name": TORN'
+        open(p, "wb").write(b"\n".join(lines) + b"\n")
+        with pytest.raises(ValueError, match="mid-file"):
+            MetricsRegistry.load(p)
+
+    def test_trailing_blank_lines_are_not_torn(self, tmp_path):
+        reg, p = self._dump(tmp_path)
+        with open(p, "a") as f:
+            f.write("\n\n")
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            loaded = MetricsRegistry.load(p)
+        assert loaded.render_text() == reg.render_text()
+
+
+# ---------------------------------------------------------------------------
+# zero-compiled-ops: the plane is invisible to the compiled path
+# ---------------------------------------------------------------------------
+
+class TestZeroCompiledOps:
+    def test_lowered_hlo_identical_with_admin_on(self, fresh_registry):
+        import jax
+        import jax.numpy as jnp
+
+        def fn(x):
+            return (x @ x).sum()
+
+        x = jnp.ones((16, 16), jnp.float32)
+        off = jax.jit(fn).lower(x).as_text()
+        with AdminServer(port=-1).start() as srv:
+            stop = threading.Event()
+
+            def scraper():
+                while not stop.is_set():
+                    _get(srv.url, "/metrics")
+
+            th = threading.Thread(target=scraper, daemon=True)
+            th.start()
+            try:
+                on = jax.jit(fn).lower(x).as_text()
+            finally:
+                stop.set()
+                th.join(timeout=5)
+        assert on == off
+        low = on.lower()
+        assert "callback" not in low and "outfeed" not in low
+
+    def test_program_cache_hits_with_admin_scraping(self, base,
+                                                    fresh_registry):
+        """Same predicts, same programs, same hit counts — scraping the
+        plane between dispatches changes nothing on the compiled path."""
+        tbl, _w, mapper, _s = base
+        probe = tbl.select(["vec"]).first_n(4)
+
+        def run(scrape_url):
+            pred = CompiledPredictor(mapper, buckets=(4,), name="zc")
+            pred.predict_table(probe)
+            if scrape_url:
+                _get(scrape_url, "/metrics")
+                _get(scrape_url, "/statusz")
+            pred.predict_table(probe)
+            if scrape_url:
+                _get(scrape_url, "/varz")
+            pred.predict_table(probe)
+            return pred.cache_stats()
+
+        stats_off = run(None)
+        with AdminServer(port=-1).start() as srv:
+            stats_on = run(srv.url)
+        assert stats_on == stats_off
+        assert stats_on["hits"] >= 1          # the cache actually hit

@@ -1,0 +1,274 @@
+#!/usr/bin/env python
+"""Live-operations-plane smoke (perf_gate leg, ISSUE 16) — exit 10.
+
+Proves the admin endpoint (``alink_tpu/common/adminz.py``) against
+REAL component state, end to end:
+
+  phase A — breaker flip through the plane: a ``PredictServer`` with
+    ``ALINK_TPU_ADMIN_PORT=-1`` armed brings the shared endpoint up;
+    a scripted ``serve.dispatch`` error storm trips the circuit
+    breaker and ``/healthz`` answers 503 WHILE it is open, then 200
+    after the half-open probe recovers the compiled path — the
+    accept-criterion flip, driven by the real breaker.
+  phase B — the PR-15 online DAG under a serving fault storm with the
+    plane armed: a scraper thread polls ``/metrics`` + ``/healthz`` +
+    ``/readyz`` throughout the run (every body must parse; client-side
+    scrape latency is measured and reported), ``/healthz`` flips 503
+    -> 200 with the storm, the armed 1 µs p99 SLO drives the
+    fast-window burn-rate alert (``alink_slo_alerts_total`` fires,
+    ``/readyz`` 503 while the burn is critical), and ``/statusz``
+    shows the DAG's swap history live.
+  phase C — burn fire-AND-clear against the live endpoint: a
+    scripted-window ``SloBurnRate`` flips ``/readyz`` to 503 on a
+    critical burn and back to 200 once the fast window ages out, with
+    the firing -> resolved transition pair on the alert log.
+
+Runs in a fresh child interpreter (bootenv CPU mesh) so fault counters,
+the metrics registry, and the shared admin endpoint start from zero.
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+EXIT = 10
+_MARK = "ALINK_ADMINZ_SMOKE_CHILD"
+
+# phase A: two dispatch errors trip the threshold-2 breaker
+STORM_BREAKER = "serve.dispatch:1-2:error"
+# phase B: a 10-dispatch error window over the DAG's serving tier
+STORM_DAG = "serve.dispatch:1-10:error"
+
+
+def main() -> int:
+    if os.environ.get(_MARK) != "1":
+        import bootenv
+        env = bootenv.cpu_mesh_env(4)
+        env[_MARK] = "1"
+        env.pop("ALINK_TPU_FAULT_INJECT", None)
+        env["ALINK_TPU_ADMIN_PORT"] = "-1"
+        env["ALINK_TPU_SERVE_BREAKER_THRESHOLD"] = "2"
+        env["ALINK_TPU_SERVE_BREAKER_BACKOFF_MS"] = "50"
+        env["ALINK_TPU_SERVE_BREAKER_MAX_MS"] = "200"
+        env["ALINK_TPU_E2E_BURN_FAST_S"] = "2"
+        env["ALINK_TPU_E2E_BURN_SLOW_S"] = "60"
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             cwd=ROOT, env=env, timeout=900)
+        return out.returncode
+
+    import json
+    import tempfile
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+    import warnings
+
+    import numpy as np
+
+    from alink_tpu.common.adminz import acquire_admin, release_admin
+    from alink_tpu.common.faults import scoped_fault_env
+    from alink_tpu.common.metrics import get_registry
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.common.params import Params
+    from alink_tpu.common.vector import DenseVector
+    from alink_tpu.online import OnlineDag, SloBurnRate, SloContract
+    from alink_tpu.operator.batch.classification.linear import (
+        LogisticRegressionTrainBatchOp)
+    from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+    from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+    from alink_tpu.operator.stream.source.sources import MemSourceStreamOp
+    from alink_tpu.serving import CompiledPredictor, PredictServer
+
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "alink_tpu_tool_fleetz", os.path.join(ROOT, "tools", "fleetz.py"))
+    fleetz = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(fleetz)
+
+    warnings.filterwarnings("ignore", category=RuntimeWarning)
+    bad = []
+
+    # the smoke holds its OWN endpoint acquisition so the port stays
+    # stable across the phases (components refcount on top of it)
+    adm = acquire_admin("adminz_smoke")
+    if adm is None or not adm.port:
+        print("adminz_smoke: the admin endpoint did not come up",
+              file=sys.stderr)
+        return EXIT
+
+    def get(path):
+        """(status, body) — 503 is a verdict here, not an error."""
+        try:
+            with urllib.request.urlopen(adm.url + path, timeout=10) as r:
+                return r.status, r.read().decode("utf-8")
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode("utf-8")
+
+    # -- fixture: labeled dense-LR stream + warm model --------------------
+    n_rows, dim, batch = 768, 16, 128            # 6 micro-batches
+    rng = np.random.RandomState(7)
+    X = rng.randn(n_rows, dim)
+    y = (X @ rng.randn(dim) + 0.3 * rng.randn(n_rows) > 0).astype(
+        np.int64)
+    vecs = np.empty(n_rows, object)
+    vecs[:] = [DenseVector(X[i]) for i in range(n_rows)]
+    tbl = MTable({"vec": vecs, "label": y}, "vec VECTOR, label LONG")
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=3).link_from(
+        MemSourceBatchOp(tbl.first_n(256)))
+    warm.get_output_table()
+
+    # -- phase A: breaker flip through /healthz ---------------------------
+    mapper = LinearModelMapper(
+        warm.get_output_table().schema, tbl.select(["vec"]).schema,
+        Params({"prediction_col": "pred", "vector_col": "vec"}))
+    mapper.load_model(warm.get_output_table())
+    pred = CompiledPredictor(mapper, buckets=(1,), name="adminz_a")
+    row = tbl.select(["vec"]).row(0)
+    with scoped_fault_env(STORM_BREAKER):
+        srv = PredictServer(pred, max_batch=1, name="adminz_a")
+        try:
+            if get("/healthz")[0] != 200:
+                bad.append("phase A: /healthz not 200 before the storm")
+            for _ in range(2):
+                try:
+                    srv.predict(row, timeout=30)
+                except Exception:
+                    pass                      # typed — the storm
+            code, body = get("/healthz")
+            doc = json.loads(body)
+            brk = doc["sources"]["serve:adminz_a"]["breaker"]["state"]
+            if code != 503 or brk != "open":
+                bad.append(f"phase A: breaker open but /healthz={code} "
+                           f"(breaker state {brk!r})")
+            srv.predict(row, timeout=30)      # degraded fallback answer
+            time.sleep(0.1)                   # past the 50 ms backoff
+            srv.predict(row, timeout=30)      # half-open probe -> closed
+            code, _ = get("/healthz")
+            if code != 200:
+                bad.append(f"phase A: breaker recovered but "
+                           f"/healthz={code}")
+        finally:
+            srv.close()
+    print("adminz_smoke: phase A — /healthz 503 while the breaker was "
+          "open, 200 after the probe recovered the compiled path")
+
+    # -- phase B: the online DAG under storm, scraped throughout ----------
+    slo = SloContract(serve_p99_s=1e-6,        # burns BY DESIGN
+                      swap_staleness_s=30.0,
+                      final_window_auc=0.5, name="adminz_b")
+    dag = OnlineDag(
+        source_fn=lambda: MemSourceStreamOp(tbl, batch_size=batch),
+        warm_model=warm, artifacts_dir=tempfile.mkdtemp(prefix="adminz_"),
+        label_col="label", vector_col="vec", time_interval=2.0,
+        checkpoint_every=3, slo=slo, name="adminz_b")
+    result = {}
+
+    def run_dag():
+        with scoped_fault_env(STORM_DAG):
+            result["report"] = dag.run()
+
+    th = threading.Thread(target=run_dag, daemon=True)
+    th.start()
+    health_codes, ready_codes, scrape_s = [], [], []
+    statusz_last = None
+    while th.is_alive():
+        t0 = time.perf_counter()
+        _, prom = get("/metrics")
+        scrape_s.append(time.perf_counter() - t0)
+        fleetz.parse_prom_text(prom)          # every scrape must parse
+        health_codes.append(get("/healthz")[0])
+        ready_codes.append(get("/readyz")[0])
+        code, body = get("/statusz")
+        if code == 200:
+            doc = json.loads(body)
+            if f"dag:adminz_b" in doc.get("sections", {}):
+                statusz_last = doc
+        time.sleep(0.03)
+    th.join()
+    rep = result.get("report")
+    if rep is None or rep.failed is not None:
+        bad.append(f"phase B: DAG failed outright: "
+                   f"{getattr(rep, 'failed', 'no report')}")
+    else:
+        if 503 not in health_codes:
+            bad.append("phase B: /healthz never read 503 during the "
+                       "dispatch-error storm")
+        elif 200 not in health_codes[health_codes.index(503):]:
+            bad.append("phase B: /healthz never recovered to 200 after "
+                       "the storm (while the DAG was still running)")
+        if 503 not in ready_codes:
+            bad.append("phase B: /readyz never read 503 — the 1 µs p99 "
+                       "burn never went critical")
+        reg = get_registry()
+        alerts = sum(rec.get("value", 0) for rec in reg.snapshot()
+                     if rec["name"] == "alink_slo_alerts_total")
+        if not alerts:
+            bad.append("phase B: alink_slo_alerts_total never fired "
+                       "under a 1 µs p99 bound")
+        burn_series = [rec for rec in reg.snapshot()
+                       if rec["name"] == "alink_slo_burn_rate"]
+        if not burn_series:
+            bad.append("phase B: no alink_slo_burn_rate gauges emitted")
+        if rep.swaps < 1:
+            bad.append(f"phase B: DAG recorded {rep.swaps} swaps")
+        if statusz_last is None:
+            bad.append("phase B: /statusz never showed the DAG section")
+        else:
+            sec = statusz_last["sections"]["dag:adminz_b"]
+            if "swaps" not in sec or "burn" not in sec:
+                bad.append(f"phase B: DAG /statusz section incomplete: "
+                           f"{sorted(sec)}")
+        if not scrape_s:
+            bad.append("phase B: zero /metrics scrapes landed mid-run")
+        else:
+            mean_ms = 1e3 * sum(scrape_s) / len(scrape_s)
+            print(f"adminz_smoke: phase B — {len(scrape_s)} /metrics "
+                  f"scrapes under load, mean {mean_ms:.2f} ms / max "
+                  f"{1e3 * max(scrape_s):.2f} ms; healthz flipped "
+                  f"503->200; burn alert fired "
+                  f"({int(alerts)} transition(s)); {rep.swaps} swaps "
+                  f"in /statusz")
+
+    # -- phase C: burn fires AND clears on the live endpoint --------------
+    burn = SloBurnRate(fast_s=0.5, slow_s=10.0, name="adminz_c")
+    adm.add_source("slo:adminz_c", burn.readiness)
+    try:
+        if get("/readyz")[0] != 200:
+            bad.append("phase C: /readyz not 200 before the burn")
+        burn.record("serve_p99", observed=5.0, bound=1.0)
+        if get("/readyz")[0] != 503:
+            bad.append("phase C: critical fast-window burn did not "
+                       "flip /readyz to 503")
+        time.sleep(0.7)                        # the fast window ages out
+        if get("/readyz")[0] != 200:
+            bad.append("phase C: /readyz did not clear after the fast "
+                       "window aged out")
+        states = [a["state"] for a in burn.alerts]
+        if states != ["firing", "resolved"]:
+            bad.append(f"phase C: alert transitions {states} != "
+                       f"['firing', 'resolved']")
+    finally:
+        adm.remove_source("slo:adminz_c")
+    print("adminz_smoke: phase C — burn alert fired (readyz 503) and "
+          "cleared (readyz 200) on the live endpoint")
+
+    release_admin()
+    if bad:
+        print("adminz_smoke: FAILED:", file=sys.stderr)
+        for m in bad:
+            print(f"  {m}", file=sys.stderr)
+        return EXIT
+    print("adminz_smoke: clean — live plane followed the real breaker, "
+          "burn alerts fired and cleared, every mid-storm scrape parsed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,7 +22,14 @@ Usage:
     python tools/doctor.py --run-dir DIR            # bench.py --run-dir output
     python tools/doctor.py --bench BENCH_quick.json [--profile PROFILE.json]
                            [--metrics METRICS.jsonl]
+    python tools/doctor.py --url http://host:port   # LIVE admin endpoint
+    python tools/doctor.py --url FLEETZ_SNAPSHOT_DIR
     ... [--json]
+
+``--url`` (ISSUE 16) points the same metrics verdict at a RUNNING
+process — an ``ALINK_TPU_ADMIN_PORT`` admin endpoint's ``/varz`` (the
+dump-file record shape served live) — or at a ``tools/fleetz.py
+--snapshot`` directory, merging every archived worker's records.
 
 Exit codes: 0 — artifacts parsed and verdicts rendered; 1 — no usable
 input. The doctor never gates (that is bench_compare --threshold's job);
@@ -78,17 +85,55 @@ def _metrics_summary(path: str) -> Dict[str, Any]:
     """The handful of registry aggregates the verdict cites (program
     cache, collectives, live-HBM gauges, serving counters) from a
     MetricsRegistry dump."""
-    out: Dict[str, Any] = {"cache": {}, "collectives": {}, "hbm_gauges": {},
-                           "serve": {}}
+    records: List[dict] = []
     with open(path) as f:
         for ln in f:
             ln = ln.strip()
             if not ln:
                 continue
             try:
-                rec = json.loads(ln)
+                records.append(json.loads(ln))
             except ValueError:
                 continue
+    return _summarize_metric_records(records)
+
+
+def _records_from_url(url: str) -> List[dict]:
+    """Metric records from a LIVE admin endpoint's ``/varz`` (JSON
+    array, dump-record shape) or a fleetz ``--snapshot`` directory
+    (every ``varz.json`` under it, merged — the fleet's union)."""
+    if os.path.isdir(url):
+        import glob
+        paths = sorted(glob.glob(os.path.join(url, "varz.json"))
+                       + glob.glob(os.path.join(url, "*", "varz.json")))
+        if not paths:
+            raise ValueError(f"{url}: no varz.json under it — not a "
+                             f"fleetz snapshot directory")
+        records: List[dict] = []
+        for p in paths:
+            doc = load_json(p)
+            if not isinstance(doc, list):
+                raise ValueError(f"{p}: not a /varz record array")
+            records.extend(r for r in doc if isinstance(r, dict))
+        return records
+    import urllib.request
+    if "://" not in url:
+        url = f"http://{url}"
+    with urllib.request.urlopen(f"{url.rstrip('/')}/varz",
+                                timeout=10) as r:
+        doc = json.loads(r.read())
+    if not isinstance(doc, list):
+        raise ValueError(f"{url}/varz: not a record array")
+    return [r for r in doc if isinstance(r, dict)]
+
+
+def _summarize_metric_records(records: List[dict]) -> Dict[str, Any]:
+    """The summary over already-parsed registry records — the shared
+    core behind dump files (``--metrics``), live ``/varz`` scrapes and
+    fleetz snapshot dirs (``--url``)."""
+    out: Dict[str, Any] = {"cache": {}, "collectives": {}, "hbm_gauges": {},
+                           "serve": {}}
+    for rec in records:
             name = rec.get("name")
             labels = rec.get("labels") or {}
             if name == "alink_comqueue_program_cache_total":
@@ -949,6 +994,12 @@ def main(argv=None) -> int:
                          "(ProfileCollector.export)")
     ap.add_argument("--metrics", metavar="PATH",
                     help="a MetricsRegistry.dump() JSONL")
+    ap.add_argument("--url", metavar="URL_OR_DIR",
+                    help="a LIVE admin endpoint (http://host:port — "
+                         "scrapes its /varz) or a tools/fleetz.py "
+                         "--snapshot directory; the metrics verdict "
+                         "renders against the running process instead "
+                         "of a dump file")
     ap.add_argument("--peak-tflops", type=float,
                     default=DEFAULT_PEAK_TFLOPS)
     ap.add_argument("--peak-hbm-gbps", type=float,
@@ -966,20 +1017,25 @@ def main(argv=None) -> int:
         bench_path = bench_path or _first_existing(d, "bench.json")
         profile_path = profile_path or _first_existing(d, "profile.json")
         metrics_path = metrics_path or _first_existing(d, "metrics.jsonl")
-    if not bench_path and not profile_path:
-        print("doctor.py: need --run-dir, --bench or --profile "
+    if not bench_path and not profile_path and not args.url:
+        print("doctor.py: need --run-dir, --bench, --profile or --url "
               "(nothing to diagnose)", file=sys.stderr)
         return 1
     try:
         bench = load_bench(bench_path) if bench_path else None
         profile = load_json(profile_path) if profile_path else None
         metrics = _metrics_summary(metrics_path) if metrics_path else None
+        if args.url:
+            live = _summarize_metric_records(_records_from_url(args.url))
+            metrics = live if metrics is None else {**metrics, **live}
     except (OSError, ValueError) as e:
         print(f"doctor.py: {e}", file=sys.stderr)
         return 1
     doc = diagnose(bench, profile, metrics,
                    args.peak_tflops, args.peak_hbm_gbps)
-    if not doc["workloads"] and not doc.get("hbm"):
+    if not doc["workloads"] and not doc.get("hbm") \
+            and (bench is not None or profile is not None):
+        # (a --url-only scrape has no profiled workloads by design)
         print("doctor.py: no profiled workloads found — was the capture "
               "run with ALINK_TPU_PROFILE=1?", file=sys.stderr)
         # still render what exists (e.g. a bench without profile rows)

@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""fleetz — scrape N alink_tpu admin endpoints into ONE fleet report
+(ISSUE 16; the observation path ROADMAP item 5's multi-host workers and
+item 2's multi-tenant fleets will ride).
+
+Every long-lived alink_tpu process with ``ALINK_TPU_ADMIN_PORT`` armed
+exposes the live operations plane (``alink_tpu/common/adminz.py``).
+This tool fans a scrape out over a worker list, merges ``/varz`` +
+``/statusz`` + the health verdicts, and renders one table — per-worker
+columns plus a fleet aggregate — with the same table machinery
+``run_report.py`` uses, so fleet output reads like every other report
+in the repo.
+
+    python tools/fleetz.py localhost:8321 localhost:8322 ...
+    python tools/fleetz.py --json host:port ...       # machine-readable
+    python tools/fleetz.py --snapshot DIR host:port   # archive scrapes
+
+``--snapshot DIR`` writes each worker's raw ``varz.json`` /
+``statusz.json`` / ``metrics.prom`` plus the merged ``fleet.json`` —
+the directory shape ``tools/doctor.py --url`` accepts as an offline
+input, so a fleet snapshot taken during an incident replays through
+the verdict renderer later.
+
+Unreachable workers are reported per worker (column ``DOWN``), not
+fatal; the exit code is nonzero only when NO worker answered.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+def _load_sibling_tool(name: str):
+    """Import a sibling tools/*.py module (tools/ is not a package)."""
+    import importlib.util
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     f"{name}.py")
+    spec = importlib.util.spec_from_file_location(
+        f"alink_tpu_tool_{name}", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def parse_prom_text(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """Parse Prometheus exposition text into ``(name, labels, value)``
+    samples — enough of the format to round-trip what
+    ``MetricsRegistry.render_text`` emits (and to prove a scraped
+    ``/metrics`` body parses, which the smoke leg asserts)."""
+    out: List[Tuple[str, Dict[str, str], float]] = []
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln or ln.startswith("#"):
+            continue
+        head, _, val = ln.rpartition(" ")
+        if not head:
+            raise ValueError(f"malformed prom sample: {ln!r}")
+        labels: Dict[str, str] = {}
+        name = head
+        if head.endswith("}"):
+            name, _, body = head.partition("{")
+            body = body[:-1]
+            i = 0
+            while i < len(body):
+                eq = body.index("=", i)
+                k = body[i:eq]
+                if body[eq + 1] != '"':
+                    raise ValueError(f"malformed labels in: {ln!r}")
+                j = eq + 2
+                buf = []
+                while body[j] != '"':
+                    if body[j] == "\\":
+                        nxt = body[j + 1]
+                        buf.append({"n": "\n"}.get(nxt, nxt))
+                        j += 2
+                    else:
+                        buf.append(body[j])
+                        j += 1
+                labels[k] = "".join(buf)
+                i = j + 1
+                if i < len(body) and body[i] == ",":
+                    i += 1
+        out.append((name, labels, float(val)))
+    return out
+
+
+def _norm_url(worker: str) -> str:
+    if "://" not in worker:
+        worker = f"http://{worker}"
+    return worker.rstrip("/")
+
+
+def _get(url: str, timeout: float) -> Tuple[int, bytes]:
+    """GET returning (status, body); admin verdict endpoints answer 503
+    with a JSON body, which is a RESULT here, not an error."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def scrape_worker(worker: str, timeout: float = 5.0) -> Dict[str, Any]:
+    """One worker's merged scrape: varz records, statusz doc, health/
+    ready verdicts, raw prom text. ``error`` set (and the rest absent)
+    when the endpoint did not answer."""
+    url = _norm_url(worker)
+    doc: Dict[str, Any] = {"worker": worker, "url": url}
+    try:
+        _, varz = _get(f"{url}/varz", timeout)
+        doc["varz"] = json.loads(varz)
+        _, statusz = _get(f"{url}/statusz", timeout)
+        doc["statusz"] = json.loads(statusz)
+        code, health = _get(f"{url}/healthz", timeout)
+        doc["healthy"] = code == 200
+        doc["health"] = json.loads(health)
+        code, ready = _get(f"{url}/readyz", timeout)
+        doc["ready"] = code == 200
+        doc["readiness"] = json.loads(ready)
+        _, prom = _get(f"{url}/metrics", timeout)
+        doc["metrics_text"] = prom.decode("utf-8")
+        doc["metrics_samples"] = len(parse_prom_text(doc["metrics_text"]))
+    except Exception as e:
+        doc["error"] = f"{type(e).__name__}: {e}"
+    return doc
+
+
+def _series_value(varz: List[dict], name: str,
+                  agg: str = "sum") -> Optional[float]:
+    """Aggregate one metric family across its label sets (sum for
+    counters, max for gauges where the worst series is the story)."""
+    vals = [rec["value"] for rec in varz
+            if rec.get("name") == name and "value" in rec]
+    if not vals:
+        return None
+    return max(vals) if agg == "max" else sum(vals)
+
+
+#: the fleet table's metric rows: (label, family, per-worker agg,
+#: fleet agg) — counters sum across the fleet, gauges take the worst
+_METRIC_ROWS = [
+    ("serve requests", "alink_serve_requests_total", "sum", "sum"),
+    ("serve p99 (s)", "alink_serve_p99_seconds", "max", "max"),
+    ("queue depth", "alink_serve_queue_depth", "max", "max"),
+    ("shed", "alink_serve_shed_total", "sum", "sum"),
+    ("breaker fallbacks", "alink_serve_breaker_fallback_total",
+     "sum", "sum"),
+    ("model swaps", "alink_serve_model_swaps_total", "sum", "sum"),
+    ("slo breaches", "alink_slo_breaches_total", "sum", "sum"),
+    ("slo burn (max)", "alink_slo_burn_rate", "max", "max"),
+    ("slo alerts", "alink_slo_alerts_total", "sum", "sum"),
+    ("admin scrapes", "alink_admin_requests_total", "sum", "sum"),
+]
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v != v:  # NaN
+        return "nan"
+    if abs(v - round(v)) < 1e-9 and abs(v) < 1e15:
+        return f"{int(round(v)):,}"
+    return f"{v:.6g}"
+
+
+def fleet_report(scrapes: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The merged machine-readable fleet document (also what
+    ``fleet.json`` archives)."""
+    workers = []
+    for s in scrapes:
+        w: Dict[str, Any] = {"worker": s["worker"], "url": s["url"]}
+        if "error" in s:
+            w["error"] = s["error"]
+        else:
+            st = s.get("statusz") or {}
+            w.update({
+                "healthy": s["healthy"], "ready": s["ready"],
+                "name": st.get("name"), "pid": st.get("pid"),
+                "uptime_s": st.get("uptime_s"),
+                "metrics_samples": s.get("metrics_samples"),
+                "metrics": {fam: _series_value(s["varz"], fam, agg)
+                            for _, fam, agg, _ in _METRIC_ROWS},
+            })
+        workers.append(w)
+    up = [w for w in workers if "error" not in w]
+    agg: Dict[str, Any] = {
+        "workers": len(workers), "reachable": len(up),
+        "healthy": sum(1 for w in up if w["healthy"]),
+        "ready": sum(1 for w in up if w["ready"]),
+    }
+    for label, fam, _, fleet_agg in _METRIC_ROWS:
+        vals = [w["metrics"][fam] for w in up
+                if w["metrics"].get(fam) is not None]
+        agg[fam] = (None if not vals
+                    else (max(vals) if fleet_agg == "max" else sum(vals)))
+    return {"workers": workers, "aggregate": agg}
+
+
+def render_fleet(report: Dict[str, Any]) -> str:
+    """Per-worker columns + one fleet aggregate column, through the
+    run_report table renderer."""
+    rr = _load_sibling_tool("run_report")
+    workers = report["workers"]
+    agg = report["aggregate"]
+    headers = ["fleet"] + [w["worker"] for w in workers] + ["aggregate"]
+
+    def col(w: Dict[str, Any], label: str, fam: Optional[str]) -> str:
+        if "error" in w:
+            return "DOWN"
+        if fam is None:
+            if label == "healthz":
+                return "ok" if w["healthy"] else "503"
+            if label == "readyz":
+                return "ok" if w["ready"] else "503"
+            if label == "uptime (s)":
+                return _fmt(w.get("uptime_s"))
+            return str(w.get("name") or "-")
+        return _fmt(w["metrics"].get(fam))
+
+    rows: List[List[str]] = []
+    rows.append(["process"] + [col(w, "process", None) for w in workers]
+                + [f"{agg['reachable']}/{agg['workers']} up"])
+    rows.append(["healthz"] + [col(w, "healthz", None) for w in workers]
+                + [f"{agg['healthy']}/{agg['reachable']} ok"])
+    rows.append(["readyz"] + [col(w, "readyz", None) for w in workers]
+                + [f"{agg['ready']}/{agg['reachable']} ok"])
+    rows.append(["uptime (s)"] + [col(w, "uptime (s)", None)
+                                  for w in workers] + ["-"])
+    for label, fam, _, _fa in _METRIC_ROWS:
+        rows.append([label] + [col(w, label, fam) for w in workers]
+                    + [_fmt(agg.get(fam))])
+    out = ["== fleet scrape =="]
+    out.append(rr._table(headers, rows))
+    down = [w for w in workers if "error" in w]
+    for w in down:
+        out.append(f"  DOWN {w['worker']}: {w['error']}")
+    return "\n".join(out)
+
+
+def write_snapshot(out_dir: str, scrapes: List[Dict[str, Any]],
+                   report: Dict[str, Any]) -> None:
+    """The offline archive: one subdir per worker with the raw scrape
+    bodies, plus the merged fleet.json (the ``doctor.py --url DIR``
+    input shape)."""
+    os.makedirs(out_dir, exist_ok=True)
+    for i, s in enumerate(scrapes):
+        sub = os.path.join(out_dir, f"worker{i}_" +
+                           s["worker"].replace("://", "_")
+                           .replace("/", "_").replace(":", "_"))
+        os.makedirs(sub, exist_ok=True)
+        if "error" in s:
+            with open(os.path.join(sub, "error.txt"), "w") as f:
+                f.write(s["error"] + "\n")
+            continue
+        with open(os.path.join(sub, "varz.json"), "w") as f:
+            json.dump(s["varz"], f)
+        with open(os.path.join(sub, "statusz.json"), "w") as f:
+            json.dump(s["statusz"], f)
+        with open(os.path.join(sub, "metrics.prom"), "w") as f:
+            f.write(s["metrics_text"])
+    with open(os.path.join(out_dir, "fleet.json"), "w") as f:
+        json.dump(report, f, indent=2)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Scrape N alink_tpu admin endpoints into one fleet "
+                    "report")
+    ap.add_argument("workers", nargs="+",
+                    help="admin endpoints (host:port or http://host:port)")
+    ap.add_argument("--timeout", type=float, default=5.0,
+                    help="per-request scrape timeout seconds")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged fleet JSON instead of tables")
+    ap.add_argument("--snapshot", metavar="DIR",
+                    help="archive raw scrapes + fleet.json under DIR "
+                         "(replayable via doctor.py --url DIR)")
+    args = ap.parse_args(argv)
+
+    scrapes = [scrape_worker(w, args.timeout) for w in args.workers]
+    report = fleet_report(scrapes)
+    if args.snapshot:
+        write_snapshot(args.snapshot, scrapes, report)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_fleet(report))
+        if args.snapshot:
+            print(f"snapshot -> {args.snapshot}")
+    return 0 if report["aggregate"]["reachable"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -79,6 +79,14 @@ python tools/chaos_smoke.py
 # names itself.
 python tools/e2e_smoke.py
 
+# live-operations-plane smoke (ISSUE 16): the admin endpoint armed on
+# a PredictServer and the online DAG under a dispatch-error storm —
+# /healthz 503 while the real breaker is open and 200 after recovery,
+# the fast-window SLO burn alert fires (readyz 503) and clears, and
+# every mid-storm /metrics scrape parses with measured latency. Exits
+# 10 (its own code) so an observability regression names itself.
+python tools/adminz_smoke.py
+
 # docs freshness gate (ISSUE 15 satellite, VERDICT #2): the README's
 # machine-generated performance/serving tables must match a fresh
 # regeneration from the newest driver-captured BENCH dump, and the
