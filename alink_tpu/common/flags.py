@@ -642,6 +642,62 @@ FLAGS.register(
     parser=lambda raw: ("sync" if raw.strip().lower() == "sync"
                         else "double"),
     accessor="alink_tpu.serving.predictor.serve_swap_mode")
+# -- multi-tenant fleet (serving/fleet.py, ISSUE 17) -------------------------
+FLAGS.register(
+    "ALINK_TPU_FLEET_HBM_BUDGET", "int", 0,
+    "device-bytes budget for resident fleet tenant weights: cold "
+    "tenants LRU-evict over it and re-admit from the snapshot store "
+    "on their next request (0 = unlimited, no eviction)", "serving",
+    key_neutral="host-side residency policy: eviction drops/re-places "
+                "weight ARGUMENTS (re-admitted bitwise from the "
+                "snapshot store); the compiled programs are keyed on "
+                "geometry and never on which tenants are resident",
+    clamp=lambda n: max(0, n),
+    accessor="alink_tpu.serving.fleet.fleet_hbm_budget")
+FLAGS.register(
+    "ALINK_TPU_FLEET_LANES", "str", "",
+    "tenant-lane bucket set of the coalesced fleet programs, "
+    "comma-separated lane widths (unset = 4,16,64): a cross-tenant "
+    "dispatch pads its weight stack to the smallest covering lane "
+    "bucket", "serving",
+    key_neutral="selects WHICH lane width a dispatch pads to; the lane "
+                "width itself rides every coalesced program-cache key "
+                "(ServingPlan.program_key lanes dimension), so a "
+                "different lane set compiles new programs but can "
+                "never reuse a stale one",
+    accessor="alink_tpu.serving.fleet.fleet_lanes")
+FLAGS.register(
+    "ALINK_TPU_FLEET_TENANT_QUOTA", "int", 0,
+    "max in-flight requests per fleet tenant; exceeding it is a typed "
+    "admission rejection (TenantQuotaExceeded, shed reason 'quota') — "
+    "one tenant's storm cannot consume another tenant's admission "
+    "slots (0 = unlimited)", "serving",
+    key_neutral="host-side admission control per tenant; never read "
+                "at trace time",
+    clamp=lambda n: max(0, n),
+    accessor="alink_tpu.serving.fleet.fleet_tenant_quota")
+FLAGS.register(
+    "ALINK_TPU_FLEET_COALESCE", "bool", True,
+    "coalesce fleet batches across same-geometry tenants through the "
+    "lane-stacked programs (per-row tenant->lane weight gather); off = "
+    "per-tenant dispatch through the group's single-model programs — "
+    "bitwise-identical answers either way (tests/test_fleet.py)",
+    "serving",
+    key_neutral="routing between two program families that answer "
+                "bitwise-identically; each family keys its own cache "
+                "entries (the lanes dimension of ServingPlan."
+                "program_key), so a toggle can never reuse a stale "
+                "program",
+    accessor="alink_tpu.serving.fleet.fleet_coalesce_enabled")
+FLAGS.register(
+    "ALINK_TPU_FLEET_SNAPSHOT_DIR", "str", "",
+    "root directory of the per-tenant fleet model snapshot store (the "
+    "eviction/re-admission backing; empty = a process-lifetime temp "
+    "directory)", "serving",
+    key_neutral="host-side snapshot storage location; snapshots are "
+                "validated against the tenant group's geometry "
+                "signature on load, never read at trace time",
+    accessor="alink_tpu.serving.fleet.fleet_snapshot_dir")
 
 # -- online-learning DAG (alink_tpu/online/, ISSUE 15) -----------------------
 # Every ALINK_TPU_E2E_* flag is host-side DAG runtime policy — stage

@@ -213,6 +213,31 @@ class SloContract:
         self._breach(v)
         return v
 
+    def observe_tenant_p99(self, tenant: str, p99_s: Optional[float],
+                           window: int) -> Optional[SloVerdict]:
+        """Per-tenant p99 clause for the multi-tenant fleet (ISSUE 17).
+
+        Same bound as the global ``serve_p99`` clause — the fleet's
+        promise is that EVERY tenant sees single-model latency, so one
+        contract bound fans out to per-tenant clauses named
+        ``serve_p99[<tenant>]``. Each tenant gets its own clause state
+        (gauges + burn window), so one noisy tenant burning budget is
+        attributable on /statusz instead of vanishing into the fleet
+        aggregate."""
+        if self.serve_p99_s is None or p99_s is None:
+            return None
+        slo = f"serve_p99[{tenant}]"
+        self._clause_state(slo, float(p99_s), float(self.serve_p99_s))
+        if p99_s <= self.serve_p99_s:
+            return None
+        v = SloVerdict(slo, False, float(p99_s),
+                       float(self.serve_p99_s),
+                       f"window {window}: tenant {tenant!r} serving p99 "
+                       f"{p99_s * 1e3:.1f} ms > bound "
+                       f"{self.serve_p99_s * 1e3:.1f} ms")
+        self._breach(v)
+        return v
+
     def observe_swap(self, staleness_s: float,
                      version: int) -> Optional[SloVerdict]:
         """Per-swap staleness check (emission -> installed)."""

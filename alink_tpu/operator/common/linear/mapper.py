@@ -248,13 +248,15 @@ class LinearModelMapper(ModelMapper):
         # ONE manifest psum per dispatch (serving/sharded.py).
         from ....serving.sharded import (linear_input_specs,
                                          linear_partition_rules,
-                                         make_linear_device_fns)
+                                         make_linear_device_fns,
+                                         make_linear_fleet_fns)
         return ServingKernel(signature=signature, model_arrays=model_arrays,
                              encode=encode, device_fns=device_fns,
                              decode=decode, model_names=("w", "b"),
                              partition_rules=linear_partition_rules(),
                              input_specs=linear_input_specs,
-                             make_sharded_fns=make_linear_device_fns)
+                             make_sharded_fns=make_linear_device_fns,
+                             make_fleet_fns=make_linear_fleet_fns)
 
     def get_output_schema(self) -> TableSchema:
         m = self.model

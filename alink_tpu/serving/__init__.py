@@ -38,25 +38,42 @@ that layer rebuilt TPU-first:
   one manifest psum per dispatch, bitwise-identical answers at every
   mesh size); ``ALINK_TPU_SERVE_REPLICAS`` fans ``PredictServer``
   batches across the chips as independent single-device replicas.
+* multi-tenant fleet (``fleet.py``) — :class:`ModelRegistry` groups
+  tenants by serving-kernel geometry (one :class:`ServingPlan` per
+  group) so same-geometry models share compiled bucket programs;
+  :class:`FleetServer` routes per-request tenant ids, coalesces
+  cross-tenant batches through lane-stacked programs (bitwise no-op
+  vs per-tenant dispatch), LRU-evicts cold tenants' device weights
+  under ``ALINK_TPU_FLEET_HBM_BUDGET`` with snapshot-store
+  re-admission, and isolates tenants with quotas + per-tenant
+  breakers; one ``ModelStreamFeeder`` multiplexes per-tenant swap
+  streams via :meth:`FleetServer.feeder_target`.
 
 See docs/serving.md for the bucket/padding contract, swap atomicity,
 admission control, and load-generator usage.
 """
 
+from .plan import ServingPlan
 from .predictor import (CompiledPredictor, ServingKernel,
                         serve_buckets, serve_compiled_enabled)
 from .server import (DeviceWeightsFeeder, ModelStreamFeeder, PredictServer,
                      RequestFuture)
+from .fleet import (FleetServer, ModelRegistry, fleet_coalesce_enabled,
+                    fleet_hbm_budget, fleet_lanes, fleet_tenant_quota)
 from .loadgen import LoadGenerator, LoadReport, percentile, serial_qps
 from .resilience import (CircuitBreaker, DeadlineExceeded, ReplicaCrashed,
-                         RequestCancelled, serve_breaker_enabled)
+                         RequestCancelled, TenantQuotaExceeded,
+                         serve_breaker_enabled)
 from .sharded import serve_replicas, serve_sharded_enabled, serving_mesh
 
 __all__ = [
-    "CompiledPredictor", "ServingKernel", "PredictServer", "RequestFuture",
-    "ModelStreamFeeder", "DeviceWeightsFeeder", "LoadGenerator",
+    "CompiledPredictor", "ServingKernel", "ServingPlan", "PredictServer",
+    "RequestFuture", "ModelStreamFeeder", "DeviceWeightsFeeder",
+    "FleetServer", "ModelRegistry", "LoadGenerator",
     "LoadReport", "percentile", "serial_qps", "serve_buckets",
     "serve_compiled_enabled", "serve_replicas", "serve_sharded_enabled",
     "serving_mesh", "CircuitBreaker", "DeadlineExceeded", "ReplicaCrashed",
-    "RequestCancelled", "serve_breaker_enabled",
+    "RequestCancelled", "TenantQuotaExceeded", "serve_breaker_enabled",
+    "fleet_coalesce_enabled", "fleet_hbm_budget", "fleet_lanes",
+    "fleet_tenant_quota",
 ]

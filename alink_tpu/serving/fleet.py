@@ -1,0 +1,1216 @@
+"""Multi-tenant model-fleet serving (ISSUE 17 tentpole).
+
+The reference's platform role is many scenario models behind one
+cluster (per-country FTRL arms, per-surface trees, A/B variants); its
+``LocalPredictor``/``ModelMapperAdapter`` layer instantiates per model
+because the JVM cannot share a compiled program across them. Here it
+can: weights are program ARGUMENTS (PR 10), so N same-geometry models
+share ONE compiled bucket program. This module is the registry + server
+that turns that into a fleet:
+
+* :class:`ModelRegistry` keys tenants by serving-kernel GEOMETRY — the
+  :class:`~alink_tpu.serving.plan.ServingPlan` ``geometry_key()``
+  (model signature x encoding x dtype x bucket set) — so every tenant
+  in a geometry group serves through the group's shared programs;
+* :class:`FleetServer` routes per-request tenant ids and COALESCES
+  batches across tenants of one group: the group's weight arrays stack
+  along a leading tenant-lane axis (the tuning ``(points,)`` carry-lane
+  idiom) and each request row gathers its own tenant's weights via an
+  int32 lane vector. The stack is the group's cached LANE TABLE —
+  every resident member at a stable slot, rebuilt only when a member
+  mutates — so steady-state dispatches never pay per-batch stacking.
+  Per-row arithmetic and reduction order are IDENTICAL to the
+  single-model programs (``ServingKernel.make_fleet_fns`` contract),
+  so coalescing is a bitwise no-op vs per-tenant dispatch —
+  tests/test_fleet.py pins it;
+* cold tenants' device weights are LRU-EVICTED under the
+  ``ALINK_TPU_FLEET_HBM_BUDGET`` device-bytes budget and re-admitted
+  transparently from the PR-2 snapshot store (``common/checkpoint.py``)
+  on their next request — bitwise-identically (the ``.npy`` round trip
+  is exact), and an eviction can never race an in-flight swap (the
+  evictor only takes tenant locks it can get without blocking);
+* per-tenant isolation rides the PR-14 resilience machinery: admission
+  quotas (:class:`~alink_tpu.serving.resilience.TenantQuotaExceeded` —
+  one tenant's storm fills its own slots, everyone else's admission is
+  untouched), per-request deadlines with typed shedding, and a
+  per-(tenant, model-version) :class:`~alink_tpu.serving.resilience.
+  CircuitBreaker` that degrades ONLY the broken tenant to its host
+  mapper while its lane is simply left out of the coalesced batch;
+* per-tenant swap streams multiplex through ONE
+  :class:`~alink_tpu.serving.server.ModelStreamFeeder`:
+  :meth:`FleetServer.feeder_target` adapts the fleet to the feeder's
+  ``swap_model`` contract with a tenant router, so a merged snapshot
+  stream hot-swaps each tenant independently with zero torn responses.
+
+Observability (ISSUE 16 ops plane): ``alink_fleet_{tenants,
+evictions_total,readmissions_total,coalesced_batches_total}`` metrics,
+per-tenant rows on adminz ``/statusz``, a fleet section in
+``tools/fleetz.py`` aggregates and a ``tools/doctor.py`` fleet verdict.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..common.adminz import acquire_admin, release_admin
+from ..common.checkpoint import load_latest_validated, save_checkpoint
+from ..common.faults import FaultInjected, maybe_crash
+from ..common.metrics import get_registry, metrics_enabled
+from ..common.mtable import MTable
+from ..common.tracing import trace_complete, trace_instant
+from ..operator.stream.prefetch import _Channel, _EMPTY, _SENTINEL
+from .loadgen import percentile as _percentile
+from .plan import ServingPlan
+from .predictor import (ServingKernel, record_serve_fallback, serve_buckets,
+                        serve_min_fill, serve_queue_depth, serve_window_s)
+from .resilience import (OPEN, CircuitBreaker, DeadlineExceeded,
+                         ReplicaCrashed, RequestCancelled,
+                         TenantQuotaExceeded, record_shed,
+                         serve_breaker_enabled)
+from .server import RequestFuture
+
+_P99_RING = 4096
+_TENANT_RING = 256      # per-tenant rolling latency window (SLO clauses)
+
+__all__ = [
+    "FleetServer", "ModelRegistry", "fleet_coalesce_enabled",
+    "fleet_hbm_budget", "fleet_lanes", "fleet_snapshot_dir",
+    "fleet_tenant_quota",
+]
+
+
+# -- flag accessors (common/flags.py registry) ------------------------------
+
+def fleet_hbm_budget() -> int:
+    """``ALINK_TPU_FLEET_HBM_BUDGET``: device-bytes budget for resident
+    tenant weights; 0 = unlimited (no eviction)."""
+    from ..common.flags import flag_value
+    return int(flag_value("ALINK_TPU_FLEET_HBM_BUDGET", 0))
+
+
+def fleet_lanes(default: Sequence[int] = (4, 16, 64)) -> Tuple[int, ...]:
+    """``ALINK_TPU_FLEET_LANES``: the tenant-lane bucket set of the
+    coalesced programs (comma-separated, like the row buckets): a
+    dispatch spanning k tenants pads its weight stack to the smallest
+    covering lane bucket, so a handful of compiled lane widths cover
+    any tenant mix."""
+    from ..common.flags import flag_value
+    raw = flag_value("ALINK_TPU_FLEET_LANES", "")
+    if not raw:
+        return tuple(default)
+    out = sorted({int(p) for p in str(raw).split(",") if p.strip()
+                  if int(p) > 0})
+    return tuple(out) or tuple(default)
+
+
+def fleet_tenant_quota() -> int:
+    """``ALINK_TPU_FLEET_TENANT_QUOTA``: max in-flight requests per
+    tenant; 0 = unlimited. Exceeding it is a typed admission rejection
+    (:class:`TenantQuotaExceeded`, shed reason ``"quota"``)."""
+    from ..common.flags import flag_value
+    return int(flag_value("ALINK_TPU_FLEET_TENANT_QUOTA", 0))
+
+
+def fleet_coalesce_enabled() -> bool:
+    """``ALINK_TPU_FLEET_COALESCE``: cross-tenant batch coalescing
+    through the lane-stacked programs. Off = per-tenant dispatch
+    through the group's single-model programs (bitwise-identical
+    answers either way — that is the ``make_fleet_fns`` contract)."""
+    from ..common.flags import flag_value
+    return bool(flag_value("ALINK_TPU_FLEET_COALESCE", True))
+
+
+def fleet_snapshot_dir() -> str:
+    """``ALINK_TPU_FLEET_SNAPSHOT_DIR``: root of the per-tenant model
+    snapshot store (the eviction/re-admission backing). Empty = a
+    process-lifetime temp directory."""
+    from ..common.flags import flag_value
+    return str(flag_value("ALINK_TPU_FLEET_SNAPSHOT_DIR", ""))
+
+
+def _tenant_dirname(tid: str) -> str:
+    """Filesystem-safe per-tenant snapshot subdirectory name."""
+    return "".join(c if (c.isalnum() or c in "._-") else "_"
+                   for c in str(tid)) or "_"
+
+
+# -- registry ---------------------------------------------------------------
+
+class _Tenant:
+    """One registered model: host mapper (always resident — it is the
+    breaker fallback and the decode authority), latest kernel, device
+    weights (``None`` while evicted), LRU stamp and counters. ``lock``
+    serializes swap vs eviction vs re-admission for THIS tenant."""
+
+    __slots__ = ("tid", "mapper", "kernel", "version", "lock",
+                 "device_arrays", "nbytes", "last_used", "snap_dir",
+                 "requests", "failed", "shed", "evictions",
+                 "readmissions", "swaps", "latencies")
+
+    def __init__(self, tid: str, mapper, kernel: ServingKernel,
+                 snap_dir: str):
+        self.tid = tid
+        self.mapper = mapper
+        self.kernel = kernel
+        self.version = 1
+        self.lock = threading.Lock()
+        self.device_arrays: Optional[Tuple] = None
+        self.nbytes = 0
+        self.last_used = 0
+        self.snap_dir = snap_dir
+        self.requests = 0
+        self.failed = 0
+        self.shed = 0
+        self.evictions = 0
+        self.readmissions = 0
+        self.swaps = 0
+        self.latencies: deque = deque(maxlen=_TENANT_RING)
+
+
+class _GeometryGroup:
+    """One serving geometry: the shared compiled-program cache of every
+    tenant whose :class:`ServingPlan` is equal. ``archetype`` is the
+    first registered kernel — its ``device_fns``/``make_fleet_fns`` are
+    version-independent pure functions of ``(model_arrays, *encoded)``,
+    which is exactly why tenants can share them (the PR-10 contract)."""
+
+    def __init__(self, plan: ServingPlan, archetype: ServingKernel):
+        self.plan = plan
+        self.archetype = archetype
+        self.fleet_fns = (archetype.make_fleet_fns()
+                          if archetype.make_fleet_fns is not None else None)
+        self.tenants = 0
+        self._lock = threading.Lock()
+        self._programs: Dict[Tuple, Callable] = {}
+        self.hits = 0
+        self.misses = 0
+        # the coalesced lane table: every resident member stacked once
+        # along the lane axis with a stable slot per tenant, reused by
+        # every dispatch until a member mutates (``bump_lanes``).
+        # ``(L, {tid: slot}, stacked_arrays)`` or None.
+        self.lane_stamp = 0
+        self._lane_cache: Optional[Tuple] = None
+
+    def bump_lanes(self) -> None:
+        """Invalidate the lane table — called by the registry on ANY
+        member mutation (register, swap, evict, re-admit), so a cached
+        stack can never serve stale or foreign weights."""
+        with self._lock:
+            self.lane_stamp += 1
+            self._lane_cache = None
+
+    def program(self, kind: str, bucket: int, trailing: Tuple,
+                lanes: Optional[int] = None) -> Callable:
+        """The compiled program for (kind, bucket, trailing shapes,
+        lane width): ``lanes=None`` is the single-model program (the
+        archetype's ``device_fns``), an int is the lane-stacked
+        coalesced twin. Every dimension rides ``plan.program_key`` —
+        a cache hit can never serve a stale program."""
+        key = self.plan.program_key(kind, bucket, trailing, lanes=lanes)
+        prog = self._programs.get(key)
+        if prog is not None:
+            self.hits += 1
+            return prog
+        import jax
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is None:
+                self.misses += 1
+                fn = (self.archetype.device_fns[kind] if lanes is None
+                      else self.fleet_fns[kind])
+                prog = self._programs[key] = jax.jit(fn)
+            else:
+                self.hits += 1
+        return prog
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"tenants": self.tenants, "programs": len(self._programs),
+                    "hits": self.hits, "misses": self.misses}
+
+
+class ModelRegistry:
+    """Tenant registry: geometry grouping, device-weight residency under
+    the HBM budget, and the snapshot store behind eviction/re-admission.
+
+    ``register(tenant_id, mapper)`` takes a LOADED mapper implementing
+    ``serving_kernel()``; the tenant's weights go on device and a
+    snapshot lands in the store (``<snapshot_dir>/<tenant>/``) with the
+    plan's ``swap_signature()`` as the validation signature — a
+    re-admission can never resurrect weights of a different geometry.
+
+    Locking: ``tenant.lock`` (outer) serializes swap/evict/re-admit per
+    tenant; the registry lock (inner) covers only the tenant map, the
+    LRU clock and the byte ledger. The evictor acquires tenant locks
+    ``blocking=False`` ONLY — a tenant mid-swap (or mid-re-admission)
+    is simply skipped this round, so eviction can never race an
+    in-flight swap.
+    """
+
+    def __init__(self, snapshot_dir: Optional[str] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 hbm_budget: Optional[int] = None, name: str = "fleet"):
+        self.name = name
+        d = snapshot_dir or fleet_snapshot_dir()
+        if not d:
+            d = tempfile.mkdtemp(prefix="alink-fleet-")
+        self.snapshot_dir = d
+        self._buckets = tuple(sorted({int(b) for b in buckets
+                                      if int(b) > 0})) \
+            if buckets else serve_buckets()
+        self._budget = fleet_hbm_budget() if hbm_budget is None \
+            else int(hbm_budget)
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, _Tenant] = {}
+        self._groups: Dict[Tuple, _GeometryGroup] = {}
+        self._group_of: Dict[str, _GeometryGroup] = {}
+        self._clock = 0
+        self._resident_bytes = 0
+        self._evictions = 0
+        self._readmissions = 0
+
+    # -- registration / swap -------------------------------------------
+    def _plan_for(self, kernel: ServingKernel) -> ServingPlan:
+        # fleet v1 is single-device (replica/sharded fleets ride ROADMAP
+        # item 5); the plan still carries sharded/mesh_fp so the
+        # geometry key stays honest when that lands
+        return ServingPlan(signature=kernel.signature,
+                           buckets=self._buckets)
+
+    def register(self, tenant_id: str, mapper) -> ServingPlan:
+        """Admit one tenant: geometry-group it, place its weights,
+        snapshot it, and evict over budget. Idempotent registration is
+        an error — re-loading a tenant's model is :meth:`swap_tenant`."""
+        tid = str(tenant_id)
+        kernel = mapper.serving_kernel()
+        if kernel is None:
+            raise TypeError(
+                f"tenant {tid!r}: {type(mapper).__name__} does not "
+                f"provide a serving kernel")
+        plan = self._plan_for(kernel)
+        tenant = _Tenant(tid, mapper, kernel,
+                         os.path.join(self.snapshot_dir,
+                                      _tenant_dirname(tid)))
+        with self._lock:
+            if tid in self._tenants:
+                raise ValueError(f"tenant {tid!r} is already registered "
+                                 f"(swap_tenant replaces its model)")
+            group = self._groups.get(plan.geometry_key())
+            if group is None:
+                group = self._groups[plan.geometry_key()] = \
+                    _GeometryGroup(plan, kernel)
+                if group.fleet_fns is None:
+                    record_serve_fallback(type(mapper).__name__,
+                                          "no-fleet-kernel",
+                                          "tenants of this geometry serve "
+                                          "per-tenant (uncoalesced)")
+            group.tenants += 1
+            self._tenants[tid] = tenant
+            self._group_of[tid] = group
+        self._snapshot(tenant, plan)
+        self._admit_arrays(tenant, kernel.model_arrays)
+        self._evict_to_budget(keep=tid)
+        if metrics_enabled():
+            get_registry().set_gauge("alink_fleet_tenants",
+                                     len(self._tenants),
+                                     {"fleet": self.name})
+        return plan
+
+    def swap_tenant(self, tenant_id: str, model_table: MTable) -> int:
+        """Hot-swap one tenant's model (the predictor's double-buffer
+        contract, per tenant): mapper build, kernel extraction, device
+        placement and the snapshot all happen under the TENANT's lock
+        on the caller's thread, then the references flip together; a
+        coalesced dispatch in flight keeps the arrays it already
+        gathered. A snapshot whose geometry differs from the tenant's
+        group is REFUSED (poisoned — a different geometry would need
+        new programs and a new group)."""
+        t = self._tenant(tenant_id)
+        group = self._group_of[t.tid]
+        with t.lock:
+            maybe_crash("serve.swap")   # the feeders' chaos site
+            base = t.mapper
+            mapper = type(base)(model_table.schema, base.data_schema,
+                                base.params)
+            mapper.load_model(model_table)
+            kernel = mapper.serving_kernel()
+            plan = self._plan_for(kernel)
+            if plan.geometry_key() != group.plan.geometry_key():
+                raise ValueError(
+                    f"tenant {t.tid!r} swap geometry mismatch: "
+                    f"{plan.swap_signature()} vs the tenant's group "
+                    f"{group.plan.swap_signature()} — a different "
+                    f"geometry must register as a new tenant")
+            t.version += 1
+            t.swaps += 1
+            save_checkpoint(t.snap_dir, t.version,
+                            [np.asarray(a) for a in kernel.model_arrays],
+                            meta={"signature": plan.swap_signature(),
+                                  "tenant": t.tid},
+                            scope="fleet", keep_last=2)
+            was = t.nbytes if t.device_arrays is not None else 0
+            import jax
+            arrays = tuple(jax.device_put(a) for a in kernel.model_arrays)
+            nbytes = sum(int(a.nbytes) for a in arrays)
+            # the flip: mapper/kernel/arrays move together under the lock
+            t.mapper, t.kernel = mapper, kernel
+            t.device_arrays, t.nbytes = arrays, nbytes
+            with self._lock:
+                self._resident_bytes += nbytes - was
+            version = t.version
+        group.bump_lanes()
+        self._evict_to_budget(keep=t.tid)
+        if metrics_enabled():
+            reg = get_registry()
+            reg.inc("alink_serve_model_swaps_total", 1,
+                    {"predictor": f"{self.name}:{t.tid}"})
+        return version
+
+    def _snapshot(self, t: _Tenant, plan: ServingPlan) -> None:
+        save_checkpoint(t.snap_dir, t.version,
+                        [np.asarray(a) for a in t.kernel.model_arrays],
+                        meta={"signature": plan.swap_signature(),
+                              "tenant": t.tid},
+                        scope="fleet", keep_last=2)
+
+    def _admit_arrays(self, t: _Tenant, host_arrays: Sequence) -> None:
+        import jax
+        with t.lock:
+            if t.device_arrays is not None:
+                return
+            arrays = tuple(jax.device_put(a) for a in host_arrays)
+            t.device_arrays = arrays
+            t.nbytes = sum(int(a.nbytes) for a in arrays)
+            with self._lock:
+                self._resident_bytes += t.nbytes
+        self._group_of[t.tid].bump_lanes()
+
+    # -- residency / LRU ------------------------------------------------
+    def _tenant(self, tenant_id: str) -> _Tenant:
+        t = self._tenants.get(str(tenant_id))
+        if t is None:
+            raise KeyError(f"unknown tenant {tenant_id!r} (register it "
+                           f"before serving it)")
+        return t
+
+    def arrays_for(self, tenant_id: str) -> Tuple:
+        """The tenant's device weights, touching its LRU stamp; an
+        EVICTED tenant re-admits here from the snapshot store — bitwise
+        (``.npy`` round trip), geometry-validated against the group
+        plan's ``swap_signature()``, transparently to the caller."""
+        t = self._tenant(tenant_id)
+        with self._lock:
+            self._clock += 1
+            t.last_used = self._clock
+        arrays = t.device_arrays
+        if arrays is not None:
+            return arrays
+        group = self._group_of[t.tid]
+        with t.lock:
+            if t.device_arrays is not None:    # raced another re-admit
+                return t.device_arrays
+            loaded = load_latest_validated(
+                t.snap_dir, group.plan.swap_signature(),
+                scope="fleet", what="fleet tenant model")
+            if loaded is None:
+                raise RuntimeError(
+                    f"tenant {t.tid!r} was evicted and its snapshot "
+                    f"store {t.snap_dir!r} holds no valid snapshot")
+            payload, _meta = loaded
+            import jax
+            arrays = tuple(jax.device_put(np.asarray(a)) for a in payload)
+            t.device_arrays = arrays
+            t.nbytes = sum(int(a.nbytes) for a in arrays)
+            t.readmissions += 1
+            with self._lock:
+                self._resident_bytes += t.nbytes
+                self._readmissions += 1
+        group.bump_lanes()
+        trace_instant("fleet.readmit", cat="serve",
+                      args={"tenant": t.tid, "bytes": t.nbytes})
+        if metrics_enabled():
+            get_registry().inc("alink_fleet_readmissions_total", 1,
+                               {"fleet": self.name})
+        self._evict_to_budget(keep=t.tid)
+        return arrays
+
+    def _evict_to_budget(self, keep: Optional[str] = None) -> int:
+        """Drop cold tenants' device weights until the ledger fits the
+        budget (0 = unlimited). Candidates go oldest-``last_used``
+        first; ``keep`` (the tenant being admitted) and any tenant
+        whose lock is HELD (a swap or re-admission in flight) are
+        skipped — the no-race rule. References are dropped, never
+        ``delete()``d: a coalesced dispatch that already gathered the
+        arrays keeps them alive until it lands."""
+        if self._budget <= 0:
+            return 0
+        evicted = 0
+        while True:
+            with self._lock:
+                if self._resident_bytes <= self._budget:
+                    break
+                candidates = sorted(
+                    (t for t in self._tenants.values()
+                     if t.device_arrays is not None and t.tid != keep),
+                    key=lambda t: t.last_used)
+            if not candidates:
+                break
+            progressed = False
+            for t in candidates:
+                if not t.lock.acquire(blocking=False):
+                    continue            # mid-swap / mid-re-admit: skip
+                try:
+                    if t.device_arrays is None:
+                        continue
+                    t.device_arrays = None
+                    t.evictions += 1
+                    evicted += 1
+                    progressed = True
+                    with self._lock:
+                        self._resident_bytes -= t.nbytes
+                        self._evictions += 1
+                        done = self._resident_bytes <= self._budget
+                finally:
+                    t.lock.release()
+                self._group_of[t.tid].bump_lanes()
+                trace_instant("fleet.evict", cat="serve",
+                              args={"tenant": t.tid, "bytes": t.nbytes})
+                if metrics_enabled():
+                    get_registry().inc("alink_fleet_evictions_total", 1,
+                                       {"fleet": self.name})
+                if done:
+                    break
+            if not progressed:
+                break                   # everything else is locked
+        if evicted and metrics_enabled():
+            get_registry().set_gauge("alink_fleet_resident_bytes",
+                                     self._resident_bytes,
+                                     {"fleet": self.name})
+        return evicted
+
+    def touch(self, tenant_ids: Sequence[str]) -> None:
+        """LRU-touch without residency work: the coalesced fast path
+        serves from the group's cached lane table and must still mark
+        its tenants hot, or the evictor would read them as cold."""
+        with self._lock:
+            for tid in tenant_ids:
+                t = self._tenants.get(str(tid))
+                if t is not None:
+                    self._clock += 1
+                    t.last_used = self._clock
+
+    # -- lookups / stats ------------------------------------------------
+    def tenant_ids(self) -> List[str]:
+        with self._lock:
+            return list(self._tenants)
+
+    def group_tenants(self, group: _GeometryGroup) -> List[_Tenant]:
+        """Every tenant of ``group`` (the lane-table rebuild scan)."""
+        with self._lock:
+            return [t for tid, t in self._tenants.items()
+                    if self._group_of[tid] is group]
+
+    def tenant(self, tenant_id: str) -> _Tenant:
+        return self._tenant(tenant_id)
+
+    def group_of(self, tenant_id: str) -> _GeometryGroup:
+        self._tenant(tenant_id)
+        return self._group_of[str(tenant_id)]
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        return self._buckets
+
+    @property
+    def hbm_budget(self) -> int:
+        return self._budget
+
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return self._resident_bytes
+
+    def stats(self) -> dict:
+        with self._lock:
+            tenants = list(self._tenants.values())
+            groups = list(self._groups.values())
+            resident = self._resident_bytes
+            ev, re = self._evictions, self._readmissions
+        return {
+            "tenants": len(tenants),
+            "geometry_groups": len(groups),
+            "resident": sum(1 for t in tenants
+                            if t.device_arrays is not None),
+            "resident_bytes": resident,
+            "hbm_budget": self._budget,
+            "evictions": ev, "readmissions": re,
+            "programs": sum(g.stats()["programs"] for g in groups),
+        }
+
+
+# -- fleet server -----------------------------------------------------------
+
+class _FleetRequest(RequestFuture):
+    __slots__ = ("tenant",)
+
+    def __init__(self, tenant: str, row: Tuple,
+                 deadline_s: Optional[float] = None):
+        super().__init__(row, deadline_s=deadline_s)
+        self.tenant = tenant
+
+
+class _FleetSwapTarget:
+    """Adapter exposing the :class:`~alink_tpu.serving.server.
+    ModelStreamFeeder` ``swap_model`` contract over the fleet: ONE
+    feeder drains a MERGED multi-tenant snapshot stream and
+    ``tenant_of(model_table)`` routes each snapshot to its tenant —
+    per-tenant swap streams multiplexed through one feeder. ``swaps``
+    records ``(tenant, version, model_table)`` so a bench/test can
+    re-validate per-tenant responses against the exact model set."""
+
+    def __init__(self, registry: ModelRegistry,
+                 tenant_of: Callable[[MTable], str]):
+        self._registry = registry
+        self._tenant_of = tenant_of
+        self.swaps: List[Tuple[str, int, MTable]] = []
+        self._lock = threading.Lock()
+
+    def swap_model(self, model_table: MTable) -> int:
+        tenant = str(self._tenant_of(model_table))
+        version = self._registry.swap_tenant(tenant, model_table)
+        with self._lock:
+            self.swaps.append((tenant, version, model_table))
+        return version
+
+
+class FleetServer:
+    """Micro-batching fleet front end over a :class:`ModelRegistry`.
+
+    One admission channel, one supervised serving loop: each drained
+    batch sheds deadline/cancelled requests, splits by tenant, and
+    dispatches per GEOMETRY GROUP — tenants of one group coalesce into
+    one lane-stacked program execution (when the kernel provides
+    ``make_fleet_fns`` and ``ALINK_TPU_FLEET_COALESCE`` is on),
+    everything else serves per tenant through the group's single-model
+    programs. Both paths answer bitwise-identically.
+    """
+
+    def __init__(self, registry: ModelRegistry,
+                 max_batch: Optional[int] = None,
+                 window_s: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 min_fill: Optional[int] = None,
+                 name: str = "fleet"):
+        self.registry = registry
+        self.name = name
+        self.max_batch = int(max_batch) if max_batch \
+            else registry.buckets[-1]
+        self.window_s = serve_window_s() if window_s is None \
+            else float(window_s)
+        self.min_fill = serve_min_fill() if min_fill is None \
+            else max(1, int(min_fill))
+        depth = serve_queue_depth() if queue_depth is None \
+            else int(queue_depth)
+        self._quota = fleet_tenant_quota()
+        self._ch = _Channel(max(1, depth), gauge_label=name)
+        self._closed = threading.Event()
+        self._stats_lock = threading.Lock()
+        self._requests = 0
+        self._failed = 0
+        self._batches = 0
+        self._coalesced = 0
+        self._uncoalesced = 0
+        self._shed = 0
+        self._fallback_batches = 0
+        self._respawns = 0
+        self._quarantined = 0
+        self._lane_rebuilds = 0
+        self._latencies: deque = deque(maxlen=_P99_RING)
+        self._inflight: Dict[str, int] = {}
+        self._inflight_lock = threading.Lock()
+        # per-tenant breakers: {tenant: (version, CircuitBreaker)} — a
+        # swap retires the old version's breaker (totals carry over)
+        self._breaker_lock = threading.Lock()
+        self._breakers: Dict[str, Tuple[int, CircuitBreaker]] = {}
+        self._breaker_totals = {"opens": 0, "reopens": 0, "probes": 0}
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"alink-fleet-{name}")
+        self._thread.start()
+        self._admin = acquire_admin(name)
+        if self._admin is not None:
+            self._admin.add_source(f"fleet:{name}", self._readiness)
+            self._admin.add_status(f"fleet:{name}", self.status)
+
+    # -- submission (any thread) ---------------------------------------
+    def submit(self, tenant_id: str, row: Tuple,
+               deadline_s: Optional[float] = None) -> RequestFuture:
+        """Enqueue one request for ``tenant_id``. Admission-time
+        isolation: an unknown tenant and a tenant over its in-flight
+        quota are SYNCHRONOUS typed rejections (``KeyError`` /
+        :class:`TenantQuotaExceeded`) — they never consume channel
+        slots another tenant could use. A full channel blocks
+        (backpressure), deadlines shed in the loop (typed, pre-
+        dispatch), exactly like :class:`PredictServer`."""
+        if self._closed.is_set():
+            raise RuntimeError(f"FleetServer {self.name!r} is closed")
+        tid = str(tenant_id)
+        self.registry.tenant(tid)            # typed KeyError if unknown
+        if self._quota > 0:
+            with self._inflight_lock:
+                n = self._inflight.get(tid, 0)
+                if n >= self._quota:
+                    with self._stats_lock:
+                        self._shed += 1
+                    t = self.registry.tenant(tid)
+                    t.shed += 1
+                    record_shed(self.name, "quota")
+                    raise TenantQuotaExceeded(tid, n, self._quota)
+                self._inflight[tid] = n + 1
+        fut = _FleetRequest(tid, tuple(row), deadline_s=deadline_s)
+        if not self._ch.put(fut):
+            self._release_slot(tid)
+            raise RuntimeError(f"FleetServer {self.name!r} is closed")
+        return fut
+
+    def predict(self, tenant_id: str, row: Tuple,
+                timeout: Optional[float] = None,
+                deadline_s: Optional[float] = None) -> Tuple:
+        return self.submit(tenant_id, row,
+                           deadline_s=deadline_s).result(timeout)
+
+    def swap_tenant(self, tenant_id: str, model_table: MTable) -> int:
+        return self.registry.swap_tenant(tenant_id, model_table)
+
+    def feeder_target(self, tenant_of: Callable[[MTable], str]
+                      ) -> _FleetSwapTarget:
+        """The multiplexing adapter: hand this to ONE
+        :class:`~alink_tpu.serving.server.ModelStreamFeeder` as its
+        ``server`` and every snapshot of the merged stream hot-swaps
+        the tenant ``tenant_of(model_table)`` names."""
+        return _FleetSwapTarget(self.registry, tenant_of)
+
+    def _release_slot(self, tid: str) -> None:
+        if self._quota > 0:
+            with self._inflight_lock:
+                n = self._inflight.get(tid, 1) - 1
+                if n <= 0:
+                    self._inflight.pop(tid, None)
+                else:
+                    self._inflight[tid] = n
+
+    # -- the supervised serving loop ------------------------------------
+    def _run(self) -> None:
+        backoff = 0.01
+        while True:
+            inflight: List[_FleetRequest] = []
+            try:
+                self._loop(inflight)
+                return
+            except BaseException as e:
+                quarantined = [f for f in inflight if not f.done()]
+                for f in quarantined:
+                    f.set_exception(ReplicaCrashed(0, e))
+                    self._release_slot(f.tenant)
+                with self._stats_lock:
+                    self._failed += len(quarantined)
+                    self._quarantined += len(quarantined)
+                    self._respawns += 1
+                trace_instant("fleet.respawn", cat="serve",
+                              args={"server": self.name,
+                                    "quarantined": len(quarantined),
+                                    "error": type(e).__name__})
+                if metrics_enabled():
+                    get_registry().inc("alink_serve_loop_respawns_total",
+                                       1, {"server": self.name})
+                if not isinstance(e, Exception):
+                    raise
+                time.sleep(backoff)
+                backoff = min(0.5, backoff * 2)
+
+    def _loop(self, inflight: List[_FleetRequest]) -> None:
+        while True:
+            del inflight[:]
+            first = self._ch.get()
+            if first is _SENTINEL:
+                return
+            inflight.append(first)
+            deadline = None
+            closing = False
+            while len(inflight) < self.max_batch:
+                got = self._ch.drain(self.max_batch - len(inflight))
+                if got:
+                    inflight.extend(got)
+                    continue
+                if len(inflight) >= self.min_fill:
+                    break
+                if deadline is None:
+                    deadline = time.monotonic() + self.window_s
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                nxt = self._ch.get(timeout=remaining)
+                if nxt is _EMPTY:
+                    break
+                if nxt is _SENTINEL:
+                    closing = True
+                    break
+                inflight.append(nxt)
+            self._serve(inflight)
+            if closing:
+                return
+
+    # -- shedding / breakers --------------------------------------------
+    def _admit(self, batch: List[_FleetRequest],
+               now: float) -> List[_FleetRequest]:
+        kept: List[_FleetRequest] = []
+        for fut in batch:
+            if fut.cancelled():
+                fut.set_exception(RequestCancelled(
+                    "request cancelled before dispatch"))
+                self._shed_one(fut, "cancelled")
+                continue
+            dl = fut.deadline_s
+            if dl is not None:
+                waited = now - fut.submitted_at
+                if waited > dl:
+                    fut.set_exception(DeadlineExceeded(waited, dl))
+                    self._shed_one(fut, "deadline")
+                    continue
+            kept.append(fut)
+        return kept
+
+    def _shed_one(self, fut: _FleetRequest, reason: str) -> None:
+        with self._stats_lock:
+            self._shed += 1
+        try:
+            self.registry.tenant(fut.tenant).shed += 1
+        except KeyError:
+            pass
+        record_shed(self.name, reason)
+        self._release_slot(fut.tenant)
+
+    def _breaker_for(self, tid: str, version: int) -> CircuitBreaker:
+        """The tenant's ACTIVE-version breaker. Per-tenant state is the
+        isolation: tenant A's failing model opens A's breaker and
+        degrades A to ITS host mapper, while A's lane simply drops out
+        of the coalesced batch — B's compiled path and error budget
+        never notice."""
+        with self._breaker_lock:
+            got = self._breakers.get(tid)
+            if got is not None and got[0] == version:
+                return got[1]
+            if got is not None:
+                got[1].retire()
+                s = got[1].snapshot()
+                for k in self._breaker_totals:
+                    self._breaker_totals[k] += s[k]
+            br = CircuitBreaker(f"{self.name}:{tid}", version)
+            self._breakers[tid] = (version, br)
+            return br
+
+    def breaker_stats(self) -> dict:
+        with self._breaker_lock:
+            snaps = {tid: br.snapshot()
+                     for tid, (_v, br) in self._breakers.items()}
+            totals = dict(self._breaker_totals)
+        open_tenants = [tid for tid, s in snaps.items()
+                        if s["state"] == OPEN]
+        for s in snaps.values():
+            for k in totals:
+                totals[k] += s[k]
+        return {"tenants_engaged": len(snaps),
+                "open_tenants": open_tenants, **totals}
+
+    # -- dispatch --------------------------------------------------------
+    def _serve(self, batch: List[_FleetRequest]) -> None:
+        batch = self._admit(batch, time.perf_counter())
+        if not batch:
+            return
+        # split by tenant, then stage per geometry group
+        by_tenant: Dict[str, List[_FleetRequest]] = {}
+        for f in batch:
+            by_tenant.setdefault(f.tenant, []).append(f)
+        staged: Dict[int, Tuple] = {}    # id(group) -> (group, entries)
+        for tid, futs in by_tenant.items():
+            try:
+                group = self.registry.group_of(tid)
+            except KeyError as e:        # unregistered mid-flight
+                for f in futs:
+                    f.set_exception(e)
+                    self._release_slot(f.tenant)
+                with self._stats_lock:
+                    self._failed += len(futs)
+                continue
+            staged.setdefault(id(group), (group, []))[1].append((tid, futs))
+        for group, entries in staged.values():
+            try:
+                self._serve_group(group, entries)
+            except FaultInjected:
+                raise                    # supervisor quarantines+respawns
+            except BaseException as e:
+                for _tid, futs in entries:
+                    for f in futs:
+                        if not f.done():
+                            f.set_exception(e)
+                            self._release_slot(f.tenant)
+                with self._stats_lock:
+                    self._failed += sum(len(fs) for _t, fs in entries)
+
+    def _serve_group(self, group: _GeometryGroup, entries: List) -> None:
+        """One geometry group's slice of the batch: route each tenant
+        through its breaker, host-serve the broken ones, coalesce the
+        rest (or per-tenant dispatch when the kernel cannot coalesce),
+        fan results back out per tenant."""
+        maybe_crash("serve.dispatch")
+        t0 = time.perf_counter()
+        compiled: List[Tuple] = []       # (tenant, futs, route, breaker)
+        for tid, futs in entries:
+            br, route = None, "compiled"
+            if serve_breaker_enabled():
+                ten = self.registry.tenant(tid)
+                br = self._breaker_for(tid, ten.version)
+                route = br.acquire()
+            if route == "fallback":
+                self._serve_host(tid, futs)
+            else:
+                compiled.append((tid, futs, route, br))
+        if not compiled:
+            return
+        use_lanes = group.fleet_fns is not None and fleet_coalesce_enabled()
+        if use_lanes:
+            self._dispatch_coalesced(group, compiled, t0)
+        else:
+            if group.fleet_fns is None:
+                # recorded once per mapper+reason by predictor helper
+                record_serve_fallback(
+                    type(self.registry.tenant(compiled[0][0]).mapper
+                         ).__name__,
+                    "no-fleet-kernel")
+            for tid, futs, route, br in compiled:
+                self._dispatch_single(group, tid, futs, route, br, t0)
+            with self._stats_lock:
+                self._uncoalesced += 1
+
+    def _serve_host(self, tid: str, futs: List[_FleetRequest]) -> None:
+        """Breaker-open degradation, per tenant: the tenant's OWN host
+        mapper answers (correct results, degraded throughput) while the
+        other tenants keep the compiled path."""
+        ten = self.registry.tenant(tid)
+        data = MTable([f.row for f in futs], ten.mapper.data_schema)
+        out = ten.mapper.map_table(data)
+        self._fan_out(tid, futs, out, time.perf_counter())
+        with self._stats_lock:
+            self._fallback_batches += 1
+        if metrics_enabled():
+            get_registry().inc("alink_serve_breaker_fallback_total", 1,
+                               {"server": self.name})
+
+    def _lane_bucket(self, k: int) -> int:
+        lanes = fleet_lanes()
+        for b in lanes:
+            if k <= b:
+                return b
+        # wider than the top lane bucket: round up to a multiple of the
+        # top bucket, so fleets of 65..128 tenants share ONE compiled
+        # width instead of one per exact resident count
+        top = lanes[-1] if lanes else 1
+        return -(-k // top) * top
+
+    def _lane_table(self, group: _GeometryGroup,
+                    tids: List[str]) -> Tuple[Tuple, Dict[str, int], int]:
+        """The group's cached coalesced weight stack: every RESIDENT
+        member holds a stable lane slot, and the stack is rebuilt only
+        when a member mutates (register/swap/evict/re-admit bumps the
+        group's lane stamp). Steady-state dispatches therefore reuse
+        one device-side stack instead of re-stacking per batch — which
+        is where the coalesced path's host cost lived. Returns
+        ``(stacked_model, slots, L)``."""
+        import jax.numpy as jnp
+        with group._lock:
+            cache = group._lane_cache
+        if cache is not None and all(t in cache[1] for t in tids):
+            self.registry.touch(tids)      # the table skips arrays_for
+            return cache[2], cache[1], cache[0]
+        # touch/re-admit every requested tenant and HOLD the returned
+        # references — a concurrent eviction drops its reference only,
+        # so this dispatch can never be torn
+        held = {tid: self.registry.arrays_for(tid) for tid in tids}
+        with group._lock:
+            stamp = group.lane_stamp       # read BEFORE capturing arrays
+        resident = {}
+        for t in self.registry.group_tenants(group):
+            ta = t.device_arrays           # atomic reference read
+            if ta is not None:
+                resident[t.tid] = ta
+        resident.update(held)
+        order = sorted(resident)
+        slots = {tid: i for i, tid in enumerate(order)}
+        L = self._lane_bucket(len(order))
+        n_arr = len(next(iter(held.values())))
+        stacked = tuple(
+            jnp.stack([resident[tid][i] for tid in order] +
+                      [jnp.zeros_like(resident[order[0]][i])] *
+                      (L - len(order)))
+            for i in range(n_arr))
+        with group._lock:
+            if group.lane_stamp == stamp:  # no mutation since capture
+                group._lane_cache = (L, slots, stacked)
+        with self._stats_lock:
+            self._lane_rebuilds += 1
+        return stacked, slots, L
+
+    def _dispatch_coalesced(self, group: _GeometryGroup, compiled: List,
+                            t0: float) -> None:
+        """ONE program execution for every compiled-route tenant of the
+        group: per-tenant encode (each tenant's OWN kernel — feature
+        names differ even when geometry matches) at exact row counts,
+        row-concatenated and zero-padded to the covering row bucket; the
+        weight stack is the group's cached LANE TABLE (every resident
+        member at a stable slot, rebuilt only on member mutation); each
+        row carries its tenant's int32 lane index. Per-row arithmetic
+        is identical to the single-model program (``make_fleet_fns``
+        contract), so the answers are bitwise the same — and a dispatch
+        holds the stack it gathered, so a concurrent swap/eviction can
+        never tear it."""
+        import jax
+        import jax.numpy as jnp
+        # encode per tenant at exact rows; split by encoding kind
+        by_kind: Dict[str, List] = {}
+        for tid, futs, route, br in compiled:
+            ten = self.registry.tenant(tid)
+            data = MTable([f.row for f in futs], ten.mapper.data_schema)
+            kind, arrays = ten.kernel.encode(data, len(futs))
+            by_kind.setdefault(kind, []).append(
+                (tid, ten, futs, data, arrays, route, br))
+        for kind, members in by_kind.items():
+            rows = sum(len(m[2]) for m in members)
+            bucket = self._bucket_for(rows)
+            # widths may differ per tenant (sparse nnz drift): pad every
+            # encoded array to the max trailing shape — zero-padding the
+            # tail of the strict left-to-right sum is bitwise-neutral
+            # (the encoders' own padding contract)
+            n_arr = len(members[0][4])
+            trailing = tuple(
+                tuple(max(m[4][i].shape[1:][d] for m in members)
+                      for d in range(members[0][4][i].ndim - 1))
+                for i in range(n_arr))
+            stacked_inputs = []
+            for i in range(n_arr):
+                proto = members[0][4][i]
+                buf = np.zeros((bucket,) + trailing[i], proto.dtype)
+                off = 0
+                for m in members:
+                    a = m[4][i]
+                    sl = (slice(off, off + a.shape[0]),) + tuple(
+                        slice(0, s) for s in a.shape[1:])
+                    buf[sl] = a
+                    off += a.shape[0]
+                stacked_inputs.append(buf)
+            # the group's cached lane table (LRU touch; re-admits
+            # evicted tenants and rebuilds only on member mutation)
+            stacked_model, slots, L = self._lane_table(
+                group, [m[0] for m in members])
+            lane = np.zeros(bucket, np.int32)
+            off = 0
+            for m in members:
+                lane[off:off + len(m[2])] = slots[m[0]]
+                off += len(m[2])
+            prog = group.program(kind, bucket, trailing, lanes=L)
+            settled = False
+            try:
+                out = prog(stacked_model, jnp.asarray(lane),
+                           *stacked_inputs)
+                if not isinstance(out, (tuple, list)):
+                    out = (out,)
+                host = jax.device_get(list(out))
+                done_t = time.perf_counter()
+                off = 0
+                delivered = []
+                for m in members:
+                    tid, ten, futs, data, _arr, route, br = m
+                    n = len(futs)
+                    sliced = tuple(np.asarray(a)[off:off + n]
+                                   for a in host)
+                    off += n
+                    delivered.append((tid, futs,
+                                      ten.kernel.decode(sliced, data)))
+                # decode succeeded for every member: settle the breakers
+                # BEFORE fan-out so a (never-expected) fan-out error
+                # cannot double-settle an acquire as both success and
+                # failure
+                settled = True
+                for m in members:
+                    if m[6] is not None:
+                        m[6].on_success(probe=(m[5] == "probe"))
+                for tid, futs, result in delivered:
+                    self._fan_out(tid, futs, result, done_t)
+            finally:
+                if not settled:
+                    for m in members:
+                        tid, _ten, futs, _d, _a, route, br = m
+                        if br is not None:
+                            br.on_failure(probe=(route == "probe"))
+            with self._stats_lock:
+                self._batches += 1
+                if len(members) > 1:
+                    self._coalesced += 1
+            if metrics_enabled() and len(members) > 1:
+                get_registry().inc("alink_fleet_coalesced_batches_total",
+                                   1, {"fleet": self.name})
+            trace_complete("fleet.batch", time.perf_counter() - t0,
+                           cat="serve",
+                           args={"rows": rows, "bucket": bucket,
+                                 "tenants": len(members), "lanes": L,
+                                 "kind": kind})
+
+    def _dispatch_single(self, group: _GeometryGroup, tid: str,
+                         futs: List[_FleetRequest], route: str,
+                         br, t0: float) -> None:
+        """Per-tenant dispatch through the group's SHARED single-model
+        programs (the fleet-fns-less / coalescing-off path, and the
+        bitwise reference the coalesced path is pinned against)."""
+        import jax
+        ten = self.registry.tenant(tid)
+        data = MTable([f.row for f in futs], ten.mapper.data_schema)
+        settled = False
+        try:
+            n = len(futs)
+            bucket = self._bucket_for(n)
+            kind, arrays = ten.kernel.encode(data, bucket)
+            model = self.registry.arrays_for(tid)
+            prog = group.program(
+                kind, bucket, tuple(a.shape[1:] for a in arrays))
+            out = prog(model, *arrays)
+            if not isinstance(out, (tuple, list)):
+                out = (out,)
+            host = jax.device_get(list(out))
+            sliced = tuple(np.asarray(a)[:n] for a in host)
+            result = ten.kernel.decode(sliced, data)
+            done_t = time.perf_counter()
+            self._fan_out(tid, futs, result, done_t)
+            if br is not None:
+                br.on_success(probe=(route == "probe"))
+            settled = True
+        except FaultInjected:
+            if br is not None and not settled:
+                settled = True
+                br.on_failure(probe=(route == "probe"))
+            raise
+        except Exception:
+            if br is not None:
+                settled = True
+                br.on_failure(probe=(route == "probe"))
+                if route == "probe":
+                    self._serve_host(tid, futs)
+                    with self._stats_lock:
+                        self._batches += 1
+                    return
+            raise
+        with self._stats_lock:
+            self._batches += 1
+        trace_complete("fleet.batch", time.perf_counter() - t0,
+                       cat="serve", args={"rows": len(futs), "tenants": 1,
+                                          "tenant": tid, "kind": kind})
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.registry.buckets:
+            if n <= b:
+                return b
+        return self.registry.buckets[-1]
+
+    def _fan_out(self, tid: str, futs: List[_FleetRequest], out: MTable,
+                 done_t: float) -> None:
+        cols = [out.col(nm) for nm in out.col_names]
+        ten = self.registry.tenant(tid)
+        lats = []
+        for i, fut in enumerate(futs):
+            fut.set_result(tuple(c[i] for c in cols))
+            lats.append(done_t - fut.submitted_at)
+            self._release_slot(tid)
+        ten.requests += len(futs)
+        ten.latencies.extend(lats)
+        with self._stats_lock:
+            self._requests += len(futs)
+            self._latencies.extend(lats)
+        if metrics_enabled():
+            reg = get_registry()
+            reg.inc("alink_serve_requests_total", len(futs),
+                    {"server": self.name})
+
+    # -- stats / admin / shutdown ---------------------------------------
+    def _readiness(self) -> dict:
+        admitting = not self._closed.is_set()
+        brs = self.breaker_stats()
+        ok = admitting and not brs["open_tenants"]
+        return {"ready": ok, "healthy": ok,
+                "admission_open": admitting,
+                "tenants": self.registry.stats()["tenants"],
+                "open_breaker_tenants": brs["open_tenants"],
+                "queue_depth": self._ch.depth()}
+
+    def tenant_stats(self, tenant_id: str) -> dict:
+        t = self.registry.tenant(tenant_id)
+        lats = list(t.latencies)
+        return {"tenant": t.tid, "version": t.version,
+                "resident": t.device_arrays is not None,
+                "bytes": t.nbytes, "requests": t.requests,
+                "shed": t.shed, "evictions": t.evictions,
+                "readmissions": t.readmissions, "swaps": t.swaps,
+                "p99_s": _percentile(lats, 99.0)}
+
+    def stats(self) -> dict:
+        with self._stats_lock:
+            lats = list(self._latencies)
+            out = {
+                "requests": self._requests, "failed": self._failed,
+                "batches": self._batches,
+                "coalesced_batches": self._coalesced,
+                "uncoalesced_batches": self._uncoalesced,
+                "shed": self._shed,
+                "fallback_batches": self._fallback_batches,
+                "lane_rebuilds": self._lane_rebuilds,
+                "loop_respawns": self._respawns,
+                "quarantined": self._quarantined,
+            }
+        out["coalesce_rate"] = (
+            out["coalesced_batches"] / out["batches"]
+            if out["batches"] else 0.0)
+        out["p50_s"] = _percentile(lats, 50.0)
+        out["p99_s"] = _percentile(lats, 99.0)
+        out["queue_depth"] = self._ch.depth()
+        out["registry"] = self.registry.stats()
+        out["breaker"] = self.breaker_stats()
+        return out
+
+    def status(self) -> dict:
+        """adminz ``/statusz`` payload: the server totals plus one row
+        per tenant (version, residency, bytes, counters, rolling
+        p99)."""
+        s = self.stats()
+        s["per_tenant"] = [self.tenant_stats(tid)
+                           for tid in sorted(self.registry.tenant_ids())]
+        return s
+
+    def close(self, timeout: float = 10.0) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        if self._admin is not None:
+            self._admin.remove_source(f"fleet:{self.name}")
+            self._admin.remove_status(f"fleet:{self.name}")
+            self._admin = None
+            release_admin()
+        self._ch.close()
+        self._thread.join(timeout)
+
+    def __enter__(self) -> "FleetServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

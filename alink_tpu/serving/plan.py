@@ -1,0 +1,109 @@
+"""ServingPlan — ONE hashable key object for the serving tier (ISSUE 17,
+the first slice of ROADMAP item 1's ExecutionPlan refactor).
+
+PRs 10-13 each threaded another dimension through the serving program
+cache by hand: the kernel signature (geometry + resolved dtype + fused
+mode), the encoding kind, the shape bucket, the bucket SET, the
+sharded-vs-single-device mode and the mesh fingerprint all rode ad-hoc
+tuples assembled inside ``CompiledPredictor._program``, and the fleet
+registry (``serving/fleet.py``) would have needed a fourth copy of the
+same convention. :class:`ServingPlan` collapses them:
+
+* ``CompiledPredictor`` resolves its plan ONCE at construction and
+  derives every program-cache key from :meth:`ServingPlan.program_key`;
+* ``ModelRegistry`` keys tenant geometry groups on
+  :meth:`ServingPlan.geometry_key` — two tenants share one compiled
+  bucket program exactly when their plans are equal (weights are
+  program ARGUMENTS, the PR-10 contract);
+* swap/snapshot signatures derive from :meth:`ServingPlan.
+  swap_signature` — a JSON-stable string, so the fleet's snapshot-store
+  re-admission can refuse a snapshot whose serving geometry drifted
+  (the ``common/checkpoint.py`` ``meta["signature"]`` contract).
+
+The plan is a FROZEN dataclass of already-resolved values — it never
+reads flags or the environment itself (alink-lint's ENV-KEY-FOLD rule
+keeps checking the resolution sites: ``CompiledPredictor.__init__``,
+the kernel builders, the fleet registry). Everything that can change a
+compiled serving program is IN the plan or in the per-dispatch key
+dimensions (``kind``, ``bucket``, encoded trailing shapes) it is
+combined with.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional, Tuple
+
+__all__ = ["ServingPlan"]
+
+
+@dataclass(frozen=True)
+class ServingPlan:
+    """The resolved serving-program identity.
+
+    ``signature`` — the :class:`~alink_tpu.serving.predictor.
+    ServingKernel` signature: model geometry, label arity, resolved
+    serve dtype and fused mode (the kernel builder folds
+    ``ALINK_TPU_SERVE_DTYPE``/``_FUSED`` into it).
+    ``buckets``   — the resolved shape-bucket set; the per-dispatch
+    bucket is a separate ``program_key`` dimension, the SET rides the
+    plan so two predictors with different bucket grids never alias.
+    ``sharded``   — the resolved multi-chip mode (a request for
+    sharding that the kernel cannot satisfy resolves to ``False``).
+    ``mesh_fp``   — the serving mesh fingerprint (device ids + axis
+    names) when sharded; ``None`` single-device.
+    """
+
+    signature: Tuple
+    buckets: Tuple[int, ...]
+    sharded: bool = False
+    mesh_fp: Optional[Tuple] = None
+
+    def __post_init__(self):
+        object.__setattr__(self, "buckets", tuple(self.buckets))
+        if self.mesh_fp is not None:
+            object.__setattr__(self, "mesh_fp", tuple(self.mesh_fp))
+
+    # -- derived keys ---------------------------------------------------
+    def geometry_key(self) -> Tuple:
+        """The tenant-grouping key (``ModelRegistry``): everything that
+        decides whether two models can share compiled bucket programs —
+        kernel signature (model geometry x encoding dtype x fused mode)
+        x bucket set x sharded mode x mesh identity."""
+        return (self.signature, self.buckets, bool(self.sharded),
+                self.mesh_fp)
+
+    def program_key(self, kind: str, bucket: int,
+                    trailing_shapes: Tuple, *,
+                    signature: Optional[Tuple] = None,
+                    sharded: Optional[bool] = None,
+                    lanes: Optional[int] = None) -> Tuple:
+        """One compiled program's cache key.
+
+        ``signature``/``sharded`` override the plan's own values for a
+        HOT-SWAPPED model version whose kernel differs from the
+        construction-time one (a different geometry swapped in compiles
+        its own programs; a kernel that cannot shard serves
+        single-device) — the per-version truth must ride the key, the
+        plan carries the predictor-level resolution. ``lanes`` is the
+        fleet's coalesced weight-lane bucket (``None`` = the
+        single-model program)."""
+        sig = self.signature if signature is None else signature
+        sh = self.sharded if sharded is None else bool(sharded)
+        # mesh identity stays the LAST element (pinned by
+        # tests/test_serving_sharded.py's key introspection)
+        return (sig, str(kind), int(bucket), tuple(trailing_shapes),
+                self.buckets, None if lanes is None else int(lanes),
+                self.mesh_fp if sh else None)
+
+    def swap_signature(self) -> str:
+        """JSON-stable geometry identity for swap/snapshot validation:
+        the fleet's snapshot store records it as ``meta["signature"]``
+        and re-admission refuses a snapshot whose serving geometry no
+        longer matches (``common/checkpoint.py`` semantics)."""
+        return repr(self.geometry_key())
+
+    def with_signature(self, signature: Tuple) -> "ServingPlan":
+        """The same plan serving a different kernel geometry (the
+        hot-swap path: buckets/mesh stay, the model signature moves)."""
+        return replace(self, signature=tuple(signature))

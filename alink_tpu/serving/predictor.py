@@ -28,13 +28,17 @@ dispatch in flight keeps its own reference to the version it started
 with, so no request ever sees a torn model and a swap never blocks the
 serving loop.
 
-Cache-key discipline: the serving program cache keys on (model
-signature, kind, bucket, encoded shapes/dtypes, mesh fingerprint) —
-everything that can change a compiled program is IN the key (the mesh
-fingerprint covers sharded-vs-single-device AND the device set), so the
-``ALINK_TPU_SERVE_*`` flags are declared key-neutral in
-``common/flags.py`` and alink-lint's ENV-KEY-FOLD rule checks this
-module as a factory root.
+Cache-key discipline: the predictor resolves ONE :class:`~alink_tpu.
+serving.plan.ServingPlan` at construction (kernel signature x bucket
+set x sharded mode x mesh fingerprint) and every program-cache key
+derives from ``plan.program_key(kind, bucket, shapes)`` — everything
+that can change a compiled program is IN the plan or the per-dispatch
+dimensions (the mesh fingerprint covers sharded-vs-single-device AND
+the device set), so the ``ALINK_TPU_SERVE_*`` flags are declared
+key-neutral in ``common/flags.py`` and alink-lint's ENV-KEY-FOLD rule
+checks this module as a factory root. The fleet registry
+(``serving/fleet.py``) groups same-geometry tenants on the same plan's
+``geometry_key()``.
 
 Multi-chip serving (ISSUE 11) lives in :mod:`alink_tpu.serving.sharded`:
 ``sharded=True`` compiles the bucket programs under the session mesh's
@@ -57,6 +61,7 @@ from ..common.faults import maybe_crash
 from ..common.metrics import get_registry, metrics_enabled
 from ..common.mtable import MTable
 from ..common.tracing import trace_complete, trace_span
+from .plan import ServingPlan
 from .sharded import (SERVE_LANES, mesh_fingerprint,
                       serve_sharded_enabled, serving_mesh)
 
@@ -205,6 +210,21 @@ class ServingKernel:
     partition_rules: Tuple = ()
     input_specs: Optional[Callable[[str], Tuple]] = None
     make_sharded_fns: Optional[Callable] = None
+    # -- multi-tenant fleet coalescing (optional; ISSUE 17) -------------
+    # ``make_fleet_fns()`` -> {kind: fn(stacked_model_arrays, lane,
+    #                          *arrays)} — lane-stacked twins of
+    #                         ``device_fns``: each model array gains a
+    #                         leading tenant-lane axis and every request
+    #                         row gathers its own tenant's weights via
+    #                         the int32 ``lane`` vector (the tuning
+    #                         ``(points,)`` carry-lane idiom). Per-row
+    #                         arithmetic and reduction order must be
+    #                         IDENTICAL to ``device_fns`` so cross-
+    #                         tenant coalescing is a bitwise no-op.
+    #                         ``None`` = the kernel cannot coalesce; the
+    #                         fleet serves its tenants through per-
+    #                         tenant dispatch (fallback recorded).
+    make_fleet_fns: Optional[Callable] = None
 
 
 def _merge_parts(parts):
@@ -332,6 +352,14 @@ class CompiledPredictor:
                              "sharded=True")
         self._replica_devices: Tuple = tuple(replica_devices) \
             if replica_devices else (None,)
+        # ONE resolved plan (ISSUE 17 / ROADMAP item 1): every program
+        # key, the fleet's geometry grouping and the swap signature
+        # derive from it instead of re-threading buckets/dtype/fused/
+        # sharded/mesh by hand at each site
+        self.plan = ServingPlan(signature=kernel.signature,
+                                buckets=self._buckets,
+                                sharded=self._sharded,
+                                mesh_fp=self._mesh_fp)
         self._sharded_fns: Dict[Tuple, Dict[str, Callable]] = {}
         self._swap_lock = threading.Lock()
         self._cache_lock = threading.Lock()
@@ -581,9 +609,9 @@ class CompiledPredictor:
         traffic shows up in the collective manifest/metrics exactly like
         training traffic."""
         sharded = self._ver_sharded(ver.kernel)
-        key = (ver.kernel.signature, kind, bucket,
-               tuple(a.shape[1:] for a in arrays),
-               self._mesh_fp if sharded else None)
+        key = self.plan.program_key(
+            kind, bucket, tuple(a.shape[1:] for a in arrays),
+            signature=ver.kernel.signature, sharded=sharded)
         entry = self._programs.get(key)
         if entry is not None:
             self._hits += 1

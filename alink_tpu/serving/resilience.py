@@ -46,8 +46,8 @@ from ..common.tracing import trace_instant
 __all__ = [
     "CLOSED", "HALF_OPEN", "OPEN", "BREAKER_STATE_CODES",
     "CircuitBreaker", "DeadlineExceeded", "ReplicaCrashed",
-    "RequestCancelled", "classify_feeder_error", "record_feeder_error",
-    "record_shed", "serve_breaker_enabled",
+    "RequestCancelled", "TenantQuotaExceeded", "classify_feeder_error",
+    "record_feeder_error", "record_shed", "serve_breaker_enabled",
 ]
 
 
@@ -72,6 +72,23 @@ class DeadlineExceeded(RuntimeError):
 class RequestCancelled(RuntimeError):
     """A request the submitter cancelled (``RequestFuture.cancel()``)
     before the serving loop dispatched it."""
+
+
+class TenantQuotaExceeded(RuntimeError):
+    """A fleet request rejected AT ADMISSION because its tenant already
+    has ``ALINK_TPU_FLEET_TENANT_QUOTA`` requests in flight. Quota is
+    per-tenant isolation, not backpressure: one tenant's storm fills its
+    own slot budget and gets typed rejections, while every other
+    tenant's admission path is untouched (their error budget never pays
+    for the noisy neighbor). Recorded as shed reason ``"quota"``."""
+
+    def __init__(self, tenant: str, in_flight: int, quota: int):
+        super().__init__(
+            f"tenant {tenant!r} quota exceeded: {in_flight} requests "
+            f"already in flight (ALINK_TPU_FLEET_TENANT_QUOTA={quota})")
+        self.tenant = tenant
+        self.in_flight = in_flight
+        self.quota = quota
 
 
 class ReplicaCrashed(RuntimeError):

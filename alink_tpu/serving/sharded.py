@@ -304,3 +304,35 @@ def make_linear_device_fns(mesh) -> Dict[str, callable]:
         return score + b
 
     return {"dense": _dense, "sparse": _sparse}
+
+
+def make_linear_fleet_fns() -> Dict[str, callable]:
+    """The binary/regression linear score kernel as TENANT-LANE-stacked
+    programs (ISSUE 17): ``{kind: fn(stacked_model_arrays, lane,
+    *encoded)}`` where each model array gained a leading tenant-lane
+    axis — ``W (L, dim8)``, ``b (L,)`` — and ``lane`` is the per-row
+    int32 tenant->lane index (the tuning ``(points,)`` carry-lane idiom
+    applied to serving weights).
+
+    Bitwise contract (the fleet's coalescing proof): per request row,
+    ``(X * W[lane])[i] == X[i] * w_tenant`` elementwise,
+    :func:`seq_chunk_sum` reduces the feature axis in the SAME strict
+    left-to-right order regardless of what the other rows of the batch
+    hold, and ``+ b[lane]`` is the same scalar add — so a row served in
+    a coalesced cross-tenant batch is bitwise-identical to the same row
+    served through its tenant's own single-model bucket program
+    (tests/test_fleet.py pins it). Padding lanes (zero weights) are
+    gathered only by padding rows, which are sliced off at decode.
+    """
+
+    def _dense(mdls, lane, X):
+        W, b = mdls                       # (L, dim8), (L,)
+        return seq_chunk_sum(X * W[lane], axis=1) + b[lane]
+
+    def _sparse(mdls, lane, idx, val):
+        W, b = mdls
+        # per-row two-level gather: row i reads its own tenant's weight
+        # slots — value-identical to the single-model w[idx] gather
+        return seq_chunk_sum(val * W[lane[:, None], idx], axis=1) + b[lane]
+
+    return {"dense": _dense, "sparse": _sparse}

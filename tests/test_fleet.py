@@ -1,0 +1,538 @@
+"""Multi-tenant fleet serving (alink_tpu/serving/fleet.py) — ISSUE 17.
+
+The load-bearing invariants:
+  * coalesced cross-tenant dispatch is BITWISE identical to per-tenant
+    dispatch AND to a single-tenant CompiledPredictor — the lane-gather
+    `W[lane]` keeps per-row arithmetic identical to the single-model
+    `w` broadcast (ServingKernel.make_fleet_fns contract);
+  * LRU eviction under the HBM budget re-admits bitwise from the
+    snapshot store, NEVER races an in-flight swap (the evictor only
+    takes tenant locks it can get without blocking), and the byte
+    ledger matches what is actually live on device;
+  * tenant isolation: quota rejections are typed and synchronous, a
+    broken tenant's breaker degrades ONLY that tenant to its host
+    mapper, and one ModelStreamFeeder multiplexes per-tenant swap
+    streams;
+  * ServingPlan is the single key object: equal plans share programs,
+    different lane widths / buckets / signatures never alias.
+"""
+
+import copy
+import gc
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from alink_tpu.common.mtable import MTable
+from alink_tpu.common.params import Params
+from alink_tpu.common.vector import DenseVector
+from alink_tpu.operator.batch.classification.linear import (
+    LogisticRegressionTrainBatchOp)
+from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+from alink_tpu.serving import (CompiledPredictor, FleetServer,
+                               ModelRegistry, ModelStreamFeeder,
+                               ServingPlan, TenantQuotaExceeded)
+
+N, D = 96, 8
+BUCKETS = (1, 4, 16, 64)
+
+
+def _train(seed=0, n=N, d=D, max_iter=2):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d)
+    y = (X @ rng.randn(d) > 0).astype(np.int64)
+    vecs = np.empty(n, object)
+    vecs[:] = [DenseVector(X[i]) for i in range(n)]
+    tbl = MTable({"vec": vecs, "label": y}, "vec VECTOR, label LONG")
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label",
+        max_iter=max_iter).link_from(MemSourceBatchOp(tbl))
+    pp = {"prediction_col": "pred", "vector_col": "vec",
+          "prediction_detail_col": "det"}
+    data_schema = tbl.select(["vec"]).schema
+    mapper = LinearModelMapper(warm.get_output_table().schema,
+                               data_schema, Params(pp))
+    mapper.load_model(warm.get_output_table())
+    return tbl, warm, mapper, data_schema
+
+
+@pytest.fixture(scope="module")
+def base():
+    tbl, warm, mapper, schema = _train(seed=0)
+    _t2, warm2, _m2, _s2 = _train(seed=17)
+    return {"tbl": tbl, "warm": warm, "mapper": mapper, "schema": schema,
+            "warm2": warm2,
+            "rows": [tbl.select(["vec"]).row(i) for i in range(16)]}
+
+
+def _tenant_mappers(mapper, k, scale=0.05):
+    """k same-geometry tenants: deepcopies with deterministically
+    perturbed coefficients (serving_kernel() reads model.coef at call
+    time, so each copy serves genuinely different weights)."""
+    out = {}
+    for i in range(k):
+        m = copy.deepcopy(mapper)
+        rng = np.random.RandomState(1000 + i)
+        m.model.coef = np.asarray(m.model.coef) \
+            + scale * rng.randn(*np.shape(m.model.coef))
+        out[f"t{i}"] = m
+    return out
+
+
+def _wait_until(cond, timeout=10.0, interval=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+def _rows_equal(a, b):
+    """Bitwise row-tuple equality (detail strings byte-for-byte,
+    floats exact)."""
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if isinstance(x, float) and isinstance(y, float):
+            if x != y and not (np.isnan(x) and np.isnan(y)):
+                return False
+        elif str(x) != str(y):
+            return False
+    return True
+
+
+def _table_rows(tbl: MTable):
+    cols = [tbl.col(nm) for nm in tbl.col_names]
+    return [tuple(c[i] for c in cols) for i in range(tbl.num_rows)]
+
+
+class TestServingPlan:
+    def test_geometry_key_groups_equal_plans(self):
+        p1 = ServingPlan(signature=("lr", 8, "f32"), buckets=(1, 4))
+        p2 = ServingPlan(signature=("lr", 8, "f32"), buckets=[1, 4])
+        assert p1 == p2
+        assert p1.geometry_key() == p2.geometry_key()
+        assert hash(p1) == hash(p2)
+
+    def test_every_dimension_splits_the_key(self):
+        p = ServingPlan(signature=("lr", 8, "f32"), buckets=(1, 4))
+        assert p.geometry_key() != ServingPlan(
+            signature=("lr", 9, "f32"), buckets=(1, 4)).geometry_key()
+        assert p.geometry_key() != ServingPlan(
+            signature=("lr", 8, "f32"), buckets=(1, 8)).geometry_key()
+        assert p.geometry_key() != ServingPlan(
+            signature=("lr", 8, "f32"), buckets=(1, 4),
+            sharded=True, mesh_fp=(0, 1)).geometry_key()
+
+    def test_program_key_lane_dimension(self):
+        p = ServingPlan(signature=("lr", 8, "f32"), buckets=(1, 4))
+        single = p.program_key("dense", 4, ((8,),))
+        laned = p.program_key("dense", 4, ((8,),), lanes=4)
+        assert single != laned
+        assert laned != p.program_key("dense", 4, ((8,),), lanes=16)
+        # and the single-model key is identical to what
+        # CompiledPredictor derives for the same dispatch
+        assert single == p.program_key("dense", 4, ((8,),), lanes=None)
+
+    def test_swap_signature_stable_and_geometry_bound(self):
+        p = ServingPlan(signature=("lr", 8, "f32"), buckets=(1, 4))
+        assert p.swap_signature() == repr(p.geometry_key())
+        q = p.with_signature(("lr", 9, "f32"))
+        assert q.swap_signature() != p.swap_signature()
+        assert q.buckets == p.buckets
+
+
+class TestRegistry:
+    def test_geometry_grouping_and_program_sharing(self, base, tmp_path):
+        reg = ModelRegistry(snapshot_dir=str(tmp_path), buckets=BUCKETS,
+                            hbm_budget=0, name="grp")
+        tenants = _tenant_mappers(base["mapper"], 3)
+        plans = [reg.register(tid, m) for tid, m in tenants.items()]
+        assert all(p == plans[0] for p in plans)
+        st = reg.stats()
+        assert st["tenants"] == 3 and st["geometry_groups"] == 1
+        g = reg.group_of("t0")
+        assert g is reg.group_of("t1") is reg.group_of("t2")
+        # one compiled program serves every tenant of the group
+        p1 = g.program("dense", 4, ((D,),))
+        p2 = g.program("dense", 4, ((D,),))
+        assert p1 is p2 and g.stats()["programs"] == 1
+
+    def test_register_twice_is_typed_error(self, base, tmp_path):
+        reg = ModelRegistry(snapshot_dir=str(tmp_path), buckets=BUCKETS,
+                            hbm_budget=0, name="dup")
+        reg.register("a", copy.deepcopy(base["mapper"]))
+        with pytest.raises(ValueError, match="already registered"):
+            reg.register("a", copy.deepcopy(base["mapper"]))
+        with pytest.raises(KeyError, match="unknown tenant"):
+            reg.arrays_for("ghost")
+
+    def test_lru_eviction_readmits_bitwise(self, base, tmp_path):
+        """The eviction/re-admission round trip is exact: the snapshot
+        store's .npy payload comes back bit-for-bit, validated against
+        the group plan's swap_signature."""
+        tenants = _tenant_mappers(base["mapper"], 2)
+        one = sum(int(np.asarray(a).nbytes) for a in
+                  tenants["t0"].serving_kernel().model_arrays)
+        reg = ModelRegistry(snapshot_dir=str(tmp_path), buckets=BUCKETS,
+                            hbm_budget=one, name="lru")
+        reg.register("t0", tenants["t0"])
+        before = [np.asarray(a) for a in reg.arrays_for("t0")]
+        reg.register("t1", tenants["t1"])   # over budget: evicts t0
+        t0 = reg.tenant("t0")
+        assert t0.device_arrays is None and t0.evictions == 1
+        assert reg.stats()["evictions"] == 1
+        after = [np.asarray(a) for a in reg.arrays_for("t0")]
+        assert t0.readmissions == 1
+        assert len(before) == len(after)
+        for b, a in zip(before, after):
+            assert b.dtype == a.dtype
+            assert np.array_equal(b, a)     # bitwise .npy round trip
+        assert reg.stats()["readmissions"] == 1
+
+    def test_eviction_never_races_inflight_swap(self, base, tmp_path):
+        """A tenant whose lock is held (a swap or re-admission in
+        flight) is skipped by the evictor — the ledger runs over budget
+        rather than tearing the swap."""
+        tenants = _tenant_mappers(base["mapper"], 2)
+        one = sum(int(np.asarray(a).nbytes) for a in
+                  tenants["t0"].serving_kernel().model_arrays)
+        reg = ModelRegistry(snapshot_dir=str(tmp_path), buckets=BUCKETS,
+                            hbm_budget=one, name="race")
+        reg.register("t0", tenants["t0"])
+        reg.register("t1", tenants["t1"])   # evicts t0
+        assert reg.tenant("t0").device_arrays is None
+        t1 = reg.tenant("t1")
+        with t1.lock:                        # simulate t1 mid-swap
+            arrays = reg.arrays_for("t0")    # re-admit t0: over budget,
+            assert arrays is not None        # but t1 is UNEVICTABLE now
+            assert t1.device_arrays is not None
+            assert reg.resident_bytes() == 2 * one
+        # lock released: the next pressure point evicts normally (t1 is
+        # the LRU-oldest — t0 was just touched)
+        evicted = reg._evict_to_budget()
+        assert evicted == 1
+        assert t1.device_arrays is None
+        assert reg.tenant("t0").device_arrays is not None
+        assert reg.resident_bytes() == one
+
+    def test_concurrent_swaps_survive_eviction_storm(self, base,
+                                                     tmp_path):
+        """Swaps on one thread, eviction-pressure touches on another:
+        no exception, the ledger stays exact, and the tenant ends on
+        the last swapped model bitwise."""
+        tenants = _tenant_mappers(base["mapper"], 3)
+        one = sum(int(np.asarray(a).nbytes) for a in
+                  tenants["t0"].serving_kernel().model_arrays)
+        reg = ModelRegistry(snapshot_dir=str(tmp_path), buckets=BUCKETS,
+                            hbm_budget=2 * one, name="storm")
+        for tid, m in tenants.items():
+            reg.register(tid, m)
+        tables = [base["warm"].get_output_table(),
+                  base["warm2"].get_output_table()]
+        errors = []
+
+        def swapper():
+            try:
+                for i in range(12):
+                    reg.swap_tenant("t0", tables[i % 2])
+            except BaseException as e:      # pragma: no cover
+                errors.append(e)
+
+        th = threading.Thread(target=swapper)
+        th.start()
+        for i in range(60):
+            reg.arrays_for(f"t{(i % 2) + 1}")   # LRU churn on t1/t2
+        th.join(30)
+        assert not errors
+        t0 = reg.tenant("t0")
+        assert t0.version == 13 and t0.swaps == 12
+        # the final arrays are exactly the last swapped model's
+        ref = LinearModelMapper(tables[1].schema, base["schema"],
+                                base["mapper"].params)
+        ref.load_model(tables[1])
+        want = [np.asarray(a) for a in ref.serving_kernel().model_arrays]
+        got = [np.asarray(a) for a in reg.arrays_for("t0")]
+        for w, g in zip(want, got):
+            assert np.array_equal(w, g)
+        # ledger == sum of the resident tenants' device bytes
+        resident = sum(t.nbytes for t in
+                       (reg.tenant(f"t{i}") for i in range(3))
+                       if t.device_arrays is not None)
+        assert reg.resident_bytes() == resident
+        assert reg.stats()["evictions"] > 0
+
+    def test_budget_ledger_matches_live_arrays(self, base, tmp_path):
+        """The registry's byte ledger is the truth about device
+        residency: registering adds exactly the tenants' bytes to
+        jax.live_arrays(), evicting returns them."""
+        import jax
+        tenants = _tenant_mappers(base["mapper"], 3)
+        one = sum(int(np.asarray(a).nbytes) for a in
+                  tenants["t0"].serving_kernel().model_arrays)
+        gc.collect()
+        base_bytes = sum(a.nbytes for a in jax.live_arrays())
+        reg = ModelRegistry(snapshot_dir=str(tmp_path), buckets=BUCKETS,
+                            hbm_budget=2 * one, name="ledger")
+        for tid, m in tenants.items():
+            reg.register(tid, m)            # third registration evicts
+        gc.collect()
+        live = sum(a.nbytes for a in jax.live_arrays()) - base_bytes
+        assert reg.resident_bytes() == 2 * one
+        assert live == reg.resident_bytes()
+        st = reg.stats()
+        assert st["resident"] == 2 and st["evictions"] == 1
+
+    def test_swap_refuses_geometry_drift(self, base, tmp_path):
+        """A snapshot of a different serving geometry is poisoned — the
+        swap raises instead of silently regrouping the tenant."""
+        reg = ModelRegistry(snapshot_dir=str(tmp_path), buckets=BUCKETS,
+                            hbm_budget=0, name="drift")
+        reg.register("a", copy.deepcopy(base["mapper"]))
+        _t, warm_wide, _m, _s = _train(seed=5, d=D + 3)
+        with pytest.raises(ValueError, match="geometry mismatch"):
+            reg.swap_tenant("a", warm_wide.get_output_table())
+        assert reg.tenant("a").version == 1     # untouched
+
+
+class TestFleetServer:
+    def _mk(self, base, tmp_path, k=3, budget=0, **kw):
+        reg = ModelRegistry(snapshot_dir=str(tmp_path), buckets=BUCKETS,
+                            hbm_budget=budget,
+                            name=kw.pop("name", "fsrv"))
+        tenants = _tenant_mappers(base["mapper"], k)
+        for tid, m in tenants.items():
+            reg.register(tid, m)
+        srv = FleetServer(reg, name=reg.name, **kw)
+        return reg, tenants, srv
+
+    def test_coalesced_bitwise_vs_single_tenant_predictor(
+            self, base, tmp_path, monkeypatch):
+        """THE fleet contract: one lane-stacked dispatch spanning three
+        tenants answers bitwise-identically to (a) per-tenant dispatch
+        through the shared single-model programs and (b) a dedicated
+        single-tenant CompiledPredictor."""
+        monkeypatch.delenv("ALINK_TPU_FLEET_COALESCE", raising=False)
+        rows = base["rows"][:3]
+        reg, tenants, srv = self._mk(base, tmp_path, k=3,
+                                     min_fill=9, window_s=5.0,
+                                     name="coal")
+        try:
+            futs = [(tid, r, srv.submit(tid, r))
+                    for tid in tenants for r in rows]
+            coalesced = {(tid, i): f.result(30)
+                         for i, (tid, _r, f) in enumerate(futs)}
+            assert _wait_until(
+                lambda: srv.stats()["coalesced_batches"] >= 1)
+            # (b) the single-tenant reference predictors
+            for tid, m in tenants.items():
+                pred = CompiledPredictor(m, buckets=BUCKETS)
+                want = _table_rows(pred.predict_table(
+                    MTable([r for r in rows],
+                           base["schema"])))
+                got = [v for (t, _i), v in coalesced.items() if t == tid]
+                for w, g in zip(want, got):
+                    assert _rows_equal(w, g), (tid, w, g)
+            # (a) per-tenant dispatch (coalescing off — same server, the
+            # flag is read live at dispatch)
+            monkeypatch.setenv("ALINK_TPU_FLEET_COALESCE", "0")
+            futs2 = [(tid, srv.submit(tid, r))
+                     for tid in tenants for r in rows]
+            single = [(tid, f.result(30)) for tid, f in futs2]
+            for (tid, got), ((tid0, _i), want) in zip(
+                    single, coalesced.items()):
+                assert tid == tid0
+                assert _rows_equal(want, got), (tid, want, got)
+            assert _wait_until(
+                lambda: srv.stats()["uncoalesced_batches"] >= 1)
+            # the two paths compiled DIFFERENT programs (lane key)
+            g = reg.group_of("t0")
+            assert g.stats()["programs"] >= 2
+        finally:
+            srv.close()
+
+    def test_distinct_tenants_get_distinct_answers(self, base, tmp_path):
+        """No cross-tenant leakage in one coalesced batch: perturbed
+        models must not answer with each other's scores."""
+        reg, tenants, srv = self._mk(base, tmp_path, k=3, min_fill=3,
+                                     window_s=5.0, name="leak")
+        try:
+            row = base["rows"][0]
+            futs = [srv.submit(tid, row) for tid in tenants]
+            got = [f.result(30) for f in futs]
+            dets = [str(g[-1]) for g in got]    # detail json strings
+            assert len(set(dets)) == 3, dets
+        finally:
+            srv.close()
+
+    def test_eviction_storm_under_serving_is_bitwise(self, base,
+                                                     tmp_path):
+        """Requests keep answering bitwise while the HBM budget churns
+        tenants through the snapshot store."""
+        tenants = _tenant_mappers(base["mapper"], 4)
+        one = sum(int(np.asarray(a).nbytes) for a in
+                  tenants["t0"].serving_kernel().model_arrays)
+        reg = ModelRegistry(snapshot_dir=str(tmp_path), buckets=BUCKETS,
+                            hbm_budget=2 * one, name="evsrv")
+        for tid, m in tenants.items():
+            reg.register(tid, m)
+        preds = {tid: CompiledPredictor(m, buckets=BUCKETS)
+                 for tid, m in tenants.items()}
+        want = {tid: _table_rows(preds[tid].predict_table(
+            MTable([base["rows"][0]], base["schema"])))[0]
+            for tid in tenants}
+        srv = FleetServer(reg, min_fill=1, window_s=0.002, name="evsrv")
+        try:
+            for i in range(24):
+                tid = f"t{i % 4}"
+                got = srv.predict(tid, base["rows"][0], timeout=30)
+                assert _rows_equal(want[tid], got), (i, tid)
+            assert reg.stats()["evictions"] > 0
+            assert reg.stats()["readmissions"] > 0
+        finally:
+            srv.close()
+
+    def test_tenant_quota_is_typed_and_isolated(self, base, tmp_path,
+                                                monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_FLEET_TENANT_QUOTA", "2")
+        reg, tenants, srv = self._mk(base, tmp_path, k=2, min_fill=1,
+                                     window_s=0.002, name="quota")
+        gate = threading.Event()
+        orig = reg.arrays_for
+
+        def stalled(tid):
+            gate.wait(30)
+            return orig(tid)
+
+        monkeypatch.setattr(reg, "arrays_for", stalled)
+        try:
+            row = base["rows"][0]
+            f1 = srv.submit("t0", row)
+            f2 = srv.submit("t0", row)
+            with pytest.raises(TenantQuotaExceeded) as ei:
+                srv.submit("t0", row)
+            assert ei.value.tenant == "t0" and ei.value.quota == 2
+            # ISOLATION: t1's admission is untouched by t0's storm
+            f3 = srv.submit("t1", row)
+            assert srv.stats()["shed"] == 1
+            assert reg.tenant("t0").shed == 1
+            assert reg.tenant("t1").shed == 0
+            gate.set()
+            for f in (f1, f2, f3):
+                f.result(30)
+            # slots released: t0 admits again
+            assert _wait_until(
+                lambda: srv._inflight.get("t0", 0) == 0)
+            srv.predict("t0", row, timeout=30)
+        finally:
+            gate.set()
+            srv.close()
+
+    def test_breaker_isolates_broken_tenant(self, base, tmp_path,
+                                            monkeypatch):
+        """t0's compiled path fails -> t0's breaker opens and t0 serves
+        host-fallback; t1 stays compiled with a closed breaker."""
+        monkeypatch.setenv("ALINK_TPU_SERVE_BREAKER_THRESHOLD", "1")
+        monkeypatch.setenv("ALINK_TPU_SERVE_BREAKER_BACKOFF_MS", "60000")
+        monkeypatch.setenv("ALINK_TPU_FLEET_COALESCE", "0")
+        reg, tenants, srv = self._mk(base, tmp_path, k=2, min_fill=1,
+                                     window_s=0.002, name="brk")
+        orig = reg.arrays_for
+
+        def poisoned(tid):
+            if str(tid) == "t0":
+                raise RuntimeError("injected: t0 device path down")
+            return orig(tid)
+
+        monkeypatch.setattr(reg, "arrays_for", poisoned)
+        try:
+            row = base["rows"][0]
+            with pytest.raises(RuntimeError, match="injected"):
+                srv.predict("t0", row, timeout=30)
+            assert _wait_until(
+                lambda: srv.breaker_stats()["open_tenants"] == ["t0"])
+            # t0 now degrades to ITS host mapper — correct answers
+            got = srv.predict("t0", row, timeout=30)
+            want = _table_rows(tenants["t0"].map_table(
+                MTable([row], base["schema"])))[0]
+            assert _rows_equal(want, got)
+            # t1 never left the compiled path
+            pred1 = CompiledPredictor(tenants["t1"], buckets=BUCKETS)
+            want1 = _table_rows(pred1.predict_table(
+                MTable([row], base["schema"])))[0]
+            assert _rows_equal(want1, srv.predict("t1", row, timeout=30))
+            assert srv.breaker_stats()["open_tenants"] == ["t0"]
+            st = srv.stats()
+            assert st["fallback_batches"] >= 1
+            assert st["failed"] == 1
+        finally:
+            srv.close()
+
+    def test_one_feeder_multiplexes_tenant_swap_streams(self, base,
+                                                        tmp_path):
+        """ONE ModelStreamFeeder drains a merged snapshot stream; the
+        feeder_target router swaps each tenant independently and
+        serving reflects each tenant's OWN new model bitwise."""
+        reg, tenants, srv = self._mk(base, tmp_path, k=2, min_fill=1,
+                                     window_s=0.002, name="mux")
+        try:
+            tbl_a = base["warm"].get_output_table()
+            tbl_b = base["warm2"].get_output_table()
+            route = {id(tbl_a): "t0", id(tbl_b): "t1"}
+
+            class _Merged:
+                def timed_batches(self):
+                    yield (0.0, tbl_a)
+                    yield (1.0, tbl_b)
+
+            target = srv.feeder_target(lambda mt: route[id(mt)])
+            feeder = ModelStreamFeeder(target, _Merged()).start()
+            assert feeder.join(30) == 2
+            assert [(t, v) for t, v, _m in target.swaps] \
+                == [("t0", 2), ("t1", 2)]
+            assert reg.tenant("t0").version == 2
+            assert reg.tenant("t1").version == 2
+            # each tenant serves ITS new model (bitwise vs a dedicated
+            # predictor built from the same table)
+            row = base["rows"][0]
+            for tid, tbl in (("t0", tbl_a), ("t1", tbl_b)):
+                ref = LinearModelMapper(tbl.schema, base["schema"],
+                                        base["mapper"].params)
+                ref.load_model(tbl)
+                pred = CompiledPredictor(ref, buckets=BUCKETS)
+                want = _table_rows(pred.predict_table(
+                    MTable([row], base["schema"])))[0]
+                assert _rows_equal(want,
+                                   srv.predict(tid, row, timeout=30))
+        finally:
+            srv.close()
+
+    def test_status_has_per_tenant_rows(self, base, tmp_path):
+        reg, tenants, srv = self._mk(base, tmp_path, k=2, min_fill=1,
+                                     window_s=0.002, name="statz")
+        try:
+            srv.predict("t0", base["rows"][0], timeout=30)
+            assert _wait_until(lambda: srv.stats()["requests"] >= 1)
+            doc = srv.status()
+            rows = {r["tenant"]: r for r in doc["per_tenant"]}
+            assert set(rows) == {"t0", "t1"}
+            assert rows["t0"]["requests"] >= 1
+            assert rows["t0"]["resident"] is True
+            assert rows["t0"]["version"] == 1
+            assert doc["registry"]["tenants"] == 2
+            assert "coalesce_rate" in doc and "p99_s" in doc
+        finally:
+            srv.close()
+
+    def test_unknown_tenant_is_synchronous_keyerror(self, base,
+                                                    tmp_path):
+        reg, tenants, srv = self._mk(base, tmp_path, k=1, name="unk")
+        try:
+            with pytest.raises(KeyError, match="unknown tenant"):
+                srv.submit("ghost", base["rows"][0])
+        finally:
+            srv.close()

@@ -230,6 +230,11 @@ def _display_name(name: str) -> str:
         # throughput DURING the scripted fault storm — degraded by
         # design; the SLO contract rides the row's own fields (ISSUE 14)
         return f"{name} (qps under storm)"
+    if name == "serve_fleet":
+        # steady-state multi-tenant throughput with cross-tenant batch
+        # coalescing; the leak proof / eviction storm evidence rides the
+        # row's own fields (ISSUE 17)
+        return f"{name} (qps, multi-tenant)"
     if name == "serve_online_e2e":
         # the whole online-learning DAG's steady-state scoring rate;
         # the SLO verdicts / recovery evidence ride the row (ISSUE 15)

@@ -132,7 +132,7 @@ def _summarize_metric_records(records: List[dict]) -> Dict[str, Any]:
     core behind dump files (``--metrics``), live ``/varz`` scrapes and
     fleetz snapshot dirs (``--url``)."""
     out: Dict[str, Any] = {"cache": {}, "collectives": {}, "hbm_gauges": {},
-                           "serve": {}}
+                           "serve": {}, "fleet": {}}
     for rec in records:
             name = rec.get("name")
             labels = rec.get("labels") or {}
@@ -173,8 +173,28 @@ def _summarize_metric_records(records: List[dict]) -> Dict[str, Any]:
                 out["serve"]["loop_respawns"] = \
                     out["serve"].get("loop_respawns", 0) \
                     + rec.get("value", 0)
+            # the multi-tenant fleet plane (ISSUE 17): tenant census
+            # and the eviction/coalescing economics behind it
+            elif name == "alink_fleet_tenants":
+                out["fleet"]["tenants"] = max(
+                    out["fleet"].get("tenants", 0), rec.get("value", 0))
+            elif name == "alink_fleet_evictions_total":
+                out["fleet"]["evictions"] = out["fleet"].get(
+                    "evictions", 0) + rec.get("value", 0)
+            elif name == "alink_fleet_readmissions_total":
+                out["fleet"]["readmissions"] = out["fleet"].get(
+                    "readmissions", 0) + rec.get("value", 0)
+            elif name == "alink_fleet_coalesced_batches_total":
+                out["fleet"]["coalesced_batches"] = out["fleet"].get(
+                    "coalesced_batches", 0) + rec.get("value", 0)
+            elif name == "alink_fleet_resident_bytes":
+                out["fleet"]["resident_bytes"] = max(
+                    out["fleet"].get("resident_bytes", 0),
+                    rec.get("value", 0))
     if not out["serve"]:
         del out["serve"]
+    if not out["fleet"]:
+        del out["fleet"]
     return out
 
 
@@ -337,6 +357,9 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
         if str(name) == "serve_online_e2e":
             continue    # the whole-loop DAG row gets its own e2e
                         # verdict section (_e2e_verdicts)
+        if str(name) == "serve_fleet":
+            continue    # the multi-tenant fleet row gets its own
+                        # verdict section (_fleet_verdicts)
         if "error" in row:
             out.append({"workload": name, "error": row["error"]})
             continue
@@ -522,6 +545,93 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
         out.append({"workload": "serving (metrics)",
                     "fixes": met_fixes})
     return out
+
+
+def _fleet_verdicts(bench: Optional[Dict[str, Any]],
+                    metrics: Optional[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+    """The multi-tenant fleet verdict (ISSUE 17): tenant census, the
+    p99-vs-single-model headline, and named fixes for the two fleet
+    failure economies — eviction THRASH (the HBM budget churns hot
+    tenants through the snapshot store) and UNDER-COALESCING (same-
+    geometry tenants dispatch one-by-one, paying per-tenant launches
+    for shared programs). Reads the ``serve_fleet`` bench row when one
+    exists and the live ``alink_fleet_*`` metrics otherwise."""
+    rows = ((bench or {}).get("workloads") or {})
+    row = rows.get("serve_fleet")
+    fleet_met = (metrics or {}).get("fleet") or {}
+    if not isinstance(row, dict) and not fleet_met:
+        return []
+    if isinstance(row, dict) and "error" in row:
+        return [{"workload": "serve_fleet", "error": row["error"]}]
+    row = row if isinstance(row, dict) else {}
+    fixes: List[str] = []
+    tenants = row.get("tenants") or fleet_met.get("tenants") or 0
+    evictions = row.get("evictions")
+    if evictions is None:
+        evictions = fleet_met.get("evictions")
+    readmissions = row.get("readmissions")
+    if readmissions is None:
+        readmissions = fleet_met.get("readmissions")
+    leaked = int(row.get("leaked_rows") or 0)
+    if leaked or row.get("parity") == "MISMATCH":
+        fixes.append(f"CRITICAL: {leaked} cross-tenant probe rows "
+                     f"leaked another tenant's scores — coalesced "
+                     f"lane gather or eviction/re-admission is routing "
+                     f"the wrong weights (serving/fleet.py "
+                     f"_dispatch_coalesced / arrays_for); nothing else "
+                     f"about the fleet matters until this is bitwise")
+    failed = int(row.get("failed_requests") or 0)
+    if failed:
+        fixes.append(f"CRITICAL: {failed} failed requests — check "
+                     f"per-tenant breaker states and server exceptions "
+                     f"before trusting the latency numbers")
+    # eviction thrash: the budget forces hot tenants out and straight
+    # back in — each re-admission pays a snapshot load + device_put in
+    # the serving path
+    if tenants and evictions and evictions > 3 * tenants:
+        fixes.append(
+            f"eviction THRASH: {int(evictions)} evictions over "
+            f"{int(tenants)} tenants ({int(readmissions or 0)} "
+            f"re-admissions) — the working set does not fit "
+            f"ALINK_TPU_FLEET_HBM_BUDGET; raise the budget, shrink "
+            f"the per-tenant model, or shard tenants across more "
+            f"fleet processes so the hot set stays resident")
+    # under-coalescing: same-geometry tenants are paying per-tenant
+    # dispatches for programs they could share
+    rate = row.get("coalesce_rate")
+    if rate is not None and rate < 0.5 and tenants and tenants > 1:
+        fixes.append(
+            f"batches under-coalesce ({rate:.0%} of dispatches carry "
+            f">1 tenant): cross-tenant stacking is not happening — "
+            f"check ALINK_TPU_FLEET_COALESCE=1, that tenants really "
+            f"share serving-kernel geometry (ModelRegistry.stats() "
+            f"groups), and hold batches long enough to mix tenants "
+            f"(ALINK_TPU_SERVE_MIN_FILL + ALINK_TPU_SERVE_WINDOW_MS)")
+    ratio = row.get("p99_vs_single")
+    if ratio is not None and ratio > 5.0:
+        fixes.append(
+            f"fleet p99 runs {ratio}x the single-model baseline: "
+            f"multi-tenancy is not free on this rig — look at "
+            f"re-admission stalls (evictions above), lane-bucket "
+            f"recompiles (ALINK_TPU_FLEET_LANES vs observed group "
+            f"sizes), and per-tenant breaker fallbacks")
+    v: Dict[str, Any] = {"workload": "serve_fleet",
+                         "tenants": int(tenants) if tenants else None,
+                         "evictions": evictions,
+                         "readmissions": readmissions,
+                         "fixes": fixes}
+    for k in ("qps_per_chip", "p50_ms", "p99_ms", "p99_ms_single",
+              "p99_vs_single", "coalesce_rate", "coalesced_batches",
+              "uncoalesced_batches", "model_swaps", "shed_requests",
+              "failed_requests", "leaked_rows", "parity",
+              "resident_bytes", "hbm_budget"):
+        if row.get(k) is not None:
+            v[k] = row[k]
+    if "resident_bytes" not in v and \
+            fleet_met.get("resident_bytes") is not None:
+        v["resident_bytes"] = fleet_met["resident_bytes"]
+    return [v]
 
 
 #: SLO clause -> the DAG stage that owns it (the e2e verdict's
@@ -725,6 +835,9 @@ def diagnose(bench: Optional[Dict[str, Any]],
     serving = _serve_verdicts(bench, metrics)
     if serving:
         doc["serving"] = serving
+    fleet = _fleet_verdicts(bench, metrics)
+    if fleet:
+        doc["fleet"] = fleet
     sweeps = _sweep_verdicts(bench)
     if sweeps:
         doc["tuning"] = sweeps
@@ -854,6 +967,51 @@ def render(doc: Dict[str, Any]) -> str:
         if not v.get("fixes"):
             out.append("  verdict: healthy — batches fill, programs "
                        "cache-hit, no failed/torn requests")
+    for v in doc.get("fleet", []):
+        out.append(f"\n== multi-tenant fleet: {v['workload']} ==")
+        if v.get("error"):
+            out.append(f"  ERROR: {v['error']}")
+            continue
+        line = (f"  {v['qps_per_chip']:,.0f} qps/chip"
+                if v.get("qps_per_chip") else "  qps n/a")
+        if v.get("tenants") is not None:
+            line += f" across {v['tenants']} tenants"
+        if v.get("p99_ms") is not None:
+            line += f", p99 {v['p99_ms']} ms"
+        if v.get("p99_vs_single") is not None:
+            line += (f" ({v['p99_vs_single']}x the single-model "
+                     f"baseline")
+            if v.get("p99_ms_single") is not None:
+                line += f" of {v['p99_ms_single']} ms"
+            line += ")"
+        out.append(line)
+        bits = []
+        if v.get("coalesce_rate") is not None:
+            bits.append(f"coalesce rate {v['coalesce_rate']:.1%}")
+        if v.get("coalesced_batches") is not None:
+            bits.append(f"{int(v['coalesced_batches'])} coalesced / "
+                        f"{int(v.get('uncoalesced_batches') or 0)} "
+                        f"solo batches")
+        if v.get("evictions") is not None:
+            bits.append(f"{int(v['evictions'])} evictions / "
+                        f"{int(v.get('readmissions') or 0)} "
+                        f"re-admissions")
+        if v.get("resident_bytes") is not None:
+            bits.append(f"resident {_fmt_bytes(v['resident_bytes'])}")
+        if v.get("model_swaps") is not None:
+            bits.append(f"{int(v['model_swaps'])} model swaps")
+        if v.get("parity"):
+            bits.append(f"parity {v['parity']}")
+        bits.append(f"{int(v.get('leaked_rows') or 0)} leaked rows")
+        if bits:
+            out.append("  " + ", ".join(bits))
+        for i, fx in enumerate(v.get("fixes") or [], 1):
+            out.append(f"  fix {i}: {fx}")
+        if not v.get("fixes"):
+            out.append("  verdict: healthy — tenants share compiled "
+                       "programs, batches coalesce, the HBM budget "
+                       "holds without thrash, and no tenant saw "
+                       "another tenant's scores")
     for v in doc.get("e2e", []):
         out.append(f"\n== online DAG e2e: {v['workload']} ==")
         if v.get("error"):

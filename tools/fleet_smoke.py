@@ -1,0 +1,293 @@
+#!/usr/bin/env python
+"""Multi-tenant fleet smoke (perf_gate leg, ISSUE 17) — exit 11.
+
+A 24-tenant fleet on a budget that holds only HALF of it, under a
+concurrent swap storm multiplexed through ONE ``ModelStreamFeeder``,
+while bursty cross-tenant traffic keeps the coalesced path hot. The
+contract it gates:
+
+  1. ZERO cross-tenant leakage, proven BITWISE: every probed response
+     matches a reference computed from that tenant's OWN model-version
+     set at one of the serving bucket shapes — never another tenant's
+     weights, never a torn half-swap. (References are computed at every
+     serving bucket because XLA's vectorization can shift the sigmoid
+     by an ULP between program shapes; a foreign tenant's weights move
+     the probabilities by ~1e-3, three orders above an ULP, so the
+     per-shape match still rejects every leak.)
+  2. the LRU eviction storm actually happened (evictions AND snapshot
+     re-admissions > 0 — the budget forces the fleet through the
+     store) and nothing failed or leaked THROUGH it;
+  3. ONE feeder drained the merged 2-round snapshot stream: every
+     tenant swapped twice (version 1 -> 3), zero skipped snapshots,
+     and the final sweep serves every tenant's LAST model bitwise;
+  4. cross-tenant batches really coalesced (coalesced_batches > 0) and
+     zero requests failed — quota/breaker isolation never tripped on a
+     healthy fleet.
+
+Runs in a fresh child interpreter (bootenv CPU mesh) so flags, fault
+counters and the metrics registry start from zero.
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+EXIT = 11
+_MARK = "ALINK_FLEET_SMOKE_CHILD"
+
+TENANTS = 24
+RESIDENT_FRACTION = 0.5          # budget holds half the fleet
+SENTINELS = 4                    # probed bitwise DURING the storm
+BUCKETS = (1, 4, 16)             # serving row-buckets (reference shapes)
+
+
+def main() -> int:
+    if os.environ.get(_MARK) != "1":
+        import bootenv
+        env = bootenv.cpu_mesh_env(4)
+        env[_MARK] = "1"
+        env.pop("ALINK_TPU_FAULT_INJECT", None)
+        # the coalesced path is the thing under test — force it on and
+        # keep the batching window short so the smoke stays fast
+        env["ALINK_TPU_FLEET_COALESCE"] = "1"
+        env.pop("ALINK_TPU_FLEET_HBM_BUDGET", None)
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             cwd=ROOT, env=env, timeout=900)
+        return out.returncode
+
+    import copy
+    import tempfile
+    import threading
+    import time
+
+    import numpy as np
+
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.common.params import Params
+    from alink_tpu.common.vector import DenseVector
+    from alink_tpu.operator.batch.classification.linear import (
+        LogisticRegressionTrainBatchOp)
+    from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+    from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+    from alink_tpu.serving import (CompiledPredictor, FleetServer,
+                                   ModelRegistry, ModelStreamFeeder)
+
+    bad = []
+
+    # -- fixture: one geometry, TENANTS perturbed-weight tenants ----------
+    n_rows, dim = 96, 8
+    rng = np.random.RandomState(11)
+    X = rng.randn(n_rows, dim)
+    y = (X @ rng.randn(dim) > 0).astype(np.int64)
+    vecs = np.empty(n_rows, object)
+    vecs[:] = [DenseVector(X[i]) for i in range(n_rows)]
+    tbl = MTable({"vec": vecs, "label": y}, "vec VECTOR, label LONG")
+    data_schema = tbl.select(["vec"]).schema
+
+    def _warm(seed):
+        op = LogisticRegressionTrainBatchOp(
+            vector_col="vec", label_col="label", max_iter=2 + seed % 2
+        ).link_from(MemSourceBatchOp(tbl.first_n(64 + 16 * (seed % 3))))
+        op.get_output_table()
+        return op
+
+    warm_a, warm_b = _warm(0), _warm(1)
+    pp = Params({"prediction_col": "pred", "vector_col": "vec",
+                 "prediction_detail_col": "det"})
+    mapper = LinearModelMapper(warm_a.get_output_table().schema,
+                               data_schema, pp)
+    mapper.load_model(warm_a.get_output_table())
+
+    tenant_mappers = {}
+    for i in range(TENANTS):
+        m = copy.deepcopy(mapper)
+        r = np.random.RandomState(7000 + i)
+        m.model.coef = np.asarray(m.model.coef) \
+            + 0.05 * r.randn(*np.shape(m.model.coef))
+        tenant_mappers[f"t{i}"] = m
+
+    per_tenant = sum(int(np.asarray(a).nbytes) for a in
+                     tenant_mappers["t0"].serving_kernel().model_arrays)
+    budget = max(1, int(TENANTS * RESIDENT_FRACTION)) * per_tenant
+    registry = ModelRegistry(
+        snapshot_dir=tempfile.mkdtemp(prefix="alink-fleet-smoke-"),
+        buckets=BUCKETS, hbm_budget=budget, name="fleet_smoke")
+    for tid, m in tenant_mappers.items():
+        registry.register(tid, m)
+
+    req = tbl.select(["vec"])
+    probes = {tid: req.row(i % n_rows)
+              for i, tid in enumerate(tenant_mappers)}
+
+    # per-tenant swap tables: distinct MTable objects over shared column
+    # arrays, so the feeder_target router stays idempotent per snapshot
+    swap_tables = {}            # (tid, round) -> MTable
+    route = {}                  # id(table) -> tenant id
+    for src, rnd in ((warm_a, 0), (warm_b, 1)):
+        mt = src.get_output_table()
+        for tid in tenant_mappers:
+            c = MTable({n: mt.col(n) for n in mt.col_names}, mt.schema)
+            swap_tables[(tid, rnd)] = c
+            route[id(c)] = tid
+
+    # Reference rows per tenant per MODEL at every serving bucket shape
+    # (the cross-shape ULP doctrine — see module docstring).
+    def _bucket_wants(m2, probe):
+        pred = CompiledPredictor(m2, buckets=BUCKETS)
+        wants = []
+        for b in BUCKETS:
+            out = pred.predict_table(MTable([probe] * b, data_schema))
+            wants.append(tuple(out.col(c)[0] for c in out.col_names))
+        return wants
+
+    def _swap_mapper(mt):
+        m2 = LinearModelMapper(mt.schema, data_schema, pp)
+        m2.load_model(mt)
+        return m2
+
+    mapper_a = _swap_mapper(warm_a.get_output_table())
+    mapper_b = _swap_mapper(warm_b.get_output_table())
+    sentinel_ids = [f"t{i}" for i in range(SENTINELS)]
+    # a sentinel may serve its original, round-0, or round-1 model while
+    # the storm is in flight — the want set is the union of the three
+    storm_wants = {tid: [w for m2 in (tenant_mappers[tid], mapper_a,
+                                      mapper_b)
+                         for w in _bucket_wants(m2, probes[tid])]
+                   for tid in sentinel_ids}
+
+    def _match(got, wants):
+        return any(all(str(a) == str(b) for a, b in zip(got, w))
+                   for w in wants)
+
+    srv = FleetServer(registry, min_fill=4, window_s=0.004,
+                      name="fleet_smoke")
+    probed = leaked = 0
+    try:
+        # -- the merged swap stream through ONE feeder --------------------
+        class _Merged:
+            # paced so the storm overlaps the probe loop for a few
+            # seconds instead of draining before the first probe lands
+            def timed_batches(self):
+                for rnd in (0, 1):
+                    for i, tid in enumerate(tenant_mappers):
+                        yield (float(rnd * TENANTS + i),
+                               swap_tables[(tid, rnd)])
+                        time.sleep(0.04)
+
+        target = srv.feeder_target(lambda mt: route[id(mt)])
+        feeder = ModelStreamFeeder(target, _Merged()).start()
+
+        # -- bursty cross-tenant load: keeps the eviction storm and the
+        # coalesced path running while the feeder swaps ------------------
+        stop = threading.Event()
+        load_failed = []
+
+        def _loader(offset):
+            ids = list(tenant_mappers)
+            k = 0
+            while not stop.is_set():
+                burst = [srv.submit(ids[(k + j + offset) % TENANTS],
+                                    probes[ids[(k + j + offset)
+                                               % TENANTS]])
+                         for j in range(8)]
+                for f in burst:
+                    try:
+                        f.result(60)
+                    except Exception as e:     # noqa: BLE001
+                        load_failed.append(repr(e))
+                k += 8
+
+        loaders = [threading.Thread(target=_loader, args=(off,),
+                                    daemon=True) for off in (0, 12)]
+        for th in loaders:
+            th.start()
+
+        # -- mid-storm sentinel probes: bitwise vs the OWN version set ---
+        deadline = time.monotonic() + 600
+        while feeder._thread.is_alive() and time.monotonic() < deadline:
+            for tid in sentinel_ids:
+                got = tuple(srv.submit(tid, probes[tid]).result(60))
+                probed += 1
+                if not _match(got, storm_wants[tid]):
+                    leaked += 1
+            time.sleep(0.01)
+        swapped = feeder.join(60)
+        stop.set()
+        for th in loaders:
+            th.join(30)
+
+        # -- feeder verdicts ---------------------------------------------
+        if feeder.error is not None:
+            bad.append(f"feeder died: {feeder.error!r}")
+        if feeder.skipped:
+            bad.append(f"feeder skipped {feeder.skipped} snapshots "
+                       f"(none were poisoned)")
+        if swapped != 2 * TENANTS:
+            bad.append(f"feeder drained {swapped} snapshots, expected "
+                       f"{2 * TENANTS} (2 rounds x {TENANTS} tenants)")
+        versions = {tid: registry.tenant(tid).version
+                    for tid in tenant_mappers}
+        wrong = {t: v for t, v in versions.items() if v != 3}
+        if wrong:
+            bad.append(f"{len(wrong)} tenants not at version 3 after "
+                       f"2 multiplexed swaps: {dict(list(wrong.items())[:4])}")
+
+        # -- final sweep: EVERY tenant serves its LAST model bitwise -----
+        for tid in tenant_mappers:
+            want = _bucket_wants(_swap_mapper(swap_tables[(tid, 1)]),
+                                 probes[tid])
+            got = tuple(srv.submit(tid, probes[tid]).result(60))
+            probed += 1
+            if not _match(got, want):
+                leaked += 1
+        if leaked:
+            bad.append(f"CRITICAL: {leaked}/{probed} probes did not "
+                       f"match the tenant's own model-version set "
+                       f"bitwise — cross-tenant leakage or a torn swap")
+
+        # -- storm + isolation verdicts ----------------------------------
+        rstats = registry.stats()
+        sstats = srv.stats()
+        if not rstats["evictions"]:
+            bad.append(f"zero evictions under a {RESIDENT_FRACTION:.0%} "
+                       f"budget — the eviction storm never happened")
+        if not rstats["readmissions"]:
+            bad.append("zero snapshot re-admissions — evicted tenants "
+                       "never came back through the store")
+        if rstats["resident_bytes"] > budget:
+            bad.append(f"resident_bytes {rstats['resident_bytes']} over "
+                       f"the {budget}-byte budget after the storm")
+        if not sstats["coalesced_batches"]:
+            bad.append("zero coalesced batches — cross-tenant stacking "
+                       "never engaged under bursty multi-tenant load")
+        if load_failed or sstats["failed"]:
+            bad.append(f"failed requests on a healthy fleet: "
+                       f"{sstats['failed']} server-side, "
+                       f"{len(load_failed)} client-side "
+                       f"({load_failed[:3]})")
+        print(f"fleet_smoke: {TENANTS} tenants on a "
+              f"{RESIDENT_FRACTION:.0%} budget — {probed} bitwise "
+              f"probes / {leaked} leaks, {rstats['evictions']} "
+              f"evictions / {rstats['readmissions']} re-admissions, "
+              f"{swapped} multiplexed swaps through one feeder, "
+              f"coalesce_rate "
+              f"{sstats['coalesce_rate']:.0%}")
+    finally:
+        srv.close()
+
+    if bad:
+        print("fleet_smoke: FAILED:", file=sys.stderr)
+        for m in bad:
+            print(f"  {m}", file=sys.stderr)
+        return EXIT
+    print("fleet_smoke: clean — zero cross-tenant leakage bitwise "
+          "through the swap + eviction storm")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

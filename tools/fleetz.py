@@ -156,6 +156,12 @@ _METRIC_ROWS = [
     ("breaker fallbacks", "alink_serve_breaker_fallback_total",
      "sum", "sum"),
     ("model swaps", "alink_serve_model_swaps_total", "sum", "sum"),
+    ("fleet tenants", "alink_fleet_tenants", "max", "sum"),
+    ("fleet evictions", "alink_fleet_evictions_total", "sum", "sum"),
+    ("fleet readmissions", "alink_fleet_readmissions_total",
+     "sum", "sum"),
+    ("fleet coalesced", "alink_fleet_coalesced_batches_total",
+     "sum", "sum"),
     ("slo breaches", "alink_slo_breaches_total", "sum", "sum"),
     ("slo burn (max)", "alink_slo_burn_rate", "max", "max"),
     ("slo alerts", "alink_slo_alerts_total", "sum", "sum"),

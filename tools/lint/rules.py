@@ -94,6 +94,17 @@ def default_config() -> LintConfig:
         # here must be key-neutral or declared
         FactoryRoot("alink_tpu/serving/sharded.py",
                     "make_linear_device_fns", frozenset({_PC})),
+        # the multi-tenant fleet (ISSUE 17): the geometry-group program
+        # factory compiles shared bucket programs keyed through
+        # ServingPlan.program_key (lane width is an explicit key
+        # dimension), and registration resolves the fleet flags — the
+        # ALINK_TPU_FLEET_* family must be key-neutral or fold
+        FactoryRoot("alink_tpu/serving/fleet.py",
+                    "_GeometryGroup.program", frozenset({_PC})),
+        FactoryRoot("alink_tpu/serving/fleet.py",
+                    "ModelRegistry.register", frozenset({_PC})),
+        FactoryRoot("alink_tpu/serving/sharded.py",
+                    "make_linear_fleet_fns", frozenset({_PC})),
         # the tuning sweep's program factory (ISSUE 12): one compiled
         # BSP program per compile group, keyed through the engine cache
         # — ALINK_TPU_SWEEP folds into the sweep program key, the ASHA

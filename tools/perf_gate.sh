@@ -87,6 +87,15 @@ python tools/e2e_smoke.py
 # 10 (its own code) so an observability regression names itself.
 python tools/adminz_smoke.py
 
+# multi-tenant fleet smoke (ISSUE 17): a 24-tenant fleet on a budget
+# that holds only half of it, under a swap storm multiplexed through
+# ONE ModelStreamFeeder — zero cross-tenant leakage proven bitwise
+# (per serving bucket shape) through concurrent swaps + LRU eviction/
+# re-admission, coalesced batches actually forming, zero failed
+# requests. Exits 11 (its own code) so a fleet-isolation regression
+# names itself.
+python tools/fleet_smoke.py
+
 # docs freshness gate (ISSUE 15 satellite, VERDICT #2): the README's
 # machine-generated performance/serving tables must match a fresh
 # regeneration from the newest driver-captured BENCH dump, and the
@@ -195,6 +204,33 @@ else:
     if not row.get("recovery_train_restart_s"):
         bad.append("serve_online_e2e: trainer restart recovery was "
                    "not measured")
+# the multi-tenant fleet row (ISSUE 17): the leak proof must be bitwise
+# over a real fleet (>= 100 tenants in the quick leg), the eviction
+# storm must have run through the snapshot store, batches must coalesce,
+# and p99 must stay in the same order as the single-model baseline
+# (loose CI bound — the doctor verdict carries the tight one)
+row = wl.get("serve_fleet")
+if not isinstance(row, dict) or "error" in row:
+    bad.append(f"serve_fleet: missing or errored "
+               f"({(row or {}).get('error')})")
+else:
+    if (row.get("tenants") or 0) < 100:
+        bad.append(f"serve_fleet: only {row.get('tenants')} concurrent "
+                   f"tenants (need >= 100)")
+    if row.get("leaked_rows"):
+        bad.append(f"serve_fleet: {row['leaked_rows']} probe rows "
+                   f"LEAKED another tenant's scores")
+    if row.get("parity") != "bitwise":
+        bad.append(f"serve_fleet: parity={row.get('parity')!r} "
+                   f"(coalesced fleet path diverged from the "
+                   f"per-tenant references)")
+    if row.get("coalesce_rate") is None or row.get("evictions") is None:
+        bad.append("serve_fleet: coalesce_rate/evictions missing — the "
+                   "row lost its storm evidence")
+    ratio = row.get("p99_vs_single")
+    if ratio is not None and ratio > 25:
+        bad.append(f"serve_fleet: fleet p99 runs {ratio}x the "
+                   f"single-model baseline (gate bound 25x)")
 if bad:
     print("perf_gate: serve smoke FAILED:", file=sys.stderr)
     for b in bad:
