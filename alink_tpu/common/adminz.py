@@ -28,6 +28,10 @@ just benchmarked, and need a live plane. This module is it:
               the registered status sections (program-cache sizes,
               model-swap history, live SLO clause + burn states)
   /tracez     a bounded snapshot of the PR-3 flight-recorder ring
+              (``?trace_id=`` narrows to one request's events)
+  /requestz   recent Layer-6 request timelines (admission → queue →
+              coalesce → dispatch → device → decode) plus everything
+              in flight; ``?trace_id=`` / ``?tenant=`` filter
   ========== ==========================================================
 
 * the :class:`ReadinessSource` contract — components plug their REAL
@@ -66,6 +70,7 @@ from .metrics import get_registry, metrics_enabled
 __all__ = [
     "AdminServer", "acquire_admin", "release_admin", "get_admin",
     "admin_enabled", "admin_port", "admin_host", "admin_tracez_events",
+    "admin_requestz_entries",
 ]
 
 
@@ -83,6 +88,12 @@ def admin_host() -> str:
 def admin_tracez_events() -> int:
     """``ALINK_TPU_ADMIN_TRACEZ``: max events per /tracez response."""
     return int(flag_value("ALINK_TPU_ADMIN_TRACEZ"))
+
+
+def admin_requestz_entries() -> int:
+    """``ALINK_TPU_ADMIN_REQUESTZ``: max request timelines per
+    /requestz response."""
+    return int(flag_value("ALINK_TPU_ADMIN_REQUESTZ"))
 
 
 def admin_enabled() -> bool:
@@ -143,8 +154,20 @@ class _Handler(BaseHTTPRequestHandler):
                     n = int(q["n"][0]) if "n" in q else None
                 except (TypeError, ValueError):
                     n = None
+                trace_id = q["trace_id"][0] if "trace_id" in q else None
                 code, ctype, body = 200, "application/json", \
-                    json.dumps(_json_safe(admin._tracez(n)))
+                    json.dumps(_json_safe(admin._tracez(n, trace_id)))
+            elif path == "/requestz":
+                q = parse_qs(parsed.query)
+                try:
+                    n = int(q["n"][0]) if "n" in q else None
+                except (TypeError, ValueError):
+                    n = None
+                trace_id = q["trace_id"][0] if "trace_id" in q else None
+                tenant = q["tenant"][0] if "tenant" in q else None
+                code, ctype, body = 200, "application/json", \
+                    json.dumps(_json_safe(
+                        admin._requestz(n, trace_id, tenant)))
             else:
                 code, ctype, body = 404, "text/plain; charset=utf-8", \
                     f"404: unknown admin path {path!r}\n" + admin._index()
@@ -163,7 +186,8 @@ class _Handler(BaseHTTPRequestHandler):
         if metrics_enabled():
             # path label is the bounded route set, never the raw path
             route = path if path in ("/", "/metrics", "/varz", "/healthz",
-                                     "/readyz", "/statusz", "/tracez") \
+                                     "/readyz", "/statusz", "/tracez",
+                                     "/requestz") \
                 else "other"
             reg = get_registry()
             reg.inc("alink_admin_requests_total", 1,
@@ -182,7 +206,7 @@ class AdminServer:
     """
 
     ENDPOINTS = ("/metrics", "/varz", "/healthz", "/readyz", "/statusz",
-                 "/tracez")
+                 "/tracez", "/requestz")
 
     def __init__(self, port: Optional[int] = None,
                  host: Optional[str] = None, name: str = "alink"):
@@ -345,16 +369,49 @@ class AdminServer:
             "sections": docs,
         }
 
-    def _tracez(self, n: Optional[int] = None) -> dict:
+    def _tracez(self, n: Optional[int] = None,
+                trace_id: Optional[str] = None) -> dict:
         """A bounded flight-recorder snapshot: the ring's meta plus the
-        LAST ``n`` events (default ``ALINK_TPU_ADMIN_TRACEZ``)."""
+        LAST ``n`` events (default ``ALINK_TPU_ADMIN_TRACEZ``).
+        ``?trace_id=`` keeps only events whose args carry that request
+        id (still clamped — the filter narrows, never widens)."""
         from .tracing import get_tracer
         tr = get_tracer()
         cap = admin_tracez_events()
         n = cap if n is None else max(1, min(int(n), cap))
         events = tr.events()
-        return {"meta": tr._meta(), "returned": min(n, len(events)),
-                "total_buffered": len(events), "events": events[-n:]}
+        total = len(events)
+        if trace_id is not None:
+            events = [e for e in events
+                      if (e.get("args") or {}).get("trace_id") == trace_id]
+        doc = {"meta": tr._meta(), "returned": min(n, len(events)),
+               "total_buffered": total, "events": events[-n:]}
+        if trace_id is not None:
+            doc["trace_id"] = trace_id
+        return doc
+
+    def _requestz(self, n: Optional[int] = None,
+                  trace_id: Optional[str] = None,
+                  tenant: Optional[str] = None) -> dict:
+        """Recent request timelines from the Layer-6 flight recorder
+        (:mod:`~alink_tpu.common.reqtrace`): completed requests newest
+        first, plus everything currently in flight. ``?n=`` is clamped
+        to ``ALINK_TPU_ADMIN_REQUESTZ``; ``?trace_id=`` / ``?tenant=``
+        filter (an exact trace_id match also searches in-flight)."""
+        from . import reqtrace
+        cap = admin_requestz_entries()
+        n = cap if n is None else max(1, min(int(n), cap))
+        recent = reqtrace.recent(n=n, tenant=tenant, trace_id=trace_id)
+        inflight = reqtrace.inflight_docs()
+        if tenant is not None:
+            inflight = [d for d in inflight if d.get("tenant") == tenant]
+        if trace_id is not None:
+            inflight = [d for d in inflight
+                        if d.get("trace_id") == trace_id]
+        return {"enabled": reqtrace.reqtrace_enabled(),
+                "returned": len(recent), "inflight": inflight,
+                "events": reqtrace.recent_events(n),
+                "requests": recent}
 
 
 # -- the refcounted process-wide instance ---------------------------------

@@ -309,6 +309,15 @@ def maybe_crash(site: str, index: Optional[int] = None) -> bool:
                   args={"site": site, "index": int(index),
                         "threshold": rule.lo, "mode": rule.mode})
     if rule.mode == "kill":
+        # the injected 'process kill' is exactly the crash class the
+        # post-mortem bundle exists for: freeze the evidence BEFORE the
+        # raise unwinds the rings' producers (lazy import — this module
+        # sits under common/flags.py in the import order; debounced, off
+        # without ALINK_TPU_POSTMORTEM_DIR)
+        from .postmortem import maybe_bundle
+        maybe_bundle("injected_kill", f"fault injected at {site}:{index}",
+                     extra={"site": site, "index": int(index),
+                            "threshold": rule.lo})
         raise FaultInjected(site, int(index), rule.lo)
     if rule.mode == "error":
         raise TransientFault(site, int(index), rule.lo)

@@ -393,6 +393,59 @@ FLAGS.register(
     "observability",
     folds_into=frozenset({PROGRAM_CACHE, CHECKPOINT_SIGNATURE}),
     accessor="alink_tpu.common.health.health_enabled")
+FLAGS.register(
+    "ALINK_TPU_REQTRACE", "bool", True,
+    "request-scoped tracing (common/reqtrace.py): per-request phase "
+    "timelines (admit->queue->coalesce->dispatch->device->decode), "
+    "tail-latency exemplars, and overlap annotations from concurrent "
+    "swap/eviction/lane-rebuild/breaker events", "observability",
+    key_neutral="host-side perf_counter marks and ring appends around "
+                "already-compiled dispatches; lowered HLO and "
+                "program-cache keys byte-identical on/off "
+                "(tests/test_reqtrace.py)",
+    accessor="alink_tpu.common.reqtrace.reqtrace_enabled")
+FLAGS.register(
+    "ALINK_TPU_REQTRACE_RING", "int", 1024,
+    "finished-request timeline ring capacity (what /requestz and "
+    "post-mortem bundles serve)", "observability",
+    key_neutral="sizes a host-side deque of finished-request documents; "
+                "never read at trace time",
+    clamp=lambda n: max(1, n), tolerant=True,
+    accessor="alink_tpu.common.reqtrace.ring_capacity")
+FLAGS.register(
+    "ALINK_TPU_ADMIN_REQUESTZ", "int", 256,
+    "max request timelines one /requestz response returns (?n= lowers "
+    "per-request; the ring itself is sized by ALINK_TPU_REQTRACE_RING)",
+    "observability",
+    key_neutral="bounds a host-side HTTP response body; the request "
+                "ring and traced programs never see it",
+    clamp=lambda n: max(1, n), tolerant=True,
+    accessor="alink_tpu.common.adminz.admin_requestz_entries")
+FLAGS.register(
+    "ALINK_TPU_POSTMORTEM_DIR", "str", "",
+    "post-mortem bundle directory (common/postmortem.py): on SLO burn "
+    "firing, breaker open, DAG stage abort, or injected kill, one "
+    "versioned JSON bundle (trace ring + request timelines + metrics "
+    "+ statusz + resolved flags) is published atomically here "
+    "(empty = capture off)", "observability",
+    key_neutral="output path for a host-side incident artifact; never "
+                "read inside a traced program",
+    accessor="alink_tpu.common.postmortem.postmortem_dir")
+FLAGS.register(
+    "ALINK_TPU_POSTMORTEM_KEEP", "int", 8,
+    "bounded bundle retention: the newest N bundles survive pruning",
+    "observability",
+    key_neutral="host-side file retention in the bundle directory only",
+    clamp=lambda n: max(1, n), tolerant=True)
+FLAGS.register(
+    "ALINK_TPU_POSTMORTEM_DEBOUNCE_S", "float", 60.0,
+    "process-wide bundle debounce window in seconds: one incident "
+    "firing several triggers (breaker open THEN burn alert) lands ONE "
+    "bundle; suppressed triggers count in "
+    "alink_postmortem_suppressed_total", "observability",
+    key_neutral="host-side rate limit on incident-artifact writes; "
+                "never trace-shaping",
+    clamp=lambda v: max(0.0, v), tolerant=True)
 
 # -- performance ------------------------------------------------------------
 FLAGS.register(

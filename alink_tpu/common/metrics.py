@@ -81,7 +81,7 @@ def _label_key(labels: Optional[Dict[str, Any]]) -> Tuple[Tuple[str, str], ...]:
 
 
 class _Series:
-    __slots__ = ("value", "counts", "sum", "count")
+    __slots__ = ("value", "counts", "sum", "count", "exemplars")
 
     def __init__(self, n_buckets: int = 0):
         self.value = 0.0
@@ -89,6 +89,12 @@ class _Series:
             self.counts = [0] * n_buckets  # per-bucket (non-cumulative)
             self.sum = 0.0
             self.count = 0
+            # one exemplar slot per bucket (OpenMetrics-style, last
+            # observation wins) — bounded by construction, so a p99
+            # bucket can link to a concrete request timeline (ISSUE 18)
+            # without the registry ever growing per-request state
+            self.exemplars: List[Optional[Dict[str, Any]]] = \
+                [None] * n_buckets
 
 
 class _Family:
@@ -161,7 +167,8 @@ class _Family:
             self._get_series(labels).value = float(value)
 
     def observe(self, value: float,
-                labels: Optional[Dict[str, Any]] = None) -> None:
+                labels: Optional[Dict[str, Any]] = None,
+                exemplar: Optional[Dict[str, Any]] = None) -> None:
         if self.kind != "histogram":
             raise TypeError(f"{self.name} is a {self.kind}, not a histogram")
         value = float(value)
@@ -174,6 +181,10 @@ class _Family:
             s.counts[i] += 1
             s.sum += value
             s.count += 1
+            if exemplar:
+                ex = dict(exemplar)
+                ex["value"] = value
+                s.exemplars[i] = ex
 
     # -- reading ----------------------------------------------------------
     def series(self) -> List[Tuple[Dict[str, str], _Series]]:
@@ -253,8 +264,10 @@ class MetricsRegistry:
 
     def observe(self, name: str, value: float,
                 labels: Optional[Dict[str, Any]] = None,
-                buckets: Optional[Sequence[float]] = None) -> None:
-        self.histogram(name, buckets=buckets).observe(value, labels)
+                buckets: Optional[Sequence[float]] = None,
+                exemplar: Optional[Dict[str, Any]] = None) -> None:
+        self.histogram(name, buckets=buckets).observe(value, labels,
+                                                      exemplar)
 
     def value(self, name: str,
               labels: Optional[Dict[str, Any]] = None) -> float:
@@ -287,6 +300,9 @@ class MetricsRegistry:
                         rec["counts"] = list(s.counts)
                         rec["sum"] = s.sum
                         rec["count"] = s.count
+                        if any(s.exemplars):
+                            rec["exemplars"] = [dict(e) if e else None
+                                                for e in s.exemplars]
                     else:
                         rec["value"] = s.value
                     out.append(rec)
@@ -358,6 +374,10 @@ class MetricsRegistry:
                     s.counts = list(rec["counts"])
                     s.sum = float(rec["sum"])
                     s.count = int(rec["count"])
+                    if rec.get("exemplars"):
+                        ex = list(rec["exemplars"])
+                        ex += [None] * (len(s.counts) - len(ex))
+                        s.exemplars = ex[:len(s.counts)]
             elif kind == "counter":
                 reg.counter(rec["name"], rec.get("help", "")) \
                    .inc(float(rec["value"]), labels)

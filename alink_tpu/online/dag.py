@@ -186,10 +186,24 @@ class _Pacer:
 
     def abort(self, stage: str, cause: BaseException) -> None:
         with self._cond:
-            if self._abort is None:
+            first = self._abort is None
+            if first:
                 self._abort = DagFailed(stage, cause)
             self.train_done = True
             self._cond.notify_all()
+        if first:
+            # the FIRST stage abort is the incident (later aborts are
+            # the shutdown cascade it causes): capture a post-mortem
+            # bundle while the rings still hold the failing stage's
+            # evidence (ISSUE 18; debounced, off without
+            # ALINK_TPU_POSTMORTEM_DIR)
+            from ..common import postmortem
+            postmortem.maybe_bundle(
+                "stage_abort",
+                f"online DAG stage {stage!r} aborted "
+                f"({type(cause).__name__}: {cause})",
+                extra={"stage": stage,
+                       "cause": type(cause).__name__})
 
     @property
     def aborted(self) -> Optional[DagFailed]:
@@ -675,6 +689,12 @@ class OnlineDag:
                                            "last_good.json")
         os.makedirs(self.ckpt_dir, exist_ok=True)
         os.makedirs(os.path.dirname(self.last_good_path), exist_ok=True)
+        # a stage-abort post-mortem bundle must name the restart point
+        # (ISSUE 18): point the bundle context at this DAG's durable
+        # artifacts (checkpoints + last-good serving model)
+        from ..common import postmortem
+        postmortem.set_context("checkpoint", self.ckpt_dir)
+        postmortem.set_context("last_good_model", self.last_good_path)
 
         # resolved at run()
         self.server = None

@@ -55,6 +55,7 @@ import threading
 import time
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
+from ..common import postmortem
 from ..common.flags import flag_value
 from ..common.metrics import get_registry, metrics_enabled
 from ..common.tracing import trace_instant
@@ -426,6 +427,18 @@ class SloBurnRate:
             del self.alerts[:-64]
             if active and reg is not None:
                 reg.inc("alink_slo_alerts_total", 1, labels)
+            if active and window == "fast":
+                # the paging alert IS the incident signal (ISSUE 18):
+                # capture a post-mortem bundle while the request/trace
+                # rings still hold the burn's evidence (debounced; off
+                # without ALINK_TPU_POSTMORTEM_DIR)
+                postmortem.maybe_bundle(
+                    "slo_burn",
+                    f"{self.name}: {slo} fast-window burn rate "
+                    f"{rate:.3f} >= {self.threshold}",
+                    extra={"dag": self.name, "slo": slo,
+                           "burn_rate": rate,
+                           "threshold": self.threshold})
         return rates
 
     # -- live verdicts (the admin plane reads these) ----------------------

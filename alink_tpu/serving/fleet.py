@@ -59,6 +59,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common import reqtrace
 from ..common.adminz import acquire_admin, release_admin
 from ..common.checkpoint import load_latest_validated, save_checkpoint
 from ..common.faults import FaultInjected, maybe_crash
@@ -366,6 +367,9 @@ class ModelRegistry:
                 self._resident_bytes += nbytes - was
             version = t.version
         group.bump_lanes()
+        reqtrace.annotate_inflight("swap", {"fleet": self.name,
+                                            "tenant": t.tid,
+                                            "version": version})
         self._evict_to_budget(keep=t.tid)
         if metrics_enabled():
             reg = get_registry()
@@ -435,6 +439,9 @@ class ModelRegistry:
         group.bump_lanes()
         trace_instant("fleet.readmit", cat="serve",
                       args={"tenant": t.tid, "bytes": t.nbytes})
+        reqtrace.annotate_inflight("readmit", {"fleet": self.name,
+                                               "tenant": t.tid,
+                                               "bytes": t.nbytes})
         if metrics_enabled():
             get_registry().inc("alink_fleet_readmissions_total", 1,
                                {"fleet": self.name})
@@ -482,6 +489,10 @@ class ModelRegistry:
                 self._group_of[t.tid].bump_lanes()
                 trace_instant("fleet.evict", cat="serve",
                               args={"tenant": t.tid, "bytes": t.nbytes})
+                reqtrace.annotate_inflight("evict",
+                                           {"fleet": self.name,
+                                            "tenant": t.tid,
+                                            "bytes": t.nbytes})
                 if metrics_enabled():
                     get_registry().inc("alink_fleet_evictions_total", 1,
                                        {"fleet": self.name})
@@ -673,8 +684,10 @@ class FleetServer:
                     raise TenantQuotaExceeded(tid, n, self._quota)
                 self._inflight[tid] = n + 1
         fut = _FleetRequest(tid, tuple(row), deadline_s=deadline_s)
+        fut.ctx = reqtrace.admit(tenant=tid)
         if not self._ch.put(fut):
             self._release_slot(tid)
+            reqtrace.finish(fut.ctx, outcome="rejected_closed")
             raise RuntimeError(f"FleetServer {self.name!r} is closed")
         return fut
 
@@ -717,6 +730,7 @@ class FleetServer:
                 for f in quarantined:
                     f.set_exception(ReplicaCrashed(0, e))
                     self._release_slot(f.tenant)
+                    reqtrace.finish(f.ctx, outcome="replica_crashed")
                 with self._stats_lock:
                     self._failed += len(quarantined)
                     self._quarantined += len(quarantined)
@@ -739,12 +753,17 @@ class FleetServer:
             first = self._ch.get()
             if first is _SENTINEL:
                 return
+            if first.ctx is not None:
+                first.ctx.mark("dequeue")
             inflight.append(first)
             deadline = None
             closing = False
             while len(inflight) < self.max_batch:
                 got = self._ch.drain(self.max_batch - len(inflight))
                 if got:
+                    for f in got:
+                        if f.ctx is not None:
+                            f.ctx.mark("dequeue")
                     inflight.extend(got)
                     continue
                 if len(inflight) >= self.min_fill:
@@ -760,6 +779,8 @@ class FleetServer:
                 if nxt is _SENTINEL:
                     closing = True
                     break
+                if nxt.ctx is not None:
+                    nxt.ctx.mark("dequeue")
                 inflight.append(nxt)
             self._serve(inflight)
             if closing:
@@ -794,6 +815,7 @@ class FleetServer:
             pass
         record_shed(self.name, reason)
         self._release_slot(fut.tenant)
+        reqtrace.finish(fut.ctx, outcome=f"shed_{reason}")
 
     def _breaker_for(self, tid: str, version: int) -> CircuitBreaker:
         """The tenant's ACTIVE-version breaker. Per-tenant state is the
@@ -832,6 +854,9 @@ class FleetServer:
         batch = self._admit(batch, time.perf_counter())
         if not batch:
             return
+        for f in batch:             # batch assembly / window hold ended
+            if f.ctx is not None:
+                f.ctx.mark("coalesce")
         # split by tenant, then stage per geometry group
         by_tenant: Dict[str, List[_FleetRequest]] = {}
         for f in batch:
@@ -844,6 +869,7 @@ class FleetServer:
                 for f in futs:
                     f.set_exception(e)
                     self._release_slot(f.tenant)
+                    reqtrace.finish(f.ctx, outcome="KeyError")
                 with self._stats_lock:
                     self._failed += len(futs)
                 continue
@@ -859,6 +885,8 @@ class FleetServer:
                         if not f.done():
                             f.set_exception(e)
                             self._release_slot(f.tenant)
+                            reqtrace.finish(f.ctx,
+                                            outcome=type(e).__name__)
                 with self._stats_lock:
                     self._failed += sum(len(fs) for _t, fs in entries)
 
@@ -963,6 +991,9 @@ class FleetServer:
                 group._lane_cache = (L, slots, stacked)
         with self._stats_lock:
             self._lane_rebuilds += 1
+        reqtrace.annotate_inflight("lane_rebuild",
+                                   {"fleet": self.name, "lanes": L,
+                                    "tenants": len(order)})
         return stacked, slots, L
 
     def _dispatch_coalesced(self, group: _GeometryGroup, compiled: List,
@@ -1022,13 +1053,19 @@ class FleetServer:
                 lane[off:off + len(m[2])] = slots[m[0]]
                 off += len(m[2])
             prog = group.program(kind, bucket, trailing, lanes=L)
+            ctxs = [f.ctx for m in members for f in m[2]
+                    if f.ctx is not None]
             settled = False
             try:
                 out = prog(stacked_model, jnp.asarray(lane),
                            *stacked_inputs)
+                for c in ctxs:
+                    c.mark("dispatch")
                 if not isinstance(out, (tuple, list)):
                     out = (out,)
                 host = jax.device_get(list(out))
+                for c in ctxs:
+                    c.mark("device")
                 done_t = time.perf_counter()
                 off = 0
                 delivered = []
@@ -1040,6 +1077,8 @@ class FleetServer:
                     off += n
                     delivered.append((tid, futs,
                                       ten.kernel.decode(sliced, data)))
+                for c in ctxs:
+                    c.mark("decode")
                 # decode succeeded for every member: settle the breakers
                 # BEFORE fan-out so a (never-expected) fan-out error
                 # cannot double-settle an acquire as both success and
@@ -1078,6 +1117,7 @@ class FleetServer:
         import jax
         ten = self.registry.tenant(tid)
         data = MTable([f.row for f in futs], ten.mapper.data_schema)
+        ctxs = [f.ctx for f in futs if f.ctx is not None]
         settled = False
         try:
             n = len(futs)
@@ -1087,11 +1127,17 @@ class FleetServer:
             prog = group.program(
                 kind, bucket, tuple(a.shape[1:] for a in arrays))
             out = prog(model, *arrays)
+            for c in ctxs:
+                c.mark("dispatch")
             if not isinstance(out, (tuple, list)):
                 out = (out,)
             host = jax.device_get(list(out))
+            for c in ctxs:
+                c.mark("device")
             sliced = tuple(np.asarray(a)[:n] for a in host)
             result = ten.kernel.decode(sliced, data)
+            for c in ctxs:
+                c.mark("decode")
             done_t = time.perf_counter()
             self._fan_out(tid, futs, result, done_t)
             if br is not None:
@@ -1128,20 +1174,34 @@ class FleetServer:
                  done_t: float) -> None:
         cols = [out.col(nm) for nm in out.col_names]
         ten = self.registry.tenant(tid)
+        rec = metrics_enabled()
+        reg = get_registry() if rec else None
+        lbl = {"server": self.name}
         lats = []
         for i, fut in enumerate(futs):
             fut.set_result(tuple(c[i] for c in cols))
-            lats.append(done_t - fut.submitted_at)
+            dt = done_t - fut.submitted_at
+            lats.append(dt)
             self._release_slot(tid)
+            ctx = fut.ctx
+            if ctx is None:
+                continue
+            qwait = ctx.phase_end("coalesce")
+            reqtrace.finish(ctx, outcome="ok")
+            if rec:
+                ex = {"trace_id": ctx.trace_id, "tenant": tid}
+                reg.observe("alink_serve_request_seconds", dt, lbl,
+                            exemplar=ex)
+                if qwait is not None:
+                    reg.observe("alink_serve_queue_wait_seconds", qwait,
+                                lbl, exemplar=ex)
         ten.requests += len(futs)
         ten.latencies.extend(lats)
         with self._stats_lock:
             self._requests += len(futs)
             self._latencies.extend(lats)
-        if metrics_enabled():
-            reg = get_registry()
-            reg.inc("alink_serve_requests_total", len(futs),
-                    {"server": self.name})
+        if rec:
+            reg.inc("alink_serve_requests_total", len(futs), lbl)
 
     # -- stats / admin / shutdown ---------------------------------------
     def _readiness(self) -> dict:

@@ -57,6 +57,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..common import reqtrace
 from ..common.faults import maybe_crash
 from ..common.metrics import get_registry, metrics_enabled
 from ..common.mtable import MTable
@@ -474,6 +475,10 @@ class CompiledPredictor:
                     standby.block_until_ready()
                 self._active = standby     # the atomic flip
             dt = time.perf_counter() - t0
+        # stamp the flip onto every request in flight: a tail exemplar
+        # overlapping this swap names it (ISSUE 18)
+        reqtrace.annotate_inflight("swap", {"predictor": self.name,
+                                            "version": standby.version})
         if metrics_enabled():
             reg = get_registry()
             reg.inc("alink_serve_model_swaps_total", 1,
@@ -524,6 +529,9 @@ class CompiledPredictor:
                     standby.block_until_ready()
                 self._active = standby     # the atomic flip
             dt = time.perf_counter() - t0
+        reqtrace.annotate_inflight("swap", {"predictor": self.name,
+                                            "version": standby.version,
+                                            "mode": "weights"})
         if metrics_enabled():
             reg = get_registry()
             reg.inc("alink_serve_model_swaps_total", 1,
@@ -717,10 +725,17 @@ class CompiledPredictor:
             out = prog(ver.arrays_for(replica), *placed)
         if not isinstance(out, (tuple, list)):
             out = (out,)
+        # request-timeline phase boundaries (ISSUE 18): dispatch work
+        # (encode + placement + program launch) ends here; the device
+        # wait is the host fetch; decode is the tail. No-ops outside a
+        # server batch scope — pure host bookkeeping either way.
+        reqtrace.batch_mark("dispatch")
         # ONE batched host fetch, then slice the padding rows off
         host = jax.device_get(list(out))
+        reqtrace.batch_mark("device")
         sliced = tuple(np.asarray(a)[:n] for a in host)
         result = ver.kernel.decode(sliced, data)
+        reqtrace.batch_mark("decode")
         trace_complete("serve.batch", time.perf_counter() - t0, cat="serve",
                        args={"rows": n, "bucket": bucket,
                              "model_version": ver.version})

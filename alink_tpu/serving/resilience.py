@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ..common import postmortem, reqtrace
 from ..common.metrics import get_registry, metrics_enabled
 from ..common.tracing import trace_instant
 
@@ -239,6 +240,21 @@ class CircuitBreaker:
         trace_instant("serve.breaker", cat="serve",
                       args={"from": frm, "to": to, "step": self._step,
                             "version": self.version})
+        # request-scoped causality (ISSUE 18): every request in flight
+        # across this transition gets the breaker event on its timeline
+        reqtrace.annotate_inflight(
+            "breaker", {"server": self.name, "from": frm, "to": to,
+                        "version": self.version})
+        if to == OPEN:
+            # breaker OPEN is an incident: freeze the evidence while the
+            # rings still hold it (debounced; off without
+            # ALINK_TPU_POSTMORTEM_DIR)
+            postmortem.maybe_bundle(
+                "breaker_open",
+                f"breaker {self.name} v{self.version} opened at step "
+                f"{self._step}",
+                extra={"server": self.name, "version": self.version,
+                       "step": self._step, "from": frm})
 
     # -- the serving loop's API -----------------------------------------
     def retire(self) -> None:

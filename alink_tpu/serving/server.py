@@ -37,6 +37,7 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Tuple
 
+from ..common import reqtrace
 from ..common.adminz import acquire_admin, release_admin
 from ..common.faults import FaultInjected
 from ..common.metrics import get_registry, metrics_enabled
@@ -75,7 +76,7 @@ class RequestFuture:
     resilience.RequestCancelled`)."""
 
     __slots__ = ("row", "_event", "_value", "_error", "submitted_at",
-                 "deadline_s", "_cancelled")
+                 "deadline_s", "_cancelled", "ctx")
 
     def __init__(self, row: Tuple, deadline_s: Optional[float] = None):
         self.row = row
@@ -85,6 +86,9 @@ class RequestFuture:
         self.submitted_at = time.perf_counter()
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self._cancelled = False
+        # request-scoped timeline (ISSUE 18) — None while the layer is
+        # off; every consumer tolerates that
+        self.ctx: Optional[reqtrace.RequestContext] = None
 
     def set_result(self, value) -> None:
         self._value = value
@@ -229,7 +233,9 @@ class PredictServer:
         if self._closed.is_set():
             raise RuntimeError(f"PredictServer {self.name!r} is closed")
         fut = RequestFuture(tuple(row), deadline_s=deadline_s)
+        fut.ctx = reqtrace.admit()
         if not self._ch.put(fut):
+            reqtrace.finish(fut.ctx, outcome="rejected_closed")
             raise RuntimeError(f"PredictServer {self.name!r} is closed")
         return fut
 
@@ -266,6 +272,7 @@ class PredictServer:
                 quarantined = [f for f in inflight if not f.done()]
                 for f in quarantined:
                     f.set_exception(ReplicaCrashed(replica, e))
+                    reqtrace.finish(f.ctx, outcome="replica_crashed")
                 with self._stats_lock:
                     self._failed += len(quarantined)
                     self._quarantined += len(quarantined)
@@ -289,12 +296,17 @@ class PredictServer:
             if first is _SENTINEL:
                 return
             inflight.append(first)
+            if first.ctx is not None:
+                first.ctx.mark("dequeue")
             deadline = None
             closing = False
             while len(inflight) < self.max_batch:
                 got = self._ch.drain(self.max_batch - len(inflight))
                 if got:
                     inflight.extend(got)
+                    for f in got:
+                        if f.ctx is not None:
+                            f.ctx.mark("dequeue")
                     continue
                 # queue drained: dispatch NOW unless the batch is under
                 # min_fill and latency budget remains
@@ -312,6 +324,8 @@ class PredictServer:
                     closing = True
                     break
                 inflight.append(nxt)
+                if nxt.ctx is not None:
+                    nxt.ctx.mark("dequeue")
             self._serve(inflight, replica)
             if closing:
                 return
@@ -329,6 +343,7 @@ class PredictServer:
                 fut.set_exception(RequestCancelled(
                     "request cancelled before dispatch"))
                 self._record_shed("cancelled")
+                reqtrace.finish(fut.ctx, outcome="shed_cancelled")
                 continue
             dl = fut.deadline_s
             if dl is not None:
@@ -336,6 +351,7 @@ class PredictServer:
                 if waited > dl:
                     fut.set_exception(DeadlineExceeded(waited, dl))
                     self._record_shed("deadline")
+                    reqtrace.finish(fut.ctx, outcome="shed_deadline")
                     continue
             kept.append(fut)
         return kept
@@ -384,6 +400,12 @@ class PredictServer:
         batch = self._admit(batch, time.perf_counter())
         if not batch:
             return
+        # the batch is assembled: the window hold / micro-batch
+        # coalescing ends here, dispatch work begins — the mark that
+        # closes the admission->dispatch queue wait
+        ctxs = [f.ctx for f in batch if f.ctx is not None]
+        for c in ctxs:
+            c.mark("coalesce")
         done_t = None
         route, br, settled = "compiled", None, False
         if serve_breaker_enabled():
@@ -407,7 +429,9 @@ class PredictServer:
                 out = self._fallback(data)
             else:
                 try:
-                    out = self.predictor.predict_table(data, replica=replica)
+                    with reqtrace.batch_scope(ctxs):
+                        out = self.predictor.predict_table(
+                            data, replica=replica)
                     if br is not None:
                         settled = True
                         br.on_success(probe=(route == "probe"))
@@ -471,12 +495,33 @@ class PredictServer:
             self._latencies.extend(lats)
             refresh = self._requests % _P99_EVERY < n
             p99 = _percentile(list(self._latencies), 99.0) if refresh else None
-        for dt in lats:
-            trace_complete("serve.request", dt, cat="serve",
-                           args={"batch_rows": n})
-        if metrics_enabled():
-            reg = get_registry()
-            lbl = {"server": self.name}
+        rec = metrics_enabled()
+        reg = get_registry() if rec else None
+        lbl = {"server": self.name}
+        for fut, dt in zip(batch, lats):
+            ctx = fut.ctx
+            if ctx is None:
+                trace_complete("serve.request", dt, cat="serve",
+                               args={"batch_rows": n})
+                continue
+            # the admission->dispatch queue wait ends at the coalesce
+            # mark (batch assembled, dispatch work starting)
+            qwait = ctx.phase_end("coalesce")
+            outcome = ("ok" if fut._error is None
+                       else type(fut._error).__name__)
+            reqtrace.finish(ctx, outcome=outcome)
+            if rec:
+                # the exemplar links the p99 bucket to THIS request's
+                # timeline (one bounded slot per bucket)
+                ex = {"trace_id": ctx.trace_id}
+                if ctx.tenant is not None:
+                    ex["tenant"] = ctx.tenant
+                reg.observe("alink_serve_request_seconds", dt, lbl,
+                            exemplar=ex)
+                if qwait is not None:
+                    reg.observe("alink_serve_queue_wait_seconds", qwait,
+                                lbl, exemplar=ex)
+        if rec:
             reg.inc("alink_serve_requests_total", n, lbl)
             reg.set_gauge("alink_serve_queue_depth", self._ch.depth(), lbl)
             if p99 is not None:

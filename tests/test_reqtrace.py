@@ -1,0 +1,627 @@
+"""Request-scoped tracing, tail-latency exemplars, and post-mortem
+bundles (ISSUE 18) — Layer 6 of the observability stack.
+
+The load-bearing invariants:
+  * every request served end-to-end carries the full mark chain
+    (admit -> dequeue -> coalesce -> dispatch -> device -> decode) and
+    the p99 exemplar of the request/queue-wait histograms resolves to
+    one of those timelines — a tail number is a *request*, not just a
+    bucket count;
+  * concurrent swap/breaker events annotate overlapping in-flight
+    requests (bounded per request), and land in the process event ring
+    that bundles archive;
+  * a multi-tenant fleet storm never bleeds one tenant's exemplar or
+    timeline into another tenant's view;
+  * lowered HLO and program-cache hit counts are BYTE-IDENTICAL with
+    request tracing on vs off — Layer 6 is host-side only;
+  * incident triggers (breaker open, injected kill) capture exactly
+    ONE debounced, atomically-published bundle that doctor and trace
+    render offline with nothing else on disk.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import importlib.util
+
+import numpy as np
+import pytest
+
+from alink_tpu.common import postmortem, reqtrace
+from alink_tpu.common.adminz import AdminServer
+from alink_tpu.common.faults import (FaultInjected, maybe_crash,
+                                     reset_faults, scoped_fault_env)
+from alink_tpu.common.metrics import MetricsRegistry, set_registry
+from alink_tpu.common.mtable import MTable
+from alink_tpu.common.params import Params
+from alink_tpu.common.reqtrace import (MAX_ANNOTATIONS, RequestContext,
+                                       p99_exemplar)
+from alink_tpu.common.tracing import Tracer, set_tracer
+from alink_tpu.common.vector import DenseVector
+from alink_tpu.operator.batch.classification.linear import (
+    LogisticRegressionTrainBatchOp)
+from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+from alink_tpu.serving import (CompiledPredictor, FleetServer,
+                               ModelRegistry, PredictServer)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FULL_MARKS = {"admit", "dequeue", "coalesce", "dispatch", "device",
+              "decode"}
+
+
+@pytest.fixture(autouse=True)
+def clean_layer6():
+    """Every test starts with empty rings and a fresh debounce clock."""
+    reqtrace.reset()
+    postmortem.reset_debounce()
+    postmortem.clear_context()
+    yield
+    reqtrace.reset()
+    postmortem.reset_debounce()
+    postmortem.clear_context()
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        yield reg
+    finally:
+        set_registry(prev)
+
+
+@pytest.fixture
+def clean_faults(monkeypatch):
+    reset_faults()
+    yield monkeypatch
+    monkeypatch.delenv("ALINK_TPU_FAULT_INJECT", raising=False)
+    reset_faults()
+
+
+@pytest.fixture(scope="module")
+def base():
+    rng = np.random.RandomState(3)
+    n, d = 128, 10
+    X = rng.randn(n, d)
+    y = (X @ rng.randn(d) > 0).astype(np.int64)
+    vecs = np.empty(n, object)
+    vecs[:] = [DenseVector(X[i]) for i in range(n)]
+    tbl = MTable({"vec": vecs, "label": y}, "vec VECTOR, label LONG")
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label",
+        max_iter=2).link_from(MemSourceBatchOp(tbl))
+    data_schema = tbl.select(["vec"]).schema
+    mapper = LinearModelMapper(warm.get_output_table().schema, data_schema,
+                               Params({"prediction_col": "pred",
+                                       "vector_col": "vec"}))
+    mapper.load_model(warm.get_output_table())
+    return tbl, warm, mapper, data_schema
+
+
+def _get(url, path):
+    try:
+        with urllib.request.urlopen(url + path, timeout=10) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_reqtrace_t", os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _hist(reg, name):
+    return [r for r in reg.snapshot() if r["name"] == name]
+
+
+# -- the context substrate ---------------------------------------------------
+
+class TestRequestContext:
+    def test_mark_chain_becomes_named_phases(self):
+        ctx = RequestContext("r1", tenant="acme")
+        for m in ("dequeue", "coalesce", "dispatch", "device", "decode"):
+            ctx.mark(m)
+        doc = ctx.to_doc(total_s=ctx.elapsed_s())
+        assert [m["phase"] for m in doc["marks"]] == \
+            ["admit", "dequeue", "coalesce", "dispatch", "device",
+             "decode"]
+        # the queue phase is named by its ENDING mark (dequeue); every
+        # other phase carries its own mark's name
+        assert set(doc["phases"]) == {"queue_s", "coalesce_s",
+                                      "dispatch_s", "device_s",
+                                      "decode_s"}
+        assert doc["tenant"] == "acme"
+        assert doc["total_s"] >= doc["marks"][-1]["t_s"]
+        # offsets are monotonic from admission (t=0)
+        ts = [m["t_s"] for m in doc["marks"]]
+        assert ts[0] == 0.0 and ts == sorted(ts)
+
+    def test_annotations_bounded_with_overflow_count(self):
+        ctx = RequestContext("r2")
+        for i in range(MAX_ANNOTATIONS + 5):
+            ctx.annotate("swap", {"version": i})
+        assert len(ctx.annotations) == MAX_ANNOTATIONS
+        assert ctx.dropped_annotations == 5
+        assert ctx.to_doc()["dropped_annotations"] == 5
+
+    def test_ring_respects_flag_capacity(self, monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_REQTRACE_RING", "4")
+        ids = []
+        for _ in range(10):
+            ctx = reqtrace.admit()
+            ids.append(ctx.trace_id)
+            reqtrace.finish(ctx)
+        docs = reqtrace.recent()
+        assert len(docs) == 4
+        # newest first, and the survivors are the LAST four finished
+        assert [d["trace_id"] for d in docs] == ids[-1:-5:-1]
+        assert reqtrace.find(ids[0]) is None
+        assert reqtrace.find(ids[-1])["trace_id"] == ids[-1]
+
+    def test_off_switch_mints_nothing(self, monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_REQTRACE", "0")
+        assert reqtrace.admit() is None
+        assert reqtrace.finish(None) is None
+        assert reqtrace.recent() == []
+
+    def test_annotate_inflight_stamps_live_requests_and_event_ring(self):
+        ctx = reqtrace.admit(tenant="a")
+        done = reqtrace.admit(tenant="b")
+        reqtrace.finish(done)
+        n = reqtrace.annotate_inflight("evict", {"tenant": "c",
+                                                 "bytes": 128})
+        assert n == 1                      # only the in-flight request
+        assert ctx.annotations[0]["kind"] == "evict"
+        assert ctx.annotations[0]["args"]["bytes"] == 128
+        evs = reqtrace.recent_events()
+        assert evs and evs[-1]["kind"] == "evict"
+        # the finished request never saw it
+        assert reqtrace.find(done.trace_id)["annotations"] == []
+
+    def test_p99_exemplar_lower_bucket_fallback(self):
+        rec = {"buckets": [0.1, 1.0], "counts": [10, 0, 1],
+               "exemplars": [{"trace_id": "rA", "value": 0.05},
+                             None, None]}
+        # p99 falls in the +Inf bucket, which never caught an exemplar
+        # — the nearest LOWER bucket's exemplar still names a request
+        assert p99_exemplar(rec)["trace_id"] == "rA"
+        assert p99_exemplar({"buckets": [], "counts": [],
+                             "exemplars": []}) is None
+
+
+# -- the serving path end-to-end ---------------------------------------------
+
+class TestServerTimeline:
+    def test_full_timeline_and_p99_exemplar_resolve(self, base,
+                                                    fresh_registry):
+        tbl, _w, mapper, _s = base
+        req = tbl.select(["vec"])
+        srv = PredictServer(CompiledPredictor(mapper, buckets=(1, 4)),
+                            name="tl")
+        try:
+            for f in [srv.submit(req.row(i)) for i in range(12)]:
+                f.result(60)
+        finally:
+            srv.close()
+        docs = reqtrace.recent()
+        assert len(docs) == 12
+        for d in docs:
+            assert {m["phase"] for m in d["marks"]} >= FULL_MARKS
+            assert d["outcome"] == "ok"
+            assert set(d["phases"]) >= {"queue_s", "coalesce_s",
+                                        "dispatch_s", "device_s",
+                                        "decode_s"}
+        # both histograms observed every request, labeled by server
+        for name in ("alink_serve_request_seconds",
+                     "alink_serve_queue_wait_seconds"):
+            recs = _hist(fresh_registry, name)
+            assert len(recs) == 1, name
+            assert recs[0]["labels"] == {"server": "tl"}
+            assert recs[0]["count"] == 12
+            # the p99 exemplar resolves to a full captured timeline
+            ex = p99_exemplar(recs[0])
+            assert ex is not None and "trace_id" in ex
+            doc = reqtrace.find(ex["trace_id"])
+            assert doc is not None
+            assert {m["phase"] for m in doc["marks"]} >= FULL_MARKS
+
+    def test_exemplars_round_trip_snapshot_load(self, base,
+                                                fresh_registry,
+                                                tmp_path):
+        tbl, _w, mapper, _s = base
+        req = tbl.select(["vec"])
+        srv = PredictServer(CompiledPredictor(mapper, buckets=(1,)),
+                            name="rt")
+        try:
+            srv.submit(req.row(0)).result(60)
+        finally:
+            srv.close()
+        p = tmp_path / "metrics.json"
+        fresh_registry.dump(str(p))
+        reloaded = MetricsRegistry.load(str(p))
+        rec = _hist(reloaded, "alink_serve_request_seconds")[0]
+        assert p99_exemplar(rec)["trace_id"] == \
+            p99_exemplar(_hist(fresh_registry,
+                               "alink_serve_request_seconds")[0]
+                         )["trace_id"]
+
+    def test_swap_annotates_overlapping_request(self, base,
+                                                fresh_registry):
+        tbl, warm, mapper, _s = base
+        srv = PredictServer(CompiledPredictor(mapper, buckets=(1, 4)),
+                            name="sw")
+        try:
+            # a request admitted but never dispatched IS in flight —
+            # the swap flip must stamp its timeline deterministically
+            ctx = reqtrace.admit()
+            srv.swap_model(warm.get_output_table())
+            kinds = [a["kind"] for a in ctx.annotations]
+            assert "swap" in kinds
+            evs = [e for e in reqtrace.recent_events()
+                   if e["kind"] == "swap"]
+            assert evs and evs[-1]["args"]["version"] == 2
+            reqtrace.finish(ctx)
+            assert "swap" in [a["kind"] for a in
+                              reqtrace.find(ctx.trace_id)["annotations"]]
+        finally:
+            srv.close()
+
+
+# -- multi-tenant isolation ---------------------------------------------------
+
+class TestFleetIsolation:
+    def test_storm_has_no_cross_tenant_bleed(self, base, fresh_registry,
+                                             tmp_path):
+        import copy
+        tbl, _w, mapper, _s = base
+        req = tbl.select(["vec"])
+        tenants = {}
+        for i in range(4):
+            m = copy.deepcopy(mapper)
+            r = np.random.RandomState(500 + i)
+            m.model.coef = np.asarray(m.model.coef) \
+                + 0.05 * r.randn(*np.shape(m.model.coef))
+            tenants[f"t{i}"] = m
+        registry = ModelRegistry(snapshot_dir=str(tmp_path),
+                                 buckets=(1, 4), name="iso")
+        for tid, m in tenants.items():
+            registry.register(tid, m)
+        srv = FleetServer(registry, min_fill=4, window_s=0.002,
+                          name="iso")
+        per_tenant = 8
+        try:
+            futs = [(tid, srv.submit(tid, req.row(i)))
+                    for i in range(per_tenant)
+                    for tid in tenants]
+            for _tid, f in futs:
+                f.result(60)
+        finally:
+            srv.close()
+        # every finished timeline carries exactly its own tenant, with
+        # the full mark chain even through the coalesced path
+        for tid in tenants:
+            docs = reqtrace.recent(tenant=tid)
+            assert len(docs) == per_tenant, tid
+            for d in docs:
+                assert d["tenant"] == tid
+                assert {m["phase"] for m in d["marks"]} >= FULL_MARKS
+        # exemplar bleed check: each histogram exemplar's tenant tag
+        # must match the tenant of the timeline its trace_id names
+        checked = 0
+        for name in ("alink_serve_request_seconds",
+                     "alink_serve_queue_wait_seconds"):
+            for rec in _hist(fresh_registry, name):
+                for ex in (rec.get("exemplars") or []):
+                    if not ex:
+                        continue
+                    doc = reqtrace.find(ex["trace_id"])
+                    assert doc is not None
+                    assert doc["tenant"] == ex["tenant"], (name, ex)
+                    checked += 1
+        assert checked > 0
+
+    def test_shed_and_rejected_outcomes_are_typed(self, base,
+                                                  fresh_registry,
+                                                  tmp_path):
+        tbl, _w, mapper, _s = base
+        req = tbl.select(["vec"])
+        registry = ModelRegistry(snapshot_dir=str(tmp_path),
+                                 buckets=(1,), name="shed")
+        registry.register("t0", mapper)
+        srv = FleetServer(registry, name="shed")
+        try:
+            f = srv.submit("t0", req.row(0), deadline_s=0.0)
+            with pytest.raises(Exception):
+                f.result(60)
+        finally:
+            srv.close()
+        outcomes = {d["outcome"] for d in reqtrace.recent()}
+        assert any(o.startswith("shed_") or o == "ok" for o in outcomes)
+        # nothing is left dangling in the in-flight set after close
+        assert reqtrace.inflight_docs() == []
+
+
+# -- zero compiled ops --------------------------------------------------------
+
+class TestZeroCompiledOps:
+    def test_lowered_hlo_identical_on_off(self, monkeypatch):
+        import jax
+        import jax.numpy as jnp
+
+        def fn(x):
+            return (x @ x).sum()
+
+        x = jnp.ones((16, 16), jnp.float32)
+        monkeypatch.setenv("ALINK_TPU_REQTRACE", "0")
+        off = jax.jit(fn).lower(x).as_text()
+        monkeypatch.setenv("ALINK_TPU_REQTRACE", "1")
+        ctxs = [reqtrace.admit() for _ in range(4)]
+        with reqtrace.batch_scope(ctxs):
+            reqtrace.batch_mark("dispatch")
+            on = jax.jit(fn).lower(x).as_text()
+        for c in ctxs:
+            reqtrace.finish(c)
+        assert on == off
+        low = on.lower()
+        assert "callback" not in low and "outfeed" not in low
+
+    def test_program_cache_hits_identical_on_off(self, base,
+                                                 fresh_registry,
+                                                 monkeypatch):
+        tbl, _w, mapper, _s = base
+        probe = tbl.select(["vec"]).first_n(4)
+
+        def run():
+            srv = PredictServer(CompiledPredictor(mapper, buckets=(4,),
+                                                  name="zc"),
+                                name="zc")
+            try:
+                for _ in range(3):
+                    for f in [srv.submit(probe.row(i)) for i in range(4)]:
+                        f.result(60)
+                return srv.predictor.cache_stats()
+            finally:
+                srv.close()
+
+        monkeypatch.setenv("ALINK_TPU_REQTRACE", "0")
+        stats_off = run()
+        reqtrace.reset()
+        monkeypatch.setenv("ALINK_TPU_REQTRACE", "1")
+        stats_on = run()
+        assert stats_on == stats_off
+        assert stats_on["hits"] >= 1
+        # and the requests really were traced in the ON run
+        assert len(reqtrace.recent()) == 12
+
+
+# -- post-mortem bundles ------------------------------------------------------
+
+class TestPostmortem:
+    def test_bundle_contents_and_debounce(self, base, fresh_registry,
+                                          monkeypatch, tmp_path):
+        monkeypatch.setenv("ALINK_TPU_POSTMORTEM_DIR", str(tmp_path))
+        tbl, _w, mapper, _s = base
+        req = tbl.select(["vec"])
+        srv = PredictServer(CompiledPredictor(mapper, buckets=(1,)),
+                            name="pm")
+        try:
+            for f in [srv.submit(req.row(i)) for i in range(4)]:
+                f.result(60)
+        finally:
+            srv.close()
+        postmortem.set_context("checkpoint", "/ckpt/42")
+        path = postmortem.maybe_bundle("breaker_open", "unit trigger",
+                                       extra={"step": 2})
+        assert path is not None and os.path.exists(path)
+        # debounced: a cascading second trigger writes NOTHING
+        assert postmortem.maybe_bundle("slo_burn", "cascade") is None
+        files = os.listdir(str(tmp_path))
+        assert len(files) == 1 and not any(f.endswith(".tmp")
+                                           for f in files)
+        doc = postmortem.load_bundle(path)
+        assert doc["format"] == postmortem.BUNDLE_FORMAT
+        assert doc["reason"] == "breaker_open"
+        assert doc["detail"] == "unit trigger"
+        assert doc["extra"] == {"step": 2}
+        assert doc["context"]["checkpoint"] == "/ckpt/42"
+        assert len(doc["requests"]) == 4
+        assert {m["phase"] for m in doc["requests"][0]["marks"]} \
+            >= FULL_MARKS
+        assert doc["flags"].get("ALINK_TPU_REQTRACE") is True
+        assert any(r["name"] == "alink_serve_request_seconds"
+                   for r in doc["metrics"])
+        # the suppressed cascade is countable
+        assert any(r["name"] == "alink_postmortem_suppressed_total"
+                   for r in fresh_registry.snapshot())
+
+    def test_debounce_window_and_retention(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("ALINK_TPU_POSTMORTEM_DIR", str(tmp_path))
+        monkeypatch.setenv("ALINK_TPU_POSTMORTEM_DEBOUNCE_S", "0")
+        monkeypatch.setenv("ALINK_TPU_POSTMORTEM_KEEP", "2")
+        paths = [postmortem.maybe_bundle(f"r{i}") for i in range(4)]
+        assert all(p is not None for p in paths)
+        left = sorted(os.listdir(str(tmp_path)))
+        assert len(left) == 2
+        # retention keeps the NEWEST bundles
+        assert os.path.basename(paths[-1]) in left
+
+    def test_breaker_open_storm_writes_one_bundle(self, base,
+                                                  fresh_registry,
+                                                  clean_faults,
+                                                  tmp_path):
+        clean_faults.setenv("ALINK_TPU_POSTMORTEM_DIR", str(tmp_path))
+        tbl, _w, mapper, _s = base
+        req = tbl.select(["vec"])
+        srv = PredictServer(CompiledPredictor(mapper, buckets=(1,)),
+                            name="pmb")
+        try:
+            srv.submit(req.row(0)).result(60)
+            with scoped_fault_env("serve.dispatch:1-8:error"):
+                for i in range(8):      # closed loop: no coalescing
+                    try:
+                        srv.submit(req.row(i)).result(60)
+                    except Exception:
+                        pass
+        finally:
+            srv.close()
+        bundles = glob.glob(os.path.join(str(tmp_path),
+                                         "postmortem_*.json"))
+        assert len(bundles) == 1
+        doc = postmortem.load_bundle(bundles[0])
+        assert doc["reason"] == "breaker_open"
+        # requests in flight across the OPEN transition carry the
+        # breaker event on their timelines OR the event ring holds it
+        assert any(e["kind"] == "breaker"
+                   for e in doc["events"])
+
+    def test_injected_kill_writes_bundle(self, clean_faults, tmp_path):
+        clean_faults.setenv("ALINK_TPU_POSTMORTEM_DIR", str(tmp_path))
+        with scoped_fault_env("unit.kill:1-1:kill"):
+            with pytest.raises(FaultInjected):
+                maybe_crash("unit.kill")
+        bundles = glob.glob(os.path.join(str(tmp_path),
+                                         "postmortem_*.json"))
+        assert len(bundles) == 1
+        doc = postmortem.load_bundle(bundles[0])
+        assert doc["reason"] == "injected_kill"
+        assert doc["extra"]["site"] == "unit.kill"
+
+    def test_unarmed_dir_writes_nothing(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("ALINK_TPU_POSTMORTEM_DIR", raising=False)
+        assert postmortem.maybe_bundle("breaker_open") is None
+
+
+# -- offline rendering (doctor + trace) ---------------------------------------
+
+class TestOfflineRendering:
+    def _bundle(self, base, tmp_path, monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_POSTMORTEM_DIR", str(tmp_path))
+        tbl, _w, mapper, _s = base
+        req = tbl.select(["vec"])
+        srv = PredictServer(CompiledPredictor(mapper, buckets=(1,)),
+                            name="od")
+        try:
+            for f in [srv.submit(req.row(i)) for i in range(6)]:
+                f.result(60)
+        finally:
+            srv.close()
+        path = postmortem.maybe_bundle("slo_burn", "offline fixture")
+        assert path is not None
+        return path, reqtrace.recent()[0]["trace_id"]
+
+    def test_doctor_renders_verdict_from_bundle_alone(
+            self, base, fresh_registry, monkeypatch, tmp_path):
+        path, _tid = self._bundle(base, tmp_path, monkeypatch)
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "doctor.py"),
+             "--bundle", path],
+            capture_output=True, text=True, cwd=ROOT, timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert "post-mortem: slo_burn" in out.stdout
+        assert "verdict:" in out.stdout
+        assert "request timelines" in out.stdout
+        # the doctor re-summarizes the bundled metrics dump offline
+        assert "queue" in out.stdout
+
+    def test_trace_renders_one_request_lifetime(self, base,
+                                                fresh_registry,
+                                                monkeypatch, tmp_path):
+        path, tid = self._bundle(base, tmp_path, monkeypatch)
+        trace = _load_tool("trace")
+        meta, events = trace.load_events(path)
+        text = trace.render_request(meta, events, tid)
+        assert text is not None
+        assert f"request {tid}" in text
+        for mark in ("admit", "dequeue", "dispatch", "decode"):
+            assert mark in text
+        # an id the bundle never saw renders nothing
+        assert trace.render_request(meta, events, "r99999999") is None
+
+    def test_doctor_rejects_wrong_format(self, tmp_path):
+        bad = tmp_path / "not_a_bundle.json"
+        bad.write_text(json.dumps({"format": "something_else"}))
+        out = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "doctor.py"),
+             "--bundle", str(bad)],
+            capture_output=True, text=True, cwd=ROOT, timeout=120)
+        assert out.returncode != 0
+
+
+# -- the admin plane ----------------------------------------------------------
+
+class TestAdminEndpoints:
+    def test_requestz_serves_filtered_timelines(self, fresh_registry):
+        for i in range(6):
+            ctx = reqtrace.admit(tenant="a" if i % 2 else "b")
+            ctx.mark("dequeue")
+            reqtrace.finish(ctx)
+        live = reqtrace.admit(tenant="a")
+        with AdminServer(port=-1).start() as srv:
+            code, text = _get(srv.url, "/requestz")
+            assert code == 200
+            doc = json.loads(text)
+            assert doc["enabled"] is True
+            assert doc["returned"] == 6
+            assert len(doc["inflight"]) == 1
+            assert doc["inflight"][0]["trace_id"] == live.trace_id
+            # tenant filter
+            _, text = _get(srv.url, "/requestz?tenant=a")
+            doc_a = json.loads(text)
+            assert all(r["tenant"] == "a" for r in doc_a["requests"])
+            assert len(doc_a["inflight"]) == 1
+            # n= narrows; trace_id= pinpoints
+            _, text = _get(srv.url, "/requestz?n=2")
+            assert len(json.loads(text)["requests"]) == 2
+            tid = doc["requests"][0]["trace_id"]
+            _, text = _get(srv.url, f"/requestz?trace_id={tid}")
+            got = json.loads(text)["requests"]
+            assert len(got) == 1 and got[0]["trace_id"] == tid
+        reqtrace.finish(live)
+
+    def test_requestz_clamped_by_flag(self, fresh_registry, monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_ADMIN_REQUESTZ", "3")
+        for _ in range(10):
+            reqtrace.finish(reqtrace.admit())
+        with AdminServer(port=-1).start() as srv:
+            _, text = _get(srv.url, "/requestz?n=50")
+            assert len(json.loads(text)["requests"]) == 3
+
+    def test_tracez_filters_by_trace_id(self, fresh_registry,
+                                        monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_TRACE", "1")
+        tr = Tracer(capacity=64)
+        prev = set_tracer(tr)
+        try:
+            ids = []
+            for _ in range(5):
+                ctx = reqtrace.admit()
+                ids.append(ctx.trace_id)
+                reqtrace.finish(ctx)
+            with AdminServer(port=-1).start() as srv:
+                code, text = _get(srv.url,
+                                  f"/tracez?trace_id={ids[2]}")
+                assert code == 200
+                doc = json.loads(text)
+                assert doc["trace_id"] == ids[2]
+                assert doc["events"], "no serve.request event captured"
+                for e in doc["events"]:
+                    assert e["args"]["trace_id"] == ids[2]
+                # unfiltered view still carries every request's event
+                _, text = _get(srv.url, "/tracez")
+                allv = json.loads(text)
+                got = {e["args"]["trace_id"] for e in allv["events"]
+                       if (e.get("args") or {}).get("trace_id")}
+                assert set(ids) <= got
+        finally:
+            set_tracer(prev)

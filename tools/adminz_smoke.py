@@ -190,6 +190,14 @@ def main() -> int:
             doc = json.loads(body)
             if f"dag:adminz_b" in doc.get("sections", {}):
                 statusz_last = doc
+        if health_codes[-1] == 503:
+            # breaker recovery can close within one backoff (50-200 ms)
+            # of the storm ending — tight-poll the 503->200 edge so the
+            # verdict below is event-driven, not polling-period luck
+            while th.is_alive() and health_codes[-1] == 503:
+                health_codes.append(get("/healthz")[0])
+                time.sleep(0.005)
+            continue
         time.sleep(0.03)
     th.join()
     rep = result.get("report")

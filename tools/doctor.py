@@ -127,6 +127,33 @@ def _records_from_url(url: str) -> List[dict]:
     return [r for r in doc if isinstance(r, dict)]
 
 
+def _hist_p99(rec: Dict[str, Any]
+              ) -> Tuple[Optional[float], Optional[dict]]:
+    """(p99 upper-bound estimate, that bucket's exemplar) from one
+    histogram snapshot record. The exemplar falls back to the nearest
+    LOWER bucket that caught one (the reqtrace.p99_exemplar contract)
+    so a tail bucket whose slot was never hit still resolves to a real
+    request."""
+    counts = rec.get("counts") or []
+    total = sum(counts)
+    if not total:
+        return None, None
+    bounds = rec.get("buckets") or []
+    exemplars = rec.get("exemplars") or []
+    target = 0.99 * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target:
+            est = (bounds[i] if i < len(bounds)
+                   else (bounds[-1] if bounds else None))
+            for j in range(i, -1, -1):
+                if j < len(exemplars) and exemplars[j]:
+                    return est, exemplars[j]
+            return est, None
+    return None, None
+
+
 def _summarize_metric_records(records: List[dict]) -> Dict[str, Any]:
     """The summary over already-parsed registry records — the shared
     core behind dump files (``--metrics``), live ``/varz`` scrapes and
@@ -161,6 +188,32 @@ def _summarize_metric_records(records: List[dict]) -> Dict[str, Any]:
             elif name == "alink_serve_shed_total":
                 out["serve"]["shed"] = out["serve"].get("shed", 0) \
                     + rec.get("value", 0)
+            # the two Layer-6 request histograms (ISSUE 18): admission->
+            # dispatch wait vs whole-request latency, each carrying the
+            # tail's exemplar trace_id so the p99 names a real request
+            elif name == "alink_serve_queue_wait_seconds":
+                out["serve"]["queue_wait_count"] = \
+                    out["serve"].get("queue_wait_count", 0) \
+                    + (rec.get("count") or 0)
+                out["serve"]["queue_wait_sum_s"] = \
+                    out["serve"].get("queue_wait_sum_s", 0.0) \
+                    + (rec.get("sum") or 0.0)
+                est, ex = _hist_p99(rec)
+                if est is not None and est >= out["serve"].get(
+                        "queue_wait_p99_est_s", -1.0):
+                    out["serve"]["queue_wait_p99_est_s"] = est
+                    if ex:
+                        out["serve"]["queue_wait_p99_exemplar"] = ex
+            elif name == "alink_serve_request_seconds":
+                out["serve"]["request_count"] = \
+                    out["serve"].get("request_count", 0) \
+                    + (rec.get("count") or 0)
+                est, ex = _hist_p99(rec)
+                if est is not None and est >= out["serve"].get(
+                        "request_p99_est_s", -1.0):
+                    out["serve"]["request_p99_est_s"] = est
+                    if ex:
+                        out["serve"]["request_p99_exemplar"] = ex
             elif name == "alink_serve_breaker_fallback_total":
                 out["serve"]["breaker_fallbacks"] = \
                     out["serve"].get("breaker_fallbacks", 0) \
@@ -195,6 +248,129 @@ def _summarize_metric_records(records: List[dict]) -> Dict[str, Any]:
         del out["serve"]
     if not out["fleet"]:
         del out["fleet"]
+    return out
+
+
+_BUNDLE_FORMAT = "alink_tpu_postmortem_v1"
+_PHASE_COLS = ("queue_s", "coalesce_s", "dispatch_s", "device_s",
+               "decode_s")
+
+
+def _load_postmortem(path: str) -> Dict[str, Any]:
+    """One post-mortem bundle (common/postmortem.py shape), version-
+    checked — the doctor stays stdlib-only, so the format contract is
+    re-validated here rather than imported."""
+    doc = load_json(path)
+    if not isinstance(doc, dict) or doc.get("format") != _BUNDLE_FORMAT:
+        raise ValueError(
+            f"{path}: not an alink_tpu post-mortem bundle (format="
+            f"{doc.get('format') if isinstance(doc, dict) else None!r}, "
+            f"want {_BUNDLE_FORMAT})")
+    return doc
+
+
+def _postmortem_section(bundle: Dict[str, Any]) -> Dict[str, Any]:
+    """The bundle's own verdict material: the trigger, the frozen
+    request timelines, the event history, and the p99-exemplar request
+    (the concrete lifetime behind the tail the incident fired on)."""
+    reqs = bundle.get("requests") or []
+    by_id = {r.get("trace_id"): r for r in reqs if isinstance(r, dict)}
+    exemplar_req = None
+    for rec in bundle.get("metrics") or []:
+        if isinstance(rec, dict) and \
+                rec.get("name") == "alink_serve_request_seconds":
+            _est, ex = _hist_p99(rec)
+            if ex and ex.get("trace_id") in by_id:
+                exemplar_req = by_id[ex["trace_id"]]
+                break
+    ev_kinds: Dict[str, int] = {}
+    for ev in bundle.get("events") or []:
+        k = str((ev or {}).get("kind", "?"))
+        ev_kinds[k] = ev_kinds.get(k, 0) + 1
+    return {
+        "reason": bundle.get("reason"),
+        "detail": bundle.get("detail"),
+        "created_unix": bundle.get("created_unix"),
+        "pid": bundle.get("pid"),
+        "context": bundle.get("context") or {},
+        "extra": bundle.get("extra") or {},
+        "requests": reqs,
+        "inflight": bundle.get("inflight") or [],
+        "event_counts": ev_kinds,
+        "trace_events": len((bundle.get("trace") or {}).get("events")
+                            or []),
+        "statusz_armed": (bundle.get("statusz") or {}).get("armed"),
+        "p99_exemplar_request": exemplar_req,
+    }
+
+
+def _request_row(r: Dict[str, Any]) -> List[str]:
+    ph = r.get("phases") or {}
+    cells = [str(r.get("trace_id") or "?"),
+             str(r.get("tenant") or "-"),
+             str(r.get("outcome") or "?"),
+             (f"{r['total_s'] * 1e3:.2f}"
+              if r.get("total_s") is not None else "-")]
+    for k in _PHASE_COLS:
+        v = ph.get(k)
+        cells.append(f"{v * 1e3:.2f}" if v is not None else "-")
+    ann = r.get("annotations") or []
+    cells.append(",".join(a.get("kind", "?") for a in ann) or "-")
+    return cells
+
+
+def _render_postmortem(pm: Dict[str, Any]) -> List[str]:
+    out = [f"\n== post-mortem: {pm.get('reason')} =="]
+    if pm.get("detail"):
+        out.append(f"  {pm['detail']}")
+    import datetime
+    when = pm.get("created_unix")
+    stamp = (datetime.datetime.fromtimestamp(when).isoformat(" ")
+             if when else "?")
+    out.append(f"  captured {stamp} by pid {pm.get('pid')}; "
+               f"{pm.get('trace_events', 0)} trace events, "
+               f"adminz {'armed' if pm.get('statusz_armed') else 'off'}")
+    for label, d in (("context", pm.get("context")),
+                     ("trigger", pm.get("extra"))):
+        if d:
+            out.append(f"  {label}: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(d.items())))
+    ev = pm.get("event_counts") or {}
+    if ev:
+        out.append("  event history: " + ", ".join(
+            f"{k} x{n}" for k, n in sorted(ev.items())))
+    reqs = pm.get("requests") or []
+    inflight = pm.get("inflight") or []
+    out.append(f"  {len(reqs)} finished request timeline(s), "
+               f"{len(inflight)} in flight at capture")
+    show = reqs[:12]
+    if show:
+        hdr = ["trace_id", "tenant", "outcome", "total"] + \
+            [c[:-2] for c in _PHASE_COLS] + ["overlapping"]
+        rows = [hdr] + [_request_row(r) for r in show]
+        widths = [max(len(row[i]) for row in rows)
+                  for i in range(len(hdr))]
+        out.append("  request timelines, newest first (ms):")
+        for row in rows:
+            out.append("    " + "  ".join(
+                c.ljust(w) for c, w in zip(row, widths)).rstrip())
+        if len(reqs) > len(show):
+            out.append(f"    ... and {len(reqs) - len(show)} more in "
+                       f"the bundle")
+    exr = pm.get("p99_exemplar_request")
+    if exr:
+        ph = exr.get("phases") or {}
+        out.append(f"  p99 exemplar -> {exr.get('trace_id')}: " + ", ".join(
+            f"{k[:-2]} {ph[k] * 1e3:.2f} ms" for k in _PHASE_COLS
+            if ph.get(k) is not None))
+        for a in exr.get("annotations") or []:
+            out.append(f"    overlapped by {a.get('kind')} at "
+                       f"+{a.get('t_s', 0) * 1e3:.2f} ms "
+                       f"{a.get('args') or ''}")
+    out.append(f"  verdict: {pm.get('reason')} fired — the bundle "
+               f"alone carries the timelines, metrics and flags above; "
+               f"tools/trace.py --trace-id ID <bundle> renders any one "
+               f"request's lifetime")
     return out
 
 
@@ -533,6 +709,25 @@ def _serve_verdicts(bench: Optional[Dict[str, Any]],
             f"wait exceeds request budgets; add replicas "
             f"(ALINK_TPU_SERVE_REPLICAS) or relax the submitted "
             f"deadline_s")
+    # satellite 1 (ISSUE 18): when the admission->dispatch wait is the
+    # p99, the tier is queue-bound — no kernel fix helps until requests
+    # stop aging in the channel
+    qw99 = serve_met.get("queue_wait_p99_est_s")
+    rq99 = serve_met.get("request_p99_est_s")
+    if not has_chaos and qw99 and rq99 and qw99 >= 0.5 * rq99:
+        line = (f"queue wait DOMINATES p99 (~{qw99 * 1e3:.1f} ms of the "
+                f"~{rq99 * 1e3:.1f} ms request p99 — "
+                f"alink_serve_queue_wait_seconds): requests age in "
+                f"admission before any device work; add replicas "
+                f"(ALINK_TPU_SERVE_REPLICAS), shorten the batch window "
+                f"(ALINK_TPU_SERVE_WINDOW_MS / ALINK_TPU_SERVE_MIN_FILL) "
+                f"or shrink the admission bound (ALINK_TPU_SERVE_QUEUE) "
+                f"so excess load sheds instead of aging")
+        ex = serve_met.get("queue_wait_p99_exemplar") or {}
+        if ex.get("trace_id"):
+            line += (f"; exemplar request {ex['trace_id']} "
+                     f"(tools/trace.py --trace-id renders its timeline)")
+        met_fixes.append(line)
     if not has_chaos and serve_met.get("feeder_errors"):
         met_fixes.append(
             f"model-stream feeders hit "
@@ -864,6 +1059,8 @@ def render(doc: Dict[str, Any]) -> str:
                f"{'%.1f ms/call' % (gap * 1e3) if gap else 'n/a'}, roofs "
                f"{rig.get('peak_tflops')} TFLOP/s peak, "
                f"{rig.get('peak_hbm_gbps')} GB/s HBM")
+    if doc.get("postmortem"):
+        out.extend(_render_postmortem(doc["postmortem"]))
     for v in doc.get("workloads", []):
         out.append(f"\n== workload: {v['workload']} ==")
         static = v.get("bound_static")
@@ -1158,6 +1355,12 @@ def main(argv=None) -> int:
                          "--snapshot directory; the metrics verdict "
                          "renders against the running process instead "
                          "of a dump file")
+    ap.add_argument("--bundle", metavar="PATH",
+                    help="a post-mortem bundle "
+                         "(common/postmortem.py, ISSUE 18): renders "
+                         "the incident verdict + per-request timeline "
+                         "table OFFLINE, with the bundle's frozen "
+                         "metrics feeding the run-level verdicts")
     ap.add_argument("--peak-tflops", type=float,
                     default=DEFAULT_PEAK_TFLOPS)
     ap.add_argument("--peak-hbm-gbps", type=float,
@@ -1175,10 +1378,12 @@ def main(argv=None) -> int:
         bench_path = bench_path or _first_existing(d, "bench.json")
         profile_path = profile_path or _first_existing(d, "profile.json")
         metrics_path = metrics_path or _first_existing(d, "metrics.jsonl")
-    if not bench_path and not profile_path and not args.url:
-        print("doctor.py: need --run-dir, --bench, --profile or --url "
-              "(nothing to diagnose)", file=sys.stderr)
+    if not bench_path and not profile_path and not args.url \
+            and not args.bundle:
+        print("doctor.py: need --run-dir, --bench, --profile, --url or "
+              "--bundle (nothing to diagnose)", file=sys.stderr)
         return 1
+    bundle = None
     try:
         bench = load_bench(bench_path) if bench_path else None
         profile = load_json(profile_path) if profile_path else None
@@ -1186,11 +1391,20 @@ def main(argv=None) -> int:
         if args.url:
             live = _summarize_metric_records(_records_from_url(args.url))
             metrics = live if metrics is None else {**metrics, **live}
+        if args.bundle:
+            bundle = _load_postmortem(args.bundle)
+            frozen = _summarize_metric_records(
+                [r for r in bundle.get("metrics") or []
+                 if isinstance(r, dict)])
+            metrics = frozen if metrics is None else {**metrics,
+                                                      **frozen}
     except (OSError, ValueError) as e:
         print(f"doctor.py: {e}", file=sys.stderr)
         return 1
     doc = diagnose(bench, profile, metrics,
                    args.peak_tflops, args.peak_hbm_gbps)
+    if bundle is not None:
+        doc["postmortem"] = _postmortem_section(bundle)
     if not doc["workloads"] and not doc.get("hbm") \
             and (bench is not None or profile is not None):
         # (a --url-only scrape has no profiled workloads by design)

@@ -16,10 +16,12 @@ in the repo.
     python tools/fleetz.py --snapshot DIR host:port   # archive scrapes
 
 ``--snapshot DIR`` writes each worker's raw ``varz.json`` /
-``statusz.json`` / ``metrics.prom`` plus the merged ``fleet.json`` —
-the directory shape ``tools/doctor.py --url`` accepts as an offline
-input, so a fleet snapshot taken during an incident replays through
-the verdict renderer later.
+``statusz.json`` / ``metrics.prom`` — plus ``tracez.json`` and
+``requestz.json`` (the Layer-6 flight-recorder and request-timeline
+views, ISSUE 18) when the worker serves them — and the merged
+``fleet.json``. The directory shape is what ``tools/doctor.py --url``
+accepts as an offline input, so a fleet snapshot taken during an
+incident replays through the verdict renderer later.
 
 Unreachable workers are reported per worker (column ``DOWN``), not
 fatal; the exit code is nonzero only when NO worker answered.
@@ -130,6 +132,15 @@ def scrape_worker(worker: str, timeout: float = 5.0) -> Dict[str, Any]:
         _, prom = _get(f"{url}/metrics", timeout)
         doc["metrics_text"] = prom.decode("utf-8")
         doc["metrics_samples"] = len(parse_prom_text(doc["metrics_text"]))
+        # the Layer-6 views (ISSUE 18) — tolerant of 404 from workers
+        # predating them, so a mixed-version fleet still scrapes clean
+        for path in ("tracez", "requestz"):
+            try:
+                code, body = _get(f"{url}/{path}", timeout)
+                if code == 200:
+                    doc[path] = json.loads(body)
+            except Exception:
+                pass
     except Exception as e:
         doc["error"] = f"{type(e).__name__}: {e}"
     return doc
@@ -274,6 +285,10 @@ def write_snapshot(out_dir: str, scrapes: List[Dict[str, Any]],
             json.dump(s["statusz"], f)
         with open(os.path.join(sub, "metrics.prom"), "w") as f:
             f.write(s["metrics_text"])
+        for path in ("tracez", "requestz"):
+            if s.get(path) is not None:
+                with open(os.path.join(sub, f"{path}.json"), "w") as f:
+                    json.dump(s[path], f)
     with open(os.path.join(out_dir, "fleet.json"), "w") as f:
         json.dump(report, f, indent=2)
 

@@ -96,6 +96,16 @@ python tools/adminz_smoke.py
 # names itself.
 python tools/fleet_smoke.py
 
+# post-mortem capture smoke (ISSUE 18): a breaker-tripping dispatch
+# storm plus an SLO fast-window burn cascade against an armed
+# ALINK_TPU_POSTMORTEM_DIR — exactly ONE bundle lands atomically (the
+# second trigger debounced, zero .tmp leftovers), and a fresh
+# interpreter renders the verdict + one request's full
+# admit->...->decode lifetime from the bundle ALONE (doctor --bundle,
+# trace --trace-id). Exits 12 (its own code) so an incident-capture
+# regression names itself.
+python tools/postmortem_smoke.py
+
 # docs freshness gate (ISSUE 15 satellite, VERDICT #2): the README's
 # machine-generated performance/serving tables must match a fresh
 # regeneration from the newest driver-captured BENCH dump, and the

@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Post-mortem capture smoke (perf_gate leg, ISSUE 18) — exit 12.
+
+Drives the two highest-signal incident triggers back-to-back against a
+live ``PredictServer`` with ``ALINK_TPU_POSTMORTEM_DIR`` armed:
+
+  1. a scripted ``serve.dispatch`` error storm trips the circuit
+     breaker OPEN — the transition captures a bundle while the request
+     ring and exemplar slots still hold the storm's evidence;
+  2. an immediate SLO fast-window burn (``SloBurnRate.record`` with a
+     blown latency clause) fires its paging alert, whose bundle hook
+     must be DEBOUNCED away — incidents cascade, captures must not.
+
+The contract it gates:
+
+  * exactly ONE bundle lands, atomically — one ``postmortem_*.json``
+    in the directory, zero ``*.tmp`` leftovers, reason named after the
+    FIRST trigger (``breaker_open``);
+  * the bundle is self-contained: finished request timelines with the
+    full mark chain (admit -> ... -> decode), a metrics dump whose
+    ``alink_serve_request_seconds`` p99 exemplar resolves to one of
+    those timelines, and the resolved flag values;
+  * a FRESH interpreter renders the verdict from the bundle ALONE —
+    ``tools/doctor.py --bundle`` (verdict + per-request timeline
+    table) and ``tools/trace.py --trace-id`` (one request's lifetime)
+    both exit 0 with nothing else on disk.
+
+Runs in a fresh child interpreter (bootenv CPU mesh) so flags, fault
+counters, the request ring and the debounce clock start from zero.
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+EXIT = 12
+_MARK = "ALINK_POSTMORTEM_SMOKE_CHILD"
+
+# visits 1-10 after arming fail: > breaker threshold (3 consecutive),
+# bounded so the post-storm sweep serves compiled again
+STORM_SPEC = "serve.dispatch:1-10:error"
+_MARKS = ("admit", "dequeue", "coalesce", "dispatch", "device", "decode")
+
+
+def main() -> int:
+    if os.environ.get(_MARK) != "1":
+        import tempfile
+
+        import bootenv
+        env = bootenv.cpu_mesh_env(4)
+        env[_MARK] = "1"
+        env.pop("ALINK_TPU_FAULT_INJECT", None)
+        env["ALINK_TPU_POSTMORTEM_DIR"] = tempfile.mkdtemp(
+            prefix="alink-postmortem-smoke-")
+        env["ALINK_TPU_SERVE_BREAKER_MAX_MS"] = "200"
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             cwd=ROOT, env=env, timeout=900)
+        return out.returncode
+
+    import glob
+    import json
+
+    import numpy as np
+
+    from alink_tpu.common.faults import scoped_fault_env
+    from alink_tpu.common.metrics import MetricsRegistry, set_registry
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.common.params import Params
+    from alink_tpu.common import reqtrace
+    from alink_tpu.common.vector import DenseVector
+    from alink_tpu.online.slo import SloBurnRate
+    from alink_tpu.operator.batch.classification.linear import (
+        LogisticRegressionTrainBatchOp)
+    from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+    from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+    from alink_tpu.serving import CompiledPredictor, PredictServer
+
+    set_registry(MetricsRegistry())
+    pmdir = os.environ["ALINK_TPU_POSTMORTEM_DIR"]
+    bad = []
+
+    # -- fixture: a trained dense-LR model + request rows -----------------
+    n_rows, dim = 256, 16
+    rng = np.random.RandomState(7)
+    X = rng.randn(n_rows, dim)
+    y = (X @ rng.randn(dim) > 0).astype(np.int64)
+    vecs = np.empty(n_rows, object)
+    vecs[:] = [DenseVector(X[i]) for i in range(n_rows)]
+    tbl = MTable({"vec": vecs, "label": y}, "vec VECTOR, label LONG")
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=2).link_from(
+        MemSourceBatchOp(tbl.first_n(128)))
+    data_schema = tbl.select(["vec"]).schema
+    mapper = LinearModelMapper(warm.get_output_table().schema, data_schema,
+                               Params({"prediction_col": "pred",
+                                       "vector_col": "vec"}))
+    mapper.load_model(warm.get_output_table())
+    req = tbl.select(["vec"])
+
+    srv = PredictServer(CompiledPredictor(mapper, buckets=(1, 4, 16)),
+                        name="pm_smoke")
+    try:
+        # -- clean traffic: fill the request ring + exemplar slots --------
+        for f in [srv.submit(req.row(i % n_rows)) for i in range(32)]:
+            f.result(60)
+
+        # -- trigger 1: dispatch error storm trips the breaker OPEN ------
+        # closed-loop (one request in flight at a time) so the batcher
+        # cannot coalesce the storm below the breaker's consecutive-
+        # failure threshold
+        with scoped_fault_env(STORM_SPEC):
+            for i in range(12):
+                try:
+                    srv.submit(req.row(i % n_rows)).result(60)
+                except Exception:      # noqa: BLE001 — typed rejections ok
+                    pass
+
+        # -- trigger 2 (cascade): SLO fast-window burn fires, and its
+        # bundle hook must be debounced away ------------------------------
+        burn = SloBurnRate(fast_s=0.5, slow_s=10.0, name="pm_smoke")
+        burn.record("serve_p99", observed=10.0, bound=1e-6)
+        if not any(a["state"] == "firing" and a["window"] == "fast"
+                   for a in burn.alerts):
+            bad.append("the SLO fast-window burn alert never fired — "
+                       "the cascade trigger was not exercised")
+    finally:
+        srv.close()
+
+    # -- exactly ONE bundle, atomically published -------------------------
+    bundles = sorted(glob.glob(os.path.join(pmdir, "postmortem_*.json")))
+    leftovers = glob.glob(os.path.join(pmdir, "*.tmp"))
+    if len(bundles) != 1:
+        bad.append(f"{len(bundles)} bundles in {pmdir}, expected exactly "
+                   f"1 (breaker_open first, slo_burn debounced): "
+                   f"{[os.path.basename(b) for b in bundles]}")
+    if leftovers:
+        bad.append(f"atomic publish leaked tmp files: {leftovers}")
+
+    trace_id = None
+    if bundles:
+        with open(bundles[0]) as fh:
+            doc = json.load(fh)
+        if doc.get("format") != "alink_tpu_postmortem_v1":
+            bad.append(f"bundle format {doc.get('format')!r}")
+        if doc.get("reason") != "breaker_open":
+            bad.append(f"bundle reason {doc.get('reason')!r}, expected "
+                       f"'breaker_open' (the FIRST trigger wins the "
+                       f"debounce window)")
+        reqs = doc.get("requests") or []
+        full = [r for r in reqs
+                if {m["phase"] for m in r.get("marks", ())}
+                >= set(_MARKS) and r.get("outcome") == "ok"]
+        if not full:
+            bad.append(f"no finished request in the bundle carries the "
+                       f"full {'->'.join(_MARKS)} timeline "
+                       f"({len(reqs)} requests captured)")
+        if not doc.get("flags"):
+            bad.append("bundle carries no resolved flag values")
+        # the p99 exemplar of the request histogram must resolve to a
+        # timeline the bundle itself holds (offline debuggability)
+        ids = {r.get("trace_id") for r in reqs}
+        for rec in doc.get("metrics") or []:
+            if rec.get("name") != "alink_serve_request_seconds":
+                continue
+            ex = reqtrace.p99_exemplar(rec)
+            if ex is None or ex.get("trace_id") not in ids:
+                bad.append(f"request-histogram p99 exemplar {ex!r} does "
+                           f"not resolve to a captured timeline")
+            elif trace_id is None:
+                trace_id = ex["trace_id"]
+        if trace_id is None and full:
+            trace_id = full[0]["trace_id"]
+
+    # -- fresh-interpreter renders: the bundle alone is enough ------------
+    if bundles and trace_id is not None:
+        doctor = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "doctor.py"),
+             "--bundle", bundles[0]],
+            cwd=ROOT, capture_output=True, text=True, timeout=120)
+        if doctor.returncode != 0:
+            bad.append(f"doctor --bundle exited {doctor.returncode}: "
+                       f"{doctor.stderr[-400:]}")
+        elif ("post-mortem: breaker_open" not in doctor.stdout
+              or "verdict:" not in doctor.stdout):
+            bad.append("doctor --bundle rendered no post-mortem verdict "
+                       "from the bundle alone")
+        tr = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "tools", "trace.py"),
+             bundles[0], "--trace-id", trace_id],
+            cwd=ROOT, capture_output=True, text=True, timeout=120)
+        if tr.returncode != 0:
+            bad.append(f"trace --trace-id {trace_id} exited "
+                       f"{tr.returncode}: {tr.stderr[-400:]}")
+        elif f"request {trace_id}" not in tr.stdout:
+            bad.append(f"trace --trace-id did not render {trace_id}'s "
+                       f"lifetime from the bundle")
+
+    if bad:
+        print("postmortem_smoke: FAILED:", file=sys.stderr)
+        for m in bad:
+            print(f"  {m}", file=sys.stderr)
+        return EXIT
+    print(f"postmortem_smoke: clean — breaker storm + SLO burn cascade "
+          f"produced exactly one atomic bundle "
+          f"({os.path.basename(bundles[0])}); doctor and trace rendered "
+          f"request {trace_id} offline")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,10 +2,16 @@
 
 Usage:
     python tools/trace.py TRACE [--top N] [--chrome OUT.json]
+    python tools/trace.py TRACE --trace-id rNNNNNNNN
 
-``TRACE`` is either a ``Tracer.export_jsonl()`` run log or a
-``Tracer.export_chrome()`` JSON (the format is auto-detected). Output
-sections:
+``TRACE`` is a ``Tracer.export_jsonl()`` run log, a
+``Tracer.export_chrome()`` JSON, or a post-mortem bundle
+(``common/postmortem.py``, ISSUE 18) — the format is auto-detected; a
+bundle contributes its frozen span ring plus the request timelines.
+``--trace-id`` switches to single-request mode: render ONE request's
+lifetime (admission -> queue -> coalesce -> dispatch -> device ->
+decode), its overlap annotations (swap/evict/lane-rebuild/breaker) and
+every trace event carrying that id. Default output sections:
 
   * Top spans by self time — per span name: count, total wall, total
     *self* time (wall minus time inside child spans), mean;
@@ -57,18 +63,38 @@ def load_events(path: str) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
             meta = doc
             events = [json.loads(ln) for ln in f.readlines()[1:]
                       if ln.strip()]
-        else:                                   # one Chrome JSON document
+        else:                                   # one JSON document
             try:
                 whole = json.load(f)
             except ValueError as e:
                 raise ValueError(f"{path}: neither an alink_tpu trace "
-                                 f"JSONL nor a Chrome trace JSON: {e}")
+                                 f"JSONL, a post-mortem bundle, nor a "
+                                 f"Chrome trace JSON: {e}")
+            if isinstance(whole, dict) and \
+                    whole.get("format") == "alink_tpu_postmortem_v1":
+                # a post-mortem bundle: its frozen span ring is the
+                # trace; the request timelines ride along in meta so
+                # --trace-id can render a lifetime with zero live state
+                tr = whole.get("trace") or {}
+                meta = dict(tr.get("meta") or {})
+                meta["postmortem"] = {
+                    k: whole.get(k)
+                    for k in ("reason", "detail", "created_unix", "pid")}
+                meta["requests"] = list(whole.get("requests") or []) + \
+                    list(whole.get("inflight") or [])
+                events = [e for e in tr.get("events") or []
+                          if isinstance(e, dict)]
+                events.sort(key=lambda e: e.get("ts", 0.0))
+                if not any("parent" in e for e in events):
+                    _infer_parents(events)
+                return meta, events
             if isinstance(whole, list):
                 # the bare-array Chrome form is also valid
                 whole = {"traceEvents": whole}
             if not isinstance(whole, dict) or "traceEvents" not in whole:
                 raise ValueError(f"{path}: neither an alink_tpu trace "
-                                 f"JSONL nor a Chrome trace JSON")
+                                 f"JSONL, a post-mortem bundle, nor a "
+                                 f"Chrome trace JSON")
             meta = dict(whole.get("otherData") or {})
             meta.setdefault("format", "chrome")
             threads = {}
@@ -247,6 +273,80 @@ def summarize(meta: Dict[str, Any], events: List[Dict[str, Any]],
     return "\n".join(out)
 
 
+_PHASE_ORDER = ("queue_s", "coalesce_s", "dispatch_s", "device_s",
+                "decode_s")
+
+
+def render_request(meta: Dict[str, Any], events: List[Dict[str, Any]],
+                   trace_id: str) -> Optional[str]:
+    """One request's lifetime (``--trace-id``): the phase timeline and
+    overlap annotations from the request document (bundle inputs carry
+    them in meta) plus every trace event tagged with the id. ``None``
+    when the id appears nowhere in the input."""
+    out: List[str] = [f"== request {trace_id} =="]
+    pm = meta.get("postmortem")
+    if pm:
+        out.append(f"  from post-mortem bundle: {pm.get('reason')} "
+                   f"({pm.get('detail')})")
+    req = next((r for r in meta.get("requests") or []
+                if isinstance(r, dict)
+                and r.get("trace_id") == trace_id), None)
+    matched = [e for e in events
+               if (e.get("args") or {}).get("trace_id") == trace_id]
+    if req is None and not matched:
+        return None
+    if req is not None:
+        line = f"  tenant {req.get('tenant') or '-'}, " \
+               f"outcome {req.get('outcome') or 'IN FLIGHT at capture'}"
+        if req.get("total_s") is not None:
+            line += f", total {req['total_s'] * 1e3:,.2f} ms"
+        out.append(line)
+        marks = req.get("marks") or []
+        if marks:
+            out.append("\n== timeline (offsets from admission) ==")
+            out.append(_table(
+                ["mark", "t_ms"],
+                [[m.get("phase", "?"), f"{m.get('t_s', 0) * 1e3:,.3f}"]
+                 for m in marks]))
+        phases = req.get("phases") or {}
+        if phases:
+            out.append("\n== per-phase durations ==")
+            out.append(_table(
+                ["phase", "ms"],
+                [[k[:-2], f"{phases[k] * 1e3:,.3f}"]
+                 for k in _PHASE_ORDER if k in phases] +
+                [[k[:-2], f"{v * 1e3:,.3f}"]
+                 for k, v in sorted(phases.items())
+                 if k not in _PHASE_ORDER]))
+        ann = req.get("annotations") or []
+        if ann:
+            out.append("\n== overlapping events (stamped while this "
+                       "request was in flight) ==")
+            for a in ann:
+                args = a.get("args") or {}
+                detail = " ".join(f"{k}={v}"
+                                  for k, v in sorted(args.items()))
+                out.append(f"  +{a.get('t_s', 0) * 1e3:,.3f} ms  "
+                           f"{a.get('kind')}  {detail}".rstrip())
+        if req.get("dropped_annotations"):
+            out.append(f"  ... and {req['dropped_annotations']} more "
+                       f"annotations dropped at the per-request bound")
+    if matched:
+        out.append(f"\n== trace events carrying trace_id ({len(matched)}) "
+                   f"==")
+        rows = []
+        for e in matched:
+            args = {k: v for k, v in (e.get("args") or {}).items()
+                    if k != "trace_id"}
+            rows.append([e.get("name", "?"), e.get("cat", "?"),
+                         (_fmt_ms(e.get("dur", 0.0))
+                          if e.get("ph") == "X" else "-"),
+                         " ".join(f"{k}={v}"
+                                  for k, v in sorted(args.items()))])
+        out.append(_table(["event", "cat", "dur_ms", "args"], rows))
+    return "\n".join(out)
+
+
 def to_chrome(meta: Dict[str, Any],
               events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Chrome Trace Event Format document from normalized events (the
@@ -259,15 +359,29 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Summarize an alink_tpu trace "
                     "(flight-recorder JSONL or Chrome JSON)")
-    ap.add_argument("trace", help="Tracer.export_jsonl() run log or "
-                                  "Tracer.export_chrome() JSON")
+    ap.add_argument("trace", help="Tracer.export_jsonl() run log, "
+                                  "Tracer.export_chrome() JSON, or a "
+                                  "post-mortem bundle")
     ap.add_argument("--top", type=int, default=15,
                     help="rows in the top-spans table (default 15)")
+    ap.add_argument("--trace-id", metavar="ID",
+                    help="render ONE request's lifetime (phases, "
+                         "overlap annotations, tagged trace events) "
+                         "instead of the whole-trace summary")
     ap.add_argument("--chrome", metavar="OUT",
                     help="also write a Chrome-trace JSON conversion "
                          "(open in Perfetto / chrome://tracing)")
     args = ap.parse_args(argv)
     meta, events = load_events(args.trace)
+    if args.trace_id:
+        text = render_request(meta, events, args.trace_id)
+        if text is None:
+            print(f"trace.py: {args.trace_id!r} appears nowhere in "
+                  f"{args.trace} (no request document, no tagged "
+                  f"event)", file=sys.stderr)
+            return 1
+        print(text)
+        return 0
     if args.chrome:
         with open(args.chrome, "w") as f:
             json.dump(to_chrome(meta, events), f)
