@@ -32,6 +32,12 @@ just benchmarked, and need a live plane. This module is it:
   /requestz   recent Layer-6 request timelines (admission → queue →
               coalesce → dispatch → device → decode) plus everything
               in flight; ``?trace_id=`` / ``?tenant=`` filter
+  /compilez   the Layer-7 compile ledger: per-cache hit/miss/eviction
+              counters, recent compile events with the structural diff
+              vs the previous plan (the changed dimension, e.g.
+              ``ALINK_TPU_SERVE_DTYPE f32→int8``), cold-start
+              time-to-first-program per subsystem, and recompile-storm
+              state; ``?n=`` bounds the event list
   ========== ==========================================================
 
 * the :class:`ReadinessSource` contract — components plug their REAL
@@ -168,6 +174,15 @@ class _Handler(BaseHTTPRequestHandler):
                 code, ctype, body = 200, "application/json", \
                     json.dumps(_json_safe(
                         admin._requestz(n, trace_id, tenant)))
+            elif path == "/compilez":
+                from . import compileledger
+                q = parse_qs(parsed.query)
+                try:
+                    n = int(q["n"][0]) if "n" in q else None
+                except (TypeError, ValueError):
+                    n = None
+                code, ctype, body = 200, "application/json", \
+                    json.dumps(_json_safe(compileledger.compilez_doc(n)))
             else:
                 code, ctype, body = 404, "text/plain; charset=utf-8", \
                     f"404: unknown admin path {path!r}\n" + admin._index()
@@ -187,7 +202,7 @@ class _Handler(BaseHTTPRequestHandler):
             # path label is the bounded route set, never the raw path
             route = path if path in ("/", "/metrics", "/varz", "/healthz",
                                      "/readyz", "/statusz", "/tracez",
-                                     "/requestz") \
+                                     "/requestz", "/compilez") \
                 else "other"
             reg = get_registry()
             reg.inc("alink_admin_requests_total", 1,
@@ -206,7 +221,7 @@ class AdminServer:
     """
 
     ENDPOINTS = ("/metrics", "/varz", "/healthz", "/readyz", "/statusz",
-                 "/tracez", "/requestz")
+                 "/tracez", "/requestz", "/compilez")
 
     def __init__(self, port: Optional[int] = None,
                  host: Optional[str] = None, name: str = "alink"):

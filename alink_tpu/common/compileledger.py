@@ -1,0 +1,454 @@
+"""The compile ledger — observability Layer 7 (ISSUE 19).
+
+Layers 1-6 watch runtime execution; the compile plane stayed dark:
+when a flag flip, bucket change or tenant geometry silently triggered
+a recompile storm (or a checkpoint refusal), nothing recorded WHICH
+key dimension changed.  This module records every compilation event
+with its :class:`~alink_tpu.common.plan.ExecutionPlan` digest, wall
+time, trigger site and a structural diff against the previous plan at
+that cache, so the ledger answers "why did this recompile" by naming
+the changed dimension (``ALINK_TPU_SERVE_DTYPE f32->int8``, ``bucket
+128->512``, ``mesh 1->4``).
+
+Instrumented caches (each registers once, then records hits / misses /
+evictions): the engine program cache (plain + checkpoint-chunked), the
+FTRL step-factory lru family, per-predictor serving caches, the fleet
+geometry groups, and the sweep compile groups (which ride the engine
+cache; their events carry the sweep site label).
+
+Surfaces:
+
+* metrics — ``alink_compile_total`` / ``alink_compile_seconds``
+  (histogram) / ``alink_compile_cache_size`` /
+  ``alink_compile_evictions_total``, all labeled ``{cache=...}``, plus
+  ``alink_compile_storms_total`` and the ``alink_compile_storm_active``
+  gauge the PR-16 burn-rate alerting can page on;
+* tracer — one ``compile`` instant per event (``common/tracing.py``);
+* ``/compilez`` — the adminz view (``common/adminz.py``): live caches
+  with occupancy/hit-rate, the last N events with diffs, cold-start
+  attribution and storm state;
+* post-mortems — a detected storm freezes one debounced PR-18 bundle
+  (``postmortem.maybe_bundle``) carrying the ledger snapshot.
+
+The ledger OBSERVES keys and must never be one: the gating flags
+(``ALINK_TPU_COMPILE_LEDGER`` — default on, ``ALINK_TPU_COMPILE_RING``)
+are registered key-neutral, everything here is host-side, and the
+byte-identity tests pin that compiled HLO and every cache key are
+identical with the ledger on or off.
+
+Storm thresholds (documented in docs/observability.md): >=
+``STORM_MISSES`` compile events on ONE cache within
+``STORM_WINDOW_S`` seconds flags a storm; the verdict names the
+dimension that changed most often across the storm's diffs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter, deque
+from typing import Any, Dict, List, Optional
+
+from .flags import flag_value
+from .plan import ExecutionPlan
+
+__all__ = [
+    "ledger_enabled", "ring_capacity", "register_cache", "record_event",
+    "record_hit", "record_eviction", "set_cache_size", "note_wall",
+    "subsystem_start", "register_stage", "lru_call", "compilez_doc",
+    "storms", "reset", "STORM_WINDOW_S", "STORM_MISSES",
+]
+
+# recompile-storm detector: N misses on one cache inside W seconds
+STORM_WINDOW_S = 60.0
+STORM_MISSES = 8
+
+
+def ledger_enabled() -> bool:
+    """``ALINK_TPU_COMPILE_LEDGER`` (default ON): the ledger is pure
+    host-side bookkeeping — compiled HLO and every cache key are
+    byte-identical either way (pinned by tests/test_plan.py)."""
+    return bool(flag_value("ALINK_TPU_COMPILE_LEDGER", True))
+
+
+def ring_capacity() -> int:
+    """``ALINK_TPU_COMPILE_RING``: bound of the host-side event ring."""
+    return max(16, int(flag_value("ALINK_TPU_COMPILE_RING", 256)))
+
+
+# ---------------------------------------------------------------------------
+# state (module-level, lock-protected except the hot hit counters)
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_seq = [0]
+_events: deque = deque(maxlen=256)
+# cache name -> {"subsystem", "capacity", "hits", "misses", "evictions",
+#                "size", "last_plan", "last_digest", "miss_times",
+#                "storms", "storm_active"}
+_caches: Dict[str, Dict[str, Any]] = {}
+# subsystem -> perf_counter at first activity; and -> seconds-to-first-
+# compiled-program once the first miss lands (cold-start attribution)
+_t0: Dict[str, float] = {}
+_ttfp: Dict[str, float] = {}
+_stages: Dict[str, Dict[str, Any]] = {}
+_start_unix = time.time()
+
+
+def reset() -> None:
+    """Tests only: drop every ring entry, cache row and attribution."""
+    with _lock:
+        _events.clear()
+        _caches.clear()
+        _t0.clear()
+        _ttfp.clear()
+        _stages.clear()
+        _lru_families.clear()
+        _seq[0] = 0
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+def _cache_row(cache: str, subsystem: str = "",
+               capacity: Optional[int] = None) -> Dict[str, Any]:
+    row = _caches.get(cache)
+    if row is None:
+        row = _caches[cache] = {
+            "subsystem": subsystem or cache.split(".")[0],
+            "capacity": capacity, "hits": 0, "misses": 0,
+            "evictions": 0, "size": 0, "last_plan": None,
+            "last_digest": None, "miss_times": deque(maxlen=64),
+            "storms": 0, "storm_active": False,
+        }
+    elif capacity is not None and row["capacity"] is None:
+        row["capacity"] = capacity
+    return row
+
+
+def register_cache(cache: str, subsystem: str = "",
+                   capacity: Optional[int] = None) -> None:
+    """Announce a cache before its first event (optional — recording
+    auto-registers) so /compilez shows it even while empty."""
+    if not ledger_enabled():
+        return
+    with _lock:
+        _cache_row(cache, subsystem, capacity)
+
+
+def subsystem_start(subsystem: str) -> None:
+    """Mark a subsystem's activity start for cold-start attribution
+    (time-to-first-program).  First call wins; later calls are free."""
+    if not ledger_enabled():
+        return
+    if subsystem not in _t0:
+        with _lock:
+            _t0.setdefault(subsystem, time.perf_counter())
+
+
+def register_stage(subsystem: str, stage: str,
+                   plan: ExecutionPlan) -> None:
+    """Record a composite's stage identity (the online DAG registers
+    its train/serve/eval stages) — surfaced under /compilez "stages"
+    so a restart's cold-start report names the stage, not just the
+    subsystem."""
+    if not ledger_enabled():
+        return
+    with _lock:
+        _stages[f"{subsystem}.{stage}"] = {
+            "subsystem": subsystem, "stage": stage,
+            "digest": plan.digest(),
+            "dims": [[n, _short(v)] for n, v in plan.dims],
+        }
+
+
+def _short(v: Any) -> str:
+    s = repr(v)
+    return s if len(s) <= 120 else s[:117] + "..."
+
+
+def record_hit(cache: str) -> None:
+    """One cache hit.  Hot path (the serving dispatch loop, the FTRL
+    per-batch factory lookup): a GIL-atomic counter bump on the
+    already-registered row, no lock, no allocation."""
+    if not ledger_enabled():
+        return
+    row = _caches.get(cache)
+    if row is None:
+        with _lock:
+            row = _cache_row(cache)
+    row["hits"] += 1
+
+
+def record_eviction(cache: str, n: int = 1) -> None:
+    if not ledger_enabled() or n <= 0:
+        return
+    with _lock:
+        row = _cache_row(cache)
+        row["evictions"] += n
+        row["size"] = max(0, row["size"] - n)
+    _metrics_inc("alink_compile_evictions_total", n, cache)
+
+
+def set_cache_size(cache: str, size: int) -> None:
+    if not ledger_enabled():
+        return
+    with _lock:
+        _cache_row(cache)["size"] = int(size)
+
+
+def note_wall(cache: str, wall_s: float) -> None:
+    """Attach a measured wall to the most recent event of ``cache``.
+
+    jit compiles LAZILY: the engine's miss event is recorded at
+    cache-insert time, but the trace+compile wall is only observable
+    around the first dispatch — which reports it here.  The histogram
+    sample is deferred to this call, so ``alink_compile_seconds`` never
+    double-counts an event."""
+    if not ledger_enabled():
+        return
+    with _lock:
+        for ev in reversed(_events):
+            if ev["cache"] == cache:
+                if ev.get("wall_s") is None:
+                    ev["wall_s"] = round(float(wall_s), 6)
+                break
+    _metrics_observe(wall_s, cache)
+
+
+def record_event(cache: str, plan: ExecutionPlan, *,
+                 wall_s: Optional[float] = None, site: str = "",
+                 subsystem: str = "") -> Dict[str, Any]:
+    """One compilation (cache-miss) event: digest + diff vs the
+    previous plan at this cache + metrics/trace/storm/cold-start
+    bookkeeping.  Returns the ledger entry (tests introspect it)."""
+    if not ledger_enabled():
+        return {}
+    now = time.perf_counter()
+    digest = plan.digest()
+    with _lock:
+        row = _cache_row(cache, subsystem)
+        diff = plan.diff(row["last_plan"])
+        row["last_plan"] = plan
+        row["last_digest"] = digest
+        row["misses"] += 1
+        row["size"] += 1
+        row["miss_times"].append(now)
+        _seq[0] += 1
+        ev = {
+            "seq": _seq[0], "t_unix": round(time.time(), 3),
+            "cache": cache, "subsystem": row["subsystem"],
+            "site": site, "digest": digest,
+            "wall_s": None if wall_s is None else round(float(wall_s), 6),
+            "diff": diff,
+        }
+        ring = _events
+        if ring.maxlen != ring_capacity():
+            ring = deque(ring, maxlen=ring_capacity())
+            globals()["_events"] = ring
+        ring.append(ev)
+        # cold-start attribution: seconds from the subsystem's first
+        # activity to its first compiled program
+        sub = row["subsystem"]
+        if sub in _t0 and sub not in _ttfp:
+            _ttfp[sub] = round(now - _t0[sub], 6)
+        storm = _check_storm(row)
+    _metrics_event(cache, ev, wall_s)
+    _trace_event(cache, ev)
+    if storm:
+        _on_storm(cache, row)
+    return ev
+
+
+def _check_storm(row: Dict[str, Any]) -> bool:
+    """Callers hold ``_lock``.  True exactly on the transition into an
+    active storm (re-arming only after the window drains)."""
+    times = row["miss_times"]
+    now = times[-1]
+    recent = sum(1 for t in times if now - t <= STORM_WINDOW_S)
+    if recent >= STORM_MISSES:
+        if not row["storm_active"]:
+            row["storm_active"] = True
+            row["storms"] += 1
+            return True
+        return False
+    row["storm_active"] = False
+    return False
+
+
+def _dominant_dim(cache: str) -> Optional[Dict[str, Any]]:
+    """The dimension that changed most often across this cache's recent
+    events — the storm verdict's "name the flag" answer."""
+    counts: Counter = Counter()
+    sample: Dict[str, Dict[str, str]] = {}
+    for ev in _events:
+        if ev["cache"] != cache:
+            continue
+        for d in ev["diff"]:
+            if d["dim"] == "cold-start":
+                continue
+            counts[d["dim"]] += 1
+            sample[d["dim"]] = d
+    if not counts:
+        return None
+    dim, n = counts.most_common(1)[0]
+    out = dict(sample[dim])
+    out["count"] = n
+    return out
+
+
+def _on_storm(cache: str, row: Dict[str, Any]) -> None:
+    from .metrics import get_registry, metrics_enabled
+    dom = None
+    with _lock:
+        dom = _dominant_dim(cache)
+    detail = f"{STORM_MISSES}+ compiles on {cache!r} within " \
+             f"{STORM_WINDOW_S:.0f}s"
+    if dom:
+        detail += (f"; dominant changed dimension {dom['dim']} "
+                   f"({dom['old']} -> {dom['new']}, x{dom['count']})")
+    if metrics_enabled():
+        reg = get_registry()
+        reg.inc("alink_compile_storms_total", 1, {"cache": cache})
+        reg.set_gauge("alink_compile_storm_active", 1, {"cache": cache})
+    try:
+        from .tracing import trace_instant
+        trace_instant("compile.storm", cat="compile",
+                      args={"cache": cache, "detail": detail})
+    except Exception:
+        pass
+    try:
+        from .postmortem import maybe_bundle
+        maybe_bundle("compile_storm", detail=detail,
+                     extra={"compilez": compilez_doc()})
+    except Exception:
+        pass
+
+
+def _metrics_inc(name: str, n: float, cache: str) -> None:
+    from .metrics import get_registry, metrics_enabled
+    if metrics_enabled():
+        get_registry().inc(name, n, {"cache": cache})
+
+
+def _metrics_observe(wall_s: float, cache: str) -> None:
+    from .metrics import get_registry, metrics_enabled
+    if metrics_enabled():
+        get_registry().observe("alink_compile_seconds", float(wall_s),
+                               {"cache": cache})
+
+
+def _metrics_event(cache: str, ev: Dict[str, Any],
+                   wall_s: Optional[float]) -> None:
+    from .metrics import get_registry, metrics_enabled
+    if not metrics_enabled():
+        return
+    reg = get_registry()
+    reg.inc("alink_compile_total", 1, {"cache": cache})
+    reg.set_gauge("alink_compile_cache_size",
+                  _caches[cache]["size"], {"cache": cache})
+    if wall_s is not None:
+        reg.observe("alink_compile_seconds", float(wall_s),
+                    {"cache": cache})
+    if not _caches[cache]["storm_active"]:
+        reg.set_gauge("alink_compile_storm_active", 0, {"cache": cache})
+
+
+def _trace_event(cache: str, ev: Dict[str, Any]) -> None:
+    try:
+        from .tracing import trace_instant
+        trace_instant("compile", cat="compile", args={
+            "cache": cache, "site": ev["site"], "digest": ev["digest"],
+            "changed": ",".join(d["dim"] for d in ev["diff"])[:200],
+        })
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# lru-factory instrumentation
+# ---------------------------------------------------------------------------
+
+def lru_call(cache: str, factory, args: tuple, *, plan: ExecutionPlan,
+             site: str, subsystem: str = "", kwargs: Optional[dict] = None):
+    """Call a ``functools.lru_cache`` step factory and classify the
+    lookup by ``cache_info()`` miss delta — the factories stay exactly
+    as they are (lru keys byte-identical; the ledger observes from
+    outside).  With the ledger off this is a direct call."""
+    kwargs = kwargs or {}
+    if not ledger_enabled() or not hasattr(factory, "cache_info"):
+        # monkeypatched/plain factories (tests) bypass the ledger
+        return factory(*args, **kwargs)
+    before = factory.cache_info().misses
+    t0 = time.perf_counter()
+    out = factory(*args, **kwargs)
+    if factory.cache_info().misses > before:
+        record_event(cache, plan, wall_s=time.perf_counter() - t0,
+                     site=site, subsystem=subsystem)
+        set_cache_size(cache, _lru_family_size(cache, factory))
+    else:
+        record_hit(cache)
+    return out
+
+
+_lru_families: Dict[str, list] = {}
+
+
+def _lru_family_size(cache: str, factory) -> int:
+    """Live entry count across every factory seen under one cache
+    label (the 7 FTRL factories aggregate as ``ftrl.step``)."""
+    fams = _lru_families.setdefault(cache, [])
+    if factory not in fams:
+        fams.append(factory)
+    return sum(f.cache_info().currsize for f in fams)
+
+
+# ---------------------------------------------------------------------------
+# the /compilez document
+# ---------------------------------------------------------------------------
+
+def storms() -> List[str]:
+    """Names of caches currently inside an active storm window."""
+    with _lock:
+        return sorted(c for c, r in _caches.items() if r["storm_active"])
+
+
+def compilez_doc(n: Optional[int] = None) -> Dict[str, Any]:
+    """The /compilez response body (and the doctor/fleetz input): live
+    caches with occupancy + hit rate, the last ``n`` events (diffs
+    included), cold-start attribution and storm state.  JSON-safe by
+    construction."""
+    cap = ring_capacity()
+    n = cap if n is None else max(1, min(int(n), cap))
+    with _lock:
+        caches = {}
+        for name, r in _caches.items():
+            total = r["hits"] + r["misses"]
+            caches[name] = {
+                "subsystem": r["subsystem"],
+                "size": r["size"], "capacity": r["capacity"],
+                "hits": r["hits"], "misses": r["misses"],
+                "evictions": r["evictions"],
+                "hit_rate": round(r["hits"] / total, 4) if total else None,
+                "last_digest": r["last_digest"],
+                "storm_active": r["storm_active"],
+                "storms": r["storms"],
+                "dominant_dim": _dominant_dim(name),
+            }
+        events = list(_events)[-n:]
+        doc = {
+            "enabled": ledger_enabled(),
+            "since_unix": round(_start_unix, 3),
+            "ring_capacity": cap,
+            "storm_window_s": STORM_WINDOW_S,
+            "storm_misses": STORM_MISSES,
+            "caches": caches,
+            "events": events,
+            "cold_start": {
+                "started": sorted(_t0),
+                "time_to_first_program_s": dict(_ttfp),
+            },
+            "stages": dict(_stages),
+        }
+    return doc

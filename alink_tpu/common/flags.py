@@ -422,6 +422,27 @@ FLAGS.register(
     clamp=lambda n: max(1, n), tolerant=True,
     accessor="alink_tpu.common.adminz.admin_requestz_entries")
 FLAGS.register(
+    "ALINK_TPU_COMPILE_LEDGER", "bool", True,
+    "compile ledger (common/compileledger.py): record every program "
+    "compilation with its ExecutionPlan digest, wall time, trigger "
+    "site and a named diff against the previous plan at that cache "
+    "(/compilez, alink_compile_* metrics, storm detection)",
+    "observability",
+    key_neutral="the ledger OBSERVES cache keys and must never be one: "
+                "pure host-side bookkeeping recorded after each cache "
+                "decision — compiled HLO, every cache key and hit/miss "
+                "behavior are byte-identical on or off (pinned by "
+                "tests/test_plan.py)",
+    accessor="alink_tpu.common.compileledger.ledger_enabled")
+FLAGS.register(
+    "ALINK_TPU_COMPILE_RING", "int", 256,
+    "compile-event ring capacity (what /compilez and post-mortem "
+    "bundles serve)", "observability",
+    key_neutral="sizes the host-side ledger deque; never read at trace "
+                "time and never part of any cache key",
+    clamp=lambda n: max(16, n), tolerant=True,
+    accessor="alink_tpu.common.compileledger.ring_capacity")
+FLAGS.register(
     "ALINK_TPU_POSTMORTEM_DIR", "str", "",
     "post-mortem bundle directory (common/postmortem.py): on SLO burn "
     "firing, breaker open, DAG stage abort, or injected kill, one "

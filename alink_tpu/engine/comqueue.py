@@ -790,23 +790,21 @@ class IterativeComQueue:
         max_iter = int(self.max_iter)
         seed = int(self.seed)
         mx = metrics_enabled() and not lower_only
-        # health-probe switch, latched per run at trace time. It MUST ride
-        # the program-cache key and the checkpoint signature: probes add
-        # stacked (max_iter,) carry entries, so a toggled flag is a
-        # structurally different program
-        probes_on = health_enabled()
-        # carry-donation switch, latched per run. Rides the program-cache
-        # key: a donated program's buffer-aliasing contract differs from
-        # the non-donated one's even though the HLO ops are identical, so
-        # a toggle must recompile, never alias-through a cached entry
-        donate = donation_enabled()
-        # collective-fusion switch (ALINK_TPU_FUSE_COLLECTIVES), latched
-        # per run. Rides the program-cache key AND the checkpoint
-        # signature: the fused program's collective set is structurally
-        # different HLO (N lane payloads -> one flattened psum), even
-        # though training results are bitwise-identical
-        from .communication import fusion_enabled, fusing, resolve_deferred
-        fuse = fusion_enabled()
+        # key-folding flag dims, latched ONCE per run at the plan
+        # derivation site (common/plan.engine_flags — the ENV-KEY-FOLD
+        # checked site).  probes: stacked (max_iter,) carry entries make
+        # a toggled flag a structurally different program.  donate: the
+        # buffer-aliasing contract differs even though the HLO ops are
+        # identical.  fuse: the fused program's collective set is
+        # structurally different HLO.  All three (plus step_log) ride
+        # the program-cache key via the ExecutionPlan below.
+        from ..common import compileledger
+        from ..common import plan as planlib
+        plan_flags = planlib.engine_flags()
+        probes_on = plan_flags[1][1]
+        donate = plan_flags[2][1]
+        fuse = plan_flags[3][1]
+        from .communication import fusing, resolve_deferred
         # per-superstep collective capture (trace-time; see communication
         # .collecting), keyed by the traced input signature: jax.jit keeps
         # a shape-keyed trace cache underneath each compiled entry, so one
@@ -1001,17 +999,22 @@ class IterativeComQueue:
         stages_dig = None
         if self._program_key is not None or self._ckpt is not None:
             stages_dig = _stages_digest(stages, criterion)
+        # ONE ExecutionPlan per exec (ROADMAP item 1): the program-cache
+        # key and the recovery signature both derive from it.  The
+        # structural guard stays (advisor r4): the stage bytecode +
+        # frozen closure cells ride in the "stages" dim, so a
+        # program_key that under-specifies a baked constant misses
+        # instead of silently re-running a stale program.
+        splan = planlib.engine_plan(
+            program_key=self._program_key, stages_digest=stages_dig,
+            mesh=mesh, num_workers=nw, max_iter=max_iter, seed=seed,
+            has_criterion=criterion is not None, flags=plan_flags,
+            part_names=tuple(sorted(parts)),
+            bcast_names=tuple(sorted(bcast)))
         if self._program_key is not None:
-            from ..common.profiling import step_log_enabled
-            # structural guard (advisor r4): the stage bytecode + frozen
-            # closure cells ride in the key, so a program_key that
-            # under-specifies a baked constant misses instead of silently
-            # re-running a stale program
-            ckey = (self._program_key, stages_dig,
-                    mesh, nw, max_iter, seed,
-                    criterion is not None, step_log_enabled(), probes_on,
-                    donate, fuse, tuple(sorted(parts)),
-                    tuple(sorted(bcast)))
+            ckey = splan.legacy_key()
+        if not lower_only:
+            compileledger.subsystem_start("engine")
 
         if self._ckpt is not None or self._boundary is not None:
             # -- durable chunked execution (engine/recovery.py) -----------
@@ -1038,6 +1041,8 @@ class IterativeComQueue:
             first = cont = None
             ckkey = ("__ckpt__", ckey) if ckey is not None else None
             if ckkey is not None:
+                compileledger.register_cache("engine.chunked", "engine",
+                                             _PROGRAM_CACHE_MAX)
                 cached = _PROGRAM_CACHE.get(ckkey)
                 if cached is not None:
                     cache_status = "hit"
@@ -1046,6 +1051,7 @@ class IterativeComQueue:
                     first, cont = cached
                     manifest = _PROGRAM_CACHE_MANIFESTS.setdefault(ckkey,
                                                                    manifest)
+                    compileledger.record_hit("engine.chunked")
             if first is None:
                 first = jax.jit(build_first_chunk())
                 cont = jit_cont()
@@ -1054,11 +1060,20 @@ class IterativeComQueue:
                     _PROGRAM_CACHE_STATS["misses"] += 1
                     _PROGRAM_CACHE[ckkey] = (first, cont)
                     _PROGRAM_CACHE_MANIFESTS[ckkey] = manifest
+                    compileledger.record_event(
+                        "engine.chunked",
+                        splan.extend(("checkpoint_chunked", True)),
+                        site=_program_label(self._program_key),
+                        subsystem="engine")
                     while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
                         old_key, _ = _PROGRAM_CACHE.popitem(last=False)
                         _PROGRAM_CACHE_JAXPRS.pop(old_key, None)
                         _PROGRAM_CACHE_MANIFESTS.pop(old_key, None)
                         _PROGRAM_CACHE_COSTS.pop(old_key, None)
+                        compileledger.record_eviction(
+                            "engine.chunked"
+                            if old_key and old_key[0] == "__ckpt__"
+                            else "engine.program")
             if mx and ckkey is not None:
                 get_registry().inc("alink_comqueue_program_cache_total", 1,
                                    {"result": cache_status})
@@ -1084,11 +1099,12 @@ class IterativeComQueue:
                     data_token = self._data_token = _freeze_closure_value(
                         {"parts": dict(self._partitioned),
                          "bcast": dict(self._broadcast)}, 3)
-                signature = recovery.program_signature(
-                    num_workers=nw, max_iter=max_iter, seed=seed,
-                    part_sig=part_sig, bcast_names=tuple(sorted(bcast)),
-                    stages_digest=stages_dig, data_token=data_token,
-                    probes_on=probes_on, fuse_collectives=fuse)
+                # the durable-run signature derives from the SAME plan
+                # as the program-cache key (content identical to the
+                # historical direct program_signature call — old
+                # snapshots stay resumable)
+                signature = planlib.engine_checkpoint_signature(
+                    splan, part_sig=part_sig, data_token=data_token)
                 resumed = recovery.resume_state(ck, signature)
             else:
                 # boundary-only chunking (set_boundary without a
@@ -1123,6 +1139,8 @@ class IterativeComQueue:
         from ..common.metrics import env_flag
         verify = env_flag("ALINK_VERIFY_PROGRAM_CACHE", default=False)
         if ckey is not None:
+            compileledger.register_cache("engine.program", "engine",
+                                         _PROGRAM_CACHE_MAX)
             compiled = _PROGRAM_CACHE.get(ckey)
         if compiled is None:
             compiled = jax.jit(build_mapped())
@@ -1130,6 +1148,13 @@ class IterativeComQueue:
                 cache_status = "miss"
                 _PROGRAM_CACHE_STATS["misses"] += 1
                 _PROGRAM_CACHE[ckey] = compiled
+                # ledger event at insert time; the trace+compile wall is
+                # only observable around the first dispatch (jit is
+                # lazy) — note_wall below attaches it
+                compileledger.record_event(
+                    "engine.program", splan,
+                    site=_program_label(self._program_key),
+                    subsystem="engine")
                 # the cached program's superstep closure writes into THIS
                 # manifest dict; store it so later cache-hit execs can
                 # read the per-superstep collective capture
@@ -1144,10 +1169,15 @@ class IterativeComQueue:
                     _PROGRAM_CACHE_JAXPRS.pop(old_key, None)
                     _PROGRAM_CACHE_MANIFESTS.pop(old_key, None)
                     _PROGRAM_CACHE_COSTS.pop(old_key, None)
+                    compileledger.record_eviction(
+                        "engine.chunked"
+                        if old_key and old_key[0] == "__ckpt__"
+                        else "engine.program")
         elif ckey is not None:
             cache_status = "hit"
             _PROGRAM_CACHE_STATS["hits"] += 1
             _PROGRAM_CACHE.move_to_end(ckey)
+            compileledger.record_hit("engine.program")
             # the cached closure traces into the manifest stored at miss
             # time, not this exec's local dict — read from the stored one
             manifest = _PROGRAM_CACHE_MANIFESTS.setdefault(ckey, manifest)
@@ -1182,7 +1212,12 @@ class IterativeComQueue:
                                 capture=True) as pw:
                 _pt0 = time.perf_counter()
                 stacked = compiled(parts, bcast)
-                pw.dispatch(time.perf_counter() - _pt0)
+                _disp = time.perf_counter() - _pt0
+                pw.dispatch(_disp)
+                if cache_status == "miss":
+                    # the first dispatch carried trace+compile — attach
+                    # its wall to this miss's ledger entry
+                    compileledger.note_wall("engine.program", _disp)
                 if pw.on:
                     _pt1 = time.perf_counter()
                     jax.block_until_ready(stacked)

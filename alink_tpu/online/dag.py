@@ -743,6 +743,17 @@ class OnlineDag:
         mapper.load_model(serve_table)
         self.predictor = CompiledPredictor(mapper, buckets=self.buckets,
                                            name=self.name)
+        # compile-plane ledger (ISSUE 19): the serving stage's program
+        # identity, so a restart's cold-start report names which stage
+        # re-paid compiles
+        from ..common import compileledger
+        from ..common.plan import dag_stage_plan
+        compileledger.subsystem_start("dag")
+        compileledger.register_stage(
+            "dag", "serving",
+            dag_stage_plan("serving", {"name": self.name,
+                                       "buckets": self.predictor.buckets,
+                                       "min_fill": self.min_fill}))
         self.server = PredictServer(self.predictor, name=self.name,
                                     min_fill=self.min_fill)
         self._versions.append((self.predictor.model_version, serve_table))
@@ -764,6 +775,15 @@ class OnlineDag:
             kw["feature_cols"] = self.feature_cols
         if self.health is not None:
             kw["health"] = self.health
+        from ..common import compileledger
+        from ..common.plan import dag_stage_plan
+        compileledger.register_stage(
+            "dag", "trainer",
+            dag_stage_plan("trainer", {"update_mode": self.update_mode,
+                                       "staleness": self.staleness,
+                                       "alpha": self.alpha,
+                                       "beta": self.beta,
+                                       "l1": self.l1, "l2": self.l2}))
         op = FtrlTrainStreamOp(self.warm_model, **kw).link_from(
             self.source_fn())
         op.set_batch_hook(self._pacer.hook)

@@ -885,71 +885,53 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
         _warm_fp = _hashlib.blake2b(
             np.ascontiguousarray(np.asarray(init.coef)).tobytes(),
             digest_size=12).hexdigest()
-        ck_signature = {"kind": "ftrl_state", "alpha": alpha, "beta": beta,
-                        "l1": l1, "l2": l2, "dim": dim, "dim_pad": dim_pad,
-                        "update_mode": update_mode,
-                        # the staleness bound shapes the trajectory only in
-                        # staleness mode; None elsewhere so changing the
-                        # (unused) knob does not refuse a valid resume
-                        "staleness": (staleness
-                                      if update_mode == "staleness" else None),
-                        "has_intercept": bool(has_icpt),
-                        "warm_coef_blake2b": _warm_fp}
-        if update_mode == "chained":
-            # the chunk length changes fp association under collisions,
-            # so a chained-mode resume must match it; the key is added
-            # CONDITIONALLY so pre-existing snapshots of the other modes
-            # keep their exact signature and stay resumable
-            ck_signature["chunk_size"] = chunk_size
-        # resolved Pallas kernel tier mode (ALINK_TPU_FTRL_KERNEL,
-        # kernels/ftrl.py): latched once per drain and passed into the
-        # sparse/staleness/chained factory lookups — it rides the lru
-        # key, so toggling never serves a stale step program
-        from ....kernels.ftrl import (chained_kernel_available,
-                                      ftrl_kernel_mode)
-        import jax as _jx
-        kern = ftrl_kernel_mode()
-        if update_mode == "chained" and kern == "pallas" \
-                and chained_kernel_available(
-                    chunk_size,
-                    np.float64 if _jx.config.jax_enable_x64
-                    else np.float32):
-            # the triangular correction kernel accumulates the SAME
-            # deltas in a different association than the dense einsum
-            # (last-ulp difference under collisions), so a chained
-            # resume refuses across the toggle. The fold resolves
-            # through the SAME memoized availability probe the step
-            # factory uses (canonical width, link-time ship dtype), so
-            # the signature always describes the arithmetic actually
-            # traced — a probe-demoted drain keeps the flag-off
-            # signature and its snapshots stay interchangeable with
-            # flag-off ones (they are the same numbers). Conditional,
-            # so every pre-existing snapshot keeps its exact signature
-            ck_signature["ftrl_kernel"] = "pallas"
-        from ....engine.communication import fusion_enabled
-        if update_mode == "chained" and fusion_enabled():
-            # ALINK_TPU_FUSE_COLLECTIVES folds into the chained-mode
-            # signature only: today every FTRL margin psum is dependency-
-            # forced to a single collective (programs are byte-identical
-            # under the flag), but the chained kernel is the one whose
-            # collision association is f32-round-sensitive — any future
-            # fused-margin chunking changes it, so chained resumes refuse
-            # across the flag conservatively. Conditional, so existing
-            # snapshots of all modes stay resumable with the flag off.
-            ck_signature["fuse_collectives"] = True
+        # ONE ExecutionPlan per drain (ROADMAP item 1): hyperparameters,
+        # geometry and the key-folding flags — ALINK_TPU_FTRL_KERNEL
+        # (the resolved tier mode the step factories fold into their lru
+        # keys, so toggling never serves a stale step program; the
+        # chained signature folds the availability-PROBED tier, so a
+        # probe-demoted drain keeps the flag-off signature and its
+        # snapshots stay interchangeable), ALINK_TPU_DONATE (the (z, n)
+        # buffer-aliasing contract rides every lru key) and the
+        # chained-only ALINK_TPU_FUSE_COLLECTIVES fold — all latched
+        # ONCE at the plan derivation site (common/plan.ftrl_plan, the
+        # ENV-KEY-FOLD checked site).  The resume signature derives from
+        # the same plan, content-identical to the historical dict
+        # (conditional chained-mode keys included), so every
+        # pre-existing snapshot keeps its exact signature and stays
+        # resumable.
+        from ....common import compileledger
+        from ....common import plan as planlib
+        fplan = planlib.ftrl_plan(
+            mesh=mesh, alpha=alpha, beta=beta, l1=l1, l2=l2, dim=dim,
+            dim_pad=dim_pad, update_mode=update_mode,
+            staleness=staleness, chunk_size=chunk_size,
+            has_intercept=bool(has_icpt), warm_fp=_warm_fp)
+        ck_signature = planlib.ftrl_checkpoint_signature(fplan)
+        kern = fplan.get("ALINK_TPU_FTRL_KERNEL")
+        compileledger.subsystem_start("ftrl")
         allow_fb = [True]    # cleared once the state commits to std layout
         sparse_step = [None]                # built lazily (sparse input only)
-        # (z, n) buffer donation (ALINK_TPU_DONATE, default on): every
-        # step program aliases its state inputs to its state outputs —
-        # no copy-on-entry, half the state HBM. Latched once per drain
-        # and passed into every factory lookup (it rides the lru key)
-        from ....engine.comqueue import donation_enabled
-        don = donation_enabled()
-        _dense, weights_fn = _ftrl_step_factory(mesh, alpha, beta, l1, l2,
-                                                donate=don)
+        don = fplan.get("ALINK_TPU_DONATE")
+
+        def _step_lookup(factory, args, label, **extra):
+            # lru lookup through the compile ledger: cache_info() miss
+            # deltas classify the call; the factory and its key tuple
+            # are untouched (byte-identical lru behavior, ledger on or
+            # off)
+            return compileledger.lru_call(
+                "ftrl.step", factory, args,
+                kwargs={k: v for k, v in extra.items()},
+                plan=fplan.extend(("factory", label)),
+                site="FtrlTrainStreamOp.link_from", subsystem="ftrl")
+
+        _dense, weights_fn = _step_lookup(
+            _ftrl_step_factory, (mesh, alpha, beta, l1, l2), "dense",
+            donate=don)
         if batch_mode:
-            _dense = _ftrl_dense_batch_step_factory(mesh, alpha, beta, l1, l2,
-                                                    donate=don)
+            _dense = _step_lookup(
+                _ftrl_dense_batch_step_factory,
+                (mesh, alpha, beta, l1, l2), "dense_batch", donate=don)
         # staleness mode: dense rows keep the strict per-sample scan (a
         # REFINEMENT of <=K staleness; dense scans are matvec-bound, not
         # gather-bound, so the chunked kernel buys nothing there)
@@ -1428,9 +1410,15 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                   # full one-hot batches run the val-less program (no
                   # value tensor shipped), partial/weighted ones the
                   # val-carrying twin
-                  step = _ftrl_fb_batch_step_factory(
-                      mesh, meta, alpha, beta, l1, l2, fbv is not None,
-                      donate=don)
+                  step = compileledger.lru_call(
+                      "ftrl.step", _ftrl_fb_batch_step_factory,
+                      (mesh, meta, alpha, beta, l1, l2, fbv is not None),
+                      kwargs={"donate": don},
+                      plan=fplan.extend(("factory", "fb_batch"),
+                                        ("fb_meta", meta),
+                                        ("with_val", fbv is not None)),
+                      site="FtrlTrainStreamOp.link_from",
+                      subsystem="ftrl")
                   if fbv is None:
                       z, n, mg = run_step(step, fbi, y, z, n)
                   else:
@@ -1450,24 +1438,29 @@ class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCo
                   _, idx, val, y, width = enc
                   if sparse_step[0] is None:
                       if batch_mode:
-                          sparse_step[0] = _ftrl_sparse_batch_step_factory(
-                              mesh, alpha, beta, l1, l2, donate=don)
+                          sparse_step[0] = _step_lookup(
+                              _ftrl_sparse_batch_step_factory,
+                              (mesh, alpha, beta, l1, l2),
+                              "sparse_batch", donate=don)
                       elif update_mode == "staleness":
-                          sparse_step[0] = _ftrl_sparse_staleness_step_factory(
-                              mesh, alpha, beta, l1, l2, staleness,
-                              donate=don, kernel=kern)
+                          sparse_step[0] = _step_lookup(
+                              _ftrl_sparse_staleness_step_factory,
+                              (mesh, alpha, beta, l1, l2, staleness),
+                              "sparse_staleness", donate=don, kernel=kern)
                       elif update_mode == "chained":
                           # strict semantics through the chained-
                           # correction chunk kernel; dense rows keep the
                           # per-sample scan (matvec-bound, not
                           # gather-bound — chunking buys nothing there)
-                          sparse_step[0] = _ftrl_sparse_chained_step_factory(
-                              mesh, alpha, beta, l1, l2, chunk_size,
-                              donate=don, kernel=kern)
+                          sparse_step[0] = _step_lookup(
+                              _ftrl_sparse_chained_step_factory,
+                              (mesh, alpha, beta, l1, l2, chunk_size),
+                              "sparse_chained", donate=don, kernel=kern)
                       else:
-                          sparse_step[0] = _ftrl_sparse_step_factory(
-                              mesh, alpha, beta, l1, l2, donate=don,
-                              kernel=kern)
+                          sparse_step[0] = _step_lookup(
+                              _ftrl_sparse_step_factory,
+                              (mesh, alpha, beta, l1, l2),
+                              "sparse", donate=don, kernel=kern)
                   z, n, mg = run_step(sparse_step[0], idx, val, y, z, n)
               if mon_on:
                   # progressive validation on the device scalars; real

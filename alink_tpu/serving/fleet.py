@@ -59,8 +59,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..common import reqtrace
+from ..common import compileledger, reqtrace
 from ..common.adminz import acquire_admin, release_admin
+from ..common.plan import serving_event_plan
 from ..common.checkpoint import load_latest_validated, save_checkpoint
 from ..common.faults import FaultInjected, maybe_crash
 from ..common.metrics import get_registry, metrics_enabled
@@ -218,8 +219,10 @@ class _GeometryGroup:
         prog = self._programs.get(key)
         if prog is not None:
             self.hits += 1
+            compileledger.record_hit("fleet.group")
             return prog
         import jax
+        _led_t0 = time.perf_counter()
         with self._lock:
             prog = self._programs.get(key)
             if prog is None:
@@ -227,8 +230,16 @@ class _GeometryGroup:
                 fn = (self.archetype.device_fns[kind] if lanes is None
                       else self.fleet_fns[kind])
                 prog = self._programs[key] = jax.jit(fn)
+                compileledger.record_event(
+                    "fleet.group",
+                    serving_event_plan(self.plan, kind=kind,
+                                       bucket=bucket, trailing=trailing,
+                                       lanes=lanes),
+                    wall_s=time.perf_counter() - _led_t0,
+                    site="_GeometryGroup.program", subsystem="fleet")
             else:
                 self.hits += 1
+                compileledger.record_hit("fleet.group")
         return prog
 
     def stats(self) -> Dict[str, int]:
@@ -290,6 +301,7 @@ class ModelRegistry:
         snapshot it, and evict over budget. Idempotent registration is
         an error — re-loading a tenant's model is :meth:`swap_tenant`."""
         tid = str(tenant_id)
+        compileledger.subsystem_start("fleet")
         kernel = mapper.serving_kernel()
         if kernel is None:
             raise TypeError(

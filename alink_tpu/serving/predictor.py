@@ -57,7 +57,8 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..common import reqtrace
+from ..common import compileledger, reqtrace
+from ..common.plan import serving_event_plan
 from ..common.faults import maybe_crash
 from ..common.metrics import get_registry, metrics_enabled
 from ..common.mtable import MTable
@@ -361,6 +362,13 @@ class CompiledPredictor:
                                 buckets=self._buckets,
                                 sharded=self._sharded,
                                 mesh_fp=self._mesh_fp)
+        # compile-ledger identity (ISSUE 19): one ledger cache per
+        # predictor; every miss in _program records an event whose diff
+        # names the changed dimension (dtype flip, new bucket, swapped
+        # geometry)
+        self._ledger_cache = f"serve.{self.name}"
+        compileledger.register_cache(self._ledger_cache, "serving")
+        compileledger.subsystem_start("serving")
         self._sharded_fns: Dict[Tuple, Dict[str, Callable]] = {}
         self._swap_lock = threading.Lock()
         self._cache_lock = threading.Lock()
@@ -623,8 +631,10 @@ class CompiledPredictor:
         entry = self._programs.get(key)
         if entry is not None:
             self._hits += 1
+            compileledger.record_hit(self._ledger_cache)
             return entry
         import jax
+        _led_t0 = time.perf_counter()
         with self._cache_lock:
             entry = self._programs.get(key)
             if entry is None:
@@ -650,12 +660,22 @@ class CompiledPredictor:
                     manifest = tuple(cap)
                 entry = (prog, manifest)
                 self._programs[key] = entry
+                compileledger.record_event(
+                    self._ledger_cache,
+                    serving_event_plan(
+                        self.plan, signature=ver.kernel.signature,
+                        sharded=sharded, kind=kind, bucket=bucket,
+                        trailing=tuple(a.shape[1:] for a in arrays)),
+                    wall_s=time.perf_counter() - _led_t0,
+                    site="CompiledPredictor._program",
+                    subsystem="serving")
                 if metrics_enabled():
                     get_registry().inc("alink_serve_program_cache_total",
                                        1, {"result": "miss",
                                            "predictor": self.name})
             else:
                 self._hits += 1
+                compileledger.record_hit(self._ledger_cache)
         return entry
 
     def cache_stats(self) -> Dict[str, int]:

@@ -283,12 +283,16 @@ def _run_sweep_queue(*, kind: str, stage, parts: Dict[str, Any],
     This is the sweep's program factory (an alink-lint factory root):
     every flag read reachable from here must fold into the program key
     or be registry-declared key-neutral. ``ALINK_TPU_SWEEP`` folds —
-    its live value rides the key below — and the ASHA knobs are
-    key-neutral (host boundary pruning of a carry lane; chunk limits
-    are traced scalars)."""
-    from ..common.flags import flag_value
+    resolved at the plan derivation site (``common/plan.sweep_plan``,
+    the ENV-KEY-FOLD checked site; the legacy program-key tuple is
+    byte-identical) — and the ASHA knobs are key-neutral (host
+    boundary pruning of a carry lane; chunk limits are traced
+    scalars)."""
+    from ..common import compileledger
+    from ..common.plan import legacy_sweep_program_key, sweep_plan
     from ..engine import IterativeComQueue
 
+    compileledger.subsystem_start("sweep")
     queue = IterativeComQueue(env=env, max_iter=int(max_iter),
                               seed=int(seed))
     for k, v in parts.items():
@@ -298,8 +302,7 @@ def _run_sweep_queue(*, kind: str, stage, parts: Dict[str, Any],
     queue.add(stage)
     queue.set_compare_criterion(_sweep_criterion)
     queue.set_program_key(
-        ("sweep", kind, bool(flag_value("ALINK_TPU_SWEEP", False)))
-        + tuple(key_tail))
+        legacy_sweep_program_key(sweep_plan(kind, tuple(key_tail))))
     if checkpoint_dir:
         queue.set_checkpoint(checkpoint_dir,
                              every=(asha.rung if asha is not None else 1),

@@ -1,0 +1,320 @@
+"""Compile-ledger behavior (ISSUE 19): event recording + named diffs,
+the bounded ring, storm detection with dominant-dimension attribution,
+lru-factory classification, the /compilez admin endpoint, fleetz
+mixed-fleet tolerance, and the doctor's offline compile verdict.
+"""
+
+import functools
+import json
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from alink_tpu.common import compileledger as cl
+from alink_tpu.common.plan import ExecutionPlan
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_ledger():
+    cl.reset()
+    yield
+    cl.reset()
+
+
+def _plan(**dims):
+    return ExecutionPlan("test", tuple(dims.items()))
+
+
+# ---------------------------------------------------------------------------
+# events + diffs + ring
+# ---------------------------------------------------------------------------
+
+class TestEvents:
+    def test_first_event_is_cold_start(self):
+        ev = cl.record_event("t.cache", _plan(x=1), site="here",
+                             subsystem="test")
+        assert ev["diff"] == [{"dim": "cold-start", "old": "-",
+                               "new": "-"}]
+        assert ev["site"] == "here" and ev["cache"] == "t.cache"
+
+    def test_diff_names_the_changed_dimension(self):
+        cl.record_event("t.cache", _plan(dtype="f32", bucket=128))
+        ev = cl.record_event("t.cache", _plan(dtype="int8", bucket=128))
+        assert ev["diff"] == [{"dim": "dtype", "old": "'f32'",
+                               "new": "'int8'"}]
+
+    def test_diffs_are_per_cache(self):
+        cl.record_event("a", _plan(x=1))
+        cl.record_event("b", _plan(x=99))
+        ev = cl.record_event("a", _plan(x=2))
+        assert ev["diff"] == [{"dim": "x", "old": "1", "new": "2"}]
+
+    def test_ring_is_bounded_by_flag(self, monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_COMPILE_RING", "16")
+        for i in range(40):
+            cl.record_event("t.cache", _plan(x=i))
+        doc = cl.compilez_doc()
+        assert doc["ring_capacity"] == 16
+        assert len(doc["events"]) == 16
+        assert doc["events"][-1]["seq"] == 40
+        # the cache row keeps the full miss count even past the ring
+        assert doc["caches"]["t.cache"]["misses"] == 40
+
+    def test_disabled_ledger_records_nothing(self, monkeypatch):
+        monkeypatch.setenv("ALINK_TPU_COMPILE_LEDGER", "0")
+        assert cl.record_event("t.cache", _plan(x=1)) == {}
+        cl.record_hit("t.cache")
+        cl.subsystem_start("test")
+        doc = cl.compilez_doc()
+        assert doc["enabled"] is False
+        assert doc["caches"] == {} and doc["events"] == []
+
+    def test_note_wall_attaches_once(self):
+        cl.record_event("t.cache", _plan(x=1))
+        cl.note_wall("t.cache", 1.25)
+        cl.note_wall("t.cache", 9.0)   # second report must not clobber
+        ev = cl.compilez_doc()["events"][-1]
+        assert ev["wall_s"] == 1.25
+
+    def test_cold_start_attribution(self):
+        cl.subsystem_start("serving")
+        cl.record_event("serve.x", _plan(x=1), subsystem="serving")
+        ttfp = cl.compilez_doc()["cold_start"]["time_to_first_program_s"]
+        assert "serving" in ttfp and ttfp["serving"] >= 0.0
+
+    def test_doc_is_json_serializable(self):
+        cl.register_cache("t.cache", "test", capacity=8)
+        cl.record_event("t.cache", _plan(x=(1, 2), y="s"))
+        cl.register_stage("dag", "serving", _plan(stage="serving"))
+        json.dumps(cl.compilez_doc())
+
+
+# ---------------------------------------------------------------------------
+# storms
+# ---------------------------------------------------------------------------
+
+class TestStorms:
+    def test_storm_fires_once_and_names_dominant_dim(self):
+        for i in range(cl.STORM_MISSES + 2):
+            cl.record_event("t.cache",
+                            _plan(dtype="f32" if i % 2 else "int8",
+                                  bucket=128))
+        doc = cl.compilez_doc()
+        row = doc["caches"]["t.cache"]
+        assert row["storm_active"] is True
+        assert row["storms"] == 1           # transition edge, not per-miss
+        dom = row["dominant_dim"]
+        assert dom["dim"] == "dtype" and dom["count"] >= cl.STORM_MISSES
+        assert cl.storms() == ["t.cache"]
+
+    def test_below_threshold_is_not_a_storm(self):
+        for i in range(cl.STORM_MISSES - 1):
+            cl.record_event("t.cache", _plan(x=i))
+        row = cl.compilez_doc()["caches"]["t.cache"]
+        assert row["storms"] == 0 and row["storm_active"] is False
+
+
+# ---------------------------------------------------------------------------
+# lru-factory classification
+# ---------------------------------------------------------------------------
+
+class TestLruCall:
+    def test_miss_then_hit_classification(self):
+        calls = []
+
+        @functools.lru_cache(maxsize=None)
+        def factory(a, b, donate=True):
+            calls.append((a, b, donate))
+            return (a, b, donate)
+
+        p = _plan(a=1)
+        out1 = cl.lru_call("f.step", factory, (1, 2), plan=p,
+                           site="t", subsystem="f",
+                           kwargs={"donate": False})
+        out2 = cl.lru_call("f.step", factory, (1, 2), plan=p,
+                           site="t", subsystem="f",
+                           kwargs={"donate": False})
+        assert out1 == out2 == (1, 2, False)
+        assert calls == [(1, 2, False)]     # lru key untouched
+        row = cl.compilez_doc()["caches"]["f.step"]
+        assert row["misses"] == 1 and row["hits"] == 1
+        assert row["size"] == 1
+
+    def test_plain_function_bypasses(self):
+        """A monkeypatched (non-lru) factory is called straight through
+        — the tests that stub factories must keep working."""
+        def plain(a):
+            return a * 2
+        assert cl.lru_call("f.step", plain, (21,), plan=_plan(),
+                           site="t") == 42
+        assert "f.step" not in cl.compilez_doc()["caches"]
+
+
+# ---------------------------------------------------------------------------
+# /compilez over the admin endpoint
+# ---------------------------------------------------------------------------
+
+class TestCompilezEndpoint:
+    def test_endpoint_serves_the_doc(self):
+        from alink_tpu.common.adminz import AdminServer
+        cl.register_cache("t.cache", "test")
+        cl.record_event("t.cache", _plan(dtype="f32"))
+        cl.record_event("t.cache", _plan(dtype="int8"))
+        srv = AdminServer(port=0)
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            doc = json.loads(urllib.request.urlopen(
+                f"{base}/compilez?n=1", timeout=10).read())
+            assert doc["enabled"] is True
+            assert "t.cache" in doc["caches"]
+            assert len(doc["events"]) == 1
+            assert doc["events"][0]["diff"][0]["dim"] == "dtype"
+            assert "/compilez" in AdminServer.ENDPOINTS
+            idx = urllib.request.urlopen(base + "/",
+                                         timeout=10).read().decode()
+            assert "/compilez" in idx
+        finally:
+            srv.close()
+
+
+# ---------------------------------------------------------------------------
+# fleetz: mixed-fleet tolerance + snapshot archiving
+# ---------------------------------------------------------------------------
+
+def _load_fleetz():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "fleetz_under_test", os.path.join(ROOT, "tools", "fleetz.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestFleetz:
+    def test_scrapes_compilez_and_tolerates_old_workers(self, tmp_path):
+        """A current worker contributes compilez.json to the snapshot;
+        a worker predating /compilez (404) scrapes clean without it —
+        the ISSUE 18 tracez/requestz mixed-fleet contract extended."""
+        import http.server
+        import threading
+
+        from alink_tpu.common.adminz import AdminServer
+        fleetz = _load_fleetz()
+        cl.record_event("t.cache", _plan(x=1), subsystem="test")
+        new = AdminServer(port=0)
+        new.start()
+
+        class OldWorker(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?")[0].rstrip("/") or "/"
+                bodies = {"/varz": b"[]", "/statusz": b"{}",
+                          "/healthz": b"{}", "/readyz": b"{}",
+                          "/metrics": b""}
+                body = bodies.get(path)
+                self.send_response(200 if body is not None else 404)
+                if body is None:
+                    body = b"404"
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        old = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                              OldWorker)
+        t = threading.Thread(target=old.serve_forever, daemon=True)
+        t.start()
+        try:
+            workers = [f"127.0.0.1:{new.port}",
+                       f"127.0.0.1:{old.server_address[1]}"]
+            scrapes = [fleetz.scrape_worker(w, timeout=10)
+                       for w in workers]
+            assert "error" not in scrapes[0]
+            assert "error" not in scrapes[1]
+            assert scrapes[0]["compilez"]["caches"]["t.cache"]
+            assert "compilez" not in scrapes[1]
+            report = fleetz.fleet_report(scrapes)
+            assert report["aggregate"]["reachable"] == 2
+            assert report["aggregate"]["alink_compile_total"] >= 1
+            out = tmp_path / "snap"
+            fleetz.write_snapshot(str(out), scrapes, report)
+            archived = list(out.glob("*/compilez.json"))
+            assert len(archived) == 1
+        finally:
+            new.close()
+            old.shutdown()
+
+    def test_series_value_reads_histogram_sum(self):
+        fleetz = _load_fleetz()
+        varz = [{"kind": "histogram", "name": "alink_compile_seconds",
+                 "labels": {}, "sum": 2.5, "count": 3,
+                 "buckets": [], "counts": []}]
+        assert fleetz._series_value(varz, "alink_compile_seconds") == 2.5
+
+
+# ---------------------------------------------------------------------------
+# doctor: offline compile verdict
+# ---------------------------------------------------------------------------
+
+def _load_doctor():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "doctor_under_test", os.path.join(ROOT, "tools", "doctor.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestDoctorCompileVerdict:
+    def _storm_doc(self):
+        cl.subsystem_start("serving")
+        for i in range(cl.STORM_MISSES + 2):
+            cl.record_event("serve.x",
+                            _plan(**{"ALINK_TPU_SERVE_DTYPE":
+                                     "f32" if i % 2 else "int8"}),
+                            subsystem="serving")
+        return cl.compilez_doc()
+
+    def test_storm_verdict_names_the_flag(self, tmp_path, capsys):
+        doctor = _load_doctor()
+        (tmp_path / "compilez.json").write_text(
+            json.dumps(self._storm_doc()))
+        assert doctor.main(["--run-dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "compile plane" in out
+        assert "RECOMPILE STORM" in out
+        assert "ALINK_TPU_SERVE_DTYPE" in out
+        assert "env flag is flapping" in out
+
+    def test_cold_start_dominated_verdict(self, tmp_path, capsys):
+        doctor = _load_doctor()
+        doc = cl.compilez_doc()
+        doc["caches"] = {"engine.program": {
+            "subsystem": "engine", "size": 1, "capacity": 32,
+            "hits": 5, "misses": 1, "evictions": 0, "hit_rate": 0.83,
+            "last_digest": "d", "storm_active": False, "storms": 0,
+            "dominant_dim": None}}
+        doc["cold_start"] = {"started": ["engine"],
+                             "time_to_first_program_s": {"engine": 42.0}}
+        (tmp_path / "compilez.json").write_text(json.dumps(doc))
+        assert doctor.main(["--run-dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "cold-start-dominated restart" in out
+        assert "engine paid 42.0s" in out
+
+    def test_healthy_verdict(self, tmp_path, capsys):
+        doctor = _load_doctor()
+        cl.record_event("t.cache", _plan(x=1))
+        for _ in range(8):
+            cl.record_hit("t.cache")
+        (tmp_path / "compilez.json").write_text(
+            json.dumps(cl.compilez_doc()))
+        assert doctor.main(["--run-dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "verdict: healthy — every compile is attributed" in out

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Compile-plane ledger smoke (perf_gate leg, ISSUE 19) — exit 13.
+
+Drives the one scenario the compile ledger exists to explain: a serve
+flag flips under load, and the ledger must attribute the resulting
+recompiles to EXACTLY that flag — not merely count them.
+
+The contract it gates:
+
+  * warm-up at the default ``ALINK_TPU_SERVE_DTYPE=f32`` compiles one
+    program per (kind, bucket) and the ledger records each with a
+    cold-start diff;
+  * steady-state traffic afterwards produces ZERO new ledger events on
+    ANY cache — a cache hit must never masquerade as a compile;
+  * flipping ``ALINK_TPU_SERVE_DTYPE=int8`` and hot-swapping the model
+    recompiles exactly the warmed program set, and every post-flip
+    event's structural diff names ``ALINK_TPU_SERVE_DTYPE f32→int8``
+    as the changed dimension — no other cache records anything
+    (zero spurious recompiles elsewhere);
+  * the ``/compilez`` document written to the run dir is enough for a
+    FRESH interpreter to render the verdict offline:
+    ``tools/doctor.py --run-dir`` names the flag in its compile-plane
+    section with nothing else on disk.
+
+Runs in a fresh child interpreter (bootenv CPU mesh) so the ledger,
+flag resolution and program caches start from zero.
+"""
+
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+EXIT = 13
+_MARK = "ALINK_COMPILEZ_SMOKE_CHILD"
+
+
+def main() -> int:
+    if os.environ.get(_MARK) != "1":
+        import tempfile
+
+        import bootenv
+        env = bootenv.cpu_mesh_env(4)
+        env[_MARK] = "1"
+        # the flip under test: start from the unset default (f32)
+        env.pop("ALINK_TPU_SERVE_DTYPE", None)
+        env.pop("ALINK_TPU_SERVE_FUSED", None)
+        env["ALINK_COMPILEZ_SMOKE_DIR"] = tempfile.mkdtemp(
+            prefix="alink-compilez-smoke-")
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             cwd=ROOT, env=env, timeout=900)
+        return out.returncode
+
+    import json
+
+    import numpy as np
+
+    from alink_tpu.common import compileledger
+    from alink_tpu.common.metrics import MetricsRegistry, set_registry
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.common.params import Params
+    from alink_tpu.common.vector import DenseVector
+    from alink_tpu.operator.batch.classification.linear import (
+        LogisticRegressionTrainBatchOp)
+    from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+    from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+    from alink_tpu.serving import CompiledPredictor
+
+    set_registry(MetricsRegistry())
+    run_dir = os.environ["ALINK_COMPILEZ_SMOKE_DIR"]
+    bad = []
+
+    def serve_events(cache):
+        return [e for e in compileledger.compilez_doc()["events"]
+                if e["cache"] == cache]
+
+    def other_misses(cache):
+        return {n: c["misses"]
+                for n, c in compileledger.compilez_doc()["caches"].items()
+                if n != cache and c.get("misses")}
+
+    # -- fixture: a trained dense-LR model + request rows -----------------
+    n_rows, dim = 64, 16
+    rng = np.random.RandomState(11)
+    X = rng.randn(n_rows, dim)
+    y = (X @ rng.randn(dim) > 0).astype(np.int64)
+    vecs = np.empty(n_rows, object)
+    vecs[:] = [DenseVector(X[i]) for i in range(n_rows)]
+    tbl = MTable({"vec": vecs, "label": y}, "vec VECTOR, label LONG")
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=2).link_from(
+        MemSourceBatchOp(tbl.first_n(32)))
+    model = warm.get_output_table()
+    data_schema = tbl.select(["vec"]).schema
+    mapper = LinearModelMapper(model.schema, data_schema,
+                               Params({"prediction_col": "pred",
+                                       "vector_col": "vec"}))
+    mapper.load_model(model)
+    req = tbl.select(["vec"]).first_n(16)
+
+    # one bucket -> exactly one compiled program per kind, so the
+    # post-flip diff is EXACTLY the flag dimension (no bucket churn
+    # riding the same diff)
+    pred = CompiledPredictor(mapper, buckets=(16,), name="cz_smoke")
+    cache = f"serve.{pred.name}"
+
+    # -- warm-up at f32: the cold-start compile set -----------------------
+    # (the fixture's LR training legitimately compiled through the
+    # engine cache — the baseline below pins every OTHER cache's miss
+    # count so the flip must not move any of them)
+    pred.predict_table(req)
+    n_warm = len(serve_events(cache))
+    if not n_warm:
+        bad.append("warm-up predict_table compiled nothing — the "
+                   "serving program factory is not feeding the ledger")
+    baseline = other_misses(cache)
+
+    # -- steady state: load with NO flag change — zero new events --------
+    for _ in range(4):
+        pred.predict_table(req)
+    n_steady = len(serve_events(cache))
+    if n_steady != n_warm:
+        bad.append(f"steady-state load grew the serve ledger from "
+                   f"{n_warm} to {n_steady} events — cache hits are "
+                   f"being recorded as compiles (spurious recompiles)")
+    if other_misses(cache) != baseline:
+        bad.append(f"steady-state load compiled outside serving: "
+                   f"{baseline} -> {other_misses(cache)}")
+
+    # -- the flip under load: f32 -> int8, hot swap, same traffic --------
+    os.environ["ALINK_TPU_SERVE_DTYPE"] = "int8"
+    pred.swap_model(model)
+    pred.predict_table(req)
+    flip_events = serve_events(cache)[n_steady:]
+    if len(flip_events) != n_warm:
+        bad.append(f"the dtype flip recompiled {len(flip_events)} "
+                   f"program(s), expected exactly the warmed set "
+                   f"({n_warm})")
+    for ev in flip_events:
+        dims = {d["dim"]: d for d in ev.get("diff") or []}
+        if set(dims) != {"ALINK_TPU_SERVE_DTYPE"}:
+            bad.append(f"post-flip diff names {sorted(dims)} — expected "
+                       f"exactly ['ALINK_TPU_SERVE_DTYPE'] (seq "
+                       f"{ev.get('seq')})")
+        else:
+            d = dims["ALINK_TPU_SERVE_DTYPE"]
+            if "f32" not in str(d.get("old")) \
+                    or "int8" not in str(d.get("new")):
+                bad.append(f"diff direction wrong: "
+                           f"{d.get('old')}→{d.get('new')}, expected "
+                           f"f32→int8")
+    doc = compileledger.compilez_doc()
+    if other_misses(cache) != baseline:
+        bad.append(f"other caches recorded compiles during the serve "
+                   f"flip: {baseline} -> {other_misses(cache)}")
+
+    # -- the run-dir artifact + offline verdict ---------------------------
+    cz_path = os.path.join(run_dir, "compilez.json")
+    with open(cz_path, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    doctor = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "doctor.py"),
+         "--run-dir", run_dir],
+        cwd=ROOT, capture_output=True, text=True, timeout=120)
+    if doctor.returncode != 0:
+        bad.append(f"doctor --run-dir exited {doctor.returncode}: "
+                   f"{doctor.stderr[-400:]}")
+    elif "compile plane" not in doctor.stdout \
+            or "ALINK_TPU_SERVE_DTYPE" not in doctor.stdout:
+        bad.append("doctor --run-dir did not render the compile-plane "
+                   "verdict naming ALINK_TPU_SERVE_DTYPE from "
+                   "compilez.json alone")
+
+    if bad:
+        print("compilez_smoke: FAILED:", file=sys.stderr)
+        for m in bad:
+            print(f"  {m}", file=sys.stderr)
+        return EXIT
+    print(f"compilez_smoke: clean — {n_warm} warm compile(s), zero "
+          f"steady-state events, dtype flip recompiled exactly "
+          f"{len(flip_events)} program(s) each attributed to "
+          f"ALINK_TPU_SERVE_DTYPE f32→int8; doctor rendered the "
+          f"verdict offline from {cz_path}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -127,6 +127,35 @@ def _records_from_url(url: str) -> List[dict]:
     return [r for r in doc if isinstance(r, dict)]
 
 
+def _compilez_from_url(url: str) -> List[Tuple[str, Any]]:
+    """``/compilez`` documents from a live admin endpoint or a fleetz
+    ``--snapshot`` directory — tolerant of workers predating the
+    endpoint (404 / missing file simply contributes nothing, the same
+    mixed-fleet contract as fleetz's tracez/requestz scrape)."""
+    out: List[Tuple[str, Any]] = []
+    if os.path.isdir(url):
+        import glob
+        for p in sorted(glob.glob(os.path.join(url, "compilez.json"))
+                        + glob.glob(os.path.join(url, "*",
+                                                 "compilez.json"))):
+            try:
+                out.append((os.path.basename(os.path.dirname(p))
+                            or "snapshot", load_json(p)))
+            except (OSError, ValueError):
+                pass
+        return out
+    import urllib.request
+    if "://" not in url:
+        url = f"http://{url}"
+    try:
+        with urllib.request.urlopen(f"{url.rstrip('/')}/compilez",
+                                    timeout=10) as r:
+            out.append((url, json.loads(r.read())))
+    except Exception:
+        pass
+    return out
+
+
 def _hist_p99(rec: Dict[str, Any]
               ) -> Tuple[Optional[float], Optional[dict]]:
     """(p99 upper-bound estimate, that bucket's exemplar) from one
@@ -991,10 +1020,104 @@ def _sweep_verdicts(bench: Optional[Dict[str, Any]]
     return out
 
 
+def _compile_verdicts(compilez: Optional[List[Tuple[str, Any]]]
+                      ) -> List[Dict[str, Any]]:
+    """The Layer-7 compile-plane verdict (ISSUE 19), rendered OFFLINE
+    from a ``/compilez`` document (run-dir ``compilez.json``, a fleetz
+    snapshot's per-worker scrape, or a post-mortem bundle's frozen
+    copy).  Each fix names the concrete dimension behind the cost: a
+    recompile storm's dominant changed dimension (a flapping flag, an
+    unbucketed geometry) or a cold-start-dominated restart's slowest
+    subsystem."""
+    out: List[Dict[str, Any]] = []
+    for label, cz in compilez or []:
+        if not isinstance(cz, dict) or not isinstance(
+                cz.get("caches"), dict):
+            continue
+        caches = cz["caches"]
+        events = [e for e in cz.get("events") or []
+                  if isinstance(e, dict)]
+        fixes: List[str] = []
+        compiles = sum(int(c.get("misses") or 0)
+                       for c in caches.values())
+        hits = sum(int(c.get("hits") or 0) for c in caches.values())
+        evictions = sum(int(c.get("evictions") or 0)
+                        for c in caches.values())
+        wall_s = round(sum(e.get("wall_s") or 0.0 for e in events), 3)
+        for name, c in sorted(caches.items()):
+            n_storms = int(c.get("storms") or 0)
+            if n_storms or c.get("storm_active"):
+                dim = c.get("dominant_dim") or {}
+                what = dim.get("dim", "?")
+                hint = ("one env flag is flapping across restarts or "
+                        "mid-run — pin it in the deployment env"
+                        if str(what).startswith("ALINK_") else
+                        "inputs are not bucketing — widen the bucket "
+                        "ladder or pad to the ladder before dispatch")
+                fixes.append(
+                    f"RECOMPILE STORM on cache {name} ({n_storms} "
+                    f"storm(s){', ACTIVE' if c.get('storm_active') else ''}"
+                    f"): dominant changed dimension {what} "
+                    f"({dim.get('old')}→{dim.get('new')}, "
+                    f"{dim.get('count', '?')} of the recent events) — "
+                    f"{hint}")
+            total = int(c.get("hits") or 0) + int(c.get("misses") or 0)
+            if (not n_storms and total >= 16
+                    and (c.get("hit_rate") or 0.0) < 0.5):
+                fixes.append(
+                    f"cache {name} hit rate "
+                    f"{c.get('hit_rate'):.0%} over {total} lookups: "
+                    f"steady-state recompile churn without a storm "
+                    f"edge — check the event diffs for the cycling "
+                    f"dimension")
+            cap = c.get("capacity")
+            if cap and int(c.get("evictions") or 0) > \
+                    max(4, int(c.get("misses") or 0) // 2):
+                fixes.append(
+                    f"cache {name} evicted {c['evictions']} programs "
+                    f"against capacity {cap}: the working set exceeds "
+                    f"the cache — raise the capacity or shrink the "
+                    f"plan-dimension fan-out")
+        cold = cz.get("cold_start") or {}
+        ttfp = {k: float(v) for k, v in
+                (cold.get("time_to_first_program_s") or {}).items()}
+        if ttfp:
+            worst = max(ttfp, key=lambda k: ttfp[k])
+            if ttfp[worst] >= 5.0:
+                fixes.append(
+                    f"cold-start-dominated restart: subsystem "
+                    f"{worst} paid {ttfp[worst]:.1f}s from first "
+                    f"activity to first compiled program — pre-warm "
+                    f"its programs at startup (AOT .lower() the plan's "
+                    f"bucket ladder) before admitting traffic")
+        out.append({
+            "label": label, "enabled": cz.get("enabled"),
+            "compiles": compiles, "hits": hits,
+            "evictions": evictions, "wall_s": wall_s,
+            "caches": {n: {"subsystem": c.get("subsystem"),
+                           "size": c.get("size"),
+                           "capacity": c.get("capacity"),
+                           "hits": c.get("hits"),
+                           "misses": c.get("misses"),
+                           "hit_rate": c.get("hit_rate"),
+                           "storms": c.get("storms")}
+                       for n, c in sorted(caches.items())},
+            "cold_start_s": {k: round(v, 3)
+                             for k, v in sorted(ttfp.items())},
+            "storms": sum(int(c.get("storms") or 0)
+                          for c in caches.values()),
+            "last_diff": (events[-1].get("diff")
+                          if events else None),
+            "fixes": fixes})
+    return out
+
+
 def diagnose(bench: Optional[Dict[str, Any]],
              profile: Optional[Dict[str, Any]],
              metrics: Optional[Dict[str, Any]],
-             peak_tflops: float, peak_hbm_gbps: float) -> Dict[str, Any]:
+             peak_tflops: float, peak_hbm_gbps: float,
+             compilez: Optional[List[Tuple[str, Any]]] = None
+             ) -> Dict[str, Any]:
     """The machine-shaped verdict document (--json emits it)."""
     rig = (bench or {}).get("rig") or {}
     peak_tflops = rig.get("peak_tflops") or peak_tflops
@@ -1036,6 +1159,9 @@ def diagnose(bench: Optional[Dict[str, Any]],
     sweeps = _sweep_verdicts(bench)
     if sweeps:
         doc["tuning"] = sweeps
+    compiled = _compile_verdicts(compilez)
+    if compiled:
+        doc["compile"] = compiled
     e2e = _e2e_verdicts(bench)
     if e2e:
         doc["e2e"] = e2e
@@ -1290,6 +1416,49 @@ def render(doc: Dict[str, Any]) -> str:
             out.append("  verdict: healthy — one program per compile "
                        "group, deterministic pruning, serial-bitwise "
                        "per-point results")
+    for v in doc.get("compile", []):
+        out.append(f"\n== compile plane: {v['label']} ==")
+        total = (v.get("compiles") or 0) + (v.get("hits") or 0)
+        rate = (f"{(v.get('hits') or 0) / total:.0%}"
+                if total else "n/a")
+        out.append(f"  {v.get('compiles')} compiles / "
+                   f"{v.get('hits')} hits ({rate} hit rate), "
+                   f"{v.get('evictions')} evictions, "
+                   f"{v.get('wall_s')}s compile wall, "
+                   f"{v.get('storms')} storm(s)")
+        caches = v.get("caches") or {}
+        if caches:
+            w = max(len(n) for n in caches)
+            out.append(f"  {'cache'.ljust(w)}  size/cap   hits  misses"
+                       f"  hit-rate  storms")
+            for n, c in caches.items():
+                hr = c.get("hit_rate")
+                out.append(
+                    f"  {n.ljust(w)}  "
+                    f"{c.get('size')}/{c.get('capacity') or '-':>3}  "
+                    f"{c.get('hits'):>6,}  {c.get('misses'):>6,}  "
+                    f"{hr:>7.1%}  {c.get('storms'):>6}"
+                    if hr is not None else
+                    f"  {n.ljust(w)}  "
+                    f"{c.get('size')}/{c.get('capacity') or '-':>3}  "
+                    f"{c.get('hits'):>6,}  {c.get('misses'):>6,}  "
+                    f"{'-':>7}  {c.get('storms'):>6}")
+        cold = v.get("cold_start_s") or {}
+        if cold:
+            out.append("  cold start (time to first program): "
+                       + ", ".join(f"{k} {s}s"
+                                   for k, s in cold.items()))
+        ld = v.get("last_diff")
+        if ld:
+            out.append("  last plan diff: " + "; ".join(
+                f"{d.get('dim')} {d.get('old')}→{d.get('new')}"
+                for d in ld if isinstance(d, dict)))
+        for i, fx in enumerate(v.get("fixes") or [], 1):
+            out.append(f"  fix {i}: {fx}")
+        if not v.get("fixes"):
+            out.append("  verdict: healthy — every compile is "
+                       "attributed, no storms, no cold-start-dominated "
+                       "restart")
     hbm = doc.get("hbm")
     if hbm is not None:
         out.append("\n== HBM (live device buffers) ==")
@@ -1378,19 +1547,25 @@ def main(argv=None) -> int:
         bench_path = bench_path or _first_existing(d, "bench.json")
         profile_path = profile_path or _first_existing(d, "profile.json")
         metrics_path = metrics_path or _first_existing(d, "metrics.jsonl")
+    compilez_path = (_first_existing(args.run_dir, "compilez.json")
+                     if args.run_dir else None)
     if not bench_path and not profile_path and not args.url \
-            and not args.bundle:
+            and not args.bundle and not compilez_path:
         print("doctor.py: need --run-dir, --bench, --profile, --url or "
               "--bundle (nothing to diagnose)", file=sys.stderr)
         return 1
     bundle = None
+    compilez: List[Tuple[str, Any]] = []
     try:
         bench = load_bench(bench_path) if bench_path else None
         profile = load_json(profile_path) if profile_path else None
         metrics = _metrics_summary(metrics_path) if metrics_path else None
+        if compilez_path:
+            compilez.append(("run-dir", load_json(compilez_path)))
         if args.url:
             live = _summarize_metric_records(_records_from_url(args.url))
             metrics = live if metrics is None else {**metrics, **live}
+            compilez.extend(_compilez_from_url(args.url))
         if args.bundle:
             bundle = _load_postmortem(args.bundle)
             frozen = _summarize_metric_records(
@@ -1398,11 +1573,15 @@ def main(argv=None) -> int:
                  if isinstance(r, dict)])
             metrics = frozen if metrics is None else {**metrics,
                                                       **frozen}
+            cz = (bundle.get("extra") or {}).get("compilez")
+            if cz:
+                compilez.append(("post-mortem bundle", cz))
     except (OSError, ValueError) as e:
         print(f"doctor.py: {e}", file=sys.stderr)
         return 1
     doc = diagnose(bench, profile, metrics,
-                   args.peak_tflops, args.peak_hbm_gbps)
+                   args.peak_tflops, args.peak_hbm_gbps,
+                   compilez=compilez)
     if bundle is not None:
         doc["postmortem"] = _postmortem_section(bundle)
     if not doc["workloads"] and not doc.get("hbm") \

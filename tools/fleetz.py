@@ -16,9 +16,10 @@ in the repo.
     python tools/fleetz.py --snapshot DIR host:port   # archive scrapes
 
 ``--snapshot DIR`` writes each worker's raw ``varz.json`` /
-``statusz.json`` / ``metrics.prom`` — plus ``tracez.json`` and
+``statusz.json`` / ``metrics.prom`` — plus ``tracez.json`` /
 ``requestz.json`` (the Layer-6 flight-recorder and request-timeline
-views, ISSUE 18) when the worker serves them — and the merged
+views, ISSUE 18) and ``compilez.json`` (the Layer-7 compile ledger,
+ISSUE 19) when the worker serves them — and the merged
 ``fleet.json``. The directory shape is what ``tools/doctor.py --url``
 accepts as an offline input, so a fleet snapshot taken during an
 incident replays through the verdict renderer later.
@@ -132,9 +133,10 @@ def scrape_worker(worker: str, timeout: float = 5.0) -> Dict[str, Any]:
         _, prom = _get(f"{url}/metrics", timeout)
         doc["metrics_text"] = prom.decode("utf-8")
         doc["metrics_samples"] = len(parse_prom_text(doc["metrics_text"]))
-        # the Layer-6 views (ISSUE 18) — tolerant of 404 from workers
-        # predating them, so a mixed-version fleet still scrapes clean
-        for path in ("tracez", "requestz"):
+        # the Layer-6 views (ISSUE 18) and the Layer-7 compile ledger
+        # (ISSUE 19) — tolerant of 404 from workers predating them, so
+        # a mixed-version fleet still scrapes clean
+        for path in ("tracez", "requestz", "compilez"):
             try:
                 code, body = _get(f"{url}/{path}", timeout)
                 if code == 200:
@@ -149,9 +151,13 @@ def scrape_worker(worker: str, timeout: float = 5.0) -> Dict[str, Any]:
 def _series_value(varz: List[dict], name: str,
                   agg: str = "sum") -> Optional[float]:
     """Aggregate one metric family across its label sets (sum for
-    counters, max for gauges where the worst series is the story)."""
-    vals = [rec["value"] for rec in varz
-            if rec.get("name") == name and "value" in rec]
+    counters, max for gauges where the worst series is the story).
+    Histogram families contribute their ``sum`` (total seconds spent),
+    which is the fleet-level story for e.g. compile wall time."""
+    vals = [rec["value"] if "value" in rec else rec["sum"]
+            for rec in varz
+            if rec.get("name") == name
+            and ("value" in rec or "sum" in rec)]
     if not vals:
         return None
     return max(vals) if agg == "max" else sum(vals)
@@ -173,6 +179,10 @@ _METRIC_ROWS = [
      "sum", "sum"),
     ("fleet coalesced", "alink_fleet_coalesced_batches_total",
      "sum", "sum"),
+    ("compiles", "alink_compile_total", "sum", "sum"),
+    ("compile wall (s)", "alink_compile_seconds", "sum", "sum"),
+    ("compile storms", "alink_compile_storms_total", "sum", "sum"),
+    ("storm active", "alink_compile_storm_active", "max", "max"),
     ("slo breaches", "alink_slo_breaches_total", "sum", "sum"),
     ("slo burn (max)", "alink_slo_burn_rate", "max", "max"),
     ("slo alerts", "alink_slo_alerts_total", "sum", "sum"),
@@ -285,7 +295,7 @@ def write_snapshot(out_dir: str, scrapes: List[Dict[str, Any]],
             json.dump(s["statusz"], f)
         with open(os.path.join(sub, "metrics.prom"), "w") as f:
             f.write(s["metrics_text"])
-        for path in ("tracez", "requestz"):
+        for path in ("tracez", "requestz", "compilez"):
             if s.get(path) is not None:
                 with open(os.path.join(sub, f"{path}.json"), "w") as f:
                     json.dump(s[path], f)

@@ -55,6 +55,7 @@ class LintConfig:
 _COMQ = "alink_tpu/engine/comqueue.py"
 _FTRL = "alink_tpu/operator/stream/onlinelearning/ftrl.py"
 _TREES = "alink_tpu/operator/common/tree/trainers.py"
+_PLAN = "alink_tpu/common/plan.py"
 
 _PC = "program_cache"
 _CKS = "checkpoint_signature"
@@ -62,59 +63,41 @@ _LRU = "step_lru"
 
 
 def default_config() -> LintConfig:
-    """The configuration for the real ``alink_tpu`` tree."""
-    ftrl_factories = (
-        "_ftrl_step_factory", "_ftrl_sparse_step_factory",
-        "_ftrl_sparse_chained_step_factory",
-        "_ftrl_sparse_staleness_step_factory",
-        "_ftrl_sparse_batch_step_factory", "_ftrl_fb_batch_step_factory",
-        "_ftrl_dense_batch_step_factory",
-    )
+    """The configuration for the real ``alink_tpu`` tree.
+
+    ISSUE 19 collapsed the per-subsystem factory roots (engine ``_run``,
+    FTRL ``link_from`` + its seven lru step factories, the serving /
+    sharded / fleet program factories, the sweep queue builder) onto
+    ``common/plan.py`` — every one of those cache keys is now DERIVED
+    from an :class:`ExecutionPlan` built at exactly one of the plan
+    constructors below, so the env-read → key-fold discipline is
+    checked where the key is born instead of at ~15 consumption sites.
+    The lru_cache structural backstop in :func:`rule_env_key_fold`
+    still sweeps every ``@lru_cache`` factory for UNDECLARED reads, so
+    a new factory that bypasses plan.py does not dodge the rule."""
     roots = [
-        # the engine's compiled-program cache + recovery signature
-        FactoryRoot(_COMQ, "IterativeComQueue._run",
-                    frozenset({_PC, _CKS})),
-        # the FTRL drain: builds the lru-keyed step programs AND the
-        # stream checkpoint signature
-        FactoryRoot(_FTRL, "FtrlTrainStreamOp.link_from",
-                    frozenset({_LRU, _CKS})),
-        # tree trainers: set_program_key callers (fused-hist fold)
+        # the ONE engine plan-derivation site: IterativeComQueue._run
+        # builds its program-cache key and checkpoint signature from
+        # engine_plan()/engine_flags() (ISSUE 19) — flag resolution
+        # happens here and nowhere else
+        FactoryRoot(_PLAN, "engine_flags", frozenset({_PC, _CKS})),
+        FactoryRoot(_PLAN, "engine_plan", frozenset({_PC, _CKS})),
+        # the ONE FTRL plan-derivation site: the drain's lru step keys
+        # and stream checkpoint signature unpack from ftrl_plan()
+        FactoryRoot(_PLAN, "ftrl_plan", frozenset({_LRU, _CKS})),
+        # the ONE sweep plan-derivation site (ISSUE 12's program key is
+        # now legacy_sweep_program_key(sweep_plan(...)))
+        FactoryRoot(_PLAN, "sweep_plan", frozenset({_PC})),
+        # tree trainers: set_program_key callers (fused-hist fold) —
+        # their key tuples predate ExecutionPlan and stay direct roots
         FactoryRoot(_TREES, "gbdt_train", frozenset({_PC})),
         FactoryRoot(_TREES, "forest_train", frozenset({_PC})),
-        # the serving tier's program factory: compiled programs key on
-        # (model signature, kind, bucket, shapes) — the ALINK_TPU_SERVE_*
-        # flags must therefore all be key-neutral, which this root checks
-        FactoryRoot("alink_tpu/serving/predictor.py",
-                    "CompiledPredictor._program", frozenset({_PC})),
-        FactoryRoot("alink_tpu/serving/predictor.py",
-                    "CompiledPredictor.predict_table", frozenset({_PC})),
-        # the SHARDED serving program factory (ISSUE 11): mesh-sharded
-        # score fns — the mesh fingerprint + sharded mode ride the
-        # serving program-cache key, so every flag read reachable from
-        # here must be key-neutral or declared
-        FactoryRoot("alink_tpu/serving/sharded.py",
-                    "make_linear_device_fns", frozenset({_PC})),
-        # the multi-tenant fleet (ISSUE 17): the geometry-group program
-        # factory compiles shared bucket programs keyed through
-        # ServingPlan.program_key (lane width is an explicit key
-        # dimension), and registration resolves the fleet flags — the
-        # ALINK_TPU_FLEET_* family must be key-neutral or fold
-        FactoryRoot("alink_tpu/serving/fleet.py",
-                    "_GeometryGroup.program", frozenset({_PC})),
-        FactoryRoot("alink_tpu/serving/fleet.py",
-                    "ModelRegistry.register", frozenset({_PC})),
-        FactoryRoot("alink_tpu/serving/sharded.py",
-                    "make_linear_fleet_fns", frozenset({_PC})),
-        # the tuning sweep's program factory (ISSUE 12): one compiled
-        # BSP program per compile group, keyed through the engine cache
-        # — ALINK_TPU_SWEEP folds into the sweep program key, the ASHA
-        # knobs are key-neutral host boundary pruning
-        FactoryRoot("alink_tpu/tuning/sweep.py",
-                    "_run_sweep_queue", frozenset({_PC})),
         # the Pallas kernel tier (ISSUE 13): the serving-kernel build
         # resolves ALINK_TPU_SERVE_FUSED/_DTYPE into the ServingKernel
-        # signature (the serving program-cache key), and the FTRL
+        # signature (the serving program-cache key, which ServingPlan /
+        # serving_event_plan consume as an opaque value), and the FTRL
         # kernel-mode resolution rides the step factories' lru keys
+        # (the sweep's staleness lane calls it outside ftrl_plan)
         FactoryRoot("alink_tpu/operator/common/linear/mapper.py",
                     "LinearModelMapper.serving_kernel", frozenset({_PC})),
         FactoryRoot("alink_tpu/kernels/serve.py",
@@ -122,8 +105,6 @@ def default_config() -> LintConfig:
         FactoryRoot("alink_tpu/kernels/ftrl.py",
                     "ftrl_kernel_mode", frozenset({_LRU, _CKS})),
     ]
-    roots += [FactoryRoot(_FTRL, f, frozenset({_LRU}))
-              for f in ftrl_factories]
     return LintConfig(
         package_dirs=("alink_tpu",),
         factory_roots=tuple(roots),

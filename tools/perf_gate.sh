@@ -106,6 +106,17 @@ python tools/fleet_smoke.py
 # regression names itself.
 python tools/postmortem_smoke.py
 
+# compile-plane ledger smoke (ISSUE 19): a serve dtype flip under load
+# against the compile ledger — warm-up compiles are recorded, steady-
+# state traffic records ZERO events (hits never masquerade as
+# compiles), the flip recompiles exactly the warmed program set with
+# every event's structural diff naming ALINK_TPU_SERVE_DTYPE f32→int8
+# and no other cache moving, and a fresh interpreter renders the
+# verdict offline from the run-dir compilez.json (doctor --run-dir).
+# Exits 13 (its own code) so a compile-attribution regression names
+# itself.
+python tools/compilez_smoke.py
+
 # docs freshness gate (ISSUE 15 satellite, VERDICT #2): the README's
 # machine-generated performance/serving tables must match a fresh
 # regeneration from the newest driver-captured BENCH dump, and the
