@@ -54,9 +54,9 @@ from .plan import ExecutionPlan
 
 __all__ = [
     "ledger_enabled", "ring_capacity", "register_cache", "record_event",
-    "record_hit", "record_eviction", "set_cache_size", "note_wall",
-    "subsystem_start", "register_stage", "lru_call", "compilez_doc",
-    "storms", "reset", "STORM_WINDOW_S", "STORM_MISSES",
+    "record_disk_hit", "record_hit", "record_eviction", "set_cache_size",
+    "note_wall", "subsystem_start", "register_stage", "lru_call",
+    "compilez_doc", "storms", "reset", "STORM_WINDOW_S", "STORM_MISSES",
 ]
 
 # recompile-storm detector: N misses on one cache inside W seconds
@@ -118,7 +118,7 @@ def _cache_row(cache: str, subsystem: str = "",
         row = _caches[cache] = {
             "subsystem": subsystem or cache.split(".")[0],
             "capacity": capacity, "hits": 0, "misses": 0,
-            "evictions": 0, "size": 0, "last_plan": None,
+            "disk_hits": 0, "evictions": 0, "size": 0, "last_plan": None,
             "last_digest": None, "miss_times": deque(maxlen=64),
             "storms": 0, "storm_active": False,
         }
@@ -238,6 +238,7 @@ def record_event(cache: str, plan: ExecutionPlan, *,
         _seq[0] += 1
         ev = {
             "seq": _seq[0], "t_unix": round(time.time(), 3),
+            "kind": "miss",
             "cache": cache, "subsystem": row["subsystem"],
             "site": site, "digest": digest,
             "wall_s": None if wall_s is None else round(float(wall_s), 6),
@@ -258,6 +259,62 @@ def record_event(cache: str, plan: ExecutionPlan, *,
     _trace_event(cache, ev)
     if storm:
         _on_storm(cache, row)
+    return ev
+
+
+def record_disk_hit(cache: str, plan: ExecutionPlan, *, wall_s: float,
+                    site: str = "", subsystem: str = "") -> Dict[str, Any]:
+    """One AOT-cache load (ISSUE 20): a program installed from disk
+    instead of compiled.  A distinct ``disk-hit`` event kind — vs
+    ``miss`` (a compilation) and the counter-only in-memory hits —
+    carrying the deserialize wall, so /compilez, doctor and fleetz can
+    attribute a warm restart.  Counts toward cold-start attribution
+    (the program IS the subsystem's first) but never toward storm
+    detection: loading from disk is the cure, not the disease."""
+    if not ledger_enabled():
+        return {}
+    now = time.perf_counter()
+    digest = plan.digest()
+    with _lock:
+        row = _cache_row(cache, subsystem)
+        diff = plan.diff(row["last_plan"])
+        row["last_plan"] = plan
+        row["last_digest"] = digest
+        row["disk_hits"] += 1
+        row["size"] += 1
+        _seq[0] += 1
+        ev = {
+            "seq": _seq[0], "t_unix": round(time.time(), 3),
+            "kind": "disk-hit",
+            "cache": cache, "subsystem": row["subsystem"],
+            "site": site, "digest": digest,
+            "wall_s": round(float(wall_s), 6),
+            "diff": diff,
+        }
+        ring = _events
+        if ring.maxlen != ring_capacity():
+            ring = deque(ring, maxlen=ring_capacity())
+            globals()["_events"] = ring
+        ring.append(ev)
+        sub = row["subsystem"]
+        if sub in _t0 and sub not in _ttfp:
+            _ttfp[sub] = round(now - _t0[sub], 6)
+    from .metrics import get_registry, metrics_enabled
+    if metrics_enabled():
+        reg = get_registry()
+        reg.inc("alink_compile_disk_hits_total", 1, {"cache": cache})
+        reg.observe("alink_aot_deserialize_seconds", float(wall_s),
+                    {"cache": cache})
+        reg.set_gauge("alink_compile_cache_size", row["size"],
+                      {"cache": cache})
+    try:
+        from .tracing import trace_instant
+        trace_instant("compile.disk-hit", cat="compile", args={
+            "cache": cache, "site": site, "digest": digest,
+            "wall_s": round(float(wall_s), 6),
+        })
+    except Exception:
+        pass
     return ev
 
 
@@ -283,7 +340,7 @@ def _dominant_dim(cache: str) -> Optional[Dict[str, Any]]:
     counts: Counter = Counter()
     sample: Dict[str, Dict[str, str]] = {}
     for ev in _events:
-        if ev["cache"] != cache:
+        if ev["cache"] != cache or ev.get("kind") == "disk-hit":
             continue
         for d in ev["diff"]:
             if d["dim"] == "cold-start":
@@ -429,6 +486,7 @@ def compilez_doc(n: Optional[int] = None) -> Dict[str, Any]:
                 "subsystem": r["subsystem"],
                 "size": r["size"], "capacity": r["capacity"],
                 "hits": r["hits"], "misses": r["misses"],
+                "disk_hits": r["disk_hits"],
                 "evictions": r["evictions"],
                 "hit_rate": round(r["hits"] / total, 4) if total else None,
                 "last_digest": r["last_digest"],

@@ -539,6 +539,41 @@ FLAGS.register(
     folds_into=frozenset({STEP_LRU, CHECKPOINT_SIGNATURE}),
     parser=_ftrl_kernel_parse,
     accessor="alink_tpu.kernels.ftrl.ftrl_kernel_mode")
+FLAGS.register(
+    "ALINK_TPU_AOT_CACHE", "bool", True,
+    "persistent AOT executable store (common/aotcache.py): serve "
+    "program-cache misses from exported-on-disk executables before "
+    "compiling (load-before-compile), and export fresh compiles for "
+    "the next process — active only when ALINK_TPU_AOT_CACHE_DIR is "
+    "also set", "performance",
+    key_neutral="the store OBSERVES the plan-keyed caches and never "
+                "keys one: every artifact is validated against the "
+                "exact ExecutionPlan digest the in-memory key derives "
+                "from plus a rig/toolchain fingerprint before install, "
+                "a mismatch falls through to the same compile as "
+                "flag-off, and installed programs are exported from "
+                "the identical jit — outputs are bitwise-identical "
+                "cache-on vs cache-off (tests/test_aotcache.py)",
+    accessor="alink_tpu.common.aotcache.aot_enabled")
+FLAGS.register(
+    "ALINK_TPU_AOT_CACHE_DIR", "str", "",
+    "AOT artifact root (<dir>/<cache>/<plan-digest>.aot plus the "
+    "<dir>/xla persistent-compilation-cache fallback); empty (the "
+    "default) disables the executable store entirely", "performance",
+    key_neutral="a host-side storage path: it decides WHERE validated "
+                "artifacts live, never which program a cache key "
+                "resolves to — unset, every instrumented site runs "
+                "its historical code path byte-for-byte",
+    accessor="alink_tpu.common.aotcache.aot_dir")
+FLAGS.register(
+    "ALINK_TPU_AOT_CACHE_KEEP", "int", 128,
+    "bounded AOT retention: the newest N artifacts per cache "
+    "directory survive the post-store prune (mtime order)",
+    "performance",
+    key_neutral="host-side file retention in the artifact directory "
+                "only; a pruned artifact is a plain load miss",
+    clamp=lambda n: max(8, n), tolerant=True,
+    accessor="alink_tpu.common.aotcache.aot_keep")
 
 # -- serving ----------------------------------------------------------------
 # The compiled serving tier's program cache keys on (model signature,

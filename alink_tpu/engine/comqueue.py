@@ -141,6 +141,40 @@ def _program_label(program_key) -> str:
                            digest_size=6).hexdigest()
 
 
+class _AotMeshCall:
+    """Dispatch a deserialized engine program (ISSUE 20).  An exported
+    multi-device module must be called in a context with the device
+    count it was built for, so each positional argument's leaves are
+    placed onto the exec mesh first — ``shard`` along the worker axis
+    (parts, stacked carries), ``repl`` replicated (broadcast state,
+    loop limits).  Single-device meshes skip placement; ``lower``
+    delegates so the static-cost probe keeps working."""
+
+    __slots__ = ("_fn", "_mesh", "_specs")
+
+    def __init__(self, fn: Callable, mesh, specs: Sequence[str]):
+        self._fn = fn
+        self._mesh = mesh
+        self._specs = tuple(specs)
+
+    def __call__(self, *args):
+        import jax
+        mesh = self._mesh
+        if mesh is not None and int(np.prod(mesh.devices.shape)) > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as _P
+            sh = {"shard": NamedSharding(mesh, _P("d")),
+                  "repl": NamedSharding(mesh, _P())}
+            args = tuple(
+                jax.tree_util.tree_map(
+                    lambda x, _s=sh[spec]: jax.device_put(x, _s), a)
+                for a, spec in zip(args, self._specs))
+        return self._fn(*args)
+
+    def lower(self, *args, **kwargs):
+        return self._fn.lower(*args, **kwargs)
+
+
 def _maybe_cost(ckey: Optional[tuple], lower_thunk: Callable) -> Optional[dict]:
     """The cached program's static XLA cost dict, memoized per key.
 
@@ -798,7 +832,7 @@ class IterativeComQueue:
         # identical.  fuse: the fused program's collective set is
         # structurally different HLO.  All three (plus step_log) ride
         # the program-cache key via the ExecutionPlan below.
-        from ..common import compileledger
+        from ..common import aotcache, compileledger
         from ..common import plan as planlib
         plan_flags = planlib.engine_flags()
         probes_on = plan_flags[1][1]
@@ -1040,6 +1074,7 @@ class IterativeComQueue:
                     ck = dataclasses.replace(ck, every=b_every)
             first = cont = None
             ckkey = ("__ckpt__", ckey) if ckey is not None else None
+            aot_first_plan = aot_cont_plan = None
             if ckkey is not None:
                 compileledger.register_cache("engine.chunked", "engine",
                                              _PROGRAM_CACHE_MAX)
@@ -1052,6 +1087,42 @@ class IterativeComQueue:
                     manifest = _PROGRAM_CACHE_MANIFESTS.setdefault(ckkey,
                                                                    manifest)
                     compileledger.record_hit("engine.chunked")
+            if (first is None and ckkey is not None and aotcache.active()
+                    and jax.process_count() == 1):
+                # load-before-compile (ISSUE 20): the chunked pair ships
+                # as two artifacts keyed off the same plan with a role
+                # dim.  Both must load or neither installs (a half pair
+                # would force a recompile anyway), so record=False here
+                # and the ledger disk-hit is written only on full success
+                _base = splan.extend(("checkpoint_chunked", True))
+                aot_first_plan = _base.extend(("role", "first"))
+                aot_cont_plan = _base.extend(("role", "cont"))
+                _site = _program_label(self._program_key)
+                lf = aotcache.load(aot_first_plan, cache="engine.chunked",
+                                   site=_site, subsystem="engine",
+                                   record=False)
+                lc = aotcache.load(aot_cont_plan, cache="engine.chunked",
+                                   site=_site, subsystem="engine",
+                                   record=False) if lf is not None else None
+                if lf is not None and lc is not None:
+                    first = _AotMeshCall(lf.fn, mesh,
+                                         ("shard", "repl", "repl"))
+                    cont = _AotMeshCall(lc.fn, mesh,
+                                        ("shard", "repl", "shard", "repl"))
+                    cache_status = "disk-hit"
+                    _PROGRAM_CACHE_STATS["hits"] += 1
+                    _PROGRAM_CACHE[ckkey] = (first, cont)
+                    # the deserialized programs never trace, so the
+                    # per-superstep collective manifest rides the artifact
+                    # header instead of the closure
+                    _m = lf.manifest(None)
+                    if isinstance(_m, dict) and _m:
+                        manifest.update(_m)
+                    _PROGRAM_CACHE_MANIFESTS[ckkey] = manifest
+                    for _lp in (lf, lc):
+                        compileledger.record_disk_hit(
+                            "engine.chunked", _base, wall_s=_lp.wall_s,
+                            site=_site, subsystem="engine")
             if first is None:
                 first = jax.jit(build_first_chunk())
                 cont = jit_cont()
@@ -1074,6 +1145,24 @@ class IterativeComQueue:
                             "engine.chunked"
                             if old_key and old_key[0] == "__ckpt__"
                             else "engine.program")
+                    if aot_first_plan is not None:
+                        # export BEFORE recovery.drive: export's trace runs
+                        # the superstep closures, so the collective
+                        # manifest is populated by the time the header
+                        # snapshots it.  Gate the cont store on the first:
+                        # a half pair on disk would never install
+                        _site = _program_label(self._program_key)
+                        _lim0 = jnp.asarray(int(max_iter), jnp.int32)
+                        if aotcache.store(aot_first_plan, first,
+                                          (parts, bcast, _lim0),
+                                          cache="engine.chunked",
+                                          site=_site, manifest=manifest):
+                            _carry_av = jax.eval_shape(first, parts, bcast,
+                                                       _lim0)
+                            aotcache.store(aot_cont_plan, cont,
+                                           (parts, bcast, _carry_av, _lim0),
+                                           cache="engine.chunked",
+                                           site=_site, manifest=manifest)
             if mx and ckkey is not None:
                 get_registry().inc("alink_comqueue_program_cache_total", 1,
                                    {"result": cache_status})
@@ -1142,6 +1231,37 @@ class IterativeComQueue:
             compileledger.register_cache("engine.program", "engine",
                                          _PROGRAM_CACHE_MAX)
             compiled = _PROGRAM_CACHE.get(ckey)
+        # verify mode is excluded: it compares fresh jaxprs against the
+        # trace recorded at compile time, and a deserialized program has
+        # no trace to baseline against
+        aot_plain = (ckey is not None and not verify
+                     and jax.process_count() == 1 and aotcache.active())
+        disk_hit = False
+        if compiled is None and aot_plain:
+            loaded = aotcache.load(splan, cache="engine.program",
+                                   site=_program_label(self._program_key),
+                                   subsystem="engine")
+            if loaded is not None:
+                compiled = _AotMeshCall(loaded.fn, mesh, ("shard", "repl"))
+                disk_hit = True
+                cache_status = "disk-hit"
+                _PROGRAM_CACHE_STATS["hits"] += 1
+                _PROGRAM_CACHE[ckey] = compiled
+                # deserialized programs never trace, so the collective
+                # manifest comes from the artifact header
+                _m = loaded.manifest(None)
+                if isinstance(_m, dict) and _m:
+                    manifest.update(_m)
+                _PROGRAM_CACHE_MANIFESTS[ckey] = manifest
+                while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+                    old_key, _ = _PROGRAM_CACHE.popitem(last=False)
+                    _PROGRAM_CACHE_JAXPRS.pop(old_key, None)
+                    _PROGRAM_CACHE_MANIFESTS.pop(old_key, None)
+                    _PROGRAM_CACHE_COSTS.pop(old_key, None)
+                    compileledger.record_eviction(
+                        "engine.chunked"
+                        if old_key and old_key[0] == "__ckpt__"
+                        else "engine.program")
         if compiled is None:
             compiled = jax.jit(build_mapped())
             if ckey is not None:
@@ -1173,7 +1293,7 @@ class IterativeComQueue:
                         "engine.chunked"
                         if old_key and old_key[0] == "__ckpt__"
                         else "engine.program")
-        elif ckey is not None:
+        elif ckey is not None and not disk_hit:
             cache_status = "hit"
             _PROGRAM_CACHE_STATS["hits"] += 1
             _PROGRAM_CACHE.move_to_end(ckey)
@@ -1223,6 +1343,15 @@ class IterativeComQueue:
                     jax.block_until_ready(stacked)
                     pw.device(time.perf_counter() - _pt1)
         hbm_snapshot("comqueue.exec")
+        if cache_status == "miss" and aot_plain:
+            # persist off the hot path, after the first dispatch: the
+            # export re-trace refreshes the same manifest dict the miss
+            # installed (superstep capture is overwrite-safe)
+            aotcache.store(splan, compiled, (parts, bcast),
+                           cache="engine.program",
+                           site=_program_label(self._program_key),
+                           manifest=_PROGRAM_CACHE_MANIFESTS.get(
+                               ckey, manifest))
         if jax.process_count() > 1:
             # multi-host session: leaves span non-addressable devices —
             # gather every worker's shard to every host before fetching

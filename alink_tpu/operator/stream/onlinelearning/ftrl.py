@@ -107,6 +107,23 @@ def _ftrl_weights(z, n, alpha, beta, l1, l2):
 # the drain loop rebinds them to the outputs, and every host read
 # (snapshot/checkpoint/pv) uses the live post-update arrays. The flag
 # rides the lru key, so toggling never aliases through a cached program.
+def _aot(fn, factory, mesh, role="step", in_specs=None, **hyper):
+    """Wrap a factory's jitted program with the persistent executable
+    store (ISSUE 20).  Artifacts key on the factory's own lru arguments
+    plus the first call's avals — deliberately NOT on the per-model
+    ``warm_coef_blake2b``: coefficients are program *arguments* and the
+    executable is byte-identical across models of one geometry, so a
+    content dim would churn the store once per model for the same
+    program.  Inert (returns ``fn`` untouched) unless the store is
+    configured."""
+    from ....common import aotcache
+    dims = ((("factory", factory), ("role", role), ("mesh", mesh))
+            + tuple(sorted(hyper.items())))
+    return aotcache.aot_jit(fn, subsystem="ftrl", cache="ftrl.step",
+                            site=factory, dims=dims, mesh=mesh,
+                            in_specs=in_specs)
+
+
 @functools.lru_cache(maxsize=64)
 def _ftrl_step_factory(mesh, alpha, beta, l1, l2, donate=False):
     """Build the jitted per-micro-batch FTRL SPMD program.
@@ -149,8 +166,12 @@ def _ftrl_step_factory(mesh, alpha, beta, l1, l2, donate=False):
                            in_specs=(P("d"), P("d")), out_specs=P("d"))
     # weights_fn never donates: the snapshot path reads w from the LIVE
     # (z, n) and the state must survive for the next micro-batch
-    return (jax.jit(fn, donate_argnums=(2, 3) if donate else ()),
-            jax.jit(weights_fn))
+    _hp = dict(alpha=alpha, beta=beta, l1=l1, l2=l2, donate=donate)
+    return (_aot(jax.jit(fn, donate_argnums=(2, 3) if donate else ()),
+                 "_ftrl_step_factory", mesh,
+                 in_specs=(P(None, "d"), P(), P("d"), P("d")), **_hp),
+            _aot(jax.jit(weights_fn), "_ftrl_step_factory", mesh,
+                 role="weights", in_specs=(P("d"), P("d")), **_hp))
 
 
 def _state_kernels(kernel: str):
@@ -284,7 +305,10 @@ def _ftrl_sparse_step_factory(mesh, alpha, beta, l1, l2, donate=False,
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=(P(), P(), P(), P("d"), P("d")),
                    out_specs=(P("d"), P("d"), P()))
-    return jax.jit(fn, donate_argnums=(3, 4) if donate else ())
+    return _aot(jax.jit(fn, donate_argnums=(3, 4) if donate else ()),
+                "_ftrl_sparse_step_factory", mesh,
+                in_specs=(P(), P(), P(), P("d"), P("d")), alpha=alpha,
+                beta=beta, l1=l1, l2=l2, donate=donate, kernel=kernel)
 
 
 @functools.lru_cache(maxsize=64)
@@ -408,7 +432,11 @@ def _ftrl_sparse_chained_step_factory(mesh, alpha, beta, l1, l2, K=16,
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=(P(), P(), P(), P("d"), P("d")),
                    out_specs=(P("d"), P("d"), P()))
-    return jax.jit(fn, donate_argnums=(3, 4) if donate else ())
+    return _aot(jax.jit(fn, donate_argnums=(3, 4) if donate else ()),
+                "_ftrl_sparse_chained_step_factory", mesh,
+                in_specs=(P(), P(), P(), P("d"), P("d")), alpha=alpha,
+                beta=beta, l1=l1, l2=l2, K=K, donate=donate,
+                kernel=kernel)
 
 
 @functools.lru_cache(maxsize=64)
@@ -489,7 +517,11 @@ def _ftrl_sparse_staleness_step_factory(mesh, alpha, beta, l1, l2, K,
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=(P(), P(), P(), P("d"), P("d")),
                    out_specs=(P("d"), P("d"), P()))
-    return jax.jit(fn, donate_argnums=(3, 4) if donate else ())
+    return _aot(jax.jit(fn, donate_argnums=(3, 4) if donate else ()),
+                "_ftrl_sparse_staleness_step_factory", mesh,
+                in_specs=(P(), P(), P(), P("d"), P("d")), alpha=alpha,
+                beta=beta, l1=l1, l2=l2, K=K, donate=donate,
+                kernel=kernel)
 
 
 @functools.lru_cache(maxsize=64)
@@ -548,7 +580,10 @@ def _ftrl_sparse_batch_step_factory(mesh, alpha, beta, l1, l2,
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=(P(), P(), P(), P("d"), P("d")),
                    out_specs=(P("d"), P("d"), P()))
-    return jax.jit(fn, donate_argnums=(3, 4) if donate else ())
+    return _aot(jax.jit(fn, donate_argnums=(3, 4) if donate else ()),
+                "_ftrl_sparse_batch_step_factory", mesh,
+                in_specs=(P(), P(), P(), P("d"), P("d")), alpha=alpha,
+                beta=beta, l1=l1, l2=l2, donate=donate)
 
 
 @functools.lru_cache(maxsize=64)
@@ -623,11 +658,19 @@ def _ftrl_fb_batch_step_factory(mesh, meta, alpha, beta, l1, l2,
         fn = shard_map(shard_fn, mesh=mesh,
                        in_specs=(P(), P(), P(), P("d"), P("d")),
                        out_specs=(P("d"), P("d"), P()))
-        return jax.jit(fn, donate_argnums=(3, 4) if donate else ())
+        return _aot(jax.jit(fn, donate_argnums=(3, 4) if donate else ()),
+                    "_ftrl_fb_batch_step_factory", mesh,
+                    in_specs=(P(), P(), P(), P("d"), P("d")), meta=meta,
+                    alpha=alpha, beta=beta, l1=l1, l2=l2,
+                    with_val=with_val, donate=donate)
     fn = shard_map(lambda fbi, y, z, n: shard_fn(fbi, None, y, z, n),
                    mesh=mesh, in_specs=(P(), P(), P("d"), P("d")),
                    out_specs=(P("d"), P("d"), P()))
-    return jax.jit(fn, donate_argnums=(2, 3) if donate else ())
+    return _aot(jax.jit(fn, donate_argnums=(2, 3) if donate else ()),
+                "_ftrl_fb_batch_step_factory", mesh,
+                in_specs=(P(), P(), P("d"), P("d")), meta=meta,
+                alpha=alpha, beta=beta, l1=l1, l2=l2,
+                with_val=with_val, donate=donate)
 
 
 @functools.lru_cache(maxsize=1)
@@ -732,7 +775,10 @@ def _ftrl_dense_batch_step_factory(mesh, alpha, beta, l1, l2,
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=(P(None, "d"), P(), P("d"), P("d")),
                    out_specs=(P("d"), P("d"), P()))
-    return jax.jit(fn, donate_argnums=(2, 3) if donate else ())
+    return _aot(jax.jit(fn, donate_argnums=(2, 3) if donate else ()),
+                "_ftrl_dense_batch_step_factory", mesh,
+                in_specs=(P(None, "d"), P(), P("d"), P("d")), alpha=alpha,
+                beta=beta, l1=l1, l2=l2, donate=donate)
 
 
 class FtrlTrainStreamOp(StreamOperator, HasVectorCol, HasFeatureCols, HasLabelCol):

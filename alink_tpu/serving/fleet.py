@@ -59,7 +59,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..common import compileledger, reqtrace
+from ..common import aotcache, compileledger, reqtrace
 from ..common.adminz import acquire_admin, release_admin
 from ..common.plan import serving_event_plan
 from ..common.checkpoint import load_latest_validated, save_checkpoint
@@ -227,20 +227,79 @@ class _GeometryGroup:
             prog = self._programs.get(key)
             if prog is None:
                 self.misses += 1
+                evplan = serving_event_plan(self.plan, kind=kind,
+                                            bucket=bucket,
+                                            trailing=trailing,
+                                            lanes=lanes)
+                # load-before-compile (ISSUE 20): a geometry another
+                # process already compiled installs from disk; a fresh
+                # compile exports itself at first dispatch (the group
+                # never sees example arguments before then)
+                if aotcache.active():
+                    loaded = aotcache.load(
+                        evplan, cache="fleet.group",
+                        site="_GeometryGroup.program", subsystem="fleet")
+                    if loaded is not None:
+                        prog = self._programs[key] = loaded.fn
+                        return prog
                 fn = (self.archetype.device_fns[kind] if lanes is None
                       else self.fleet_fns[kind])
-                prog = self._programs[key] = jax.jit(fn)
+                prog = jax.jit(fn)
+                if aotcache.active():
+                    prog = aotcache.deferred_store(
+                        evplan, prog, cache="fleet.group",
+                        site="_GeometryGroup.program", key=key)
+                self._programs[key] = prog
                 compileledger.record_event(
-                    "fleet.group",
-                    serving_event_plan(self.plan, kind=kind,
-                                       bucket=bucket, trailing=trailing,
-                                       lanes=lanes),
+                    "fleet.group", evplan,
                     wall_s=time.perf_counter() - _led_t0,
                     site="_GeometryGroup.program", subsystem="fleet")
             else:
                 self.hits += 1
                 compileledger.record_hit("fleet.group")
         return prog
+
+    def warm_from_disk(self) -> int:
+        """Install every AOT artifact whose program key, re-derived
+        against THIS group's plan, still digests to the artifact's plan
+        digest — the tenant-geometry grid of a previous process loads
+        before the fleet admits traffic.  Returns programs installed."""
+        if not aotcache.active():
+            return 0
+        import ast
+        n = 0
+        for _path, header in aotcache.scan("fleet.group"):
+            try:
+                key = ast.literal_eval(header.get("key_repr") or "")
+            except Exception:
+                continue
+            if not isinstance(key, tuple) or len(key) != 7:
+                continue
+            sig, kind, bucket, trailing, buckets, lanes, _mesh = key
+            if tuple(buckets) != tuple(self.plan.buckets) \
+                    or tuple(sig) != tuple(self.plan.signature):
+                continue
+            evplan = serving_event_plan(self.plan, kind=kind,
+                                        bucket=bucket,
+                                        trailing=tuple(trailing),
+                                        lanes=lanes)
+            if evplan.digest() != header.get("plan_digest"):
+                continue
+            key = self.plan.program_key(kind, bucket, tuple(trailing),
+                                        lanes=lanes)
+            with self._lock:
+                if key in self._programs:
+                    continue
+            loaded = aotcache.load(evplan, cache="fleet.group",
+                                   site="_GeometryGroup.warm_from_disk",
+                                   subsystem="fleet")
+            if loaded is None:
+                continue
+            with self._lock:
+                if key not in self._programs:
+                    self._programs[key] = loaded.fn
+                    n += 1
+        return n
 
     def stats(self) -> Dict[str, int]:
         with self._lock:
@@ -559,6 +618,17 @@ class ModelRegistry:
         with self._lock:
             return self._resident_bytes
 
+    def warm_from_disk(self) -> int:
+        """Admission warming (ISSUE 20): every registered geometry
+        group pre-installs its exported bucket x lane programs from the
+        AOT cache.  Called by ``FleetServer`` before its readiness
+        source arms; returns programs installed across all groups."""
+        if not aotcache.active():
+            return 0
+        with self._lock:
+            groups = list(self._groups.values())
+        return sum(g.warm_from_disk() for g in groups)
+
     def stats(self) -> dict:
         with self._lock:
             tenants = list(self._tenants.values())
@@ -662,6 +732,15 @@ class FleetServer:
         self._breaker_lock = threading.Lock()
         self._breakers: Dict[str, Tuple[int, CircuitBreaker]] = {}
         self._breaker_totals = {"opens": 0, "reopens": 0, "probes": 0}
+        # admission warming (ISSUE 20): the registered tenant
+        # geometries pre-install their exported programs BEFORE the
+        # readiness source arms below — /readyz never flips while the
+        # first cross-tenant batches would pay compiles the disk holds
+        self.warmed_programs = 0
+        try:
+            self.warmed_programs = registry.warm_from_disk()
+        except Exception:
+            pass
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"alink-fleet-{name}")
         self._thread.start()

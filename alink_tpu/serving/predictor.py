@@ -57,7 +57,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..common import compileledger, reqtrace
+from ..common import aotcache, compileledger, reqtrace
 from ..common.plan import serving_event_plan
 from ..common.faults import maybe_crash
 from ..common.metrics import get_registry, metrics_enabled
@@ -639,6 +639,28 @@ class CompiledPredictor:
             entry = self._programs.get(key)
             if entry is None:
                 self._misses += 1
+                evplan = serving_event_plan(
+                    self.plan, signature=ver.kernel.signature,
+                    sharded=sharded, kind=kind, bucket=bucket,
+                    trailing=tuple(a.shape[1:] for a in arrays))
+                # load-before-compile (ISSUE 20): an exported executable
+                # for this exact plan digest installs instead of a fresh
+                # trace+compile. Sharded programs stay on the compile
+                # path — their trace captures the collective manifest.
+                if not sharded and aotcache.active():
+                    loaded = aotcache.load(
+                        evplan, cache=self._ledger_cache,
+                        site="CompiledPredictor._program",
+                        subsystem="serving")
+                    if loaded is not None:
+                        entry = (loaded.fn, ())
+                        self._programs[key] = entry
+                        if metrics_enabled():
+                            get_registry().inc(
+                                "alink_serve_program_cache_total", 1,
+                                {"result": "disk-hit",
+                                 "predictor": self.name})
+                        return entry
                 if sharded:
                     fn = self._sharded_fn(ver.kernel, kind)
                 else:
@@ -661,14 +683,16 @@ class CompiledPredictor:
                 entry = (prog, manifest)
                 self._programs[key] = entry
                 compileledger.record_event(
-                    self._ledger_cache,
-                    serving_event_plan(
-                        self.plan, signature=ver.kernel.signature,
-                        sharded=sharded, kind=kind, bucket=bucket,
-                        trailing=tuple(a.shape[1:] for a in arrays)),
+                    self._ledger_cache, evplan,
                     wall_s=time.perf_counter() - _led_t0,
                     site="CompiledPredictor._program",
                     subsystem="serving")
+                if not sharded and aotcache.active():
+                    aotcache.store(
+                        evplan, prog,
+                        (ver.arrays_for(0),) + tuple(call_args),
+                        cache=self._ledger_cache,
+                        site="CompiledPredictor._program", key=key)
                 if metrics_enabled():
                     get_registry().inc("alink_serve_program_cache_total",
                                        1, {"result": "miss",
@@ -677,6 +701,56 @@ class CompiledPredictor:
                 self._hits += 1
                 compileledger.record_hit(self._ledger_cache)
         return entry
+
+    def warm_from_disk(self) -> int:
+        """Admission warming (ISSUE 20): install every AOT artifact in
+        this predictor's cache directory whose program-cache key, when
+        re-derived against THIS predictor's plan, still digests to the
+        artifact's plan digest — the bucket x dtype grid of a previous
+        process loads before the first request instead of compiling on
+        it.  Foreign or drifted artifacts are skipped (a fingerprint
+        mismatch refuses loudly inside :func:`aotcache.load`); returns
+        how many programs were installed."""
+        if not aotcache.active():
+            return 0
+        import ast
+        n = 0
+        for _path, header in aotcache.scan(self._ledger_cache):
+            try:
+                key = ast.literal_eval(header.get("key_repr") or "")
+            except Exception:
+                continue
+            if not isinstance(key, tuple) or len(key) != 7:
+                continue
+            sig, kind, bucket, trailing, buckets, lanes, mesh_fp = key
+            if lanes is not None or mesh_fp is not None:
+                continue          # fleet-lane / sharded: not this cache
+            if tuple(buckets) != self._buckets:
+                continue
+            evplan = serving_event_plan(
+                self.plan, signature=sig, sharded=False, kind=kind,
+                bucket=bucket, trailing=tuple(trailing))
+            if evplan.digest() != header.get("plan_digest"):
+                continue          # geometry drifted: a plain miss
+            # install under the key _program would derive TODAY (the
+            # artifact's stored repr is advisory, the derivation is
+            # authoritative)
+            key = self.plan.program_key(kind, bucket, tuple(trailing),
+                                        signature=sig, sharded=False)
+            with self._cache_lock:
+                if key in self._programs:
+                    continue
+            loaded = aotcache.load(
+                evplan, cache=self._ledger_cache,
+                site="CompiledPredictor.warm_from_disk",
+                subsystem="serving")
+            if loaded is None:
+                continue
+            with self._cache_lock:
+                if key not in self._programs:
+                    self._programs[key] = (loaded.fn, ())
+                    n += 1
+        return n
 
     def cache_stats(self) -> Dict[str, int]:
         self.flush_metrics()

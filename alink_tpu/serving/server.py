@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import threading
 import time
+import warnings
 from collections import deque
 from typing import Callable, List, Optional, Tuple
 
@@ -181,6 +182,17 @@ class PredictServer:
         # mesh device; a SHARDED predictor already spans every chip
         # with one program, so it always runs one loop.
         self.replicas = self._resolve_replicas(replicas)
+        # admission warming (ISSUE 20): pre-install the predictor's
+        # exported bucket x dtype grid from the AOT cache BEFORE the
+        # admin readiness source is armed below — /readyz never flips
+        # while first requests would still pay a cold compile the disk
+        # already holds. No cache dir configured = exactly no work.
+        self.warmed_programs = 0
+        try:
+            self.warmed_programs = predictor.warm_from_disk()
+        except Exception as e:
+            warnings.warn(f"serve:{name}: AOT admission warming failed "
+                          f"({e!r}); serving opens cold", RuntimeWarning)
         self._threads = []
         for i in range(self.replicas):
             th = threading.Thread(
@@ -569,6 +581,7 @@ class PredictServer:
             "queue_depth": self._ch.depth(),
             "shed": shed, "fallback_batches": fb,
             "loop_respawns": respawns, "quarantined": quarantined,
+            "warmed_programs": self.warmed_programs,
             "breaker": self.breaker_stats(),
         }
 

@@ -3247,6 +3247,66 @@ def quick_tuning_sweep(h: Harness):
     return _tuning_sweep_row(h, 4000, 32, 100, 24, rung=5, eta=5, reps=2)
 
 
+def quick_cold_start(h: Harness):
+    """Restart-to-first-response, cold vs AOT-warmed (ISSUE 20).
+
+    Two fresh CPU-mesh child interpreters (the coldstart_smoke fixture)
+    share one artifact directory: the first pays the full trace+XLA
+    compile on its first request and exports every program; the second
+    restarts against the warmed store and deserializes instead.  The
+    row reports both first-response walls, the restart speedup, and the
+    ledger's per-subsystem time-to-first-program — the measured
+    evidence for the 'kill the cold start' claim.  Children force a
+    CPU mesh so the row never contends with the parent harness for the
+    accelerator; the speedup is conservative on a real TPU, where the
+    avoided compile is far larger."""
+    import subprocess
+    import sys
+    import tempfile
+
+    import bootenv
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    script = os.path.join(root, "tools", "coldstart_smoke.py")
+    cache_dir = tempfile.mkdtemp(prefix="alink-bench-aot-")
+    run_dir = tempfile.mkdtemp(prefix="alink-bench-aot-run-")
+    res = {}
+    for role in ("cold", "warm"):
+        env = bootenv.cpu_mesh_env(4)
+        env["ALINK_COLDSTART_SMOKE_CHILD"] = "1"
+        env["ALINK_TPU_AOT_CACHE_DIR"] = cache_dir
+        env.pop("ALINK_TPU_AOT_CACHE", None)
+        env["ALINK_COLDSTART_SMOKE_DIR"] = run_dir
+        env["ALINK_COLDSTART_SMOKE_OUT"] = os.path.join(
+            run_dir, f"{role}.json")
+        subprocess.run([sys.executable, script], cwd=root, env=env,
+                       check=True, timeout=900)
+        with open(env["ALINK_COLDSTART_SMOKE_OUT"]) as fh:
+            res[role] = json.load(fh)
+    cold, warm = res["cold"], res["warm"]
+    return {
+        "cold_first_response_s": round(cold["first_response_s"], 4),
+        "warm_first_response_s": round(warm["first_response_s"], 4),
+        "restart_speedup": round(cold["first_response_s"]
+                                 / max(warm["first_response_s"], 1e-9),
+                                 2),
+        "cold_startup_to_response_s": round(
+            cold["startup_to_response_s"], 3),
+        "warm_startup_to_response_s": round(
+            warm["startup_to_response_s"], 3),
+        "warm_serve_misses": warm["serve_misses"],
+        "warm_disk_hits": warm["serve_disk_hits"],
+        "warm_admission_warmed": warm["warmed_programs"],
+        "ttfp_cold_s": {k: round(float(v), 3)
+                        for k, v in sorted(cold["ttfp"].items())},
+        "ttfp_warm_s": {k: round(float(v), 3)
+                        for k, v in sorted(warm["ttfp"].items())},
+        "parity": ("bitwise" if warm["digest"] == cold["digest"]
+                   else "MISMATCH"),
+        "bound": "compile-plane",
+    }
+
+
 QUICK_WORKLOADS = (("logreg_criteo", quick_logreg),
                    ("logreg_ckpt", quick_logreg_ckpt),
                    ("kmeans_iris", quick_kmeans),
@@ -3262,7 +3322,8 @@ QUICK_WORKLOADS = (("logreg_criteo", quick_logreg),
                    ("serve_logreg_sharded", quick_serve_sharded),
                    ("serve_chaos", quick_serve_chaos),
                    ("serve_fleet", quick_serve_fleet),
-                   ("serve_online_e2e", quick_serve_online_e2e))
+                   ("serve_online_e2e", quick_serve_online_e2e),
+                   ("cold_start", quick_cold_start))
 
 
 # ---------------------------------------------------------------------------
@@ -3463,6 +3524,13 @@ def main(argv=None):
             ftrl["batch_mode_samples_per_sec_per_chip"],
             ftrl.get("batch_mode_vs_baseline", 0.0),
             ftrl.get("batch_mode_pct_chip_peak_flops", 0.0)]
+    cs = workloads.get("cold_start", {})
+    if cs.get("warm_first_response_s"):
+        # warm restart-to-first-response as a RATE (1/s) so
+        # bench_compare --threshold gates a persistent-cache regression
+        # (slower warm restart) exactly like a throughput drop
+        compact["cold_start_warm1stinv"] = [
+            round(1.0 / cs["warm_first_response_s"], 3), 0.0, 0.0]
     serve = workloads.get("serve_logreg", {})
     if serve.get("p99_ms"):
         # p99 as a RATE (1/p99) so bench_compare --threshold gates p99

@@ -1,0 +1,344 @@
+"""Persistent AOT executable store (common/aotcache.py, ISSUE 20).
+
+The contract under test: a disk artifact loads ONLY when its plan
+digest and rig fingerprint both match exactly — anything stale,
+foreign or corrupt is refused loudly (never deserialized wrong, never
+a crash) and the caller falls through to a fresh compile; loaded
+programs are bitwise-identical to freshly compiled ones; retention is
+bounded; the ledger records deserializes as ``disk-hit`` events
+distinct from compiles.
+"""
+
+import json
+import os
+import struct
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from alink_tpu.common import aotcache, compileledger
+from alink_tpu.common.plan import ExecutionPlan
+
+
+@pytest.fixture
+def store_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("ALINK_TPU_AOT_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("ALINK_TPU_AOT_CACHE", raising=False)
+    monkeypatch.delenv("ALINK_TPU_AOT_CACHE_KEEP", raising=False)
+    aotcache.reset()
+    compileledger.reset()
+    yield str(tmp_path)
+    aotcache.reset()
+    compileledger.reset()
+
+
+def _plan(**dims):
+    base = {"kind": "unit", "n": 3}
+    base.update(dims)
+    return ExecutionPlan("test", tuple(sorted(base.items())))
+
+
+def _fn():
+    return jax.jit(lambda x: jnp.sin(x) * 2.0 + jnp.cumsum(x))
+
+
+X = np.linspace(-2.0, 3.0, 17, dtype=np.float32)
+
+
+def _mutate(path, fix):
+    """Parse blob -> (header dict, payload), apply ``fix(header,
+    payload) -> (header, payload)``, rewrite the artifact in place."""
+    blob = open(path, "rb").read()
+    assert blob[:8] == aotcache.MAGIC
+    (hlen,) = struct.unpack(">I", blob[8:12])
+    header = json.loads(blob[12:12 + hlen].decode())
+    payload = blob[12 + hlen:]
+    header, payload = fix(header, payload)
+    hdr = json.dumps(header).encode()
+    with open(path, "wb") as fh:
+        fh.write(aotcache.MAGIC + struct.pack(">I", len(hdr)) + hdr
+                 + payload)
+
+
+# ---------------------------------------------------------------------------
+# round trip + ledger
+# ---------------------------------------------------------------------------
+
+def test_inactive_without_dir(monkeypatch):
+    monkeypatch.delenv("ALINK_TPU_AOT_CACHE_DIR", raising=False)
+    assert not aotcache.active()
+    assert aotcache.load(_plan(), cache="t") is None
+    assert not aotcache.store(_plan(), _fn(), (X,), cache="t")
+
+
+def test_flag_kills_store(store_dir, monkeypatch):
+    monkeypatch.setenv("ALINK_TPU_AOT_CACHE", "0")
+    assert not aotcache.active()
+
+
+def test_roundtrip_bitwise_and_disk_hit_event(store_dir):
+    plan = _plan()
+    fresh = _fn()
+    want = np.asarray(fresh(X))
+    assert aotcache.store(plan, fresh, (X,), cache="t", site="unit")
+    loaded = aotcache.load(plan, cache="t", site="unit",
+                           subsystem="unit")
+    assert loaded is not None
+    got = np.asarray(loaded.fn(X))
+    assert got.tobytes() == want.tobytes()
+    assert loaded.wall_s >= 0.0
+    assert loaded.header["plan_digest"] == plan.digest()
+    doc = compileledger.compilez_doc()
+    evs = [e for e in doc["events"] if e["cache"] == "t"]
+    assert [e.get("kind") for e in evs] == ["disk-hit"]
+    assert doc["caches"]["t"]["disk_hits"] == 1
+    assert doc["caches"]["t"]["misses"] == 0
+
+
+def test_different_plan_is_a_silent_miss(store_dir):
+    aotcache.store(_plan(), _fn(), (X,), cache="t")
+    assert aotcache.load(_plan(n=4), cache="t") is None
+    assert aotcache.stats()["refusals"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the refusal matrix: stale/foreign/corrupt artifacts never deserialize
+# ---------------------------------------------------------------------------
+
+def _stored_path(plan):
+    p = aotcache.artifact_path("t", plan.digest())
+    assert os.path.exists(p)
+    return p
+
+
+def test_refuses_plan_digest_mismatch(store_dir):
+    plan_a, plan_b = _plan(), _plan(n=99)
+    aotcache.store(plan_a, _fn(), (X,), cache="t")
+    # a stale artifact squatting on plan_b's path (e.g. a buggy sync)
+    os.replace(_stored_path(plan_a),
+               aotcache.artifact_path("t", plan_b.digest()))
+    with pytest.warns(RuntimeWarning, match="plan-digest-mismatch"):
+        assert aotcache.load(plan_b, cache="t") is None
+    assert aotcache.stats()["refusals"] == 1
+    # refusal falls through to a fresh compile that still works
+    assert np.allclose(np.asarray(_fn()(X)), np.asarray(_fn()(X)))
+
+
+def test_refuses_jaxlib_version_mismatch(store_dir):
+    plan = _plan()
+    aotcache.store(plan, _fn(), (X,), cache="t")
+
+    def bump(header, payload):
+        header["fingerprint"]["jaxlib"] = "0.0.1-other-rig"
+        return header, payload
+
+    _mutate(_stored_path(plan), bump)
+    with pytest.warns(RuntimeWarning, match="fingerprint-mismatch.*jaxlib"):
+        assert aotcache.load(plan, cache="t") is None
+    assert aotcache.stats()["refusals"] == 1
+
+
+def test_refuses_device_count_mismatch(store_dir):
+    plan = _plan()
+    aotcache.store(plan, _fn(), (X,), cache="t")
+
+    def bump(header, payload):
+        header["fingerprint"]["device_count"] = 8192
+        return header, payload
+
+    _mutate(_stored_path(plan), bump)
+    with pytest.warns(RuntimeWarning,
+                      match="fingerprint-mismatch.*device_count"):
+        assert aotcache.load(plan, cache="t") is None
+
+
+def test_refuses_truncated_payload(store_dir):
+    plan = _plan()
+    aotcache.store(plan, _fn(), (X,), cache="t")
+    path = _stored_path(plan)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as fh:
+        fh.write(blob[:-16])
+    with pytest.warns(RuntimeWarning, match="payload-corrupt"):
+        assert aotcache.load(plan, cache="t") is None
+
+
+def test_refuses_flipped_payload_byte(store_dir):
+    plan = _plan()
+    aotcache.store(plan, _fn(), (X,), cache="t")
+
+    def flip(header, payload):
+        mid = len(payload) // 2
+        return header, (payload[:mid]
+                        + bytes([payload[mid] ^ 0xFF])
+                        + payload[mid + 1:])
+
+    _mutate(_stored_path(plan), flip)
+    with pytest.warns(RuntimeWarning, match="payload-corrupt"):
+        assert aotcache.load(plan, cache="t") is None
+
+
+def test_refuses_bad_magic(store_dir):
+    plan = _plan()
+    aotcache.store(plan, _fn(), (X,), cache="t")
+    path = _stored_path(plan)
+    blob = open(path, "rb").read()
+    with open(path, "wb") as fh:
+        fh.write(b"NOTANAOT" + blob[8:])
+    with pytest.warns(RuntimeWarning):
+        assert aotcache.load(plan, cache="t") is None
+    assert aotcache.stats()["refusals"] == 1
+
+
+def test_refusal_never_feeds_the_ledger_a_disk_hit(store_dir):
+    plan = _plan()
+    aotcache.store(plan, _fn(), (X,), cache="t")
+    _mutate(_stored_path(plan),
+            lambda h, p: ({**h, "plan_digest": "f" * 32}, p))
+    with pytest.warns(RuntimeWarning):
+        assert aotcache.load(plan, cache="t", subsystem="unit") is None
+    doc = compileledger.compilez_doc()
+    assert all(e.get("kind") != "disk-hit" for e in doc["events"])
+
+
+# ---------------------------------------------------------------------------
+# retention + scan + header
+# ---------------------------------------------------------------------------
+
+def test_retention_prunes_to_keep(store_dir, monkeypatch):
+    monkeypatch.setenv("ALINK_TPU_AOT_CACHE_KEEP", "8")
+    for i in range(11):
+        assert aotcache.store(_plan(n=i), _fn(), (X,), cache="t")
+    files = [p for p, _ in aotcache.scan("t")]
+    assert len(files) == 8
+
+
+def test_keep_floor_is_eight(monkeypatch):
+    monkeypatch.setenv("ALINK_TPU_AOT_CACHE_KEEP", "1")
+    assert aotcache.aot_keep() == 8
+
+
+def test_scan_headers(store_dir):
+    plan = _plan()
+    aotcache.store(plan, _fn(), (X,), cache="t", site="unit",
+                   key=("k", 1))
+    ((path, header),) = aotcache.scan("t")
+    assert header["plan_digest"] == plan.digest()
+    assert header["cache"] == "t"
+    assert header["key_repr"] == repr(("k", 1))
+    assert header["fingerprint"] == aotcache.fingerprint()
+    assert aotcache.scan("missing-cache") == []
+
+
+def test_tmp_files_never_published(store_dir):
+    aotcache.store(_plan(), _fn(), (X,), cache="t")
+    leftovers = [f for f in os.listdir(os.path.join(store_dir, "t"))
+                 if not f.endswith(".aot")]
+    assert leftovers == []
+
+
+# ---------------------------------------------------------------------------
+# lazy factory wrapper (the FTRL path)
+# ---------------------------------------------------------------------------
+
+def test_aot_jit_roundtrip(store_dir):
+    dims = (("factory", "unit"), ("alpha", 0.5))
+    w1 = aotcache.aot_jit(_fn(), subsystem="unit", cache="t",
+                          site="unit", dims=dims)
+    want = np.asarray(w1(X))
+    assert aotcache.stats()["stores"] == 1
+    w2 = aotcache.aot_jit(_fn(), subsystem="unit", cache="t",
+                          site="unit", dims=dims)
+    got = np.asarray(w2(X))
+    assert aotcache.stats()["loads"] == 1
+    assert got.tobytes() == want.tobytes()
+    # second dispatch uses the installed impl, no second load
+    np.asarray(w2(X))
+    assert aotcache.stats()["loads"] == 1
+
+
+def test_aot_jit_inactive_returns_fn(monkeypatch):
+    monkeypatch.delenv("ALINK_TPU_AOT_CACHE_DIR", raising=False)
+    fn = _fn()
+    assert aotcache.aot_jit(fn, subsystem="u", cache="t", site="s",
+                            dims=()) is fn
+
+
+def test_aot_jit_avals_split_the_key(store_dir):
+    dims = (("factory", "unit"),)
+    w1 = aotcache.aot_jit(_fn(), subsystem="unit", cache="t",
+                          site="unit", dims=dims)
+    w1(X)
+    w2 = aotcache.aot_jit(_fn(), subsystem="unit", cache="t",
+                          site="unit", dims=dims)
+    w2(X.astype(np.float64).astype(np.float32)[:5])  # different shape
+    # two artifacts: the input avals joined the plan
+    assert len(aotcache.scan("t")) == 2
+
+
+# ---------------------------------------------------------------------------
+# engine wiring: cache-on vs cache-off bitwise identity
+# ---------------------------------------------------------------------------
+
+def _run_engine(key):
+    from alink_tpu.engine.comqueue import IterativeComQueue
+
+    def stage(ctx):
+        if ctx.is_init_step:
+            ctx.put_obj("acc", jnp.zeros(()))
+        x = ctx.get_obj("x")
+        ctx.put_obj("acc",
+                    ctx.get_obj("acc") + ctx.all_reduce_sum(x.sum()))
+
+    x = np.arange(16, dtype=np.float32) / 7.0
+    q = (IterativeComQueue(max_iter=3)
+         .init_with_partitioned_data("x", x)
+         .add(stage)
+         .set_program_key(key))
+    return np.asarray(q.exec().get("acc"))
+
+
+def test_engine_cache_on_off_bitwise(store_dir, monkeypatch):
+    from alink_tpu.engine.comqueue import clear_program_cache
+
+    clear_program_cache()
+    monkeypatch.delenv("ALINK_TPU_AOT_CACHE_DIR", raising=False)
+    off = _run_engine(("aot_unit", 1))
+
+    monkeypatch.setenv("ALINK_TPU_AOT_CACHE_DIR", store_dir)
+    clear_program_cache()
+    compileledger.reset()
+    stored = _run_engine(("aot_unit", 1))  # compiles + exports
+    assert aotcache.stats()["stores"] >= 1
+    assert stored.tobytes() == off.tobytes()
+
+    clear_program_cache()  # simulate the restart: only the disk remains
+    compileledger.reset()
+    warm = _run_engine(("aot_unit", 1))
+    assert warm.tobytes() == off.tobytes()
+    doc = compileledger.compilez_doc()
+    evs = [e for e in doc["events"] if e["cache"] == "engine.program"]
+    assert [e.get("kind") for e in evs] == ["disk-hit"]
+    assert doc["caches"]["engine.program"]["misses"] == 0
+
+
+def test_engine_stale_artifact_recompiles(store_dir, monkeypatch):
+    from alink_tpu.engine.comqueue import clear_program_cache
+
+    monkeypatch.setenv("ALINK_TPU_AOT_CACHE_DIR", store_dir)
+    clear_program_cache()
+    off = _run_engine(("aot_unit_stale", 1))
+    ((path, _),) = [ph for ph in aotcache.scan("engine.program")]
+    _mutate(path, lambda h, p: ({**h, "plan_digest": "0" * 32}, p))
+    clear_program_cache()
+    compileledger.reset()
+    aotcache.reset()
+    with pytest.warns(RuntimeWarning, match="plan-digest-mismatch"):
+        warm = _run_engine(("aot_unit_stale", 1))
+    assert warm.tobytes() == off.tobytes()
+    doc = compileledger.compilez_doc()
+    evs = [e for e in doc["events"] if e["cache"] == "engine.program"]
+    assert [e.get("kind", "miss") for e in evs] == ["miss"]

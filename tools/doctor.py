@@ -1043,7 +1043,18 @@ def _compile_verdicts(compilez: Optional[List[Tuple[str, Any]]]
         hits = sum(int(c.get("hits") or 0) for c in caches.values())
         evictions = sum(int(c.get("evictions") or 0)
                         for c in caches.values())
+        disk_hits = sum(int(c.get("disk_hits") or 0)
+                        for c in caches.values())
         wall_s = round(sum(e.get("wall_s") or 0.0 for e in events), 3)
+        deser_s = round(sum(e.get("wall_s") or 0.0 for e in events
+                            if e.get("kind") == "disk-hit"), 3)
+        fresh = [e for e in events if e.get("kind") != "disk-hit"]
+        if disk_hits and not fresh:
+            fixes.append(
+                f"warm restart: all {disk_hits} program(s) came from "
+                f"the persistent AOT store ({deser_s}s total "
+                f"deserialize, zero XLA compiles) — the cold start is "
+                f"dead; keep the cache dir on the deploy path")
         for name, c in sorted(caches.items()):
             n_storms = int(c.get("storms") or 0)
             if n_storms or c.get("storm_active"):
@@ -1087,18 +1098,21 @@ def _compile_verdicts(compilez: Optional[List[Tuple[str, Any]]]
                 fixes.append(
                     f"cold-start-dominated restart: subsystem "
                     f"{worst} paid {ttfp[worst]:.1f}s from first "
-                    f"activity to first compiled program — pre-warm "
-                    f"its programs at startup (AOT .lower() the plan's "
-                    f"bucket ladder) before admitting traffic")
+                    f"activity to first compiled program — set "
+                    f"ALINK_TPU_AOT_CACHE_DIR and pre-export the "
+                    f"bucket ladder with tools/warmcache.py so "
+                    f"restarts deserialize instead of recompile")
         out.append({
             "label": label, "enabled": cz.get("enabled"),
             "compiles": compiles, "hits": hits,
             "evictions": evictions, "wall_s": wall_s,
+            "disk_hits": disk_hits, "deserialize_s": deser_s,
             "caches": {n: {"subsystem": c.get("subsystem"),
                            "size": c.get("size"),
                            "capacity": c.get("capacity"),
                            "hits": c.get("hits"),
                            "misses": c.get("misses"),
+                           "disk_hits": c.get("disk_hits"),
                            "hit_rate": c.get("hit_rate"),
                            "storms": c.get("storms")}
                        for n, c in sorted(caches.items())},
@@ -1423,6 +1437,8 @@ def render(doc: Dict[str, Any]) -> str:
                 if total else "n/a")
         out.append(f"  {v.get('compiles')} compiles / "
                    f"{v.get('hits')} hits ({rate} hit rate), "
+                   f"{v.get('disk_hits') or 0} disk hit(s) "
+                   f"({v.get('deserialize_s') or 0.0}s deserialize), "
                    f"{v.get('evictions')} evictions, "
                    f"{v.get('wall_s')}s compile wall, "
                    f"{v.get('storms')} storm(s)")
@@ -1430,18 +1446,20 @@ def render(doc: Dict[str, Any]) -> str:
         if caches:
             w = max(len(n) for n in caches)
             out.append(f"  {'cache'.ljust(w)}  size/cap   hits  misses"
-                       f"  hit-rate  storms")
+                       f"  disk-hits  hit-rate  storms")
             for n, c in caches.items():
                 hr = c.get("hit_rate")
                 out.append(
                     f"  {n.ljust(w)}  "
                     f"{c.get('size')}/{c.get('capacity') or '-':>3}  "
                     f"{c.get('hits'):>6,}  {c.get('misses'):>6,}  "
+                    f"{c.get('disk_hits') or 0:>9,}  "
                     f"{hr:>7.1%}  {c.get('storms'):>6}"
                     if hr is not None else
                     f"  {n.ljust(w)}  "
                     f"{c.get('size')}/{c.get('capacity') or '-':>3}  "
                     f"{c.get('hits'):>6,}  {c.get('misses'):>6,}  "
+                    f"{c.get('disk_hits') or 0:>9,}  "
                     f"{'-':>7}  {c.get('storms'):>6}")
         cold = v.get("cold_start_s") or {}
         if cold:

@@ -180,6 +180,7 @@ _METRIC_ROWS = [
     ("fleet coalesced", "alink_fleet_coalesced_batches_total",
      "sum", "sum"),
     ("compiles", "alink_compile_total", "sum", "sum"),
+    ("compile disk hits", "alink_compile_disk_hits_total", "sum", "sum"),
     ("compile wall (s)", "alink_compile_seconds", "sum", "sum"),
     ("compile storms", "alink_compile_storms_total", "sum", "sum"),
     ("storm active", "alink_compile_storm_active", "max", "max"),

@@ -117,6 +117,16 @@ python tools/postmortem_smoke.py
 # itself.
 python tools/compilez_smoke.py
 
+# cold-start smoke (ISSUE 20): two fresh interpreters share one AOT
+# artifact directory — the first compiles and exports the demo serving
+# grid, the second restarts against it and must answer its first
+# request with ZERO serve-cache compiles (every program a ledger
+# disk-hit), a first response faster than the cold baseline, and
+# bitwise-identical predictions; doctor renders the warm-restart
+# verdict offline from the run-dir compilez.json. Exits 14 (its own
+# code) so a persistent-cache regression names itself.
+python tools/coldstart_smoke.py
+
 # docs freshness gate (ISSUE 15 satellite, VERDICT #2): the README's
 # machine-generated performance/serving tables must match a fresh
 # regeneration from the newest driver-captured BENCH dump, and the

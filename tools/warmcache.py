@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Pre-export the serving program grid into the persistent AOT store
+(ISSUE 20) — off the request path.
+
+A cold serving restart pays one XLA compile per (kind, bucket, dtype)
+program before it can answer its first request.  With
+``ALINK_TPU_AOT_CACHE_DIR`` set, every compile also exports its
+executable to disk, and the NEXT restart deserializes instead of
+compiling (``PredictServer``/``FleetServer`` pre-load the grid before
+``/readyz`` flips).  This CLI runs that first, expensive pass in a
+throwaway process at deploy time, so even the first serving process
+after a binary roll starts warm:
+
+    python tools/warmcache.py --dir /srv/alink/aotcache \\
+        --name lr_demo --dim 16 --buckets 16,64 --dtypes f32,int8
+
+The fixture is the repo's deterministic demo-LR model (the same one
+``tools/compilez_smoke.py`` serves); pass the SAME ``--name``, ``--dim``
+and bucket ladder the server will use — artifacts key on the full
+execution plan plus a rig fingerprint, so a mismatched grid simply
+never loads (refused loudly, never deserialized wrong).  Real rigs
+warming a production model instead run one admission pass of real
+traffic with the cache dir set; this tool covers the demo/bench loop.
+"""
+
+import argparse
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+def _build_fixture(dim: int, rows: int):
+    """The deterministic dense-LR fixture shared with compilez_smoke."""
+    import numpy as np
+
+    from alink_tpu.common.mtable import MTable
+    from alink_tpu.common.params import Params
+    from alink_tpu.common.vector import DenseVector
+    from alink_tpu.operator.batch.classification.linear import (
+        LogisticRegressionTrainBatchOp)
+    from alink_tpu.operator.batch.source.sources import MemSourceBatchOp
+    from alink_tpu.operator.common.linear.mapper import LinearModelMapper
+
+    rng = np.random.RandomState(11)
+    X = rng.randn(rows, dim)
+    y = (X @ rng.randn(dim) > 0).astype(np.int64)
+    vecs = np.empty(rows, object)
+    vecs[:] = [DenseVector(X[i]) for i in range(rows)]
+    tbl = MTable({"vec": vecs, "label": y}, "vec VECTOR, label LONG")
+    warm = LogisticRegressionTrainBatchOp(
+        vector_col="vec", label_col="label", max_iter=2).link_from(
+        MemSourceBatchOp(tbl.first_n(min(32, rows))))
+    model = warm.get_output_table()
+    mapper = LinearModelMapper(model.schema, tbl.select(["vec"]).schema,
+                               Params({"prediction_col": "pred",
+                                       "vector_col": "vec"}))
+    mapper.load_model(model)
+    return mapper, tbl
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="pre-compile + export the serving program grid "
+                    "into the persistent AOT store")
+    ap.add_argument("--dir", required=True,
+                    help="AOT cache directory (ALINK_TPU_AOT_CACHE_DIR)")
+    ap.add_argument("--name", default="warm",
+                    help="predictor name — artifacts land under "
+                         "serve.<name> and only a predictor with the "
+                         "same name warms from them")
+    ap.add_argument("--dim", type=int, default=16,
+                    help="fixture feature dimension")
+    ap.add_argument("--buckets", default="16",
+                    help="comma-separated bucket ladder, e.g. 16,64")
+    ap.add_argument("--dtypes", default="f32",
+                    help="comma-separated ALINK_TPU_SERVE_DTYPE values "
+                         "to warm, e.g. f32,int8")
+    args = ap.parse_args(argv)
+
+    os.environ["ALINK_TPU_AOT_CACHE_DIR"] = os.path.abspath(args.dir)
+    os.environ.setdefault("ALINK_TPU_AOT_CACHE", "1")
+
+    from alink_tpu.common import aotcache, compileledger
+    from alink_tpu.serving import CompiledPredictor
+
+    buckets = tuple(sorted({int(b) for b in args.buckets.split(",")
+                            if b.strip()}))
+    dtypes = [d.strip() for d in args.dtypes.split(",") if d.strip()]
+    if not buckets or not dtypes:
+        ap.error("--buckets and --dtypes must be non-empty")
+    mapper, tbl = _build_fixture(args.dim, rows=max(buckets) * 2)
+
+    warmed = 0
+    for dtype in dtypes:
+        os.environ["ALINK_TPU_SERVE_DTYPE"] = dtype
+        pred = CompiledPredictor(mapper, buckets=buckets, name=args.name)
+        for b in buckets:
+            # one request sized to each rung compiles (or disk-hits)
+            # exactly that rung's program and exports it on miss
+            pred.predict_table(tbl.select(["vec"]).first_n(b))
+            warmed += 1
+    st = aotcache.stats()
+    doc = compileledger.compilez_doc()
+    cache = f"serve.{args.name}"
+    row = (doc.get("caches") or {}).get(cache) or {}
+    print(f"warmcache: {warmed} grid point(s) over buckets={buckets} "
+          f"dtypes={dtypes} -> {st['stores']} artifact(s) exported, "
+          f"{st['loads']} already on disk "
+          f"(cache {cache}: {row.get('misses', 0)} compile(s), "
+          f"{row.get('disk_hits', 0)} disk hit(s)) under "
+          f"{os.environ['ALINK_TPU_AOT_CACHE_DIR']}")
+    if st["export_skipped"]:
+        print(f"warmcache: WARNING — {st['export_skipped']} program(s) "
+              f"could not be exported on this rig (see warnings above); "
+              f"the XLA fallback cache still covers them",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
